@@ -1,0 +1,71 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas/pjit.
+
+Layer map (SURVEY.md §7): ops/ is the PHI analog (pure jax fns + Pallas),
+core/ is the eager engine (Tensor + vjp tape), static/ collapses
+ProgramDesc+CINN+InterpreterCore into traced jaxprs + cached pjit executables,
+distributed/ maps Fleet/HCG onto jax.sharding meshes with XLA collectives.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import ops  # registers the op library  # noqa: F401
+from .core import (  # noqa: F401
+    CPUPlace, CUDAPlace, Parameter, Place, TPUPlace, Tensor, bfloat16, bool_,
+    complex64, complex128, device_count, enable_grad, float16, float32,
+    float64, get_default_dtype, get_device, get_flags, int8, int16, int32,
+    int64, is_compiled_with_tpu, no_grad, seed, set_default_dtype, set_device,
+    set_flags, set_grad_enabled, uint8,
+)
+from .core.rng import get_rng_state, set_rng_state  # noqa: F401
+from . import autograd  # noqa: F401
+from .autograd import grad, is_grad_enabled  # noqa: F401
+
+# Functional tensor API (paddle.add, paddle.matmul, ...) re-exported at top
+# level, as paddle does.
+from .tensor import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401
+    chunk, einsum, masked_select, nonzero, pow, round, slice, strided_slice,
+    topk, trace, unique, unstack,
+)
+from .tensor.creation import (  # noqa: F401
+    arange, assign, empty, empty_like, eye, full, full_like, is_tensor,
+    linspace, logspace, numel, ones, ones_like, to_tensor, zeros, zeros_like,
+)
+from .tensor.random import (  # noqa: F401
+    bernoulli, multinomial, normal, poisson, rand, randint, randint_like,
+    randn, randperm, standard_normal, uniform,
+)
+
+# subpackages — extended as layers land (SURVEY.md §7 build order)
+_SUBPACKAGES = [
+    "nn", "optimizer", "io", "metric", "vision", "amp", "static", "jit",
+    "distributed", "device", "profiler", "incubate", "sparse", "framework",
+    "hapi", "text", "audio", "distribution", "quantization", "utils",
+]
+import importlib as _importlib
+
+for _pkg in _SUBPACKAGES:
+    try:
+        globals()[_pkg] = _importlib.import_module(f".{_pkg}", __name__)
+    except ModuleNotFoundError as _e:
+        if f"paddle_tpu.{_pkg}" not in str(_e):
+            raise  # real error inside an existing subpackage
+del _importlib, _pkg
+
+if "framework" in globals() and hasattr(framework, "save"):  # noqa: F821
+    save = framework.save  # noqa: F821
+    load = framework.load  # noqa: F821
+if "hapi" in globals() and hasattr(hapi, "Model"):  # noqa: F821
+    Model = hapi.Model  # noqa: F821
+    summary = hapi.summary  # noqa: F821
+if "static" in globals() and hasattr(static, "enable_static"):  # noqa: F821
+    enable_static = static.enable_static  # noqa: F821
+    disable_static = static.disable_static  # noqa: F821
+    in_dynamic_mode = static.in_dynamic_mode  # noqa: F821
+if "distributed" in globals():
+    try:
+        DataParallel = distributed.parallel.DataParallel  # noqa: F821
+    except AttributeError:
+        pass

@@ -1,0 +1,161 @@
+"""paddle.autograd analog: backward, grad, PyLayer, no_grad.
+
+Ref: python/paddle/autograd/ (upstream layout, unverified — mount empty).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tape as tape_mod
+from ..core.tape import enable_grad, no_grad, set_grad_enabled  # noqa: F401
+from ..core.tensor import Tensor
+
+
+def is_grad_enabled() -> bool:
+    return tape_mod.grad_enabled()
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tape_mod.backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — returns grads of `outputs` w.r.t. `inputs` without
+    touching .grad. create_graph (higher-order via the tape) is not yet
+    supported; use paddle_tpu.incubate.functional_grad for nested grads."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is not supported yet; "
+            "use jax-level transforms (paddle_tpu.jit) for higher-order AD"
+        )
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    store = {}
+    targets = {id(t) for t in inputs}
+    retain = bool(retain_graph) if retain_graph is not None else False
+    tape_mod.backward(outputs, grad_tensors=grad_outputs,
+                      retain_graph=retain, targets=targets, store=store,
+                      accumulate_leaf=False)
+    results: List[Optional[Tensor]] = []
+    for t in inputs:
+        if id(t) in store:
+            results.append(Tensor(store[id(t)], stop_gradient=True))
+        else:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs received no gradient; pass "
+                    "allow_unused=True to get None instead"
+                )
+            results.append(None)
+    return results
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd function (paddle.autograd.PyLayer analog).
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = x.exp()
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor
+            return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        record = tape_mod.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        out_tensors = [
+            o if isinstance(o, Tensor) else Tensor(o) for o in out_list
+        ]
+        if record:
+            n_out = len(out_tensors)
+
+            def vjp_fn(cts):
+                if n_out == 1 and not isinstance(cts, (tuple, list)):
+                    cts = (cts,)
+                ct_tensors = [Tensor(c, stop_gradient=True) for c in cts]
+                with no_grad():
+                    gin = cls.backward(ctx, *ct_tensors)
+                if not isinstance(gin, (tuple, list)):
+                    gin = (gin,)
+                gin = list(gin)
+                # map returned grads onto tensor inputs
+                out = []
+                gi = 0
+                for t in tensor_inputs:
+                    g = gin[gi] if gi < len(gin) else None
+                    gi += 1
+                    if g is None:
+                        out.append(jnp.zeros(t._data.shape, t._data.dtype))
+                    else:
+                        out.append(g._data if isinstance(g, Tensor)
+                                   else jnp.asarray(g))
+                return tuple(out)
+
+            node = tape_mod.GradNode(
+                vjp_fn if len(out_tensors) > 1 else
+                (lambda ct: vjp_fn((ct,))),
+                tensor_inputs,
+                n_outputs=len(out_tensors),
+                name=cls.__name__,
+                out_avals=[(o._data.shape, o._data.dtype)
+                           for o in out_tensors],
+            )
+            for i, t in enumerate(out_tensors):
+                t._grad_node = node
+                t._out_index = i
+                t.stop_gradient = False
+        return tuple(out_tensors) if multi else out_tensors[0]
+
+
+def set_to_zero_if_none(grads, refs):
+    return [
+        g if g is not None else Tensor(jnp.zeros(r._data.shape, r._data.dtype))
+        for g, r in zip(grads, refs)
+    ]

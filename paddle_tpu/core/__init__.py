@@ -1,0 +1,15 @@
+from . import dtype as dtype_mod  # noqa: F401
+from .dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, convert_dtype, float16, float32,
+    float64, get_default_dtype, int8, int16, int32, int64, set_default_dtype,
+    uint8,
+)
+from .enforce import EnforceNotMet, enforce  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .place import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, device_count, get_device,
+    is_compiled_with_tpu, set_device,
+)
+from .rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from .tape import enable_grad, no_grad, set_grad_enabled  # noqa: F401
+from .tensor import Parameter, Tensor  # noqa: F401

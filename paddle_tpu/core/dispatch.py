@@ -1,0 +1,155 @@
+"""Single op dispatch point: eager (+tape), AMP, static-graph capture.
+
+This is the analog of Paddle's generated dygraph functions + PHI API dispatch
+(ref: paddle/fluid/eager/auto_code_generator + paddle/phi/api/lib, upstream
+layout, unverified — mount empty): every framework op call flows through
+`apply_op`, which
+  1. in static mode, appends an OpDesc to the current Program and returns
+     symbolic tensors (meta via jax.eval_shape);
+  2. under AMP, casts floating inputs per the op's white/black list;
+  3. eagerly executes the pure jax fn — through jax.vjp when any input needs
+     grad, recording a GradNode on the tape.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import tape as tape_mod
+from .flags import get_flag
+from ..ops.registry import OpDef
+
+# hooks installed by the static and amp modules (avoids import cycles)
+_STATIC_HANDLER: List[Optional[Callable]] = [None]
+_IN_STATIC_MODE: List[Callable] = [lambda: False]
+_AMP_HANDLER: List[Optional[Callable]] = [None]
+
+
+def set_static_handler(in_static_mode_fn, handler):
+    _IN_STATIC_MODE[0] = in_static_mode_fn
+    _STATIC_HANDLER[0] = handler
+
+
+def set_amp_handler(handler):
+    _AMP_HANDLER[0] = handler
+
+
+def _tensor_class():
+    from .tensor import Tensor
+
+    return Tensor
+
+
+def _is_float(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(
+        dtype, jnp.complexfloating
+    )
+
+
+def apply_op(opdef: OpDef, *args, **kwargs):
+    """Execute a registered op on Tensor/array/scalar args."""
+    Tensor = _tensor_class()
+
+    if _STATIC_HANDLER[0] is not None and _IN_STATIC_MODE[0]():
+        return _STATIC_HANDLER[0](opdef, args, kwargs)
+
+    # Flatten args; Tensor leaves become traced positions.
+    flat, treedef = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+    tensor_idx = [i for i, leaf in enumerate(flat) if isinstance(leaf, Tensor)]
+    tensors: List[Any] = [flat[i] for i in tensor_idx]
+    datas = [t._data for t in tensors]
+
+    if _AMP_HANDLER[0] is not None:
+        datas = _AMP_HANDLER[0](opdef, datas)
+
+    def rebuild(xs):
+        new_flat = list(flat)
+        for i, x in zip(tensor_idx, xs):
+            new_flat[i] = x
+        return jax.tree_util.tree_unflatten(treedef, new_flat)
+
+    def fn(*xs):
+        return opdef.fn(*rebuild(xs), **kwargs)
+
+    record = (
+        tape_mod.grad_enabled()
+        and any(not t.stop_gradient for t in tensors)
+    )
+
+    if record:
+        out_data, vjp_fn = jax.vjp(fn, *datas)
+    else:
+        out_data = fn(*datas)
+
+    multi = opdef.multi_output or isinstance(out_data, (tuple, list))
+    outs_flat = list(out_data) if multi else [out_data]
+
+    if record:
+        # Only float outputs can carry gradients; if none do, drop the node.
+        any_float_out = any(_is_float(o.dtype) for o in outs_flat)
+        if not any_float_out:
+            record = False
+
+    if get_flag("FLAGS_check_nan_inf"):
+        for o in outs_flat:
+            if _is_float(o.dtype) and bool(jnp.any(~jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"op {opdef.name!r} produced nan/inf output"
+                )
+
+    out_tensors = [Tensor(o, stop_gradient=not record) for o in outs_flat]
+
+    if record:
+        node = tape_mod.GradNode(
+            vjp_fn,
+            tensors,
+            n_outputs=len(outs_flat),
+            name=opdef.name,
+            out_avals=[(o.shape, o.dtype) for o in outs_flat],
+        )
+        for i, t in enumerate(out_tensors):
+            t._grad_node = node
+            t._out_index = i
+
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]
+
+
+def apply_callable(name: str, fn: Callable, *tensors):
+    """Ad-hoc closure op (e.g. __getitem__): tensors are the only traced args;
+    everything else is baked into `fn`."""
+    Tensor = _tensor_class()
+    if _STATIC_HANDLER[0] is not None and _IN_STATIC_MODE[0]():
+        opdef = OpDef(name, fn)
+        return _STATIC_HANDLER[0](opdef, tensors, {})
+    datas = [t._data for t in tensors]
+    record = tape_mod.grad_enabled() and any(
+        not t.stop_gradient for t in tensors
+    )
+    if record:
+        out_data, vjp_fn = jax.vjp(fn, *datas)
+    else:
+        out_data = fn(*datas)
+    multi = isinstance(out_data, (tuple, list))
+    outs_flat = list(out_data) if multi else [out_data]
+    if record and not any(_is_float(o.dtype) for o in outs_flat):
+        record = False
+    out_tensors = [Tensor(o, stop_gradient=not record) for o in outs_flat]
+    if record:
+        node = tape_mod.GradNode(
+            vjp_fn,
+            list(tensors),
+            n_outputs=len(outs_flat),
+            name=name,
+            out_avals=[(o.shape, o.dtype) for o in outs_flat],
+        )
+        for i, t in enumerate(out_tensors):
+            t._grad_node = node
+            t._out_index = i
+    return tuple(out_tensors) if multi else out_tensors[0]

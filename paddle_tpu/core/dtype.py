@@ -1,0 +1,91 @@
+"""Dtype system.
+
+Paddle-shaped dtype surface (ref: paddle/phi/common/data_type.h, upstream
+layout, unverified — mount empty) implemented directly over numpy/jax dtypes.
+TPU-first: bfloat16 is a first-class citizen; float64 is supported on CPU for
+tests but discouraged on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects are numpy dtypes (jax uses the same), exposed with
+# paddle-style names.
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = jnp.bfloat16.dtype  # ml_dtypes-backed numpy dtype
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+
+_NAME2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    # paddle legacy aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_DEFAULT_DTYPE = [float32]
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp dtype, Tensor.dtype) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME2DTYPE[dtype]
+        except KeyError:
+            return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise ValueError(f"default dtype must be floating, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating_point(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (float16, bfloat16, float32, float64)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.integer) or d == bool_
+
+
+def is_complex(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.complexfloating)

@@ -1,0 +1,44 @@
+"""Error-checking layer (ref: paddle/common/enforce.h, upstream layout,
+unverified — mount empty).
+
+`enforce(cond, msg)` raises EnforceNotMet with a captured python stack, mirroring
+PADDLE_ENFORCE's stacktraced errors. Kept lightweight: on the TPU hot path all
+invariants should be checked at trace time, never per-step.
+"""
+from __future__ import annotations
+
+import traceback
+
+
+class EnforceNotMet(RuntimeError):
+    """Invariant violation — paddle's PADDLE_ENFORCE analog."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+def enforce(cond, msg: str = "enforce failed", exc=EnforceNotMet):
+    if not cond:
+        stack = "".join(traceback.format_stack()[:-1][-6:])
+        raise exc(f"{msg}\n----- python call stack -----\n{stack}")
+
+
+def enforce_eq(a, b, msg: str = ""):
+    enforce(a == b, f"expected {a!r} == {b!r}. {msg}", InvalidArgumentError)
+
+
+def enforce_shape_match(shape_a, shape_b, msg: str = ""):
+    enforce(
+        tuple(shape_a) == tuple(shape_b),
+        f"shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)}. {msg}",
+        InvalidArgumentError,
+    )

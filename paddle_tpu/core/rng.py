@@ -1,0 +1,145 @@
+"""RNG state management.
+
+Paddle has a global generator (`paddle.seed`) plus Fleet's RNGStatesTracker for
+parallel-consistent dropout (ref: fleet/meta_parallel/parallel_layers/random.py,
+upstream layout, unverified — mount empty).
+
+TPU-native design: threefry counter keys. Two modes:
+  * eager: a global mutable key, split on every draw;
+  * traced (inside jit): a `rng_guard(key)` context supplies a base key that is
+    split deterministically per draw, so the same program always consumes keys
+    functionally — no hidden state inside compiled code.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Mutable RNG stream over a threefry key."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        # trace-mode stack: (base_key, counter_list)
+        self._trace_stack = []
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        if self._trace_stack:
+            base, counter = self._trace_stack[-1]
+            counter[0] += 1
+            return jax.random.fold_in(base, counter[0])
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state):
+        self._key = jax.random.wrap_key_data(np.asarray(state, dtype=np.uint32))
+
+    @contextlib.contextmanager
+    def trace_mode(self, base_key):
+        """Within jit tracing: draw keys functionally from `base_key`."""
+        self._trace_stack.append((base_key, [0]))
+        try:
+            yield
+        finally:
+            self._trace_stack.pop()
+
+
+_DEFAULT_GENERATOR = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _DEFAULT_GENERATOR
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed"""
+    _DEFAULT_GENERATOR.manual_seed(s)
+    return _DEFAULT_GENERATOR
+
+
+def next_key():
+    return _DEFAULT_GENERATOR.next_key()
+
+
+@contextlib.contextmanager
+def rng_guard(base_key):
+    """Supply the base key for a traced region (used by jitted train steps)."""
+    with _DEFAULT_GENERATOR.trace_mode(base_key):
+        yield
+
+
+def get_rng_state():
+    return _DEFAULT_GENERATOR.get_state()
+
+
+def set_rng_state(state):
+    _DEFAULT_GENERATOR.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG streams — Fleet's tracker for TP-consistent dropout.
+
+    Model-parallel regions register a stream whose seed is offset by the mp
+    rank so dropout masks differ across tensor-parallel shards while the
+    default stream stays identical (Megatron semantics).
+    """
+
+    def __init__(self):
+        self._states = {}
+
+    def reset(self):
+        self._states.clear()
+
+    def add(self, name: str, seed_: int):
+        if name in self._states:
+            raise ValueError(f"rng state {name!r} already added")
+        self._states[name] = Generator(seed_)
+
+    def get_generator(self, name: str) -> Generator:
+        if name not in self._states:
+            raise KeyError(f"rng state {name!r} not found")
+        return self._states[name]
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        """Temporarily make the named stream the default generator."""
+        global _DEFAULT_GENERATOR
+        if name not in self._states:
+            raise KeyError(f"rng state {name!r} not found; call add() first")
+        prev = _DEFAULT_GENERATOR
+        _DEFAULT_GENERATOR = self._states[name]
+        try:
+            yield
+        finally:
+            _DEFAULT_GENERATOR = prev
+
+
+_MODEL_PARALLEL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _MODEL_PARALLEL_TRACKER
+
+
+def model_parallel_random_seed(seed_: int, mp_rank: int = 0):
+    """Fleet parity: distinct 'local_seed' per mp rank, shared 'global_seed'."""
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", seed_)
+    tracker.add("local_seed", seed_ + 1024 + mp_rank)

@@ -1,0 +1,221 @@
+"""Imperative autograd: a tape of vjp closures.
+
+Paddle's eager engine records one GradNode per traced op and runs a
+reverse-topological backward (ref: paddle/fluid/eager/backward.cc, upstream
+layout, unverified — mount empty). Here each eager op that touches a
+grad-requiring tensor is executed through `jax.vjp`, and the returned vjp
+closure (holding XLA-resident residuals) becomes the GradNode. `backward()`
+walks producers in reverse topological order, accumulating cotangents.
+
+Hot-path note: this tape exists for dygraph parity and debugging; performance
+work happens in jitted step functions (hapi/jit/distributed), where autodiff is
+jax.grad over the functional model and no tape is involved.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+
+class GradNode:
+    """One recorded op: vjp closure + graph edges."""
+
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_grads", "out_avals",
+                 "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, n_outputs: int, name: str = "",
+                 out_avals=None):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs              # list[Tensor] — differentiable positions
+        self.n_outputs = n_outputs
+        self.out_grads: Optional[list] = None  # cotangent accumulation slots
+        self.out_avals = out_avals        # (shape, dtype) per output, for zero-fill
+        self.name = name
+
+    def ready(self) -> bool:
+        return self.out_grads is not None and all(
+            g is not None for g in self.out_grads
+        )
+
+
+class _TapeState:
+    enabled = True
+    # nesting depth of no_grad contexts
+    _guard_depth = 0
+
+
+_STATE = _TapeState()
+
+
+def grad_enabled() -> bool:
+    return _STATE.enabled
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _STATE.enabled
+        _STATE.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _STATE.enabled
+        _STATE.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self._prev
+        return False
+
+
+def set_grad_enabled(mode: bool):
+    class _Guard:
+        def __enter__(self_g):
+            self_g._prev = _STATE.enabled
+            _STATE.enabled = bool(mode)
+            return self_g
+
+        def __exit__(self_g, *exc):
+            _STATE.enabled = self_g._prev
+            return False
+
+    return _Guard()
+
+
+def _toposort(root_nodes) -> List[GradNode]:
+    """Reverse-topological order (consumers before producers) over the
+    subgraph reachable from `root_nodes` via node.inputs[*].grad node edges."""
+    visited = set()
+    order: List[GradNode] = []
+
+    # iterative DFS postorder
+    for root in root_nodes:
+        if id(root) in visited:
+            continue
+        stack = [(root, iter(root.inputs))]
+        visited.add(id(root))
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for t in it:
+                prod = t._grad_node
+                if prod is not None and id(prod) not in visited:
+                    visited.add(id(prod))
+                    stack.append((prod, iter(prod.inputs)))
+                    advanced = True
+                    break
+            if not advanced:
+                order.append(node)
+                stack.pop()
+    order.reverse()  # consumers first
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             targets=None, store=None, accumulate_leaf: bool = True):
+    """Run the backward engine from `tensors` (paddle.autograd.backward).
+
+    `targets`/`store` support paddle.grad(): cotangents deposited for tensors
+    whose id is in `targets` are also accumulated into `store[id]`.
+    """
+    from .tensor import Tensor
+
+    def _collect(t, g):
+        if targets is not None and id(t) in targets:
+            store[id(t)] = g if id(t) not in store else store[id(t)] + g
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # Seed cotangents.
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        node = t._grad_node
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires grad_tensors"
+                )
+            g_data = jnp.ones_like(t._data)
+        else:
+            g_data = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if node is None:
+            # leaf: accumulate directly
+            _collect(t, g_data)
+            if accumulate_leaf and not t.stop_gradient:
+                t._accumulate_grad(g_data)
+            continue
+        _collect(t, g_data)
+        if node.out_grads is None:
+            node.out_grads = [None] * node.n_outputs
+        idx = t._out_index
+        node.out_grads[idx] = (
+            g_data if node.out_grads[idx] is None else node.out_grads[idx] + g_data
+        )
+        roots.append(node)
+
+    if not roots:
+        return
+
+    order = _toposort(roots)
+
+    with no_grad():
+        for node in order:
+            if node.out_grads is None:
+                continue  # not reached by any cotangent
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"backward through {node.name!r} a second time: the graph "
+                    "was freed — pass retain_graph=True to the first backward"
+                )
+            # vjp requires cotangents for all outputs; fill unreached with zeros
+            if node.n_outputs == 1:
+                in_grads = node.vjp_fn(node.out_grads[0])
+            else:
+                cts = tuple(
+                    c if c is not None
+                    else jnp.zeros(av[0], av[1])
+                    for c, av in zip(node.out_grads, node.out_avals)
+                )
+                in_grads = node.vjp_fn(cts)
+            for t, g in zip(node.inputs, in_grads):
+                if g is None:
+                    continue
+                _collect(t, g)
+                prod = t._grad_node
+                if prod is None:
+                    if accumulate_leaf and not t.stop_gradient:
+                        t._accumulate_grad(g)
+                else:
+                    if prod.out_grads is None:
+                        prod.out_grads = [None] * prod.n_outputs
+                    i = t._out_index
+                    prod.out_grads[i] = (
+                        g if prod.out_grads[i] is None else prod.out_grads[i] + g
+                    )
+            if not retain_graph:
+                node.vjp_fn = None
+                node.inputs = ()
+            node.out_grads = None

@@ -1,0 +1,627 @@
+"""The user-facing Tensor: a paddle-shaped mutable handle over a jax.Array.
+
+Paddle's Tensor mutates in place and carries autograd state (ref:
+paddle/fluid/pybind/eager_method.cc, upstream layout, unverified — mount
+empty). jax arrays are immutable, so mutation is modeled as rebinding
+`_data` (and, for differentiable in-place ops, rebinding the grad-node edge so
+later reads see the new value in the autograd graph).
+
+The wrapper is deliberately thin: every op goes through core.dispatch.apply_op
+so eager/tape/AMP/static-capture all share one path, and jitted step functions
+bypass the wrapper entirely by tracing the same registered pure functions.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import tape as tape_mod
+from .dispatch import apply_op, apply_callable
+from .dtype import convert_dtype, get_default_dtype
+from .place import Place, _get_current_place
+from ..ops.registry import get_op
+
+
+def _unwrap_index(item):
+    """Convert Tensors inside an index expression to raw arrays."""
+    if isinstance(item, Tensor):
+        return item._data
+    if isinstance(item, tuple):
+        return tuple(_unwrap_index(i) for i in item)
+    if isinstance(item, list):
+        return [_unwrap_index(i) for i in item]
+    return item
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_grad_node", "_out_index",
+                 "name", "persistable", "_hooks", "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True,
+                 name: str = ""):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            dt = convert_dtype(dtype)
+            if dt is None and isinstance(data, (float,)):
+                dt = get_default_dtype()
+            if dt is None and isinstance(data, np.ndarray) and \
+                    data.dtype == np.float64:
+                dt = get_default_dtype()
+            data = jnp.asarray(data, dtype=dt)
+        elif dtype is not None:
+            data = data.astype(convert_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self._hooks = None
+
+    # ------------------------------------------------------------ properties
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def dim(self):
+        return self._data.ndim
+
+    def rank(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def element_size(self) -> int:
+        return self.dtype.itemsize
+
+    @property
+    def place(self) -> Place:
+        return _get_current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        return apply_op(get_op("transpose"), self,
+                        perm=list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        return apply_op(get_op("t"), self)
+
+    # ------------------------------------------------------------ conversion
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def astype(self, dtype):
+        return apply_op(get_op("cast"), self, dtype=dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu") or \
+                    isinstance(a, Place):
+                from .place import set_device  # resolve kind
+
+                place = a if isinstance(a, Place) else None
+                if place is None:
+                    from .place import CPUPlace, TPUPlace
+
+                    place = CPUPlace(0) if a == "cpu" else TPUPlace(0)
+                out = Tensor(jax.device_put(out._data, place.jax_device()),
+                             stop_gradient=out.stop_gradient)
+            else:
+                out = out.astype(a)
+        return out
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def cuda(self, device_id=0):
+        return self.to("tpu")
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        tape_mod.backward([self], None if grad_tensor is None else [grad_tensor],
+                          retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_grad(self):
+        self.grad = None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return apply_op(get_op("clone"), self)
+
+    def register_hook(self, hook):
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        class _Removable:
+            def __init__(s, hooks, h):
+                s._hooks, s._h = hooks, h
+
+            def remove(s):
+                if s._h in s._hooks:
+                    s._hooks.remove(s._h)
+
+        return _Removable(self._hooks, hook)
+
+    def _accumulate_grad(self, g_data):
+        g_data = g_data.astype(self._data.dtype) if \
+            g_data.dtype != self._data.dtype else g_data
+        if self._hooks:
+            gt = Tensor(g_data, stop_gradient=True)
+            for h in self._hooks:
+                r = h(gt)
+                if r is not None:
+                    gt = r if isinstance(r, Tensor) else Tensor(r)
+            g_data = gt._data
+        if self.grad is None:
+            self.grad = Tensor(g_data, stop_gradient=True)
+        else:
+            self.grad._data = self.grad._data + g_data
+
+    def _snapshot(self) -> "Tensor":
+        """Alias preserving the current value + autograd edge — recorded as
+        the *input* of an in-place op so the pre-mutation graph stays
+        reachable (jax arrays are immutable, so the data is safe to share)."""
+        t = Tensor(self._data, stop_gradient=self.stop_gradient)
+        t._grad_node = self._grad_node
+        t._out_index = self._out_index
+        t.name = self.name
+        return t
+
+    def _inplace_from(self, out: "Tensor"):
+        """Adopt `out`'s value and autograd edge (in-place op semantics)."""
+        self._data = out._data
+        self._grad_node = out._grad_node
+        self._out_index = out._out_index
+        self.stop_gradient = out.stop_gradient
+        return self
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, item):
+        raw = _unwrap_index(item)
+
+        def fn(x):
+            return x[raw]
+
+        return apply_callable("getitem", fn, self)
+
+    def __setitem__(self, item, value):
+        raw = _unwrap_index(item)
+        snap = self._snapshot()
+        if isinstance(value, Tensor):
+            def fn(x, v):
+                return x.at[raw].set(v.astype(x.dtype))
+
+            out = apply_callable("setitem", fn, snap, value)
+        else:
+            val = jnp.asarray(value)
+
+            def fn(x):
+                return x.at[raw].set(val.astype(x.dtype))
+
+            out = apply_callable("setitem", fn, snap)
+        self._inplace_from(out)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # --------------------------------------------------------------- dunders
+    def _binary(self, opname, other, reverse=False):
+        if isinstance(other, np.ndarray):
+            other = Tensor(other)
+        a, b = (other, self) if reverse else (self, other)
+        return apply_op(get_op(opname), a, b)
+
+    def __add__(self, o):
+        return self._binary("add", o)
+
+    def __radd__(self, o):
+        return self._binary("add", o, True)
+
+    def __sub__(self, o):
+        return self._binary("subtract", o)
+
+    def __rsub__(self, o):
+        return self._binary("subtract", o, True)
+
+    def __mul__(self, o):
+        return self._binary("multiply", o)
+
+    def __rmul__(self, o):
+        return self._binary("multiply", o, True)
+
+    def __truediv__(self, o):
+        return self._binary("divide", o)
+
+    def __rtruediv__(self, o):
+        return self._binary("divide", o, True)
+
+    def __floordiv__(self, o):
+        return self._binary("floor_divide", o)
+
+    def __rfloordiv__(self, o):
+        return self._binary("floor_divide", o, True)
+
+    def __mod__(self, o):
+        return self._binary("mod", o)
+
+    def __rmod__(self, o):
+        return self._binary("mod", o, True)
+
+    def __pow__(self, o):
+        if isinstance(o, (int, float)):
+            return apply_op(get_op("pow_scalar"), self, value=o)
+        return self._binary("elementwise_pow", o)
+
+    def __rpow__(self, o):
+        if isinstance(o, (int, float)):
+            return apply_op(get_op("rpow_scalar"), self, value=o)
+        return self._binary("elementwise_pow", o, True)
+
+    def __matmul__(self, o):
+        return self._binary("matmul", o)
+
+    def __rmatmul__(self, o):
+        return self._binary("matmul", o, True)
+
+    def __neg__(self):
+        return apply_op(get_op("neg"), self)
+
+    def __abs__(self):
+        return apply_op(get_op("abs"), self)
+
+    def __invert__(self):
+        op = "logical_not" if self.dtype == np.bool_ else "bitwise_not"
+        return apply_op(get_op(op), self)
+
+    def __and__(self, o):
+        op = "logical_and" if self.dtype == np.bool_ else "bitwise_and"
+        return self._binary(op, o)
+
+    def __or__(self, o):
+        op = "logical_or" if self.dtype == np.bool_ else "bitwise_or"
+        return self._binary(op, o)
+
+    def __xor__(self, o):
+        op = "logical_xor" if self.dtype == np.bool_ else "bitwise_xor"
+        return self._binary(op, o)
+
+    def __eq__(self, o):
+        return self._binary("equal", o)
+
+    def __ne__(self, o):
+        return self._binary("not_equal", o)
+
+    def __lt__(self, o):
+        return self._binary("less_than", o)
+
+    def __le__(self, o):
+        return self._binary("less_equal", o)
+
+    def __gt__(self, o):
+        return self._binary("greater_than", o)
+
+    def __ge__(self, o):
+        return self._binary("greater_equal", o)
+
+    __hash__ = object.__hash__
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._data)!r})")
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # jax interop: Tensors can be passed straight into jnp functions.
+    def __jax_array__(self):
+        return self._data
+
+    # ------------------------------------------------- inplace paddle methods
+    def _inplace_op(self, opname, *args, **kwargs):
+        out = apply_op(get_op(opname), self._snapshot(), *args, **kwargs)
+        return self._inplace_from(out)
+
+    def add_(self, y):
+        if isinstance(y, np.ndarray):
+            y = Tensor(y)
+        return self._inplace_op("add", y)
+
+    def subtract_(self, y):
+        return self._inplace_op("subtract", y)
+
+    def multiply_(self, y):
+        return self._inplace_op("multiply", y)
+
+    def scale_(self, scale=1.0, bias=0.0, bias_after_scale=True):
+        return self._inplace_op("scale", scale=scale, bias=bias,
+                                bias_after_scale=bias_after_scale)
+
+    def clip_(self, min=None, max=None):
+        return self._inplace_op("clip", min=min, max=max)
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def exponential_(self, lam=1.0):
+        from .rng import next_key
+
+        u = jax.random.uniform(next_key(), self._data.shape,
+                               dtype=self._data.dtype)
+        self._data = -jnp.log1p(-u) / lam
+        return self
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):
+        from .rng import next_key
+
+        self._data = jax.random.uniform(
+            next_key(), self._data.shape, dtype=self._data.dtype,
+            minval=min, maxval=max)
+        return self
+
+    def normal_(self, mean=0.0, std=1.0):
+        from .rng import next_key
+
+        self._data = mean + std * jax.random.normal(
+            next_key(), self._data.shape, dtype=self._data.dtype)
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, dtype=self._data.dtype).reshape(
+            self._data.shape)
+        return self
+
+    def copy_(self, other, non_blocking=False):
+        return self.set_value(other)
+
+    def reconstruct_from_(self, other):
+        self._data = other._data
+        return self
+
+    # value_and-shape helpers used across the framework
+    def _replace_data(self, data):
+        self._data = data
+        return self
+
+
+class Parameter(Tensor):
+    """Trainable tensor (paddle.base.framework.Parameter analog)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer",
+                 "need_clip", "is_distributed", "_sharding_axes")
+
+    def __init__(self, data, dtype=None, name: str = "", trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self._sharding_axes = None  # PartitionSpec-like hint for pjit paths
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _make_method(opname):
+    op = get_op(opname)
+
+    def method(self, *args, **kwargs):
+        return apply_op(op, self, *args, **kwargs)
+
+    method.__name__ = opname
+    return method
+
+
+# Tensor methods generated from the registry: method name -> op name.
+_METHOD_TABLE = {
+    # math
+    "add": "add", "subtract": "subtract", "multiply": "multiply",
+    "divide": "divide", "floor_divide": "floor_divide", "mod": "mod",
+    "remainder": "remainder", "pow": "elementwise_pow", "maximum": "maximum",
+    "minimum": "minimum", "fmax": "fmax", "fmin": "fmin", "atan2": "atan2",
+    "scale": "scale", "neg": "neg", "abs": "abs", "sqrt": "sqrt",
+    "rsqrt": "rsqrt", "exp": "exp", "expm1": "expm1", "log": "log",
+    "log2": "log2", "log10": "log10", "log1p": "log1p", "sin": "sin",
+    "cos": "cos", "tan": "tan", "asin": "asin", "acos": "acos",
+    "atan": "atan", "sinh": "sinh", "cosh": "cosh", "tanh": "tanh",
+    "asinh": "asinh", "acosh": "acosh", "atanh": "atanh",
+    "sigmoid": "sigmoid", "erf": "erf", "erfinv": "erfinv", "floor": "floor",
+    "ceil": "ceil", "round": "round", "trunc": "trunc", "frac": "frac",
+    "sign": "sign", "reciprocal": "reciprocal", "square": "square",
+    "clip": "clip", "lerp": "lerp", "logit": "logit",
+    "nan_to_num": "nan_to_num", "conj": "conj", "angle": "angle",
+    "real": "real", "imag": "imag", "digamma": "digamma", "lgamma": "lgamma",
+    "i0": "i0", "sinc": "sinc", "deg2rad": "deg2rad", "rad2deg": "rad2deg",
+    "heaviside": "heaviside", "hypot": "hypot", "copysign": "copysign",
+    "logaddexp": "logaddexp", "stanh": "stanh",
+    # reduction
+    "sum": "sum", "mean": "mean", "max": "max", "min": "min", "amax": "amax",
+    "amin": "amin", "prod": "prod", "all": "all", "any": "any",
+    "argmax": "argmax", "argmin": "argmin", "logsumexp": "logsumexp",
+    "std": "std", "var": "var", "median": "median", "nanmean": "nanmean",
+    "nansum": "nansum", "count_nonzero": "count_nonzero", "cumsum": "cumsum",
+    "cumprod": "cumprod", "logcumsumexp": "logcumsumexp",
+    # comparison / logical
+    "equal": "equal", "not_equal": "not_equal", "less_than": "less_than",
+    "less_equal": "less_equal", "greater_than": "greater_than",
+    "greater_equal": "greater_equal", "equal_all": "equal_all",
+    "isclose": "isclose", "allclose": "allclose", "isnan": "isnan",
+    "isinf": "isinf", "isfinite": "isfinite",
+    "logical_and": "logical_and", "logical_or": "logical_or",
+    "logical_xor": "logical_xor", "logical_not": "logical_not",
+    "bitwise_and": "bitwise_and", "bitwise_or": "bitwise_or",
+    "bitwise_xor": "bitwise_xor", "bitwise_not": "bitwise_not",
+    # manipulation
+    "reshape": "reshape", "transpose": "transpose", "flatten": "flatten",
+    "squeeze": "squeeze", "unsqueeze": "unsqueeze", "split": "split",
+    "unbind": "unbind", "expand": "expand", "broadcast_to": "broadcast_to",
+    "expand_as": "expand_as", "tile": "tile", "gather": "gather",
+    "gather_nd": "gather_nd", "index_select": "index_select",
+    "index_sample": "index_sample", "take_along_axis": "take_along_axis",
+    "put_along_axis": "put_along_axis", "scatter": "scatter",
+    "scatter_nd_add": "scatter_nd_add", "where": "where", "flip": "flip",
+    "roll": "roll", "sort": "sort", "argsort": "argsort", "pad": "pad",
+    "repeat_interleave": "repeat_interleave", "tril": "tril", "triu": "triu",
+    "diag": "diag", "diagonal": "diagonal", "diag_embed": "diag_embed",
+    "kron": "kron", "moveaxis": "moveaxis", "swapaxes": "swapaxes",
+    "rot90": "rot90", "masked_fill": "masked_fill", "bincount": "bincount",
+    "as_strided": "as_strided",
+    # linalg
+    "matmul": "matmul", "bmm": "bmm", "mm": "mm", "dot": "dot",
+    "outer": "outer", "inner": "inner", "cross": "cross", "t": "t",
+    "norm": "norm", "cholesky": "cholesky", "inverse": "inverse",
+    "trace": "trace_op", "mv": "mv", "histogram": "histogram",
+    # nn
+    "relu": "relu", "softmax": "softmax", "log_softmax": "log_softmax",
+    "one_hot": "one_hot",
+}
+
+for _m, _op in _METHOD_TABLE.items():
+    if not hasattr(Tensor, _m):
+        setattr(Tensor, _m, _make_method(_op))
+
+
+def _topk_method(self, k, axis=-1, largest=True, sorted=True):
+    idx = apply_op(get_op("topk_indices"), self, k=k, axis=axis,
+                   largest=largest)
+    vals = apply_op(get_op("take_along_axis"), self, idx, axis=axis)
+    return vals, idx
+
+
+Tensor.topk = _topk_method
+
+
+def _chunk_method(self, chunks, axis=0):
+    return apply_op(get_op("split"), self, num_or_sections=chunks, axis=axis)
+
+
+Tensor.chunk = _chunk_method
+
+
+def _unique_method(self, return_index=False, return_inverse=False,
+                   return_counts=False, axis=None):
+    """Eager-only (dynamic output shape)."""
+    arr = np.asarray(self._data)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        out = [Tensor(r) for r in res]
+        # paddle order: (out, index, inverse, counts)
+        return tuple(out)
+    return Tensor(res)
+
+
+Tensor.unique = _unique_method
+
+
+def _nonzero_method(self, as_tuple=False):
+    """Eager-only (dynamic output shape)."""
+    arr = np.asarray(self._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(n) for n in nz)
+    return Tensor(np.stack(nz, axis=-1).astype(np.int64))
+
+
+Tensor.nonzero = _nonzero_method
+
+
+def _masked_select_method(self, mask):
+    arr = np.asarray(self._data)
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    return Tensor(arr[m])
+
+
+Tensor.masked_select = _masked_select_method

@@ -1,0 +1,8 @@
+"""Op library: importing this package registers every op into the registry."""
+from .registry import OPS, OpDef, get_op, register_op  # noqa: F401
+from . import math  # noqa: F401
+from . import reduction  # noqa: F401
+from . import comparison  # noqa: F401
+from . import manipulation  # noqa: F401
+from . import linalg  # noqa: F401
+from . import nn_ops  # noqa: F401

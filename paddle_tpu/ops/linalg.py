@@ -1,0 +1,176 @@
+"""Linear-algebra ops. Matmuls are MXU-bound on TPU — everything here keeps
+them batched and lets XLA pick tiling; precision follows
+FLAGS_tpu_default_matmul_precision.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+@register_op("matmul", amp_list="white")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@register_op("bmm", amp_list="white")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register_op("mm", amp_list="white")
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register_op("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_op("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register_op("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@register_op("cross")
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@register_op("t", inplace_view=True)
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+@register_op("norm", amp_list="black")
+def norm(x, p="fro", axis=None, keepdim=False):
+    if axis is None and p in ("fro", 2):
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p if p != "fro" else "fro",
+                               axis=tuple(axis), keepdims=keepdim)
+    if p == "fro":
+        p = 2
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p
+    )
+
+
+@register_op("einsum", amp_list="white")
+def einsum(operands, equation):
+    return jnp.einsum(equation, *list(operands))
+
+
+@register_op("cholesky", amp_list="black")
+def cholesky(x, upper=False):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+@register_op("qr", multi_output=True, amp_list="black")
+def qr(x, mode="reduced"):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+@register_op("svd", multi_output=True, amp_list="black")
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)
+
+
+@register_op("inverse", amp_list="black")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@register_op("pinv", amp_list="black")
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+@register_op("det", amp_list="black")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@register_op("slogdet", multi_output=True, amp_list="black")
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return sign, logabs
+
+
+@register_op("matrix_power", amp_list="black")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_op("eigh", multi_output=True, amp_list="black")
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@register_op("solve", amp_list="black")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@register_op("triangular_solve", amp_list="black")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return lax.linalg.triangular_solve(
+        x, y, left_side=True, lower=not upper,
+        transpose_a=transpose, unit_diagonal=unitriangular,
+    )
+
+
+@register_op("lstsq", multi_output=True, amp_list="black")
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register_op("matrix_rank", amp_list="black")
+def matrix_rank(x, tol=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@register_op("cond", amp_list="black")
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@register_op("histogram")
+def histogram(x, bins=100, min=0.0, max=0.0):
+    rng = None if (min == 0.0 and max == 0.0) else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng)
+    return hist
+
+
+@register_op("mv", amp_list="white")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@register_op("trace_op")
+def trace_op(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)

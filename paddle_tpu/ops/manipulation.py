@@ -1,0 +1,401 @@
+"""Shape/layout manipulation ops (PHI manipulation kernel analog).
+
+All shape arguments are static (python ints/tuples) — XLA requires static
+shapes; dynamic-shape paddle features (nonzero, masked_select) are eager-only
+and documented as such.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+@register_op("reshape", inplace_view=True)
+def reshape(x, shape):
+    shape = tuple(int(s) for s in shape)
+    return jnp.reshape(x, shape)
+
+
+@register_op("transpose", inplace_view=True)
+def transpose(x, perm):
+    return jnp.transpose(x, axes=tuple(perm))
+
+
+@register_op("flatten", inplace_view=True)
+def flatten(x, start_axis=0, stop_axis=-1):
+    ndim = x.ndim
+    if ndim == 0:
+        return x.reshape(1)
+    start = start_axis % ndim
+    stop = stop_axis % ndim
+    shape = x.shape
+    mid = 1
+    for s in shape[start:stop + 1]:
+        mid *= s
+    new_shape = shape[:start] + (mid,) + shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+@register_op("squeeze", inplace_view=True)
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % x.ndim for a in axis)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@register_op("unsqueeze", inplace_view=True)
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    out = x
+    for a in sorted(axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@register_op("concat")
+def concat(xs, axis=0):
+    return jnp.concatenate(list(xs), axis=int(axis))
+
+
+@register_op("stack")
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=int(axis))
+
+
+@register_op("split", multi_output=True)
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if dim % n != 0:
+            raise ValueError(f"cannot split dim {dim} into {n} equal parts")
+        sizes = [dim // n] * n
+    else:
+        sizes = list(num_or_sections)
+        if any(s == -1 for s in sizes):
+            known = sum(s for s in sizes if s != -1)
+            sizes = [dim - known if s == -1 else s for s in sizes]
+    offsets = []
+    acc = 0
+    for s in sizes[:-1]:
+        acc += s
+        offsets.append(acc)
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+@register_op("unbind", multi_output=True)
+def unbind(x, axis=0):
+    axis = int(axis)
+    return tuple(
+        lax.index_in_dim(x, i, axis=axis, keepdims=False)
+        for i in range(x.shape[axis])
+    )
+
+
+@register_op("expand")
+def expand(x, shape):
+    shape = list(shape)
+    # paddle: -1 keeps the original size
+    ndim = len(shape)
+    xshape = (1,) * (ndim - x.ndim) + tuple(x.shape)
+    out_shape = tuple(
+        xshape[i] if shape[i] == -1 else int(shape[i]) for i in range(ndim)
+    )
+    return jnp.broadcast_to(x.reshape(xshape), out_shape)
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+@register_op("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("tile")
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@register_op("cast", inplace_view=True)
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+
+    return x.astype(convert_dtype(dtype))
+
+
+@register_op("gather")
+def gather(x, index, axis=0):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=int(axis))
+
+
+@register_op("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@register_op("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=int(axis))
+
+
+@register_op("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@register_op("take_along_axis")
+def take_along_axis(x, indices, axis, broadcast=True):
+    return jnp.take_along_axis(x, indices, axis=int(axis))
+
+
+@register_op("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(values, indices.shape).astype(x.dtype)
+    dims = [i for i in range(x.ndim) if i != axis % x.ndim]
+    grids = jnp.meshgrid(*[jnp.arange(indices.shape[d]) for d in range(indices.ndim)],
+                         indexing="ij")
+    full_idx = list(grids)
+    full_idx[axis % x.ndim] = indices
+    loc = tuple(full_idx)
+    if reduce == "assign":
+        return x.at[loc].set(values)
+    if reduce in ("add", "sum"):
+        return x.at[loc].add(values)
+    if reduce in ("multiply", "mul"):
+        return x.at[loc].multiply(values)
+    raise ValueError(f"unsupported reduce mode {reduce!r}")
+
+
+@register_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero destination rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@register_op("where")
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@register_op("flip")
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@register_op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis if axis is None else tuple(
+        axis if isinstance(axis, (list, tuple)) else (axis,)))
+
+
+@register_op("sort")
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=axis, stable=stable)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register_op("argsort")
+def argsort(x, axis=-1, descending=False, stable=False):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype("int64")
+
+
+@register_op("topk_indices")
+def topk_indices(x, k, axis=-1, largest=True):
+    """Indices of top-k (nondifferentiable); values come from take_along_axis."""
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    if not largest:
+        xm = -xm
+    _, idx = lax.top_k(xm, k)
+    return jnp.moveaxis(idx, -1, axis).astype("int64")
+
+
+@register_op("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    # paddle F.pad: `pad` is per-axis lo/hi list, innermost axes first for
+    # the NCHW/NCL/NCDHW forms, or len == 2*ndim covering all axes.
+    ndim = x.ndim
+    pads = list(pad)
+    if len(pads) == 2 * ndim:
+        cfg = [(int(pads[2 * i]), int(pads[2 * i + 1])) for i in range(ndim)]
+    else:
+        n_spatial = len(pads) // 2
+        cfg = [(0, 0)] * (ndim - n_spatial)
+        spatial = [
+            (int(pads[2 * i]), int(pads[2 * i + 1])) for i in range(n_spatial)
+        ]
+        if data_format.startswith("NC"):
+            cfg = cfg + spatial[::-1] if len(pads) == 2 else cfg + spatial
+        else:
+            cfg = [(0, 0)] + spatial + [(0, 0)]
+    if len(pads) == 2 and ndim >= 3 and data_format.startswith("NC"):
+        # common paddle shorthand: pad last axis
+        cfg = [(0, 0)] * (ndim - 1) + [(int(pads[0]), int(pads[1]))]
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    if mode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=mode_map[mode])
+
+
+@register_op("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_op("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@register_op("diag")
+def diag(x, offset=0, padding_value=0.0):
+    if x.ndim == 1 and padding_value != 0.0:
+        out = jnp.diag(x, k=offset)
+        mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+    return jnp.diag(x, k=offset)
+
+
+@register_op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        out = base.at[..., idx, idx + offset].set(x)
+    else:
+        out = base.at[..., idx - offset, idx].set(x)
+    src1 = x.ndim - 1
+    src2 = x.ndim
+    out = jnp.moveaxis(out, (src1, src2), (dim1, dim2))
+    return out
+
+
+@register_op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_op("slice_op", inplace_view=True)
+def slice_op(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+@register_op("strided_slice", inplace_view=True)
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+@register_op("as_strided", inplace_view=True)
+def as_strided(x, shape, stride, offset=0):
+    flat = x.reshape(-1)
+    idx = jnp.zeros(tuple(shape), dtype=jnp.int32) + offset
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s) * st
+        idx = idx + r.reshape((-1,) + (1,) * (len(shape) - d - 1))
+    return flat[idx]
+
+
+@register_op("moveaxis", inplace_view=True)
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_op("swapaxes", inplace_view=True)
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@register_op("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register_op("one_hot")
+def one_hot(x, num_classes):
+    import jax
+
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@register_op("set_value_by_index")
+def set_value_by_index(x, value, _index_tree=None):
+    # used by Tensor.__setitem__ through apply_callable; kept for Program mode
+    raise NotImplementedError
+
+
+@register_op("meshgrid", multi_output=True)
+def meshgrid(xs, indexing="ij"):
+    return tuple(jnp.meshgrid(*list(xs), indexing=indexing))
+
+
+@register_op("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+@register_op("full_like")
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+@register_op("bincount")
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@register_op("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, values,
+                           side="right" if right else "left")
+    return out.astype("int32" if out_int32 else "int64")
+
+
+@register_op("clone")
+def clone(x):
+    return jnp.copy(x)

@@ -1,0 +1,849 @@
+"""NN ops: activations, conv/pool, normalization, embedding, losses, attention.
+
+PHI nn-kernel analog (ref: paddle/phi/kernels/gpu/*, fusion/*, upstream layout,
+unverified — mount empty). Convs/matmuls hit the MXU; everything elementwise
+around them is left to XLA fusion. Attention has a jnp reference implementation
+here; the Pallas flash/splash kernel lives in paddle_tpu/ops/pallas_kernels.py
+and is selected automatically when shapes allow.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+# ----------------------------------------------------------------- activations
+
+
+@register_op("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register_op("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@register_op("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@register_op("silu")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@register_op("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@register_op("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
+
+
+@register_op("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+@register_op("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op("celu")
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+@register_op("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@register_op("hardsigmoid")
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register_op("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@register_op("hardshrink")
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros_like(x))
+
+
+@register_op("softshrink")
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, jnp.zeros_like(x)))
+
+
+@register_op("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@register_op("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op("softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jax.nn.softplus(bx) / beta)
+
+
+@register_op("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register_op("prelu")
+def prelu(x, weight):
+    w = weight
+    if w.size > 1 and x.ndim >= 2:
+        # channel dim is axis 1 (NCHW)
+        shape = [1] * x.ndim
+        shape[1] = w.size
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@register_op("rrelu")
+def rrelu(x, lower=0.125, upper=1.0 / 3.0, training=False):
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@register_op("softmax", amp_list="black")
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax", amp_list="black")
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("glu")
+def glu(x, axis=-1):
+    return jax.nn.glu(x, axis=axis)
+
+
+@register_op("maxout")
+def maxout(x, groups, axis=1):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis] = c // groups
+    shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+# ------------------------------------------------------------------ conv/pool
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v), int(v))
+
+
+def _conv_padding(padding, k, stride, dilation, n_spatial):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n_spatial
+    padding = list(padding)
+    if len(padding) == n_spatial:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n_spatial:
+        return [
+            (int(padding[2 * i]), int(padding[2 * i + 1]))
+            for i in range(n_spatial)
+        ]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+@register_op("conv2d", amp_list="white")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    kh, kw = weight.shape[-2], weight.shape[-1]
+    pad = _conv_padding(padding, (kh, kw), stride, dilation, 2)
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "OIHW", "NHWC"),
+    )
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@register_op("conv1d", amp_list="white")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    stride = (int(stride) if isinstance(stride, int) else int(stride[0]),)
+    dilation = (int(dilation) if isinstance(dilation, int) else int(dilation[0]),)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    elif isinstance(padding, int):
+        pad = [(padding, padding)]
+    else:
+        p = list(padding)
+        pad = [(p[0], p[-1])]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, ("NCH", "OIH", "NCH"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+@register_op("conv3d", amp_list="white")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    def _triple(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(int(i) for i in v)
+        return (int(v),) * 3
+
+    stride = _triple(stride)
+    dilation = _triple(dilation)
+    pad = _conv_padding(padding, weight.shape[-3:], stride, dilation, 3)
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape, ("NCDHW", "OIDHW", "NCDHW")
+    )
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+@register_op("conv2d_transpose", amp_list="white")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    output_padding = _pair(output_padding)
+    # weight layout paddle: (in, out//groups, kh, kw)
+    kh, kw = weight.shape[-2], weight.shape[-1]
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    padp = _conv_padding(padding, (kh, kw), stride, dilation, 2)
+    # gradient-of-conv formulation: lhs_dilation = stride
+    pads = []
+    for (plo, phi), k, d, op_ in zip(padp, (kh, kw), dilation, output_padding):
+        eff_k = (k - 1) * d + 1
+        pads.append((eff_k - 1 - plo, eff_k - 1 - phi + op_))
+    if groups == 1:
+        w = jnp.swapaxes(weight, 0, 1)  # (out, in, kh, kw)
+    else:
+        cin, cog = weight.shape[0], weight.shape[1]
+        w = weight.reshape(groups, cin // groups, cog, kh, kw)
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * cog, cin // groups, kh, kw)
+    w = jnp.flip(w, axis=(-2, -1))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool(x, kernel, stride, padding, init, op, data_format="NCHW",
+          count_include_pad=True, is_avg=False):
+    kernel = _pair(kernel)
+    stride = _pair(stride) if stride is not None else kernel
+    if data_format == "NCHW":
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        sp_axes = (2, 3)
+    else:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        sp_axes = (1, 2)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _conv_padding(padding, kernel, stride, (1, 1), 2)
+        pad = [(0, 0), (0, 0), p[0], p[1]] if data_format == "NCHW" else \
+              [(0, 0), p[0], p[1], (0, 0)]
+    out = lax.reduce_window(x, init, op, window, strides, pad)
+    if is_avg:
+        if count_include_pad or pad == "VALID" or (
+            not isinstance(pad, str) and all(p == (0, 0) for p in pad)
+        ):
+            out = out / (kernel[0] * kernel[1])
+        else:
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
+            out = out / cnt
+    return out
+
+
+@register_op("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, -jnp.inf, lax.max,
+                 data_format)
+
+
+@register_op("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               count_include_pad=True, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 0.0, lax.add, data_format,
+                 count_include_pad=count_include_pad, is_avg=True)
+
+
+@register_op("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    oh, ow = _pair(output_size)
+    if data_format != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        out = x.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+    else:
+        # general adaptive pooling via per-window means
+        def win_mean(hi, wi):
+            hs, he = (hi * h) // oh, -(-((hi + 1) * h) // oh)
+            ws, we = (wi * w) // ow, -(-((wi + 1) * w) // ow)
+            return x[:, :, hs:he, ws:we].mean(axis=(2, 3))
+
+        rows = [jnp.stack([win_mean(i, j) for j in range(ow)], axis=-1)
+                for i in range(oh)]
+        out = jnp.stack(rows, axis=-2)
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+@register_op("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return x.reshape(n, c, oh, h // oh, ow, w // ow).max(axis=(3, 5))
+    def win_max(hi, wi):
+        hs, he = (hi * h) // oh, -(-((hi + 1) * h) // oh)
+        ws, we = (wi * w) // ow, -(-((wi + 1) * w) // ow)
+        return x[:, :, hs:he, ws:we].max(axis=(2, 3))
+
+    rows = [jnp.stack([win_max(i, j) for j in range(ow)], axis=-1)
+            for i in range(oh)]
+    return jnp.stack(rows, axis=-2)
+
+
+@register_op("max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (stride if isinstance(stride, int) else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, k), (1, 1, s),
+        [(0, 0), (0, 0), (p, p)],
+    )
+
+
+@register_op("avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (stride if isinstance(stride, int) else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+    out = lax.reduce_window(
+        x, 0.0, lax.add, (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p, p)]
+    )
+    return out / k
+
+
+# -------------------------------------------------------------- normalization
+
+
+@register_op("layer_norm", amp_list="black")
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5,
+               begin_norm_axis=-1):
+    if isinstance(begin_norm_axis, int) and begin_norm_axis >= 0:
+        axes = tuple(range(begin_norm_axis, x.ndim))
+    else:
+        axes = (x.ndim - 1,)
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("rms_norm", amp_list="black")
+def rms_norm(x, weight=None, epsilon=1e-6):
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    out = (x32 * lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@register_op("batch_norm_infer", amp_list="black")
+def batch_norm_infer(x, running_mean, running_var, weight=None, bias=None,
+                     epsilon=1e-5, data_format="NCHW"):
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = -1
+    inv = lax.rsqrt(running_var.astype(jnp.float32) + epsilon).reshape(shape)
+    out = (x.astype(jnp.float32) - running_mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+@register_op("batch_norm_train", multi_output=True, amp_list="black")
+def batch_norm_train(x, weight=None, bias=None, epsilon=1e-5,
+                     data_format="NCHW"):
+    """Returns (out, batch_mean, batch_var). Running-stat update is the
+    layer's job (momentum blending outside the op, like PHI's batch_norm)."""
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes)
+    var = jnp.var(x32, axis=axes)
+    shape = [1] * x.ndim
+    shape[c_axis] = -1
+    out = (x32 - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype), mean, var
+
+
+@register_op("group_norm", amp_list="black")
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    if data_format != "NCHW":
+        raise NotImplementedError("group_norm supports NCHW")
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    rest = x.shape[2:]
+    x32 = x.astype(jnp.float32).reshape((n, g, c // g) + rest)
+    axes = tuple(range(2, x32.ndim))
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
+    out = ((x32 - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1] * x.ndim
+    shape[1] = -1
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+@register_op("instance_norm", amp_list="black")
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + epsilon)
+    shape = [1] * x.ndim
+    shape[1] = -1
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+@register_op("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    pad_cfg = [(0, 0)] * x.ndim
+    pad_cfg[1] = (half, size - 1 - half)
+    sq = jnp.pad(sq, pad_cfg)
+    window = [1] * x.ndim
+    window[1] = size
+    s = lax.reduce_window(sq, 0.0, lax.add, tuple(window), (1,) * x.ndim,
+                          [(0, 0)] * x.ndim)
+    return x / jnp.power(k + alpha * s, beta)
+
+
+# --------------------------------------------------------- dropout/emb/linear
+
+
+@register_op("dropout")
+def dropout(x, key, p=0.5, training=True, mode="upscale_in_train", axis=None):
+    if not training or p == 0.0:
+        return x
+    if p >= 1.0:
+        return jnp.zeros_like(x) if mode == "upscale_in_train" else x * 0.0
+    shape = x.shape
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape=shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+    return jnp.where(keep, x, jnp.zeros_like(x))
+
+
+@register_op("embedding")
+def embedding(ids, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+@register_op("linear", amp_list="white")
+def linear(x, weight, bias=None):
+    # paddle weight layout: (in_features, out_features)
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# --------------------------------------------------------------------- losses
+
+
+@register_op("cross_entropy", amp_list="black")
+def cross_entropy(logits, label, soft_label=False, axis=-1,
+                  ignore_index=-100, reduction="mean", weight=None,
+                  label_smoothing=0.0):
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    n_classes = logits.shape[axis]
+    if soft_label:
+        target = label.astype(jnp.float32)
+        loss = -jnp.sum(target * logp, axis=axis)
+        valid = jnp.ones(loss.shape, dtype=jnp.float32)
+    else:
+        lbl = label
+        if lbl.ndim == logp.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = (lbl != ignore_index).astype(jnp.float32)
+        safe = jnp.where(lbl == ignore_index, 0, lbl)
+        if label_smoothing > 0.0:
+            onehot = jax.nn.one_hot(safe, n_classes, axis=axis)
+            target = onehot * (1.0 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(target * logp, axis=axis)
+        else:
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis
+            ).squeeze(axis)
+        if weight is not None:
+            w = jnp.take(weight, safe)
+            loss = loss * w
+            valid = valid * w
+        loss = loss * (lbl != ignore_index).astype(loss.dtype)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    denom = jnp.maximum(jnp.sum(valid), 1.0)
+    return jnp.sum(loss) / denom
+
+
+@register_op("nll_loss", amp_list="black")
+def nll_loss(logp, label, weight=None, ignore_index=-100, reduction="mean"):
+    lbl = label.astype(jnp.int32)
+    valid = (lbl != ignore_index).astype(jnp.float32)
+    safe = jnp.where(lbl == ignore_index, 0, lbl)
+    loss = -jnp.take_along_axis(logp, safe[:, None], axis=1).squeeze(1)
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        loss = loss * w
+        valid = valid * w
+    loss = loss * (lbl != ignore_index).astype(loss.dtype)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+@register_op("mse_loss")
+def mse_loss(input, label, reduction="mean"):
+    loss = jnp.square(input - label)
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("l1_loss")
+def l1_loss(input, label, reduction="mean"):
+    loss = jnp.abs(input - label)
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                     diff - 0.5 * delta)
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("binary_cross_entropy", amp_list="black")
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    x = jnp.clip(input.astype(jnp.float32), eps, 1.0 - eps)
+    loss = -(label * jnp.log(x) + (1.0 - label) * jnp.log(1.0 - x))
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("binary_cross_entropy_with_logits", amp_list="black")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    logit = logit.astype(jnp.float32)
+    label = label.astype(jnp.float32)
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1.0 - label) * logit + log_w * (
+            jnp.log(jnp.exp(-max_val) + jnp.exp(-logit - max_val)) + max_val
+        )
+    else:
+        loss = (1.0 - label) * logit + max_val + jnp.log(
+            jnp.exp(-max_val) + jnp.exp(-logit - max_val)
+        )
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("kl_div", amp_list="black")
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe = jnp.where(label > 0, label, 1.0)
+        loss = jnp.where(label > 0, label * (jnp.log(safe) - input),
+                         jnp.zeros_like(label))
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return jnp.mean(loss)
+
+
+@register_op("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input,
+                     jnp.clip(margin - input, 0.0, None))
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot_ = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot_ / jnp.maximum(n1 * n2, eps)
+
+
+@register_op("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.clip(-label * (input - other) + margin, 0.0, None)
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("sigmoid_focal_loss", amp_list="black")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    logit32 = logit.astype(jnp.float32)
+    label32 = label.astype(jnp.float32)
+    max_val = jnp.clip(-logit32, 0, None)
+    ce = (1.0 - label32) * logit32 + max_val + jnp.log(
+        jnp.exp(-max_val) + jnp.exp(-logit32 - max_val))
+    p_t = p * label32 + (1 - p) * (1 - label32)
+    loss = ce * jnp.power(1 - p_t, gamma)
+    alpha_t = alpha * label32 + (1 - alpha) * (1 - label32)
+    loss = alpha_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("label_smooth")
+def label_smooth(label, epsilon=0.1, prior_dist=None):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * prior_dist
+    return (1.0 - epsilon) * label + epsilon / n
+
+
+@register_op("square_error_cost")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+# ------------------------------------------------------------------ attention
+
+
+@register_op("scaled_dot_product_attention", amp_list="white")
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 scale=None):
+    """Reference attention. Layout: (batch, seq, heads, head_dim) — paddle's
+    flash_attention layout. The Pallas flash kernel substitutes this op on TPU
+    for long sequences (see ops/pallas_kernels.py)."""
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    q = jnp.einsum("bqhd->bhqd", query)
+    k = jnp.einsum("bkhd->bhkd", key)
+    v = jnp.einsum("bkhd->bhkd", value)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+# ---------------------------------------------------------------------- misc
+
+
+@register_op("interpolate")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    n, c, h, w = x.shape
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            (scale_factor, scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    oh, ow = int(size[0]), int(size[1])
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "linear": "linear"}[mode]
+    out = jax.image.resize(x, (n, c, oh, ow), method=method)
+    return out.astype(x.dtype)
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    out = x.reshape(n, oc, r, r, h, w)
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+    return out.reshape(n, oc, h * r, w * r)
+
+
+@register_op("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i * dh:i * dh + sh * oh:sh,
+                       j * dw:j * dw + sw * ow:sw]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)  # n, c, kh*kw, oh, ow
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+@register_op("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    sim = jnp.matmul(anchor, positive.T)
+    lbl = labels.reshape(-1, 1)
+    target = (lbl == lbl.T).astype(jnp.float32)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=-1)
+    ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), axis=1)) +
+                    jnp.mean(jnp.sum(jnp.square(positive), axis=1))) * 0.25
+    return ce + reg
+
+
+@register_op("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([x5[:, 1:, :fold], jnp.zeros_like(x5[:, :1, :fold])],
+                           axis=1)
+    right = jnp.concatenate([jnp.zeros_like(x5[:, :1, fold:2 * fold]),
+                             x5[:, :-1, fold:2 * fold]], axis=1)
+    rest = x5[:, :, 2 * fold:]
+    out = jnp.concatenate([left, right, rest], axis=2)
+    return out.reshape(nt, c, h, w)

@@ -1,0 +1,68 @@
+"""Op registry — the PHI-kernel-library analog (ref: paddle/phi/core/
+kernel_factory.* and paddle/phi/api/yaml/ops.yaml, upstream layout, unverified
+— mount empty).
+
+Each op is a pure function over jax arrays (jnp/lax/pallas). One registry entry
+is the single source of truth consumed by:
+  * the eager dispatcher (with the autograd tape via jax.vjp),
+  * the static-graph Program builder (ops are appended by name and re-executed
+    by the Executor when interpreting a Program),
+  * jitted train steps (which call the same pure functions directly).
+
+There is no per-backend kernel selection: XLA is the backend. Shape/dtype
+inference (InferMeta) is jax.eval_shape over the same function.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "multi_output", "inplace_view", "amp_list")
+
+    def __init__(self, name: str, fn: Callable, multi_output: bool = False,
+                 inplace_view: bool = False, amp_list: Optional[str] = None):
+        self.name = name
+        self.fn = fn
+        # whether fn returns a tuple of arrays rather than a single array
+        self.multi_output = multi_output
+        # view-like ops (reshape/slice) — safe under AMP, never cast
+        self.inplace_view = inplace_view
+        # 'white' (run in low precision), 'black' (keep fp32), None (follow inputs)
+        self.amp_list = amp_list
+
+    def infer_meta(self, *args, **kwargs):
+        """InferMeta analog: abstract shape/dtype evaluation."""
+        return jax.eval_shape(functools.partial(self.fn, **kwargs), *args)
+
+    def __repr__(self):
+        return f"OpDef({self.name})"
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, multi_output: bool = False, inplace_view: bool = False,
+                amp_list: Optional[str] = None):
+    """Decorator registering a pure jax function as a framework op."""
+
+    def deco(fn: Callable):
+        opdef = OpDef(name, fn, multi_output=multi_output,
+                      inplace_view=inplace_view, amp_list=amp_list)
+        if name in OPS:
+            raise ValueError(f"op {name!r} registered twice")
+        OPS[name] = opdef
+        fn.opdef = opdef
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return OPS[name]
+    except KeyError:
+        raise KeyError(f"op {name!r} is not registered") from None

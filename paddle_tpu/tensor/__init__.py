@@ -1,0 +1,128 @@
+"""paddle.tensor analog: functional API over Tensors, generated from the op
+registry (the PHI-API-codegen idea — ref §2.4 of SURVEY.md — done at import
+time instead of build time)."""
+from __future__ import annotations
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..ops.registry import OPS, get_op
+from .creation import (  # noqa: F401
+    arange, as_complex, as_real, assign, clone, complex, diagflat, empty,
+    empty_like, eye, full, full_like, is_tensor, linspace, logspace, numel,
+    ones, ones_like, to_tensor, tril_indices, triu_indices, zeros, zeros_like,
+)
+from .random import (  # noqa: F401
+    bernoulli, multinomial, normal, poisson, rand, rand_like, randint,
+    randint_like, randn, randn_like, randperm, standard_normal, uniform,
+)
+
+
+def _make_fn(opname):
+    op = get_op(opname)
+
+    def fn(*args, **kwargs):
+        return apply_op(op, *args, **kwargs)
+
+    fn.__name__ = opname
+    fn.__qualname__ = opname
+    fn.__doc__ = (op.fn.__doc__ or "") + f"\n\n(framework op {opname!r})"
+    return fn
+
+
+# Ops exposed as module-level functions under their registry name.
+_FN_EXPORTS = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "maximum", "minimum", "fmax", "fmin", "atan2", "scale",
+    "neg", "abs", "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10",
+    "log1p", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "tanh", "asinh", "acosh", "atanh", "sigmoid", "erf", "erfinv", "floor",
+    "ceil", "trunc", "frac", "sign", "reciprocal", "square", "clip", "lerp",
+    "logit", "nan_to_num", "conj", "angle", "real", "imag", "digamma",
+    "lgamma", "i0", "sinc", "deg2rad", "rad2deg", "heaviside", "hypot",
+    "copysign", "logaddexp", "stanh", "multiply_scalar", "pow_scalar",
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "all", "any",
+    "argmax", "argmin", "logsumexp", "std", "var", "median", "nanmean",
+    "nansum", "count_nonzero", "cumsum", "cumprod", "logcumsumexp", "cummax",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "equal_all", "isclose", "allclose", "isnan", "isinf",
+    "isfinite", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "reshape", "transpose", "flatten", "squeeze", "unsqueeze", "concat",
+    "stack", "split", "unbind", "expand", "broadcast_to", "expand_as",
+    "tile", "cast", "gather", "gather_nd", "index_select", "index_sample",
+    "take_along_axis", "put_along_axis", "scatter", "scatter_nd_add",
+    "where", "flip", "roll", "sort", "argsort", "repeat_interleave", "tril",
+    "triu", "diag", "diagonal", "diag_embed", "kron", "moveaxis", "swapaxes",
+    "rot90", "masked_fill", "bincount", "searchsorted", "as_strided",
+    "meshgrid", "one_hot",
+    "matmul", "bmm", "mm", "dot", "outer", "inner", "cross", "t", "norm",
+    "cholesky", "inverse", "mv", "histogram",
+]
+
+_g = globals()
+for _name in _FN_EXPORTS:
+    if _name not in _g:
+        _g[_name] = _make_fn(_name)
+
+
+def pow(x, y):
+    if isinstance(y, (int, float)):
+        return apply_op(get_op("pow_scalar"), x, value=y)
+    return apply_op(get_op("elementwise_pow"), x, y)
+
+
+def round(x):
+    return apply_op(get_op("round"), x)
+
+
+def chunk(x, chunks, axis=0):
+    return apply_op(get_op("split"), x, num_or_sections=chunks, axis=axis)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    return Tensor.topk(x, k, axis=axis, largest=largest)
+
+
+def unique(x, **kwargs):
+    return Tensor.unique(x, **kwargs)
+
+
+def nonzero(x, as_tuple=False):
+    return Tensor.nonzero(x, as_tuple=as_tuple)
+
+
+def masked_select(x, mask):
+    return Tensor.masked_select(x, mask)
+
+
+def einsum(equation, *operands):
+    return apply_op(get_op("einsum"), list(operands), equation=equation)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return apply_op(get_op("trace_op"), x, offset=offset, axis1=axis1,
+                    axis2=axis2)
+
+
+def slice(x, axes, starts, ends):
+    return apply_op(get_op("slice_op"), x, axes=list(axes),
+                    starts=list(starts), ends=list(ends))
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    return apply_op(get_op("strided_slice"), x, axes=list(axes),
+                    starts=list(starts), ends=list(ends),
+                    strides=list(strides))
+
+
+def increment(x, value=1.0):
+    return x.add_(to_tensor(value, dtype=x.dtype))
+
+
+def unstack(x, axis=0, num=None):
+    return list(apply_op(get_op("unbind"), x, axis=axis))
+
+
+def split_fn(x, num_or_sections, axis=0):
+    return apply_op(get_op("split"), x, num_or_sections=num_or_sections,
+                    axis=axis)

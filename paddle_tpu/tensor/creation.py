@@ -1,0 +1,140 @@
+"""Tensor creation API (paddle.tensor.creation analog)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    t = Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+    if place is not None:
+        t = t.to(place)
+        t.stop_gradient = stop_gradient
+    return t
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default or get_default_dtype()
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(tuple(shape) if not isinstance(shape, int)
+                            else (shape,), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(tuple(shape) if not isinstance(shape, int)
+                           else (shape,), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return Tensor(jnp.full(tuple(shape) if not isinstance(shape, int)
+                           else (shape,), fill_value, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor) else x,
+                                 dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data if isinstance(x, Tensor) else x,
+                                dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data if isinstance(x, Tensor) else x,
+                                fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python scalars")
+    if dtype is None:
+        dtype = "int64" if all(
+            isinstance(v, int) for v in (start, end, step)) else \
+            get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(start, stop, int(num),
+                               dtype=_dt(dtype, np.dtype("float32"))))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=_dt(dtype, np.dtype("float32"))))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(x._data if isinstance(x, Tensor) else x,
+                               k=offset))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(np.stack([r, c]).astype(convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(np.stack([r, c]).astype(convert_dtype(dtype)))
+
+
+def assign(x, output=None):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is None:
+        return Tensor(jnp.copy(data))
+    output._data = jnp.asarray(data, dtype=output._data.dtype)
+    return output
+
+
+def clone(x):
+    return x.clone()
+
+
+def numel(x):
+    return Tensor(np.int64(x.size))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def complex(real, imag):
+    return Tensor(jax.lax.complex(real._data, imag._data))
+
+
+def as_complex(x):
+    d = x._data
+    return Tensor(jax.lax.complex(d[..., 0], d[..., 1]))
+
+
+def as_real(x):
+    d = x._data
+    return Tensor(jnp.stack([jnp.real(d), jnp.imag(d)], axis=-1))

@@ -1,0 +1,104 @@
+"""Random tensor creation (paddle.tensor.random analog) — threefry-keyed."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.rng import next_key
+from ..core.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype=d))
+
+
+def randn(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dtype=d))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)) if shape is None else _shape(shape)
+        return Tensor(m + s * jax.random.normal(next_key(), shp,
+                                                dtype=get_default_dtype()))
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(next_key(), shp,
+                                                 dtype=get_default_dtype()))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=d,
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), low, high,
+                                     dtype=d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(
+        convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    p = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(next_key(), p).astype(
+        p.dtype if jnp.issubdtype(p.dtype, jnp.floating) else "float32"))
+
+
+def poisson(x, name=None):
+    lam = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(next_key(), lam).astype(lam.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits,
+                                     shape=p.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(next_key(), p.shape, dtype=jnp.float32)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        out = idx
+    return Tensor(out.astype("int64"))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype=dtype)
+
+
+def rand_like(x, dtype=None):
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.uniform(next_key(), tuple(x.shape), dtype=d))
+
+
+def randn_like(x, dtype=None):
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.normal(next_key(), tuple(x.shape), dtype=d))

@@ -1,0 +1,35 @@
+"""Test harness config.
+
+Mirrors the reference's single-host multi-device emulation (SURVEY.md §4):
+8 fake devices on CPU via xla_force_host_platform_device_count so every
+mesh/collective/parallelism test runs hermetically without TPU hardware.
+Must run before jax is first imported.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# full fp32 matmuls for numeric comparisons (TPU bench keeps its own default)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _seed_framework():
+    import paddle_tpu as paddle
+
+    paddle.seed(1234)
+    yield

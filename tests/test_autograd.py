@@ -1,0 +1,219 @@
+"""Tape autograd: backward, accumulation, no_grad, paddle.grad, PyLayer.
+Gradients checked against analytic results and finite differences (the
+reference's OpTest grad-check pattern, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        hi = f(x.copy().reshape(x.shape))
+        flat[i] = orig - eps
+        lo = f(x.copy().reshape(x.shape))
+        flat[i] = orig
+        gf[i] = (hi - lo) / (2 * eps)
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x + 3 * x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_accumulation_over_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_clear_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_fanout_accumulates(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        a = x * 2
+        b = x * 5
+        (a + b).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_diamond_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x          # 4
+        z = y + y * y      # 4 + 16
+        z.backward()
+        # dz/dy = 1 + 2y = 9; dy/dx = 2x = 4 → 36
+        np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+    def test_matmul_grad(self):
+        a_np = np.random.rand(3, 4).astype("float32")
+        b_np = np.random.rand(4, 2).astype("float32")
+        a = paddle.to_tensor(a_np, stop_gradient=False)
+        b = paddle.to_tensor(b_np, stop_gradient=False)
+        paddle.matmul(a, b).sum().backward()
+        np.testing.assert_allclose(
+            a.grad.numpy(), np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+        np.testing.assert_allclose(
+            b.grad.numpy(), a_np.T @ np.ones((3, 2)), rtol=1e-5)
+
+    def test_broadcast_grad(self):
+        x = paddle.to_tensor(np.ones((3, 4), "float32"), stop_gradient=False)
+        b = paddle.to_tensor(np.ones((4,), "float32"), stop_gradient=False)
+        (x + b).sum().backward()
+        np.testing.assert_allclose(b.grad.numpy(), [3, 3, 3, 3])
+
+    def test_nonscalar_needs_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            (x * 2).backward()
+        (x * 2).backward(paddle.ones([2]))
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        z = y * 3
+        assert z.stop_gradient
+
+    def test_finite_difference_softmax(self):
+        x_np = np.random.rand(5).astype("float32")
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        y = paddle.nn_functional_softmax = x.softmax()
+        (y * paddle.to_tensor([1.0, 0, 0, 0, 0])).sum().backward()
+
+        def f(v):
+            e = np.exp(v - v.max())
+            return (e / e.sum())[0]
+
+        ng = numeric_grad(f, x_np.copy().astype("float64"))
+        np.testing.assert_allclose(x.grad.numpy(), ng, atol=1e-3)
+
+    def test_mixed_dtype_no_grad_for_int(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        idx = x.argmax()
+        assert idx.stop_gradient
+
+    def test_multi_output_split_grad(self):
+        x = paddle.to_tensor(np.arange(6, dtype="float32"),
+                             stop_gradient=False)
+        a, b = paddle.split(x, 2)
+        (a.sum() * 2 + b.sum() * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 3, 3, 3])
+
+
+class TestNoGrad:
+    def test_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_decorator(self):
+        @paddle.no_grad()
+        def f(t):
+            return t * 2
+
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        assert f(x).stop_gradient
+
+    def test_enable_grad_nested(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            with paddle.enable_grad():
+                y = x * 2
+        assert not y.stop_gradient
+
+
+class TestGradAPI:
+    def test_basic(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [4.0])
+        assert x.grad is None  # grad() must not touch .grad
+
+    def test_intermediate_input(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * 3
+        z = y * y
+        (gy,) = paddle.grad(z, y)
+        np.testing.assert_allclose(gy.numpy(), [12.0])
+
+    def test_allow_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        u = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, [x, u])
+        y2 = x * 2
+        g = paddle.grad(y2, [x, u], allow_unused=True)
+        assert g[1] is None
+
+
+class TestHooks:
+    def test_leaf_hook_scales_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        x.register_hook(lambda g: g * 2)
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_hook_remove(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        h = x.register_hook(lambda g: g * 2)
+        h.remove()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+class TestPyLayer:
+    def test_custom_exp(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Exp(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = x.exp()
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                (y,) = ctx.saved_tensor
+                return dy * y
+
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = Exp.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.exp([1.0]), rtol=1e-5)
+
+    def test_multi_input_output(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class MulAdd(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b, a + b
+
+            @staticmethod
+            def backward(ctx, d_mul, d_add):
+                a, b = ctx.saved_tensor
+                return d_mul * b + d_add, d_mul * a + d_add
+
+        a = paddle.to_tensor([2.0], stop_gradient=False)
+        b = paddle.to_tensor([5.0], stop_gradient=False)
+        m, s = MulAdd.apply(a, b)
+        (m + s).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [6.0])
+        np.testing.assert_allclose(b.grad.numpy(), [3.0])

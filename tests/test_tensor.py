@@ -1,0 +1,291 @@
+"""Tensor wrapper behavior: creation, properties, methods, indexing,
+in-place semantics. Pattern follows the reference's OpTest idea (SURVEY.md §4):
+compare against NumPy reference results."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == np.float32
+        np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_default_float32_from_float64(self):
+        t = paddle.to_tensor(np.zeros((2, 2), dtype=np.float64))
+        assert t.dtype == np.float32
+
+    def test_int_dtype(self):
+        t = paddle.to_tensor([1, 2, 3])
+        assert t.dtype in (np.int32, np.int64)
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5),
+            rtol=1e-6)
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3,
+                                      dtype=np.float32))
+
+    def test_random_shapes(self):
+        assert paddle.rand([4, 5]).shape == [4, 5]
+        assert paddle.randn([4, 5]).shape == [4, 5]
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([8]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([8]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestProperties:
+    def test_shape_ndim_size(self):
+        t = paddle.zeros([2, 3, 4])
+        assert t.shape == [2, 3, 4]
+        assert t.ndim == 3
+        assert t.size == 24
+        assert t.numel() == 24
+
+    def test_T(self):
+        t = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+        np.testing.assert_array_equal(t.T.numpy(), t.numpy().T)
+
+    def test_item(self):
+        assert paddle.to_tensor(3.5).item() == pytest.approx(3.5)
+
+    def test_astype(self):
+        t = paddle.to_tensor([1.7, 2.3]).astype("int32")
+        assert t.dtype == np.int32
+
+
+class TestMath:
+    def test_binary_ops(self):
+        a = paddle.to_tensor([4.0, 9.0])
+        b = paddle.to_tensor([2.0, 3.0])
+        np.testing.assert_allclose((a + b).numpy(), [6, 12])
+        np.testing.assert_allclose((a - b).numpy(), [2, 6])
+        np.testing.assert_allclose((a * b).numpy(), [8, 27])
+        np.testing.assert_allclose((a / b).numpy(), [2, 3])
+        np.testing.assert_allclose((a ** 2).numpy(), [16, 81])
+        np.testing.assert_allclose((a % b).numpy(), [0, 0])
+        np.testing.assert_allclose((2 + a).numpy(), [6, 11])
+        np.testing.assert_allclose((1 - a).numpy(), [-3, -8])
+
+    def test_unary_ops(self):
+        a = paddle.to_tensor([1.0, 4.0])
+        np.testing.assert_allclose(a.sqrt().numpy(), [1, 2])
+        np.testing.assert_allclose(a.log().numpy(), np.log([1, 4]),
+                                   rtol=1e-6)
+        np.testing.assert_allclose((-a).numpy(), [-1, -4])
+        np.testing.assert_allclose(abs(paddle.to_tensor([-2.0])).numpy(), [2])
+
+    def test_matmul(self):
+        a = np.random.rand(3, 4).astype("float32")
+        b = np.random.rand(4, 5).astype("float32")
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+    def test_matmul_transpose_flags(self):
+        a = np.random.rand(4, 3).astype("float32")
+        b = np.random.rand(5, 4).astype("float32")
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True, transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b.T, rtol=1e-5)
+
+    def test_clip(self):
+        a = paddle.to_tensor([-1.0, 0.5, 2.0])
+        np.testing.assert_allclose(a.clip(0.0, 1.0).numpy(), [0, 0.5, 1])
+
+
+class TestReduction:
+    def test_sum_mean(self):
+        x = np.random.rand(3, 4).astype("float32")
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t.sum().numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(t.sum(axis=1).numpy(), x.sum(1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(t.mean(axis=0, keepdim=True).numpy(),
+                                   x.mean(0, keepdims=True), rtol=1e-5)
+
+    def test_max_min_argmax(self):
+        x = np.array([[1.0, 5.0], [3.0, 2.0]], dtype="float32")
+        t = paddle.to_tensor(x)
+        assert t.max().item() == 5.0
+        assert t.min().item() == 1.0
+        np.testing.assert_array_equal(t.argmax(axis=1).numpy(), [1, 0])
+
+    def test_cumsum(self):
+        x = np.arange(6).reshape(2, 3).astype("float32")
+        np.testing.assert_allclose(
+            paddle.to_tensor(x).cumsum(axis=1).numpy(), x.cumsum(1))
+
+    def test_std_var_unbiased(self):
+        x = np.random.rand(10).astype("float32")
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t.std().numpy(), x.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(t.var(unbiased=False).numpy(),
+                                   x.var(), rtol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_transpose_flatten(self):
+        x = np.arange(24).reshape(2, 3, 4).astype("float32")
+        t = paddle.to_tensor(x)
+        assert t.reshape([4, 6]).shape == [4, 6]
+        assert t.reshape([-1, 6]).shape == [4, 6]
+        np.testing.assert_array_equal(
+            t.transpose([2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+        assert t.flatten().shape == [24]
+        assert t.flatten(1, 2).shape == [2, 12]
+
+    def test_squeeze_unsqueeze(self):
+        t = paddle.zeros([2, 1, 3])
+        assert t.squeeze(1).shape == [2, 3]
+        assert t.unsqueeze(0).shape == [1, 2, 1, 3]
+        assert t.unsqueeze([0, 2]).shape == [1, 2, 1, 1, 3]
+
+    def test_concat_stack_split(self):
+        a = paddle.ones([2, 3])
+        b = paddle.zeros([2, 3])
+        assert paddle.concat([a, b], axis=0).shape == [4, 3]
+        assert paddle.stack([a, b], axis=0).shape == [2, 2, 3]
+        parts = paddle.split(paddle.arange(10), 2)
+        assert [p.shape for p in parts] == [[5], [5]]
+        parts = paddle.split(paddle.arange(10), [3, -1])
+        assert [p.shape for p in parts] == [[3], [7]]
+
+    def test_gather_index_select(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+        idx = paddle.to_tensor([0, 2])
+        np.testing.assert_array_equal(
+            x.gather(idx).numpy(), x.numpy()[[0, 2]])
+        np.testing.assert_array_equal(
+            x.index_select(idx, axis=1).numpy(), x.numpy()[:, [0, 2]])
+
+    def test_where(self):
+        c = paddle.to_tensor([True, False])
+        a = paddle.to_tensor([1.0, 1.0])
+        b = paddle.to_tensor([2.0, 2.0])
+        np.testing.assert_allclose(paddle.where(c, a, b).numpy(), [1, 2])
+
+    def test_topk(self):
+        x = paddle.to_tensor([[1.0, 9.0, 3.0], [7.0, 2.0, 5.0]])
+        vals, idx = paddle.topk(x, k=2)
+        np.testing.assert_allclose(vals.numpy(), [[9, 3], [7, 5]])
+        np.testing.assert_array_equal(idx.numpy(), [[1, 2], [0, 2]])
+
+    def test_sort_argsort(self):
+        x = paddle.to_tensor([3.0, 1.0, 2.0])
+        np.testing.assert_allclose(x.sort().numpy(), [1, 2, 3])
+        np.testing.assert_array_equal(x.argsort().numpy(), [1, 2, 0])
+        np.testing.assert_allclose(
+            x.sort(descending=True).numpy(), [3, 2, 1])
+
+    def test_tril_triu(self):
+        x = paddle.ones([3, 3])
+        assert x.tril().numpy().sum() == 6
+        assert x.triu(1).numpy().sum() == 3
+
+    def test_unique_nonzero_eager(self):
+        x = paddle.to_tensor([3, 1, 3, 0])
+        np.testing.assert_array_equal(x.unique().numpy(), [0, 1, 3])
+        nz = paddle.nonzero(x)
+        assert nz.shape == [3, 1]
+
+
+class TestIndexing:
+    def test_basic(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+        np.testing.assert_array_equal(x[0].numpy(), [0, 1, 2, 3])
+        np.testing.assert_array_equal(x[:, 1].numpy(), [1, 5, 9])
+        np.testing.assert_array_equal(x[1:, ::2].numpy(),
+                                      x.numpy()[1:, ::2])
+
+    def test_tensor_index(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+        idx = paddle.to_tensor([2, 0])
+        np.testing.assert_array_equal(x[idx].numpy(), x.numpy()[[2, 0]])
+
+    def test_bool_mask_getitem(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        m = x > 1.5
+        np.testing.assert_allclose(x.masked_select(m).numpy(), [2, 3])
+
+    def test_setitem(self):
+        x = paddle.zeros([3, 3])
+        x[1] = 5.0
+        assert x.numpy()[1].sum() == 15
+        x[0, 0] = paddle.to_tensor(2.0)
+        assert x.numpy()[0, 0] == 2
+
+    def test_setitem_grad_flows(self):
+        x = paddle.ones([3], dtype="float32")
+        x.stop_gradient = False
+        y = x * 2
+        y[0] = 0.0
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0, 2, 2])
+
+
+class TestInplace:
+    def test_add_(self):
+        x = paddle.ones([2])
+        x.add_(paddle.ones([2]))
+        np.testing.assert_allclose(x.numpy(), [2, 2])
+
+    def test_fill_zero(self):
+        x = paddle.ones([2, 2])
+        x.fill_(3.0)
+        assert x.numpy().sum() == 12
+        x.zero_()
+        assert x.numpy().sum() == 0
+
+    def test_set_value(self):
+        x = paddle.zeros([2, 2])
+        x.set_value(np.ones((2, 2), dtype="float32"))
+        assert x.numpy().sum() == 4
+
+
+class TestComparison:
+    def test_compare_ops(self):
+        a = paddle.to_tensor([1.0, 2.0, 3.0])
+        b = paddle.to_tensor([2.0, 2.0, 2.0])
+        np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+        np.testing.assert_array_equal((a == b).numpy(),
+                                      [False, True, False])
+        assert bool(paddle.allclose(a, a))
+
+    def test_logical(self):
+        a = paddle.to_tensor([True, False])
+        b = paddle.to_tensor([True, True])
+        np.testing.assert_array_equal((a & b).numpy(), [True, False])
+        np.testing.assert_array_equal((~a).numpy(), [False, True])
+
+
+class TestDtypePromotion:
+    def test_float_int(self):
+        a = paddle.to_tensor([1, 2])
+        b = paddle.to_tensor([0.5, 0.5])
+        assert (a + b).dtype == np.float32
+
+    def test_cast_roundtrip(self):
+        a = paddle.to_tensor([1.9])
+        assert a.astype("int64").astype("float32").item() == 1.0
+
+    def test_bfloat16(self):
+        a = paddle.to_tensor([1.0, 2.0], dtype="bfloat16")
+        assert a.dtype == paddle.bfloat16
+        assert (a + a).dtype == paddle.bfloat16

@@ -1,0 +1,4 @@
+"""paddle.distributed analog — extended at L5 (mesh/fleet/collectives)."""
+from .env import (  # noqa: F401
+    get_rank, get_world_size, init_parallel_env, is_initialized,
+)

@@ -1,0 +1,139 @@
+"""Gradient clipping (ref: python/paddle/nn/clip.py, upstream layout,
+unverified). Clip objects are attached to optimizers (grad_clip=...) and
+applied to [(param, grad)] lists before the update; the functional form is
+reused inside jitted train steps and by HybridParallelClipGrad (which psums
+the squared norm across mesh axes first)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def _clip_fn(self):
+        """Pure (grads_pytree -> grads_pytree) used by jitted steps."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max),
+                                  stop_gradient=True)))
+        return out
+
+    def _clip_fn(self):
+        import jax
+
+        def fn(grads):
+            return jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, self.min, self.max), grads)
+
+        return fn
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                 1.0)
+            out.append((p, Tensor((g._data * factor).astype(g._data.dtype),
+                                  stop_gradient=True)))
+        return out
+
+    def _clip_fn(self):
+        import jax
+
+        def fn(grads):
+            def clip_one(g):
+                norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                factor = jnp.minimum(
+                    self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                return (g * factor).astype(g.dtype)
+
+            return jax.tree_util.tree_map(clip_one, grads)
+
+        return fn
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    @staticmethod
+    def _global_norm_sq(datas):
+        return sum(jnp.sum(jnp.square(d.astype(jnp.float32)))
+                   for d in datas)
+
+    def __call__(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+        if not clippable:
+            return params_grads
+        gnorm_sq = self._global_norm_sq([g._data for _, g in clippable])
+        gnorm = jnp.sqrt(gnorm_sq)
+        factor = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._data * factor).astype(
+                    g._data.dtype), stop_gradient=True)))
+        return out
+
+    def _clip_fn(self):
+        import jax
+
+        def fn(grads):
+            leaves = jax.tree_util.tree_leaves(grads)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12),
+                                 1.0)
+            return jax.tree_util.tree_map(
+                lambda g: (g * factor).astype(g.dtype), grads)
+
+        return fn
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.power(sum(
+            jnp.sum(jnp.power(jnp.abs(g._data.astype(jnp.float32)),
+                              norm_type)) for g in grads), 1.0 / norm_type)
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = (p.grad._data * factor).astype(p.grad._data.dtype)
+    return Tensor(total)

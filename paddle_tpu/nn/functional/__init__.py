@@ -1,0 +1,469 @@
+"""nn.functional — paddle.nn.functional analog over the op registry."""
+from __future__ import annotations
+
+from ...core.dispatch import apply_op
+from ...core.rng import next_key
+from ...core.tensor import Tensor
+from ...ops.registry import get_op
+
+
+def _op(name):
+    return get_op(name)
+
+
+# ---------------------------------------------------------------- activations
+def relu(x, name=None):
+    return apply_op(_op("relu"), x)
+
+
+def relu6(x, name=None):
+    return apply_op(_op("relu6"), x)
+
+
+def relu_(x):
+    return x._inplace_op("relu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(_op("gelu"), x, approximate=approximate)
+
+
+def silu(x, name=None):
+    return apply_op(_op("silu"), x)
+
+
+def swish(x, name=None):
+    return apply_op(_op("swish"), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(_op("leaky_relu"), x, negative_slope=negative_slope)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(_op("elu"), x, alpha=alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(_op("selu"), x, scale=scale, alpha=alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(_op("celu"), x, alpha=alpha)
+
+
+def hardswish(x, name=None):
+    return apply_op(_op("hardswish"), x)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return apply_op(_op("hardsigmoid"), x, slope=slope, offset=offset)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(_op("hardtanh"), x, min=min, max=max)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(_op("hardshrink"), x, threshold=threshold)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(_op("softshrink"), x, threshold=threshold)
+
+
+def tanhshrink(x, name=None):
+    return apply_op(_op("tanhshrink"), x)
+
+
+def mish(x, name=None):
+    return apply_op(_op("mish"), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(_op("softplus"), x, beta=beta, threshold=threshold)
+
+
+def softsign(x, name=None):
+    return apply_op(_op("softsign"), x)
+
+
+def prelu(x, weight, name=None):
+    return apply_op(_op("prelu"), x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    return apply_op(_op("rrelu"), x, lower=lower, upper=upper,
+                    training=training)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = apply_op(_op("softmax"), x, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    out = apply_op(_op("log_softmax"), x, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op(_op("glu"), x, axis=axis)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return apply_op(_op("maxout"), x, groups=groups, axis=axis)
+
+
+def sigmoid(x, name=None):
+    return apply_op(_op("sigmoid"), x)
+
+
+def tanh(x, name=None):
+    return apply_op(_op("tanh"), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    g = jax.random.gumbel(next_key(), tuple(x.shape))
+    y = softmax((x + Tensor(g.astype(str(x.dtype)))) / temperature, axis=axis)
+    if hard:
+        idx = y.argmax(axis=axis)
+        hard_y = apply_op(_op("one_hot"), idx, num_classes=x.shape[axis])
+        y = (hard_y - y).detach() + y
+    return y
+
+
+# --------------------------------------------------------------- linear/conv
+def linear(x, weight, bias=None, name=None):
+    return apply_op(_op("linear"), x, weight, bias)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return apply_op(_op("conv2d"), x, weight, bias, stride=stride,
+                    padding=padding, dilation=dilation, groups=groups,
+                    data_format=data_format)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return apply_op(_op("conv1d"), x, weight, bias, stride=stride,
+                    padding=padding, dilation=dilation, groups=groups,
+                    data_format=data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return apply_op(_op("conv3d"), x, weight, bias, stride=stride,
+                    padding=padding, dilation=dilation, groups=groups,
+                    data_format=data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", name=None):
+    return apply_op(_op("conv2d_transpose"), x, weight, bias, stride=stride,
+                    padding=padding, output_padding=output_padding,
+                    dilation=dilation, groups=groups, data_format=data_format)
+
+
+# ------------------------------------------------------------------- pooling
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return apply_op(_op("max_pool2d"), x, kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode,
+                    data_format=data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return apply_op(_op("avg_pool2d"), x, kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode,
+                    count_include_pad=not exclusive, data_format=data_format)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return apply_op(_op("adaptive_avg_pool2d"), x, output_size=output_size,
+                    data_format=data_format)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return apply_op(_op("adaptive_max_pool2d"), x, output_size=output_size)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               name=None):
+    return apply_op(_op("max_pool1d"), x, kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               name=None):
+    return apply_op(_op("avg_pool1d"), x, kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode)
+
+
+# ------------------------------------------------------------- norm/dropout
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        n_axes = 1
+    else:
+        n_axes = len(list(normalized_shape))
+    return apply_op(_op("layer_norm"), x, weight, bias, epsilon=epsilon,
+                    begin_norm_axis=x.ndim - n_axes)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    return apply_op(_op("rms_norm"), x, weight, epsilon=epsilon)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    use_stats = (not training) if use_global_stats is None else \
+        use_global_stats
+    if use_stats:
+        return apply_op(_op("batch_norm_infer"), x, running_mean, running_var,
+                        weight, bias, epsilon=epsilon,
+                        data_format=data_format)
+    out, batch_mean, batch_var = apply_op(
+        _op("batch_norm_train"), x, weight, bias, epsilon=epsilon,
+        data_format=data_format)
+    if running_mean is not None:
+        running_mean._data = (momentum * running_mean._data +
+                              (1.0 - momentum) * batch_mean._data)
+        running_var._data = (momentum * running_var._data +
+                             (1.0 - momentum) * batch_var._data)
+    return out
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    return apply_op(_op("group_norm"), x, weight, bias,
+                    num_groups=num_groups, epsilon=epsilon,
+                    data_format=data_format)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    return apply_op(_op("instance_norm"), x, weight, bias, epsilon=eps)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return apply_op(_op("local_response_norm"), x, size=size, alpha=alpha,
+                    beta=beta, k=k)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply_callable
+
+    def fn(v):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis,
+                              keepdims=True), 1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply_callable("normalize", fn, x)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    return apply_op(_op("dropout"), x, key, p=p, training=training,
+                    mode=mode, axis=axis)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply_callable
+
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = 1.0 / jnp.sqrt((alpha_p ** 2 * p + 1.0) * (1.0 - p))
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, jnp.asarray(alpha_p, v.dtype)) + b
+
+    return apply_callable("alpha_dropout", fn, x)
+
+
+# -------------------------------------------------------------- emb/padding
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return apply_op(_op("embedding"), x, weight, padding_idx=padding_idx,
+                    sparse=sparse)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(_op("one_hot"), x, num_classes=num_classes)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return apply_op(_op("pad"), x, pad=list(pad), mode=mode, value=value,
+                    data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    return apply_op(_op("interpolate"), x, size=size,
+                    scale_factor=scale_factor, mode=mode,
+                    align_corners=align_corners, data_format=data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return apply_op(_op("pixel_shuffle"), x, upscale_factor=upscale_factor)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return apply_op(_op("unfold"), x, kernel_sizes=kernel_sizes,
+                    strides=strides, paddings=paddings, dilations=dilations)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    return apply_op(_op("temporal_shift"), x, seg_num=seg_num,
+                    shift_ratio=shift_ratio)
+
+
+# -------------------------------------------------------------------- losses
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  label_smoothing=0.0, name=None):
+    return apply_op(_op("cross_entropy"), input, label, weight,
+                    soft_label=soft_label, axis=axis,
+                    ignore_index=ignore_index, reduction=reduction,
+                    label_smoothing=label_smoothing)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, axis=axis,
+                         reduction="none")
+    if loss.ndim == logits.ndim - 1:
+        loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return apply_op(_op("nll_loss"), input, label, weight,
+                    ignore_index=ignore_index, reduction=reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(_op("mse_loss"), input, label, reduction=reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(_op("l1_loss"), input, label, reduction=reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return apply_op(_op("smooth_l1_loss"), input, label,
+                    reduction=reduction, delta=delta)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    return apply_op(_op("binary_cross_entropy"), input, label, weight,
+                    reduction=reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    return apply_op(_op("binary_cross_entropy_with_logits"), logit, label,
+                    weight, reduction=reduction, pos_weight=pos_weight)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    return apply_op(_op("kl_div"), input, label, reduction=reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    return apply_op(_op("sigmoid_focal_loss"), logit, label, normalizer,
+                    alpha=alpha, gamma=gamma, reduction=reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply_op(_op("margin_ranking_loss"), input, other, label,
+                    margin=margin, reduction=reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    return apply_op(_op("hinge_embedding_loss"), input, label, margin=margin,
+                    reduction=reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply_op(_op("cosine_similarity"), x1, x2, axis=axis, eps=eps)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return apply_op(_op("label_smooth"), label, epsilon=epsilon,
+                    prior_dist=prior_dist)
+
+
+def square_error_cost(input, label):
+    return apply_op(_op("square_error_cost"), input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return apply_op(_op("npair_loss"), anchor, positive, labels,
+                    l2_reg=l2_reg)
+
+
+# ----------------------------------------------------------------- attention
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Layout: (batch, seqlen, num_heads, head_dim) — paddle flash_attention
+    layout. Dispatches to the Pallas flash kernel on TPU when available."""
+    from ...ops import pallas_kernels
+
+    if pallas_kernels.flash_attention_available(query, key, value, attn_mask):
+        return pallas_kernels.flash_attention(query, key, value,
+                                              is_causal=is_causal)
+    return apply_op(_op("scaled_dot_product_attention"), query, key, value,
+                    attn_mask, dropout_p=dropout_p, is_causal=is_causal)

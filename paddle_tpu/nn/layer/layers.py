@@ -1,0 +1,374 @@
+"""nn.Layer — the module base class (≈ torch.nn.Module).
+
+Ref: python/paddle/nn/layer/layers.py (upstream layout, unverified — mount
+empty). Holds Parameters/buffers/sublayers with paddle's exact API surface
+(create_parameter, add_sublayer, state_dict, train/eval, hooks).
+
+TPU note: layers are stateful python objects for the imperative API; the jit
+path extracts a functional (params, buffers) pytree via
+paddle_tpu.jit.functionalize and re-binds it under tracing, so one Layer
+definition serves both dygraph and compiled execution.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ...core.dtype import convert_dtype, get_default_dtype
+from ...core.tensor import Parameter, Tensor
+from .. import initializer as I
+
+
+class ParamAttr:
+    """paddle.ParamAttr analog."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"cannot convert {attr!r} to ParamAttr")
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+_LAYER_COUNTER = collections.defaultdict(int)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        cls = type(self).__name__.lower()
+        _LAYER_COUNTER[cls] += 1
+        self._full_name = name_scope or f"{cls}_{_LAYER_COUNTER[cls] - 1}"
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._parameters: Dict[str, Optional[Parameter]] = \
+            collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self.training = True
+        self._forward_pre_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._hook_id = 0
+
+    # --------------------------------------------------------------- params
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = (I.global_bias_init() if is_bias else
+                    I.global_weight_init())
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        data = init(shape, dtype)
+        p = Parameter(data, name=attr.name or "", trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[name] = parameter
+        if parameter is not None and not parameter.name:
+            parameter.name = f"{self._full_name}.{name}"
+        return parameter
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    # ------------------------------------------------------------ attribute
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise AttributeError("call Layer.__init__ first")
+            params[name] = value
+            if not value.name:
+                value.name = f"{self._full_name}.{name}"
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise AttributeError("call Layer.__init__ first")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            params[name] = value
+        elif layers is not None and name in layers:
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        if "_parameters" in self.__dict__ and name in self.__dict__["_parameters"]:
+            return self.__dict__["_parameters"][name]
+        if "_sub_layers" in self.__dict__ and name in self.__dict__["_sub_layers"]:
+            return self.__dict__["_sub_layers"][name]
+        if "_buffers" in self.__dict__ and name in self.__dict__["_buffers"]:
+            return self.__dict__["_buffers"][name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        if name in self._parameters:
+            del self._parameters[name]
+        elif name in self._sub_layers:
+            del self._sub_layers[name]
+        elif name in self._buffers:
+            del self._buffers[name]
+        else:
+            object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # ------------------------------------------------------------ iteration
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, l in self.named_children():
+            if l is None or id(l) in layers_set:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=True,
+                                         layers_set=layers_set)
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for lp, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{lp}.{name}" if lp else name), p
+
+    def buffers(self, include_sublayers=True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{lp}.{name}" if lp else name), b
+
+    # ------------------------------------------------------------ modes/etc
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dtype = convert_dtype(dtype)
+            for _, p in self.named_parameters():
+                if np.issubdtype(p.dtype, np.floating):
+                    p._data = p._data.astype(dtype)
+            for _, b in self.named_buffers():
+                if b is not None and np.issubdtype(b.dtype, np.floating):
+                    b._data = b._data.astype(dtype)
+            self._dtype = dtype
+        if device is not None:
+            import jax
+
+            from ...core.place import Place, set_device
+
+            place = device if isinstance(device, Place) else None
+            if place is None:
+                from ...core.place import CPUPlace, TPUPlace
+
+                place = CPUPlace(0) if str(device).startswith("cpu") \
+                    else TPUPlace(0)
+            dev = place.jax_device()
+            for _, p in self.named_parameters():
+                p._data = jax.device_put(p._data, dev)
+            for _, b in self.named_buffers():
+                if b is not None:
+                    b._data = jax.device_put(b._data, dev)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # ----------------------------------------------------------- state_dict
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else \
+            collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            short = name.rsplit(".", 1)[-1]
+            # find owning layer to check persistability
+            dest[name] = b
+        # drop non-persistable buffers
+        non_persist = set()
+        for lp, layer in self.named_sublayers(include_self=True):
+            for bname in layer._non_persistable_buffer_names:
+                non_persist.add(f"{lp}.{bname}" if lp else bname)
+        for k in non_persist:
+            dest.pop(k, None)
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            target = own[k]
+            arr = v._data if isinstance(v, Tensor) else np.asarray(v)
+            import jax.numpy as jnp
+
+            target._data = jnp.asarray(arr, dtype=target._data.dtype).reshape(
+                target._data.shape)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -------------------------------------------------------------- forward
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self.named_children():
+            child = repr(l).split("\n")
+            child = [child[0]] + ["  " + c for c in child[1:]]
+            lines.append(f"  ({name}): " + "\n".join(child))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"

@@ -1,0 +1,282 @@
+"""Recurrent layers (SimpleRNN/LSTM/GRU) built on lax.scan — XLA-friendly
+sequential control flow (no python loops under jit). Ref:
+python/paddle/nn/layer/rnn.py (upstream layout, unverified)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_callable
+from ...core.tensor import Tensor
+from ...tensor.creation import zeros
+from .. import initializer as I
+from .layers import Layer
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, n_gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / (hidden_size ** 0.5)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [n_gates * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [n_gates * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [n_gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [n_gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1, **kw)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size])
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wih, whh, bih, bhh):
+            return act(x @ wih.T + bih + h @ whh.T + bhh)
+
+        out = apply_callable("simple_rnn_cell", fn, inputs, states,
+                             self.weight_ih, self.weight_hh, self.bias_ih,
+                             self.bias_hh)
+        return out, out
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4, **kw)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = zeros([inputs.shape[0], self.hidden_size])
+            c = zeros([inputs.shape[0], self.hidden_size])
+        else:
+            h, c = states
+
+        def fn(x, h_, c_, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + h_ @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c_ + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply_callable("lstm_cell", fn, inputs, h, c,
+                                      self.weight_ih, self.weight_hh,
+                                      self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3, **kw)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size])
+
+        def fn(x, h, wih, whh, bih, bhh):
+            gi = x @ wih.T + bih
+            gh = h @ whh.T + bhh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            return (1.0 - z) * n + z * h
+
+        out = apply_callable("gru_cell", fn, inputs, states, self.weight_ih,
+                             self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class RNN(Layer):
+    """Wraps a cell into a layer scanning over time (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        outputs = []
+        states = initial_states
+        idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for i in idx:
+            x_t = inputs[:, i] if time_axis == 1 else inputs[i]
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        from ...tensor import stack
+
+        out = stack(outputs, axis=time_axis)
+        return out, states
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrent net over lax.scan."""
+
+    _MODE = ""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirectional else 1
+        self.num_directions = num_dirs
+        n_gates = {"RNN_TANH": 1, "RNN_RELU": 1, "LSTM": 4, "GRU": 3}[
+            self._MODE]
+        std = 1.0 / (hidden_size ** 0.5)
+        u = I.Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_size = input_size if layer == 0 else hidden_size * num_dirs
+                suffix = "_reverse" if d == 1 else ""
+                self.add_parameter(
+                    f"weight_ih_l{layer}{suffix}",
+                    self.create_parameter([n_gates * hidden_size, in_size],
+                                          default_initializer=u))
+                self.add_parameter(
+                    f"weight_hh_l{layer}{suffix}",
+                    self.create_parameter(
+                        [n_gates * hidden_size, hidden_size],
+                        default_initializer=u))
+                self.add_parameter(
+                    f"bias_ih_l{layer}{suffix}",
+                    self.create_parameter([n_gates * hidden_size],
+                                          is_bias=True,
+                                          default_initializer=u))
+                self.add_parameter(
+                    f"bias_hh_l{layer}{suffix}",
+                    self.create_parameter([n_gates * hidden_size],
+                                          is_bias=True,
+                                          default_initializer=u))
+
+    def _cell_fn(self):
+        mode = self._MODE
+
+        def step(carry, x_t, wih, whh, bih, bhh):
+            if mode == "LSTM":
+                h, c = carry
+                gates = x_t @ wih.T + bih + h @ whh.T + bhh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                           jax.nn.sigmoid(o))
+                c_new = f * c + i * jnp.tanh(g)
+                h_new = o * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+            if mode == "GRU":
+                h = carry
+                gi = x_t @ wih.T + bih
+                gh = h @ whh.T + bhh
+                ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+                hr, hz, hn = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                n = jnp.tanh(in_ + r * hn)
+                h_new = (1.0 - z) * n + z * h
+                return h_new, h_new
+            h = carry
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+            h_new = act(x_t @ wih.T + bih + h @ whh.T + bhh)
+            return h_new, h_new
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self._MODE
+        time_major = self.time_major
+        num_layers = self.num_layers
+        num_dirs = self.num_directions
+        hidden = self.hidden_size
+        step = self._cell_fn()
+        weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                suffix = "_reverse" if d == 1 else ""
+                weights += [getattr(self, f"weight_ih_l{layer}{suffix}"),
+                            getattr(self, f"weight_hh_l{layer}{suffix}"),
+                            getattr(self, f"bias_ih_l{layer}{suffix}"),
+                            getattr(self, f"bias_hh_l{layer}{suffix}")]
+
+        def fn(x, *ws):
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)  # (T, B, F)
+            batch = xs.shape[1]
+            final_h, final_c = [], []
+            for layer in range(num_layers):
+                outs = []
+                for d in range(num_dirs):
+                    wi = 4 * (layer * num_dirs + d)
+                    wih, whh, bih, bhh = ws[wi:wi + 4]
+                    h0 = jnp.zeros((batch, hidden), xs.dtype)
+                    carry = (h0, jnp.zeros_like(h0)) if mode == "LSTM" else h0
+                    seq = jnp.flip(xs, 0) if d == 1 else xs
+
+                    def f(c, x_t, wih=wih, whh=whh, bih=bih, bhh=bhh):
+                        return step(c, x_t, wih, whh, bih, bhh)
+
+                    carry, ys = jax.lax.scan(f, carry, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs.append(ys)
+                    if mode == "LSTM":
+                        final_h.append(carry[0])
+                        final_c.append(carry[1])
+                    else:
+                        final_h.append(carry)
+                xs = outs[0] if num_dirs == 1 else jnp.concatenate(outs, -1)
+            out = xs if time_major else jnp.swapaxes(xs, 0, 1)
+            h_stack = jnp.stack(final_h, 0)
+            if mode == "LSTM":
+                return out, h_stack, jnp.stack(final_c, 0)
+            return out, h_stack
+
+        result = apply_callable(f"rnn_{mode.lower()}", fn, inputs, *weights)
+        if mode == "LSTM":
+            out, h, c = result
+            return out, (h, c)
+        out, h = result
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    _MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        self._MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    _MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    _MODE = "GRU"

@@ -1,0 +1,38 @@
+"""nn.utils — weight_norm/spectral_norm/parameter vector helpers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Simplified weight norm: reparameterize at attach time (static)."""
+    import warnings
+
+    warnings.warn("paddle_tpu weight_norm applies a one-time normalization; "
+                  "full reparameterized training support is pending")
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    import warnings
+
+    warnings.warn("paddle_tpu spectral_norm is a stub")
+    return layer
+
+
+def parameters_to_vector(parameters):
+    datas = [p._data.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(datas))
+
+
+def vector_to_parameters(vec, parameters):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._data = vec._data[offset:offset + n].reshape(p._data.shape).astype(
+            p._data.dtype)
+        offset += n

@@ -551,8 +551,8 @@ def linear(x, weight, bias=None):
 
 
 @register_op("cross_entropy", amp_list="black")
-def cross_entropy(logits, label, soft_label=False, axis=-1,
-                  ignore_index=-100, reduction="mean", weight=None,
+def cross_entropy(logits, label, weight=None, soft_label=False, axis=-1,
+                  ignore_index=-100, reduction="mean",
                   label_smoothing=0.0):
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=axis)

@@ -1,0 +1,129 @@
+"""Pallas TPU kernels — the PHI `fusion/` + flash-attention analog (ref:
+paddle/phi/kernels/gpu/flash_attn_kernel.cu over the external flashattn lib,
+upstream layout, unverified — mount empty).
+
+Selection policy: the functional layer calls *_available() first; on
+non-TPU backends or awkward shapes we fall back to the jnp reference op and
+let XLA fuse. The kernels themselves follow the pallas_guide.md playbook:
+block over (seq_q,) grid, keep K/V tiles in VMEM, online-softmax accumulation
+in fp32.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK_Q = 512
+_BLOCK_K = 512
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform not in ("cpu",)
+    except RuntimeError:
+        return False
+
+
+def flash_attention_available(q, k, v, attn_mask=None) -> bool:
+    if attn_mask is not None:
+        return False
+    if not _on_tpu():
+        return False
+    qd = q._data if hasattr(q, "_data") else q
+    kd = k._data if hasattr(k, "_data") else k
+    b, sq, h, d = qd.shape
+    sk = kd.shape[1]
+    # MXU-friendly shapes only; otherwise the XLA reference path is fine.
+    return d % 128 == 0 and sq % _BLOCK_Q == 0 and sk % _BLOCK_K == 0
+
+
+@functools.partial(jax.jit, static_argnames=("is_causal",))
+def _flash_attention_data(q, k, v, is_causal=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    # layout: (b, h, s, d) for blocking
+    qt = jnp.einsum("bshd->bhsd", q)
+    kt = jnp.einsum("bshd->bhsd", k)
+    vt = jnp.einsum("bshd->bhsd", v)
+
+    block_q = min(_BLOCK_Q, sq)
+    block_k = min(_BLOCK_K, sk)
+    n_q = sq // block_q
+    n_k = sk // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+        qi = pl.program_id(2)
+        ki = pl.program_id(3)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        qblk = q_ref[0, 0].astype(jnp.float32) * scale
+        kblk = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qblk, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if is_causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        m_prev = m_ref[...]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = m_cur
+        vblk = v_ref[0, 0].astype(jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(ki == n_k - 1)
+        def _done():
+            o_ref[0, 0] = (acc_ref[...] /
+                           jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+    grid = (b, h, n_q, n_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+    )(qt, kt, vt)
+    return jnp.einsum("bhsd->bshd", out)
+
+
+def flash_attention(q, k, v, is_causal=False):
+    """Tensor-level wrapper used by nn.functional."""
+    from ..core.dispatch import apply_callable
+
+    def fn(qd, kd, vd):
+        return _flash_attention_data(qd, kd, vd, is_causal=is_causal)
+
+    return apply_callable("flash_attention", fn, q, k, v)

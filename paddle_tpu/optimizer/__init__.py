@@ -1,0 +1,495 @@
+"""Optimizers (ref: python/paddle/optimizer/, upstream layout, unverified).
+
+Design: each optimizer defines a *pure* per-parameter update rule
+(`_apply_update`). The eager `step()` runs one jitted function over the whole
+parameter pytree (single XLA dispatch per step — the analog of Paddle's fused
+optimizer kernels), and jitted training paths (hapi/fleet) call
+`functional_step` with explicit state, so numerics are identical in both
+modes. State lives in `_accumulators[param_name][slot]` as jax arrays.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.flags import get_flag
+from ..core.tensor import Parameter, Tensor
+from . import lr as lr_mod
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
+    "AdamWDL", "RMSProp", "Adadelta", "Lamb", "LRScheduler", "lr",
+]
+
+lr = lr_mod
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    _slot_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode "
+                "(pass model.parameters())")
+        # param groups support
+        self._param_groups = []
+        if parameters and isinstance(parameters[0], dict):
+            flat = []
+            for group in parameters:
+                g = dict(group)
+                g["params"] = list(group["params"])
+                flat.extend(g["params"])
+                self._param_groups.append(g)
+            self._parameter_list = flat
+        else:
+            self._parameter_list = list(parameters)
+            self._param_groups = [{"params": self._parameter_list}]
+        self._learning_rate = learning_rate
+        self.regularization = None
+        if isinstance(weight_decay, float):
+            self.regularization = L2Decay(weight_decay)
+        elif weight_decay is not None:
+            self.regularization = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[str, Dict[str, jax.Array]] = {}
+        self._step_count = 0
+        self._name = name
+        self._param_name_cache = {}
+        self._jit_cache = {}
+
+    # ----------------------------------------------------------------- hooks
+    def _create_accumulators(self, p_data) -> Dict[str, jax.Array]:
+        return {}
+
+    def _apply_update(self, p, g, acc: Dict, lr_val, t, lr_scale=1.0):
+        """Pure: (param, grad, slots, lr, step) -> (new_param, new_slots)."""
+        raise NotImplementedError
+
+    def _decoupled_decay(self) -> float:
+        """AdamW-style decoupled weight decay coefficient (0 = coupled)."""
+        return 0.0
+
+    # ------------------------------------------------------------------- lr
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate.last_lr
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ------------------------------------------------------------- step path
+    def _param_name(self, p: Parameter) -> str:
+        key = id(p)
+        if key not in self._param_name_cache:
+            name = p.name or f"param_{len(self._param_name_cache)}"
+            if name in {v for v in self._param_name_cache.values()}:
+                name = f"{name}_{len(self._param_name_cache)}"
+            self._param_name_cache[key] = name
+        return self._param_name_cache[key]
+
+    def _ensure_accumulators(self, p: Parameter):
+        name = self._param_name(p)
+        if name not in self._accumulators:
+            acc = self._create_accumulators(p._data)
+            if self._multi_precision and jnp.issubdtype(
+                    p._data.dtype, jnp.floating) and \
+                    p._data.dtype != jnp.float32:
+                acc["master_weight"] = p._data.astype(jnp.float32)
+            self._accumulators[name] = acc
+        return self._accumulators[name]
+
+    def _update_tree(self, p_datas, g_datas, accs, lr_val, t, lr_scales,
+                     coupled_wd, decoupled_wd, clip_fn):
+        # 1. coupled regularization (L2 adds wd*p to grad)
+        if coupled_wd:
+            g_datas = [g + coupled_wd * p.astype(g.dtype)
+                       for p, g in zip(p_datas, g_datas)]
+        # 2. gradient clipping
+        if clip_fn is not None:
+            g_datas = clip_fn(g_datas)
+        # 3. per-param update
+        new_ps, new_accs = [], []
+        for p, g, acc, s in zip(p_datas, g_datas, accs, lr_scales):
+            master = acc.pop("master_weight", None)
+            work_p = master if master is not None else p
+            if decoupled_wd:
+                work_p = work_p * (1.0 - lr_val * decoupled_wd)
+            np_, nacc = self._apply_update(work_p, g.astype(jnp.float32)
+                                          if master is not None else g,
+                                          acc, lr_val, t, lr_scale=s)
+            if master is not None:
+                nacc["master_weight"] = np_
+                np_ = np_.astype(p.dtype)
+            new_ps.append(np_)
+            new_accs.append(nacc)
+        return new_ps, new_accs
+
+    def step(self):
+        params = [p for p in self._parameter_list
+                  if p.trainable and p.grad is not None]
+        if not params:
+            self._post_step()
+            return
+        self._step_count += 1
+        for p in params:
+            self._ensure_accumulators(p)
+        names = [self._param_name(p) for p in params]
+        p_datas = [p._data for p in params]
+        g_datas = [p.grad._data for p in params]
+        accs = [dict(self._accumulators[n]) for n in names]
+        lr_scales = tuple(p.optimize_attr.get("learning_rate", 1.0)
+                          for p in params)
+        coupled = self.regularization.coeff if isinstance(
+            self.regularization, L2Decay) else 0.0
+        decoupled = self._decoupled_decay()
+        clip_fn = self._grad_clip._clip_fn() if self._grad_clip is not None \
+            else None
+
+        cache_key = (tuple((d.shape, str(d.dtype)) for d in p_datas),
+                     lr_scales, bool(clip_fn))
+        if cache_key not in self._jit_cache:
+            def jitted(p_list, g_list, acc_list, lr_val, t):
+                return self._update_tree(p_list, g_list, acc_list, lr_val, t,
+                                         lr_scales, coupled, decoupled,
+                                         clip_fn)
+
+            self._jit_cache[cache_key] = jax.jit(jitted)
+        lr_val = jnp.asarray(self.get_lr(), dtype=jnp.float32)
+        t = jnp.asarray(self._step_count, dtype=jnp.int32)
+        new_ps, new_accs = self._jit_cache[cache_key](
+            p_datas, g_datas, accs, lr_val, t)
+        for p, name, np_, nacc in zip(params, names, new_ps, new_accs):
+            p._data = np_
+            self._accumulators[name] = nacc
+        self._post_step()
+
+    def _post_step(self):
+        pass
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    # -------------------------------------------------- functional (jit) API
+    def functional_state(self, params_dict):
+        """Initial optimizer state for a functional train step:
+        {param_name: {slot: array}}"""
+        state = {}
+        for name, data in params_dict.items():
+            acc = self._create_accumulators(data)
+            if self._multi_precision and jnp.issubdtype(
+                    data.dtype, jnp.floating) and data.dtype != jnp.float32:
+                acc["master_weight"] = data.astype(jnp.float32)
+            state[name] = acc
+        return state
+
+    def functional_step(self, params_dict, grads_dict, state, lr_val, t):
+        """Pure: used inside jitted train steps (hapi/fleet). Applies
+        regularization, clipping and the update rule exactly as step()."""
+        names = list(params_dict.keys())
+        p_datas = [params_dict[n] for n in names]
+        g_datas = [grads_dict[n] for n in names]
+        accs = [dict(state[n]) for n in names]
+        coupled = self.regularization.coeff if isinstance(
+            self.regularization, L2Decay) else 0.0
+        clip_fn = self._grad_clip._clip_fn() if self._grad_clip is not None \
+            else None
+        new_ps, new_accs = self._update_tree(
+            p_datas, g_datas, accs, lr_val, t, (1.0,) * len(names), coupled,
+            self._decoupled_decay(), clip_fn)
+        return (dict(zip(names, new_ps)),
+                {n: a for n, a in zip(names, new_accs)})
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self):
+        out = {}
+        for pname, acc in self._accumulators.items():
+            for slot, arr in acc.items():
+                out[f"{pname}.{slot}"] = Tensor(arr)
+        out["@step_count"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step_count", 0))
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for key, val in state_dict.items():
+            if key in ("@step_count", "LR_Scheduler"):
+                continue
+            pname, slot = key.rsplit(".", 1)
+            arr = val._data if isinstance(val, Tensor) else jnp.asarray(
+                np.asarray(val))
+            self._accumulators.setdefault(pname, {})[slot] = arr
+
+    def _accumulators_for(self, p):
+        return self._ensure_accumulators(p)
+
+
+class SGD(Optimizer):
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        return p - (lr_val * lr_scale) * g.astype(p.dtype), acc
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, p_data):
+        return {"velocity": jnp.zeros_like(
+            p_data, dtype=jnp.float32 if self._multi_precision
+            else p_data.dtype)}
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g = g.astype(p.dtype)
+        v = self._momentum * acc["velocity"].astype(p.dtype) + g
+        if self._use_nesterov:
+            new_p = p - (lr_val * lr_scale) * (g + self._momentum * v)
+        else:
+            new_p = p - (lr_val * lr_scale) * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self, p_data):
+        return {"moment": jnp.full_like(p_data, self._init_acc,
+                                        dtype=jnp.float32)}
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g32 = g.astype(jnp.float32)
+        m = acc["moment"] + jnp.square(g32)
+        new_p = p - ((lr_val * lr_scale) * g32 /
+                     (jnp.sqrt(m) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _create_accumulators(self, p_data):
+        acc = {
+            "moment1": jnp.zeros_like(p_data, dtype=jnp.float32),
+            "moment2": jnp.zeros_like(p_data, dtype=jnp.float32),
+        }
+        if self._amsgrad:
+            acc["moment2_max"] = jnp.zeros_like(p_data, dtype=jnp.float32)
+        return acc
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g32 = g.astype(jnp.float32)
+        m1 = self._beta1 * acc["moment1"] + (1 - self._beta1) * g32
+        m2 = self._beta2 * acc["moment2"] + (1 - self._beta2) * \
+            jnp.square(g32)
+        t_f = t.astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(self._beta1, t_f)
+        bc2 = 1.0 - jnp.power(self._beta2, t_f)
+        m1_hat = m1 / bc1
+        if self._amsgrad:
+            m2_max = jnp.maximum(acc["moment2_max"], m2)
+            m2_hat = m2_max / bc2
+            new_acc = {"moment1": m1, "moment2": m2, "moment2_max": m2_max}
+        else:
+            m2_hat = m2 / bc2
+            new_acc = {"moment1": m1, "moment2": m2}
+        upd = (lr_val * lr_scale) * m1_hat / (jnp.sqrt(m2_hat) +
+                                              self._epsilon)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), new_acc
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._wd_coeff = float(weight_decay) if isinstance(
+            weight_decay, (int, float)) else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_decay(self):
+        return self._wd_coeff
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, p_data):
+        return {"moment": jnp.zeros_like(p_data, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(p_data, dtype=jnp.float32)}
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * acc["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * acc["inf_norm"], jnp.abs(g32))
+        t_f = t.astype(jnp.float32)
+        lr_t = (lr_val * lr_scale) / (1.0 - jnp.power(self._beta1, t_f))
+        new_p = (p.astype(jnp.float32) -
+                 lr_t * m / (u + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, p_data):
+        acc = {"mean_square": jnp.zeros_like(p_data, dtype=jnp.float32),
+               "momentum": jnp.zeros_like(p_data, dtype=jnp.float32)}
+        if self._centered:
+            acc["mean_grad"] = jnp.zeros_like(p_data, dtype=jnp.float32)
+        return acc
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * acc["mean_square"] + (1 - self._rho) * \
+            jnp.square(g32)
+        if self._centered:
+            mg = self._rho * acc["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            new_acc = {"mean_square": ms, "mean_grad": mg}
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+            new_acc = {"mean_square": ms}
+        mom = self._momentum * acc["momentum"] + \
+            (lr_val * lr_scale) * g32 / denom
+        new_acc["momentum"] = mom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), new_acc
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, p_data):
+        return {"avg_squared_grad": jnp.zeros_like(p_data,
+                                                   dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p_data,
+                                                     dtype=jnp.float32)}
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * acc["avg_squared_grad"] + \
+            (1 - self._rho) * jnp.square(g32)
+        upd = g32 * jnp.sqrt(acc["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * acc["avg_squared_update"] + \
+            (1 - self._rho) * jnp.square(upd)
+        new_p = (p.astype(jnp.float32) - (lr_val * lr_scale) * upd).astype(
+            p.dtype)
+        return new_p, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+
+    def _create_accumulators(self, p_data):
+        return {"moment1": jnp.zeros_like(p_data, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p_data, dtype=jnp.float32)}
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m1 = self._beta1 * acc["moment1"] + (1 - self._beta1) * g32
+        m2 = self._beta2 * acc["moment2"] + (1 - self._beta2) * \
+            jnp.square(g32)
+        t_f = t.astype(jnp.float32)
+        m1_hat = m1 / (1.0 - jnp.power(self._beta1, t_f))
+        m2_hat = m2 / (1.0 - jnp.power(self._beta2, t_f))
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon) + \
+            self._lamb_wd * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = (p32 - (lr_val * lr_scale) * trust * r).astype(p.dtype)
+        return new_p, {"moment1": m1, "moment2": m2}
+
+
+AdamWDL = AdamW  # incubate alias

@@ -1,0 +1,244 @@
+"""nn.Layer machinery + layer numerics vs NumPy references."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_parameters_registration(self):
+        l = nn.Linear(3, 4)
+        assert len(l.parameters()) == 2
+        names = dict(l.named_parameters())
+        assert "weight" in names and "bias" in names
+        assert l.weight.shape == [3, 4]
+        assert l.bias.shape == [4]
+
+    def test_sublayer_nesting(self):
+        net = nn.Sequential(nn.Linear(2, 3), nn.Sequential(nn.Linear(3, 4)))
+        assert len(net.parameters()) == 4
+        assert len(list(net.named_sublayers())) == 3
+
+    def test_state_dict_roundtrip(self):
+        a = nn.Linear(3, 4)
+        b = nn.Linear(3, 4)
+        b.set_state_dict(a.state_dict())
+        np.testing.assert_array_equal(a.weight.numpy(), b.weight.numpy())
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Dropout(0.5), nn.Sequential(nn.Dropout(0.5)))
+        net.eval()
+        assert all(not l.training for l in net.sublayers())
+        net.train()
+        assert all(l.training for l in net.sublayers())
+
+    def test_apply(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+        seen = []
+        net.apply(lambda l: seen.append(type(l).__name__))
+        assert seen.count("Linear") == 2
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        l(paddle.randn([1, 2]))
+        assert calls == [1]
+        h.remove()
+        l(paddle.randn([1, 2]))
+        assert calls == [1]
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm2D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_to_dtype(self):
+        l = nn.Linear(2, 2).to(dtype="bfloat16")
+        assert l.weight.dtype == paddle.bfloat16
+
+
+class TestLayerNumerics:
+    def test_linear_matches_numpy(self):
+        l = nn.Linear(3, 4)
+        x = np.random.rand(5, 3).astype("float32")
+        out = l(paddle.to_tensor(x)).numpy()
+        ref = x @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_conv2d_matches_simple_case(self):
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        conv.weight.set_value(np.ones((1, 1, 2, 2), "float32"))
+        x = np.arange(9, dtype="float32").reshape(1, 1, 3, 3)
+        out = conv(paddle.to_tensor(x)).numpy()
+        # each output = sum of 2x2 window
+        expected = np.array([[[[0 + 1 + 3 + 4, 1 + 2 + 4 + 5],
+                               [3 + 4 + 6 + 7, 4 + 5 + 7 + 8]]]],
+                            dtype="float32")
+        np.testing.assert_allclose(out, expected)
+
+    def test_conv2d_grouped(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        out = conv(paddle.randn([2, 4, 5, 5]))
+        assert out.shape == [2, 8, 5, 5]
+
+    def test_conv2d_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+        out = deconv(paddle.randn([2, 3, 8, 8]))
+        assert out.shape == [2, 6, 16, 16]
+
+    def test_layer_norm(self):
+        ln = nn.LayerNorm(8)
+        x = np.random.rand(4, 8).astype("float32") * 5
+        out = ln(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_batch_norm_train_and_eval(self):
+        bn = nn.BatchNorm2D(3, momentum=0.5)
+        x = paddle.randn([8, 3, 4, 4])
+        out = bn(x)
+        np.testing.assert_allclose(
+            out.numpy().mean(axis=(0, 2, 3)), 0, atol=1e-4)
+        # running stats moved away from init
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [8, 3, 4, 4]
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor([0, 1]))
+        np.testing.assert_allclose(out.numpy()[0], 0)
+
+    def test_dropout_train_vs_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        out = d(x)
+        frac_zero = float((out == 0).astype("float32").mean())
+        assert 0.3 < frac_zero < 0.7
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_maxpool_avgpool(self):
+        x = paddle.to_tensor(
+            np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2)(x)
+        np.testing.assert_allclose(mp.numpy().reshape(-1), [5, 7, 13, 15])
+        ap = nn.AvgPool2D(2)(x)
+        np.testing.assert_allclose(ap.numpy().reshape(-1),
+                                   [2.5, 4.5, 10.5, 12.5])
+
+    def test_adaptive_avg_pool(self):
+        out = nn.AdaptiveAvgPool2D(1)(paddle.randn([2, 3, 7, 7]))
+        assert out.shape == [2, 3, 1, 1]
+
+    def test_softmax_layer(self):
+        out = nn.Softmax()(paddle.randn([3, 5]))
+        np.testing.assert_allclose(out.numpy().sum(-1), 1, rtol=1e-5)
+
+    def test_activations_shapes(self):
+        x = paddle.randn([4, 4])
+        for cls in [nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.SiLU,
+                    nn.LeakyReLU, nn.ELU, nn.Hardswish, nn.Mish,
+                    nn.Softplus]:
+            assert cls()(x).shape == [4, 4]
+
+    def test_mha_self_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        out = mha(paddle.randn([2, 5, 16]))
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.randn([2, 5, 16]))
+        assert out.shape == [2, 5, 16]
+
+    def test_lstm_gru(self):
+        out, (h, c) = nn.LSTM(4, 8)(paddle.randn([2, 6, 4]))
+        assert out.shape == [2, 6, 8] and h.shape == [1, 2, 8]
+        out, h = nn.GRU(4, 8, direction="bidirect")(paddle.randn([2, 6, 4]))
+        assert out.shape == [2, 6, 16]
+
+    def test_grad_flows_through_layers(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        loss = net(paddle.randn([4, 4])).sum()
+        loss.backward()
+        for p in net.parameters():
+            assert p.grad is not None
+
+
+class TestFunctional:
+    def test_cross_entropy_matches_numpy(self):
+        logits = np.random.rand(4, 3).astype("float32")
+        labels = np.array([0, 2, 1, 1])
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels)).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.randn([4, 3])
+        labels = paddle.to_tensor([0, -100, 1, -100])
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        loss_manual = F.cross_entropy(logits[paddle.to_tensor([0, 2])],
+                                      paddle.to_tensor([0, 1]))
+        np.testing.assert_allclose(loss.numpy(), loss_manual.numpy(),
+                                   rtol=1e-5)
+
+    def test_mse(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 2.0])
+        assert float(F.mse_loss(a, b)) == pytest.approx(2.0)
+
+    def test_bce_with_logits(self):
+        logit = paddle.to_tensor([0.0])
+        label = paddle.to_tensor([1.0])
+        np.testing.assert_allclose(
+            F.binary_cross_entropy_with_logits(logit, label).numpy(),
+            np.log(2), rtol=1e-5)
+
+    def test_attention_reference(self):
+        q = paddle.randn([2, 4, 2, 8])
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        assert out.shape == [2, 4, 2, 8]
+
+    def test_one_hot(self):
+        out = F.one_hot(paddle.to_tensor([0, 2]), 3)
+        np.testing.assert_array_equal(out.numpy(),
+                                      [[1, 0, 0], [0, 0, 1]])
+
+    def test_pad(self):
+        x = paddle.ones([1, 1, 2, 2])
+        out = F.pad(x, [1, 1, 1, 1])
+        assert out.shape == [1, 1, 4, 4]
+
+    def test_interpolate(self):
+        x = paddle.randn([1, 3, 4, 4])
+        assert F.interpolate(x, scale_factor=2).shape == [1, 3, 8, 8]
+        assert F.interpolate(x, size=[2, 2], mode="bilinear").shape == \
+            [1, 3, 2, 2]
+
+
+class TestInitializers:
+    def test_constant(self):
+        l = nn.Linear(4, 4, weight_attr=nn.initializer.Constant(2.0))
+        np.testing.assert_allclose(l.weight.numpy(), 2.0)
+
+    def test_xavier_scale(self):
+        import paddle_tpu.nn.initializer as I
+
+        w = I.XavierNormal()((1000, 1000), "float32")
+        assert abs(float(w.std()) - (2.0 / 2000) ** 0.5) < 1e-3
+
+    def test_kaiming(self):
+        import paddle_tpu.nn.initializer as I
+
+        w = I.KaimingNormal()((1000, 100), "float32")
+        assert abs(float(w.std()) - (2.0 / 1000) ** 0.5) < 5e-3

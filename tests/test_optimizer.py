@@ -1,0 +1,204 @@
+"""Optimizers: analytic single-step checks + convergence + schedulers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Parameter
+
+
+def make_param(value):
+    return Parameter(np.asarray(value, dtype="float32"))
+
+
+def set_grad(p, g):
+    from paddle_tpu.core.tensor import Tensor
+
+    p.grad = Tensor(np.asarray(g, dtype="float32"))
+
+
+class TestSGD:
+    def test_single_step(self):
+        p = make_param([1.0, 2.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        set_grad(p, [1.0, 1.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.9, 1.9], rtol=1e-6)
+
+    def test_weight_decay_l2(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p],
+                                   weight_decay=0.5)
+        set_grad(p, [0.0])
+        opt.step()
+        # grad += wd * p → 0.5; p = 1 - 0.1*0.5
+        np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-6)
+
+
+class TestMomentum:
+    def test_two_steps(self):
+        p = make_param([0.0])
+        opt = paddle.optimizer.Momentum(learning_rate=1.0, momentum=0.5,
+                                        parameters=[p])
+        set_grad(p, [1.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-1.0])
+        set_grad(p, [1.0])
+        opt.step()
+        # v = 0.5*1 + 1 = 1.5 → p = -1 - 1.5
+        np.testing.assert_allclose(p.numpy(), [-2.5])
+
+
+class TestAdam:
+    def test_first_step_magnitude(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[p])
+        set_grad(p, [10.0])
+        opt.step()
+        # bias-corrected first step ≈ lr
+        np.testing.assert_allclose(p.numpy(), [0.9], atol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[p],
+                                     weight_decay=0.1)
+        set_grad(p, [0.0])
+        opt.step()
+        # pure decay: p *= (1 - lr*wd) = 0.99; adam update ~0 (grad 0)
+        np.testing.assert_allclose(p.numpy(), [0.99], atol=1e-6)
+
+    def test_state_dict_roundtrip(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[p])
+        set_grad(p, [1.0])
+        opt.step()
+        sd = opt.state_dict()
+        p2 = make_param([1.0])
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[p2])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+
+    def test_multi_precision_master_weights(self):
+        p = Parameter(np.asarray([1.0], dtype="float32"))
+        p._data = p._data.astype("bfloat16")
+        opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=[p],
+                                    multi_precision=True)
+        set_grad(p, [1.0])
+        p.grad._data = p.grad._data.astype("bfloat16")
+        opt.step()
+        acc = opt._accumulators[opt._param_name(p)]
+        assert "master_weight" in acc
+        assert str(acc["master_weight"].dtype) == "float32"
+
+
+class TestConvergence:
+    @pytest.mark.parametrize("opt_cls,kwargs", [
+        ("SGD", {"learning_rate": 0.5}),
+        ("Momentum", {"learning_rate": 0.1, "momentum": 0.9}),
+        ("Adam", {"learning_rate": 0.1}),
+        ("AdamW", {"learning_rate": 0.1}),
+        ("RMSProp", {"learning_rate": 0.05}),
+        ("Adagrad", {"learning_rate": 0.5}),
+        ("Adamax", {"learning_rate": 0.2}),
+        ("Adadelta", {"learning_rate": 5.0}),
+        ("Lamb", {"learning_rate": 0.05}),
+    ])
+    def test_minimize_quadratic(self, opt_cls, kwargs):
+        p = make_param([5.0])
+        opt = getattr(paddle.optimizer, opt_cls)(parameters=[p], **kwargs)
+        for _ in range(150):
+            loss = (p * p).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert abs(float(p.numpy()[0])) < 0.3, float(p.numpy()[0])
+
+
+class TestGradClip:
+    def test_global_norm_clip(self):
+        p = make_param([3.0, 4.0])  # grad norm 5
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p],
+                                   grad_clip=clip)
+        set_grad(p, [3.0, 4.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [3.0 - 0.6, 4.0 - 0.8],
+                                   rtol=1e-5)
+
+    def test_clip_by_value(self):
+        p = make_param([0.0])
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=[p],
+            grad_clip=nn.ClipGradByValue(0.5))
+        set_grad(p, [2.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-0.5])
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = [sched.last_lr]
+        for _ in range(4):
+            sched.step()
+            lrs.append(sched.last_lr)
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_linear_warmup(self):
+        sched = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=5,
+                                                 start_lr=0.0, end_lr=0.1)
+        for _ in range(5):
+            sched.step()
+        assert sched.last_lr == pytest.approx(0.1)
+
+    def test_cosine(self):
+        sched = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        sched.step(10)
+        assert sched.last_lr == pytest.approx(0.0, abs=1e-6)
+
+    def test_optimizer_uses_scheduler(self):
+        p = make_param([1.0])
+        sched = paddle.optimizer.lr.StepDecay(1.0, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+        set_grad(p, [1.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.0])  # lr 1.0
+        sched.step()
+        set_grad(p, [1.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-0.1], rtol=1e-5)  # lr 0.1
+
+    def test_noam(self):
+        sched = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10)
+        peak_region = []
+        for _ in range(20):
+            sched.step()
+            peak_region.append(sched.last_lr)
+        assert max(peak_region) == pytest.approx(peak_region[9], rel=1e-6)
+
+    def test_reduce_on_plateau(self):
+        sched = paddle.optimizer.lr.ReduceOnPlateau(1.0, patience=1,
+                                                    factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            sched.step(loss)
+        assert sched.last_lr < 1.0
+
+
+class TestParamGroups:
+    def test_groups_flatten(self):
+        p1, p2 = make_param([1.0]), make_param([1.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[
+            {"params": [p1]}, {"params": [p2]}])
+        set_grad(p1, [1.0])
+        set_grad(p2, [1.0])
+        opt.step()
+        np.testing.assert_allclose(p1.numpy(), [0.9], rtol=1e-6)
+        np.testing.assert_allclose(p2.numpy(), [0.9], rtol=1e-6)
+
+    def test_per_param_lr_scale(self):
+        p = make_param([1.0])
+        p.optimize_attr["learning_rate"] = 0.5
+        opt = paddle.optimizer.SGD(learning_rate=0.2, parameters=[p])
+        set_grad(p, [1.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)

@@ -100,7 +100,7 @@ class TestConvergence:
         ("RMSProp", {"learning_rate": 0.05}),
         ("Adagrad", {"learning_rate": 0.5}),
         ("Adamax", {"learning_rate": 0.2}),
-        ("Adadelta", {"learning_rate": 5.0}),
+        ("Adadelta", {"learning_rate": 20.0}),
         ("Lamb", {"learning_rate": 0.05}),
     ])
     def test_minimize_quadratic(self, opt_cls, kwargs):

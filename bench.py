@@ -1,0 +1,130 @@
+"""Driver benchmark: ERNIE-1.0 pretrain tokens/sec/chip (BASELINE.json metric).
+
+Runs the full framework train step (hapi-style jitted functional step: forward
++ MLM loss + jax.grad + Adam, bf16 autocast O2) on the available accelerator
+and prints ONE JSON line. vs_baseline is measured MFU / 0.40 — the fraction of
+the north-star target (no published reference numbers exist; see BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+PEAK_BF16_FLOPS = {
+    # device_kind substring -> peak bf16 FLOP/s per chip
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in PEAK_BF16_FLOPS.items():
+        if sub in kind:
+            return peak
+    return None
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.core import tape as tape_mod
+    from paddle_tpu.core.rng import default_generator
+    from paddle_tpu.jit.functional import call_functional, extract_state
+    from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        cfg = ErnieConfig.ernie_base()  # ERNIE-1.0: L12 H768 A12 vocab 18k
+        batch, seq, steps, warmup = 32, 512, 20, 3
+    else:  # CPU smoke fallback; driver runs on TPU
+        cfg = ErnieConfig.tiny()
+        batch, seq, steps, warmup = 8, 128, 5, 1
+
+    model = ErnieForPretraining(cfg)
+    model.train()
+    params, buffers = extract_state(model)
+    opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                parameters=model.parameters())
+    opt_state = opt.functional_state(params)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    def train_step(params, buffers, opt_state, lr, t, key, ids, labels):
+        def loss_of(p):
+            with amp.auto_cast(level="O1", dtype="bfloat16"):
+                (logits, nsp), new_buffers = call_functional(
+                    model, p, buffers, (ids,), rng_key=key, training=True)
+            with tape_mod.no_grad():
+                loss = model.loss(paddle.Tensor(logits), paddle.Tensor(nsp),
+                                  paddle.Tensor(labels))
+            return loss._data, new_buffers
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        new_params, new_opt = opt.functional_step(params, grads, opt_state,
+                                                  lr, t)
+        return loss, new_params, new_buffers, new_opt
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 2))
+    lr = jnp.float32(1e-4)
+
+    for i in range(warmup):
+        key = default_generator().next_key()
+        loss, params, buffers, opt_state = jitted(
+            params, buffers, opt_state, lr, jnp.int32(i + 1), key, ids,
+            labels)
+    float(np.asarray(loss))  # full sync: value fetch, not block_until_ready
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        key = default_generator().next_key()
+        loss, params, buffers, opt_state = jitted(
+            params, buffers, opt_state, lr, jnp.int32(warmup + i + 1), key,
+            ids, labels)
+    # sync via a device->host value fetch: the final loss depends on every
+    # queued step, and on some PJRT transports (axon relay)
+    # block_until_ready returns before queued work drains
+    final_loss = float(np.asarray(loss))
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # PaLM-style: 6N per token (fwd+bwd) + attention 12*L*H*seq
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * \
+        cfg.hidden_size * seq
+    peak = _peak_flops(dev)
+    mfu = (tokens_per_sec * flops_per_token / peak) if peak else 0.0
+
+    print(json.dumps({
+        "metric": "ernie1.0_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "device": getattr(dev, "device_kind", dev.platform),
+            "batch": batch, "seq": seq, "steps": steps,
+            "step_time_ms": round(dt / steps * 1e3, 2),
+            "mfu": round(mfu, 4),
+            "params": n_params,
+            "final_loss": final_loss,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,254 @@
+"""Automatic mixed precision — paddle.amp analog, TPU-first.
+
+Ref: python/paddle/amp/auto_cast.py, grad_scaler.py (upstream layout,
+unverified — mount empty). O1 = white/black-list autocast at op dispatch; O2 =
+"pure" low-precision (params decorated to the amp dtype, fp32 master weights in
+the optimizer). On TPU the natural dtype is bfloat16, whose exponent range
+matches fp32 — so loss scaling is mathematically unnecessary; GradScaler keeps
+paddle's API/semantics (incl. dynamic scaling for float16) but defaults to a
+no-op-safe identity path under bfloat16.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor
+
+# Ops that are numerically safe & fast in low precision (MXU-bound).
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "conv1d", "conv2d", "conv3d", "conv2d_transpose",
+    "linear", "einsum", "addmm",
+}
+# Ops kept in fp32 for numerical stability.
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "nll_loss", "cosine_similarity", "mean", "sum", "pow", "rsqrt",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "norm",
+    "cumsum", "cumprod", "sigmoid_cross_entropy_with_logits", "erfinv",
+    "kl_div",
+}
+
+_STATE = {
+    "enabled": False,
+    "level": "O1",
+    "dtype": jnp.bfloat16,
+    "white": frozenset(),
+    "black": frozenset(),
+}
+
+
+def _is_float(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating)
+
+
+def _amp_handler(opdef, datas):
+    """Installed into core.dispatch: cast op inputs per list membership."""
+    if not _STATE["enabled"]:
+        return datas
+    if opdef.inplace_view:
+        return datas
+    name = opdef.name
+    amp_dtype = _STATE["dtype"]
+    if name in _STATE["black"]:
+        target = jnp.float32
+    elif _STATE["level"] == "O2" or name in _STATE["white"]:
+        target = amp_dtype
+    else:
+        return datas
+    return [
+        d.astype(target) if _is_float(d.dtype) and d.dtype != target else d
+        for d in datas
+    ]
+
+
+_dispatch.set_amp_handler(_amp_handler)
+
+
+def _resolve_dtype(dtype):
+    if dtype in ("float16", "fp16", jnp.float16, np.float16):
+        return jnp.float16
+    return jnp.bfloat16
+
+
+class auto_cast:
+    """Context manager enabling autocast (paddle.amp.auto_cast).
+
+    level 'O1': white-listed ops run in `dtype`, black-listed ops in fp32,
+    everything else follows its inputs. 'O2': all float ops in `dtype` except
+    the black list.
+    """
+
+    def __init__(self, enable: bool = True,
+                 custom_white_list: Optional[Sequence[str]] = None,
+                 custom_black_list: Optional[Sequence[str]] = None,
+                 level: str = "O1", dtype: str = "bfloat16",
+                 use_promote: bool = True):
+        if level not in ("O0", "O1", "O2", "OD"):
+            raise ValueError(f"level must be O0/OD/O1/O2, got {level!r}")
+        self.enable = enable and level not in ("O0",)
+        self.level = level
+        self.dtype = _resolve_dtype(dtype)
+        white = set(WHITE_LIST)
+        black = set(BLACK_LIST)
+        if custom_white_list:
+            white |= set(custom_white_list)
+            black -= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+            white -= set(custom_black_list)
+        self.white = frozenset(white)
+        self.black = frozenset(black)
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = dict(_STATE)
+        _STATE.update(
+            enabled=self.enable, level=self.level, dtype=self.dtype,
+            white=self.white, black=self.black,
+        )
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.update(self._saved)
+        return False
+
+
+amp_guard = auto_cast  # legacy alias (paddle.fluid.dygraph.amp_guard)
+
+
+def is_auto_cast_enabled() -> bool:
+    return _STATE["enabled"]
+
+
+def get_amp_dtype():
+    return _STATE["dtype"] if _STATE["enabled"] else jnp.float32
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate: cast model params to the amp dtype (O2 path).
+
+    Optimizers already keep fp32 master copies per-param (multi_precision), so
+    only the live params are cast here.
+    """
+    if level not in ("O1", "O2"):
+        raise ValueError("decorate level must be O1 or O2")
+    target = _resolve_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if _is_float(p._data.dtype):
+                    p._data = p._data.astype(target)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (paddle.amp.GradScaler).
+
+    Ref: python/paddle/amp/grad_scaler.py (upstream layout, unverified).
+    Under bfloat16 (TPU default) scaling is unnecessary; `enable=False` or
+    bfloat16 autocast makes scale/step the identity path with zero overhead.
+    """
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2, use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._use_dynamic
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable or self._scale == 1.0:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        """check_finite_and_unscale analog: divide grads by scale, detect inf."""
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._data
+            if self._scale != 1.0:
+                g = g * jnp.asarray(inv, dtype=g.dtype)
+                p.grad._data = g
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+        self._found_inf = found
+
+    def step(self, optimizer):
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        """update_loss_scaling analog: grow/shrink the scale."""
+        if not (self._enable and self._use_dynamic):
+            self._found_inf = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, new_scale: float):
+        self._scale = float(new_scale)
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+            "use_dynamic_loss_scaling": self._use_dynamic,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)

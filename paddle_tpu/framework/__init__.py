@@ -1,0 +1,7 @@
+"""paddle.framework — save/load + glue re-exports.
+
+Ref: python/paddle/framework/ (upstream layout, unverified — mount empty).
+"""
+from .io import save, load  # noqa: F401
+from ..core import get_default_dtype, set_default_dtype  # noqa: F401
+from ..core.rng import seed  # noqa: F401

@@ -1,0 +1,70 @@
+"""paddle.save / paddle.load — pickle protocol over state_dict.
+
+Ref: python/paddle/framework/io.py (upstream layout, unverified — mount
+empty). Tensors are serialized as numpy arrays (host pull) and rehydrated as
+Tensors on load; nested dicts/lists/tuples and optimizer state round-trip.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_SENTINEL = "__paddle_tpu_tensor__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return {_SENTINEL: True, "data": obj.numpy(),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get(_SENTINEL):
+            arr = obj["data"]
+            if return_numpy:
+                return arr
+            t = Tensor(arr, stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", "")
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """Serialize a Tensor / state_dict / nested structure to `path`."""
+    if isinstance(path, (str, os.PathLike)):
+        d = os.path.dirname(str(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+    else:  # file-like object
+        pickle.dump(_pack(obj), path, protocol=protocol)
+
+
+def load(path, **configs):
+    """Load what `save` wrote. `return_numpy=True` yields numpy arrays."""
+    return_numpy = configs.get("return_numpy", False)
+    if isinstance(path, (str, os.PathLike)):
+        with open(path, "rb") as f:
+            raw = pickle.load(f)
+    else:
+        raw = pickle.load(path)
+    return _unpack(raw, return_numpy)

@@ -1,0 +1,7 @@
+"""paddle.hapi — high-level API (Model.fit / callbacks / summary).
+
+Ref: python/paddle/hapi/ (upstream layout, unverified — mount empty).
+"""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

@@ -1,0 +1,77 @@
+"""paddle.summary (ref: python/paddle/hapi/model_summary.py, upstream layout,
+unverified — mount empty). Uses jax.eval_shape — no FLOPs are spent."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.functional import call_functional, extract_state
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def make_hook(name):
+        def hook(layer, inputs, outputs):
+            outs = outputs if isinstance(outputs, (list, tuple)) else \
+                [outputs]
+            shapes = [list(o.shape) for o in outs if isinstance(o, Tensor)]
+            n_params = sum(
+                int(np.prod(p.shape)) for p in layer._parameters.values()
+                if p is not None)
+            rows.append((name, type(layer).__name__, shapes, n_params))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+
+    try:
+        if input is not None:
+            args = [input] if isinstance(input, Tensor) else list(input)
+            datas = [a._data for a in args]
+        else:
+            if input_size is None:
+                raise ValueError("summary needs input_size or input")
+            sizes = [input_size] if isinstance(input_size, tuple) else \
+                list(input_size)
+            dts = dtypes or ["float32"] * len(sizes)
+            if isinstance(dts, str):
+                dts = [dts] * len(sizes)
+            datas = [jnp.zeros([1 if s is None or s == -1 else s
+                                for s in size], dtype=dt)
+                     for size, dt in zip(sizes, dts)]
+        params, buffers = extract_state(net)
+        # run abstractly — hooks fire during tracing, shapes are exact
+        jax.eval_shape(
+            lambda p, b, *d: call_functional(net, p, b, d,
+                                             training=False)[0],
+            params, buffers, *datas)
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    w = max([len(r[0]) + len(r[1]) for r in rows] + [30]) + 8
+    line = "-" * (w + 40)
+    print(line)
+    print(f"{'Layer (type)':<{w}}{'Output Shape':<24}{'Param #':>12}")
+    print(line)
+    for name, typ, shapes, n in rows:
+        shape_s = str(shapes[0]) if len(shapes) == 1 else str(shapes)
+        print(f"{name + ' (' + typ + ')':<{w}}{shape_s:<24}{n:>12,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

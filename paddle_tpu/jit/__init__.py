@@ -1,0 +1,11 @@
+"""paddle.jit — to_static + save/load over XLA compilation.
+
+Ref: python/paddle/jit/api.py (upstream layout, unverified — mount empty).
+Where Paddle AST-rewrites or bytecode-captures Python into a Program, the
+TPU-native path traces the ordinary Python forward under jax.jit via
+functionalize (jit/functional.py); the compiled-executable cache plays the
+role of InterpreterCore. jit.save/load serialize StableHLO (L4, static
+module).
+"""
+from .functional import bind_state, call_functional, extract_state  # noqa: F401
+from .api import TranslatedLayer, load, save, to_static  # noqa: F401

@@ -1,0 +1,314 @@
+"""paddle.jit.to_static / save / load.
+
+Ref: python/paddle/jit/api.py + jit/dy2static/program_translator.py (upstream
+layout, unverified — mount empty). `to_static` returns a StaticFunction whose
+__call__ traces the wrapped Layer/function once per input signature into an
+XLA executable and caches it (the pjit-cache-as-InterpreterCore design,
+SURVEY.md §7). `jit.save` exports StableHLO text + weights; `jit.load` returns
+a TranslatedLayer executing the saved module.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .functional import call_functional, extract_state
+
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "InputSpec",
+           "not_to_static", "ignore_module"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec — abstract input signature."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")
+
+    def to_shape_dtype(self, concrete_batch=1):
+        shape = tuple(concrete_batch if s == -1 else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, jnp.dtype(self.dtype))
+
+
+def _sig_of(args):
+    sig = []
+    for a in args:
+        if isinstance(a, Tensor):
+            sig.append(("T", a._data.shape, str(a._data.dtype)))
+        elif isinstance(a, (jax.Array, np.ndarray)):
+            sig.append(("A", tuple(a.shape), str(a.dtype)))
+        else:
+            sig.append(("S", a))
+    return tuple(sig)
+
+
+class StaticFunction:
+    """The compiled wrapper returned by @to_static."""
+
+    def __init__(self, fn_or_layer, input_spec: Optional[Sequence] = None,
+                 build_strategy=None, full_graph=True):
+        from ..nn import Layer
+
+        self._is_layer = isinstance(fn_or_layer, Layer)
+        self._layer = fn_or_layer if self._is_layer else getattr(
+            fn_or_layer, "__self__", None)
+        self._fn = fn_or_layer
+        self._input_spec = input_spec
+        self._cache = {}
+        self.__name__ = getattr(fn_or_layer, "__name__",
+                                type(fn_or_layer).__name__)
+
+    @property
+    def input_spec(self):
+        return self._input_spec
+
+    def _compiled_for(self, args):
+        sig = _sig_of(args)
+        entry = self._cache.get(sig)
+        if entry is not None:
+            return entry
+
+        if self._layer is not None:
+            layer = self._layer
+            params, buffers = extract_state(layer)
+            training = layer.training
+
+            def pure(params, buffers, *datas):
+                outs, new_buffers = call_functional(
+                    layer, params, buffers, datas, training=training)
+                return outs, new_buffers
+
+            compiled = jax.jit(pure)
+        else:
+            fn = self._fn
+
+            def pure(params, buffers, *datas):
+                wrapped = [Tensor(d) for d in datas]
+                from ..core import tape as tape_mod
+
+                with tape_mod.no_grad():
+                    result = fn(*wrapped)
+                unwrap = lambda x: x._data if isinstance(x, Tensor) else x
+                return jax.tree_util.tree_map(
+                    unwrap, result,
+                    is_leaf=lambda x: isinstance(x, Tensor)), {}
+
+            compiled = jax.jit(pure)
+        self._cache[sig] = compiled
+        return compiled
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            raise TypeError("to_static call supports positional args only")
+        datas = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                 for a in args]
+        if self._layer is not None:
+            params, buffers = extract_state(self._layer)
+        else:
+            params, buffers = {}, {}
+        compiled = self._compiled_for(args)
+        outs, new_buffers = compiled(params, buffers, *datas)
+        # write back mutated buffers (BN running stats under training)
+        if new_buffers:
+            named = {n: b for n, b in self._layer.named_buffers()
+                     if b is not None}
+            for n, val in new_buffers.items():
+                if n in named:
+                    named[n]._data = val
+        wrap = lambda x: Tensor(x) if isinstance(x, jax.Array) else x
+        return jax.tree_util.tree_map(wrap, outs)
+
+    # paddle API parity helpers
+    def concrete_program(self):
+        return self
+
+    @property
+    def code(self):
+        import inspect
+
+        try:
+            return inspect.getsource(
+                self._fn.forward if self._is_layer else self._fn)
+        except (OSError, TypeError):
+            return "<source unavailable>"
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator: compile a function or Layer for static execution."""
+
+    def deco(fn):
+        from ..nn import Layer
+
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn, input_spec, build_strategy, full_graph)
+            fn.forward_static = sf
+            fn._static_function = sf
+            return fn if kwargs.get("_return_layer") else sf
+        return StaticFunction(fn, input_spec, build_strategy, full_graph)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+_META = "meta.json"
+_HLO = "module.stablehlo"
+_WEIGHTS = "weights.pkl"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save: export StableHLO + weights.
+
+    `path` is a prefix (paddle convention: path + '.json'/'.pdiparams'); here
+    a directory `path + '.tpu_model/'` is written containing the lowered
+    StableHLO text of the eval-mode forward, the state pytree, and meta.
+    """
+    from ..nn import Layer
+
+    target = (layer._fn if isinstance(layer, StaticFunction) else layer)
+    if isinstance(layer, StaticFunction):
+        input_spec = input_spec or layer.input_spec
+        net = layer._layer
+    elif isinstance(layer, Layer):
+        net = layer
+        sf = getattr(layer, "_static_function", None)
+        input_spec = input_spec or (sf.input_spec if sf else None)
+    else:
+        raise TypeError("jit.save expects a Layer or StaticFunction")
+    if input_spec is None:
+        raise ValueError(
+            "jit.save requires input_spec (list of InputSpec/Tensor) when the "
+            "function has not been called yet")
+
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        elif isinstance(s, Tensor):
+            specs.append(InputSpec(s.shape, str(s.dtype)))
+        else:
+            arr = np.asarray(s)
+            specs.append(InputSpec(arr.shape, str(arr.dtype)))
+
+    params, buffers = extract_state(net)
+    was_training = net.training
+    net.eval()
+    try:
+        def pure(params, buffers, *datas):
+            outs, _ = call_functional(net, params, buffers, datas,
+                                      training=False)
+            return outs
+
+        from jax import export as jax_export
+
+        # dynamic (-1/None) dims become export symbols so the saved module
+        # accepts any batch size, like a saved inference program should
+        scope = jax_export.SymbolicScope()
+        n_sym = 0
+        abstract = []
+        for s in specs:
+            dims = []
+            for d in s.shape:
+                if d == -1:
+                    dims.append(jax_export.symbolic_shape(
+                        f"b{n_sym}", scope=scope)[0])
+                    n_sym += 1
+                else:
+                    dims.append(d)
+            abstract.append(jax.ShapeDtypeStruct(tuple(dims),
+                                                 jnp.dtype(s.dtype)))
+        lowered = jax.jit(pure).lower(params, buffers, *abstract)
+        hlo_text = lowered.as_text()
+        exported = jax_export.export(jax.jit(pure))(
+            params, buffers, *abstract)
+        blob = exported.serialize()
+    finally:
+        if was_training:
+            net.train()
+
+    out_dir = str(path) + ".tpu_model"
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, _HLO), "w") as f:
+        f.write(hlo_text)
+    with open(os.path.join(out_dir, _HLO + ".bin"), "wb") as f:
+        f.write(blob)
+    with open(os.path.join(out_dir, _WEIGHTS), "wb") as f:
+        pickle.dump({
+            "params": {k: np.asarray(v) for k, v in params.items()},
+            "buffers": {k: np.asarray(v) for k, v in buffers.items()},
+        }, f, protocol=4)
+    with open(os.path.join(out_dir, _META), "w") as f:
+        json.dump({
+            "input_specs": [
+                {"shape": list(s.shape), "dtype": str(s.dtype),
+                 "name": s.name} for s in specs],
+            "format": "stablehlo+pickle", "version": 1,
+        }, f, indent=2)
+
+
+class TranslatedLayer:
+    """jit.load product: executes the saved StableHLO module.
+
+    Source is gone after save, so execution goes through jax.export
+    deserialization of the serialized module — the inference-predictor path
+    (ref: paddle/fluid/inference AnalysisPredictor, upstream layout,
+    unverified; here XLA is the whole analysis+runtime)."""
+
+    def __init__(self, out_dir):
+        self._dir = out_dir
+        with open(os.path.join(out_dir, _META)) as f:
+            self._meta = json.load(f)
+        with open(os.path.join(out_dir, _WEIGHTS), "rb") as f:
+            w = pickle.load(f)
+        self._params = {k: jnp.asarray(v) for k, v in w["params"].items()}
+        self._buffers = {k: jnp.asarray(v) for k, v in w["buffers"].items()}
+        with open(os.path.join(out_dir, _HLO + ".bin"), "rb") as f:
+            blob = f.read()
+        from jax import export as jax_export
+
+        self._exported = jax_export.deserialize(blob)
+
+    def __call__(self, *args):
+        datas = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                 for a in args]
+        out = self._exported.call(self._params, self._buffers, *datas)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def parameters(self):
+        return [Tensor(v) for v in self._params.values()]
+
+    def state_dict(self):
+        out = {k: Tensor(v) for k, v in self._params.items()}
+        out.update({k: Tensor(v) for k, v in self._buffers.items()})
+        return out
+
+
+def load(path, **configs):
+    out_dir = str(path) + ".tpu_model"
+    if not os.path.isdir(out_dir):
+        raise FileNotFoundError(out_dir)
+    return TranslatedLayer(out_dir)

@@ -1,0 +1,8 @@
+"""paddle_tpu.models — flagship model families for the driver benchmarks.
+
+Upstream these live in the PaddleNLP ecosystem (ERNIE/GPT/LLaMA on top of
+paddle.nn); here they are first-class so the framework ships runnable
+benchmark models (BASELINE.json configs #3-#5).
+"""
+from .ernie import ErnieConfig, ErnieModel, ErnieForPretraining  # noqa: F401
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401

@@ -1,0 +1,120 @@
+"""GPT-family decoder for the hybrid-parallel benchmark (BASELINE.json config
+#4: GPT-3 1.3B TP+PP; upstream model lives in the PaddleNLP ecosystem).
+
+Pre-LN causal transformer. Attention uses the framework's
+scaled_dot_product_attention op so the Pallas flash path (ops/pallas_kernels)
+kicks in on TPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+
+    @classmethod
+    def gpt3_1p3b(cls):
+        return cls(hidden_size=2048, num_hidden_layers=24,
+                   num_attention_heads=16, intermediate_size=8192,
+                   max_position_embeddings=2048)
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=256,
+                   max_position_embeddings=128)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.dropout_p = cfg.attention_probs_dropout_prob
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        qkv = qkv.transpose([2, 0, 3, 1, 4])  # 3,b,nh,s,hd
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.dropout_p if self.training else 0.0)
+        ctx = ctx.transpose([0, 2, 1, 3]).reshape([b, s, h])
+        return self.out(ctx)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.ffn_in = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.ffn_out = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.ffn_out(F.gelu(self.ffn_in(self.ln2(x)))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: Optional[GPTConfig] = None):
+        super().__init__()
+        self.config = cfg or GPTConfig()
+        cfg = self.config
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        from .ernie import _init_transformer_weights
+
+        _init_transformer_weights(self, 0.02)
+
+    def forward(self, input_ids, position_ids=None):
+        from ..tensor.creation import arange
+
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = arange(s, dtype="int64").unsqueeze(0)
+        x = self.dropout(self.wte(input_ids) + self.wpe(position_ids))
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: Optional[GPTConfig] = None):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        # tied LM head: one [h, vocab] matmul
+        return h.matmul(self.gpt.wte.weight, transpose_y=True)
+
+    def loss(self, logits, labels):
+        vocab = logits.shape[-1]
+        return F.cross_entropy(
+            logits[:, :-1].reshape([-1, vocab]),
+            labels[:, 1:].reshape([-1]))

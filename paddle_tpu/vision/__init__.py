@@ -1,0 +1,29 @@
+"""paddle.vision — datasets, transforms, models, vision ops.
+
+Ref: python/paddle/vision/ (upstream layout, unverified — mount empty).
+"""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import ops  # noqa: F401
+
+from .models import *  # noqa: F401,F403
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend: str):
+    global _image_backend
+    if backend not in ("pil", "cv2", "numpy", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    from .datasets import _default_loader
+
+    return _default_loader(path)

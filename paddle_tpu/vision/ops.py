@@ -1,0 +1,162 @@
+"""paddle.vision.ops — detection ops (nms, roi_align, boxes).
+
+Ref: python/paddle/vision/ops.py (upstream layout, unverified — mount empty).
+Implemented as jax functions; NMS uses a lax.fori_loop suppression sweep so it
+stays jittable (static box count, no data-dependent Python control flow).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "box_area", "box_iou", "roi_align", "RoIAlign",
+           "roi_pool", "RoIPool"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_area(boxes):
+    b = _unwrap(boxes)
+    return Tensor((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def _iou_matrix(boxes1, boxes2):
+    area1 = (boxes1[:, 2] - boxes1[:, 0]) * (boxes1[:, 3] - boxes1[:, 1])
+    area2 = (boxes2[:, 2] - boxes2[:, 0]) * (boxes2[:, 3] - boxes2[:, 1])
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    return Tensor(_iou_matrix(_unwrap(boxes1), _unwrap(boxes2)))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS. Returns kept indices sorted by descending score."""
+    b = _unwrap(boxes).astype(jnp.float32)
+    n = b.shape[0]
+    s = (_unwrap(scores).astype(jnp.float32) if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    if category_idxs is not None:
+        # category-aware: offset boxes per class so cross-class IoU is 0
+        cat = _unwrap(category_idxs).astype(jnp.float32)
+        max_coord = jnp.max(b) + 1.0
+        b = b + (cat * max_coord)[:, None]
+
+    order = jnp.argsort(-s)
+    b_sorted = b[order]
+    iou = _iou_matrix(b_sorted, b_sorted)
+
+    def body(i, keep):
+        # suppress i if it overlaps any earlier kept box
+        overlap = (iou[i] > iou_threshold) & keep & (jnp.arange(n) < i)
+        return keep.at[i].set(~jnp.any(overlap))
+
+    keep = jax.lax.fori_loop(1, n, body, jnp.ones(n, dtype=bool))
+    kept = order[jnp.where(keep)[0]]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(kept)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign with bilinear sampling (NCHW input, boxes [K,4] x1y1x2y2)."""
+    xd = _unwrap(x).astype(jnp.float32)
+    bx = _unwrap(boxes).astype(jnp.float32)
+    bn = _unwrap(boxes_num)
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    N, C, H, W = xd.shape
+    # batch index per box from boxes_num
+    batch_idx = jnp.repeat(jnp.arange(N), bn, total_repeat_length=bx.shape[0])
+
+    offset = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def one_roi(b_i, box):
+        x1, y1, x2, y2 = box * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_h = rh / oh
+        bin_w = rw / ow
+        # sample grid: oh*sr x ow*sr points
+        ys = y1 + (jnp.arange(oh * sr) + 0.5) * bin_h / sr
+        xs = x1 + (jnp.arange(ow * sr) + 0.5) * bin_w / sr
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(ys - y0, 0, 1)
+        wx = jnp.clip(xs - x0, 0, 1)
+        img = xd[b_i]  # C,H,W
+        v = (img[:, y0[:, None], x0[None, :]] * (1 - wy)[:, None] * (1 - wx)[None, :]
+             + img[:, y1i[:, None], x0[None, :]] * wy[:, None] * (1 - wx)[None, :]
+             + img[:, y0[:, None], x1i[None, :]] * (1 - wy)[:, None] * wx[None, :]
+             + img[:, y1i[:, None], x1i[None, :]] * wy[:, None] * wx[None, :])
+        # average pool each sr x sr cell
+        v = v.reshape(C, oh, sr, ow, sr).mean(axis=(2, 4))
+        return v
+
+    out = jax.vmap(one_roi)(batch_idx, bx)
+    return Tensor(out)
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool via max over aligned sample grid (sr=2 max approximation)."""
+    xd = _unwrap(x).astype(jnp.float32)
+    bx = _unwrap(boxes).astype(jnp.float32)
+    bn = _unwrap(boxes_num)
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    N, C, H, W = xd.shape
+    batch_idx = jnp.repeat(jnp.arange(N), bn, total_repeat_length=bx.shape[0])
+    sr = 2
+
+    def one_roi(b_i, box):
+        x1, y1, x2, y2 = jnp.round(box * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        ys = y1 + (jnp.arange(oh * sr) + 0.5) * rh / (oh * sr)
+        xs = x1 + (jnp.arange(ow * sr) + 0.5) * rw / (ow * sr)
+        yi = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
+        img = xd[b_i]
+        v = img[:, yi[:, None], xi[None, :]]
+        return v.reshape(C, oh, sr, ow, sr).max(axis=(2, 4))
+
+    out = jax.vmap(one_roi)(batch_idx, bx)
+    return Tensor(out)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)

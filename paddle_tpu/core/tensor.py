@@ -38,7 +38,8 @@ def _unwrap_index(item):
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_grad_node", "_out_index",
-                 "name", "persistable", "_hooks", "__weakref__")
+                 "name", "persistable", "_hooks", "process_mesh",
+                 "placements", "__weakref__")
 
     def __init__(self, data, dtype=None, stop_gradient: bool = True,
                  name: str = ""):
@@ -62,6 +63,8 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._hooks = None
+        self.process_mesh = None   # set by dist.shard_tensor/reshard
+        self.placements = None
 
     # ------------------------------------------------------------ properties
     @property
@@ -476,7 +479,8 @@ class Parameter(Tensor):
     """Trainable tensor (paddle.base.framework.Parameter analog)."""
 
     __slots__ = ("trainable", "optimize_attr", "regularizer",
-                 "need_clip", "is_distributed", "_sharding_axes")
+                 "need_clip", "is_distributed", "_sharding_axes",
+                 "dist_spec", "sequence_parallel")
 
     def __init__(self, data, dtype=None, name: str = "", trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable,
@@ -488,6 +492,8 @@ class Parameter(Tensor):
         self.need_clip = True
         self.is_distributed = False
         self._sharding_axes = None  # PartitionSpec-like hint for pjit paths
+        self.dist_spec = None       # TP partition marks (mp_layers._mark)
+        self.sequence_parallel = False
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

@@ -1,4 +1,29 @@
-"""paddle.distributed analog — extended at L5 (mesh/fleet/collectives)."""
-from .env import (  # noqa: F401
-    get_rank, get_world_size, init_parallel_env, is_initialized,
+"""paddle.distributed — communication API, fleet, launch, checkpoint.
+
+Ref: python/paddle/distributed/ (upstream layout, unverified — mount empty).
+See SURVEY.md §2.3: dygraph ProcessGroup + static c_* ops collapse into XLA
+collectives bound to mesh-axis names; TCPStore/fleetrun bootstrap maps to
+jax.distributed.initialize + slice metadata.
+"""
+from .env import init_parallel_env, is_initialized  # noqa: F401
+from .group import (  # noqa: F401
+    Group, destroy_process_group, get_group, new_group,
 )
+from .communication import (  # noqa: F401
+    P2POp, ReduceOp, all_gather, all_gather_object, all_reduce,
+    alltoall, alltoall_single, barrier, batch_isend_irecv, broadcast,
+    get_rank, get_world_size, irecv, isend, recv, reduce, reduce_scatter,
+    scatter, send, stream, wait,
+)
+from .parallel import DataParallel, ParallelEnv  # noqa: F401
+from . import fleet  # noqa: F401
+from .fleet import DistributedStrategy  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    reshard, shard_layer, shard_tensor,
+)
+from . import sharding  # noqa: F401

@@ -1,0 +1,188 @@
+"""Semi-auto parallel API: ProcessMesh + placements + shard_tensor.
+
+Ref: python/paddle/distributed/auto_parallel/api.py (upstream layout,
+unverified — mount empty). Paddle implements sharding propagation, a
+partitioner and reshard passes over its IR; on TPU these are XLA GSPMD's job,
+so the API is nearly native sugar: ProcessMesh wraps jax.sharding.Mesh,
+Shard/Replicate/Partial map to PartitionSpec entries, shard_tensor is
+jax.device_put with a NamedSharding, and reshard is device_put to a new one.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "get_mesh", "set_mesh"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return "Partial()"
+
+
+class ProcessMesh:
+    """N-D logical process mesh with named dims, backed by jax Mesh."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[List[str]] = None):
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.flatten().tolist()
+        self._dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
+        devs = np.asarray(jax.devices())
+        if devs.size < arr.size:
+            raise ValueError(
+                f"mesh needs {arr.size} devices, have {devs.size}")
+        self._jax_mesh = jax.sharding.Mesh(
+            devs[arr.flatten()].reshape(arr.shape), tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    def get_dim_size(self, name: str) -> int:
+        return self._shape[self._dim_names.index(name)]
+
+    def jax_mesh(self):
+        return self._jax_mesh
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self._dim_names})"
+
+
+_GLOBAL_MESH = [None]
+
+
+def set_mesh(mesh: ProcessMesh):
+    _GLOBAL_MESH[0] = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _GLOBAL_MESH[0]
+
+
+def _to_partition_spec(mesh: ProcessMesh, placements) -> PartitionSpec:
+    """placements[i] describes mesh dim i; build the per-tensor-dim spec."""
+    if placements is None:
+        return PartitionSpec()
+    max_dim = -1
+    for p in placements:
+        if isinstance(p, Shard):
+            max_dim = max(max_dim, p.dim)
+    entries = [None] * (max_dim + 1)
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            name = mesh.dim_names[mesh_dim]
+            if entries[p.dim] is None:
+                entries[p.dim] = name
+            elif isinstance(entries[p.dim], tuple):
+                entries[p.dim] = entries[p.dim] + (name,)
+            else:
+                entries[p.dim] = (entries[p.dim], name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements,
+                 dtype=None, place=None, stop_gradient=None):
+    """Place a tensor on the mesh with the given placements."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    spec = _to_partition_spec(mesh, placements)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    t._data = jax.device_put(t._data, sharding)
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    return t
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements):
+    """Re-place onto (possibly different) mesh/placements; XLA moves data."""
+    spec = _to_partition_spec(mesh, placements)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    out = Tensor(jax.device_put(dist_tensor._data, sharding),
+                 stop_gradient=dist_tensor.stop_gradient)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Shard a Layer's params per shard_fn(name, layer, mesh); defaults to
+    replicated placement."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for p in sublayer._parameters.values():
+                if p is not None:
+                    shard_tensor(p, mesh,
+                                 [Replicate()] * mesh.ndim)
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    return layer

@@ -1,0 +1,142 @@
+"""Distributed checkpoint: save/load_state_dict with resharding.
+
+Ref: python/paddle/distributed/checkpoint/{save_state_dict,load_state_dict,
+metadata}.py (upstream layout, unverified — mount empty). Paddle writes
+per-rank shard files + global metadata and reshards on load across changed
+meshes. Here each host writes the shards of the jax.Arrays it addresses
+(addressable_shards) plus a JSON metadata file keyed by (name, global shape,
+shard index ranges); load assembles the requested global arrays from any
+shard layout and re-places them under the current sharding — load-time
+resharding across different mesh shapes/degrees for free.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Dict
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict"]
+
+_META = "metadata.json"
+
+
+def _unwrap(v):
+    if isinstance(v, Tensor):
+        return v._data
+    return v
+
+
+def save_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, async_save: bool = False):
+    """Write per-shard files + metadata under directory `path`."""
+    os.makedirs(path, exist_ok=True)
+    pid = jax.process_index()
+    meta = {"version": 1, "tensors": {}, "world": jax.process_count()}
+    shard_file = os.path.join(path, f"shard_{pid}.pkl")
+    payload = {}
+    for name, val in _flatten(state_dict).items():
+        arr = _unwrap(val)
+        if isinstance(arr, jax.Array):
+            global_shape = list(arr.shape)
+            shards = []
+            for s in arr.addressable_shards:
+                key = f"{name}@{s.index}"
+                payload[key] = np.asarray(s.data)
+                shards.append({
+                    "key": key,
+                    "index": [[sl.start or 0,
+                               sl.stop if sl.stop is not None else dim]
+                              for sl, dim in zip(s.index, global_shape)]
+                    if s.index else [],
+                })
+            meta["tensors"][name] = {
+                "shape": global_shape,
+                "dtype": str(arr.dtype),
+                "shards": shards,
+                "file": os.path.basename(shard_file),
+            }
+        else:
+            payload[name] = arr
+            meta["tensors"][name] = {"scalar": True,
+                                     "file": os.path.basename(shard_file)}
+    with open(shard_file, "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    if pid == coordinator_rank:
+        with open(os.path.join(path, _META), "w") as f:
+            json.dump(meta, f)
+
+
+def load_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0):
+    """Fill `state_dict`'s tensors in place from `path`, resharding to each
+    tensor's current sharding."""
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    # only read the shard files metadata references — a stale shard from an
+    # earlier larger-world save must not override fresh values
+    live_files = {info["file"] for info in meta["tensors"].values()}
+    payload = {}
+    for fname in sorted(live_files):
+        with open(os.path.join(path, fname), "rb") as f:
+            payload.update(pickle.load(f))
+
+    flat = _flatten(state_dict)
+    for name, val in flat.items():
+        info = meta["tensors"].get(name)
+        if info is None:
+            raise KeyError(f"checkpoint at {path} has no tensor {name!r}")
+        if info.get("scalar"):
+            new = payload[name]
+            _assign(state_dict, name, new)
+            continue
+        full = np.zeros(info["shape"], dtype=np.dtype(info["dtype"]))
+        for sh in info["shards"]:
+            chunk = payload[sh["key"]]
+            if sh["index"]:
+                slices = tuple(slice(a, b) for a, b in sh["index"])
+                full[slices] = chunk
+            else:
+                full[...] = chunk
+        cur = _unwrap(val)
+        if isinstance(cur, jax.Array) and hasattr(cur, "sharding"):
+            new = jax.device_put(full, cur.sharding)  # reshard to current
+        else:
+            new = jax.numpy.asarray(full)
+        _assign(state_dict, name, new)
+    return state_dict
+
+
+def _flatten(d, prefix=""):
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _assign(d, dotted, new_val):
+    # state_dicts are usually FLAT with dotted keys ('fc.weight'); only
+    # descend when the key is genuinely nested dicts
+    if dotted in d:
+        cur, leaf = d, dotted
+    else:
+        parts = dotted.split(".")
+        cur = d
+        for p in parts[:-1]:
+            cur = cur[p]
+        leaf = parts[-1]
+    old = cur[leaf]
+    if isinstance(old, Tensor):
+        old._data = (new_val if isinstance(new_val, jax.Array)
+                     else jax.numpy.asarray(new_val))
+    else:
+        cur[leaf] = new_val

@@ -1,0 +1,338 @@
+"""paddle.distributed communication API over XLA collectives.
+
+Ref: python/paddle/distributed/communication/ + the c_* collective ops in
+paddle/fluid/operators/collective/ (upstream layout, unverified — mount
+empty). Two execution regimes:
+
+* **Traced under shard_map** (the TPU-native hot path): each wrapper lowers to
+  the XLA collective bound to the group's mesh-axis name — psum, all_gather,
+  psum_scatter, ppermute, all_to_all — and XLA schedules it on ICI/DCN.
+* **Eager, no named axis in scope**: the group degenerates to world_size 1
+  (single-controller process owns all devices), so ops are identity — the
+  same contract paddle gives before init_parallel_env.
+
+In-place semantics follow paddle: all_reduce/broadcast rebind tensor._data.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .group import Group, get_default_group, new_group  # noqa: F401
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "broadcast", "scatter", "alltoall", "alltoall_single",
+    "send", "recv", "isend", "irecv", "barrier", "batch_isend_irecv",
+    "P2POp", "wait", "get_rank", "get_world_size", "is_initialized",
+    "stream",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _axis_in_scope(axis_name: str) -> bool:
+    """True when `axis_name` is a live named axis (inside shard_map/pmap)."""
+    try:
+        from jax._src import core as jcore
+
+        frame = jcore.get_axis_env() if hasattr(jcore, "get_axis_env") else None
+        if frame is not None:
+            return axis_name in frame.axis_sizes
+    except Exception:
+        pass
+    try:
+        jax.lax.axis_size(axis_name)
+        return True
+    except (NameError, KeyError, Exception):
+        return False
+
+
+def _resolve(group: Optional[Group]) -> Group:
+    return group if group is not None else get_default_group()
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _rebind(x, val):
+    if isinstance(x, Tensor):
+        x._data = val
+        return x
+    return Tensor(val)
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    g = group
+    if g is not None and _axis_in_scope(g.axis_name):
+        return jax.lax.axis_index(g.axis_name)
+    from . import env as _env
+
+    return _env.get_rank()
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.nranks
+    from . import env as _env
+
+    return _env.get_world_size()
+
+
+def is_initialized() -> bool:
+    from .env import is_initialized as _init
+
+    return _init()
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    g = _resolve(group)
+    if _axis_in_scope(g.axis_name):
+        x = _data(tensor)
+        if op == ReduceOp.AVG:
+            out = jax.lax.pmean(x, g.axis_name)
+        elif op == ReduceOp.PROD:
+            # sign-correct product: |x| via exp-log-psum, sign via parity
+            neg = jax.lax.psum((x < 0).astype(x.dtype), g.axis_name)
+            mag = jnp.exp(jax.lax.psum(jnp.log(jnp.abs(x)), g.axis_name))
+            out = mag * jnp.where(neg % 2 == 1, -1.0, 1.0).astype(x.dtype)
+        else:
+            out = _REDUCERS[op](x, g.axis_name)
+        return _rebind(tensor, out)
+    return tensor  # world_size 1
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True):
+    """All ranks compute the reduction; only dst's value is meaningful —
+    under SPMD the cheapest faithful implementation is an all_reduce."""
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_gather(tensor_list: Optional[List], tensor=None,
+               group: Optional[Group] = None, sync_op: bool = True, axis=0):
+    """paddle signature: all_gather(tensor_list, tensor, group)."""
+    g = _resolve(group)
+    if tensor is None:  # functional style: all_gather(x) -> stacked
+        tensor = tensor_list
+        tensor_list = None
+    x = _data(tensor)
+    if _axis_in_scope(g.axis_name):
+        out = jax.lax.all_gather(x, g.axis_name, axis=0, tiled=False)
+        parts = [out[i] for i in range(g.nranks)]
+    else:
+        parts = [x]
+    if tensor_list is not None:
+        tensor_list.extend(Tensor(p) for p in parts)
+        return tensor_list
+    return Tensor(jnp.concatenate(parts, axis=axis) if parts[0].ndim
+                  else jnp.stack(parts))
+
+
+def all_gather_object(object_list: List, obj, group: Optional[Group] = None):
+    g = _resolve(group)
+    if _axis_in_scope(g.axis_name):
+        raise RuntimeError("all_gather_object is host-side only; call it "
+                           "outside jitted code")
+    object_list.extend([obj] * 1)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True):
+    """Reduce across the group, scatter equal chunks (ZeRO's workhorse)."""
+    g = _resolve(group)
+    if tensor_list is not None:
+        x = jnp.concatenate([_data(t) for t in tensor_list], axis=0)
+    else:
+        x = _data(tensor)
+    if _axis_in_scope(g.axis_name):
+        out = jax.lax.psum_scatter(x, g.axis_name, scatter_dimension=0,
+                                   tiled=True)
+        return _rebind(tensor, out)
+    return _rebind(tensor, x)
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    g = _resolve(group)
+    if _axis_in_scope(g.axis_name):
+        x = _data(tensor)
+        src_local = g.get_group_rank(src) if src in g.ranks else src
+        # select src's value on every rank: gather then index (XLA folds this
+        # into a broadcast collective)
+        out = jax.lax.all_gather(x, g.axis_name)[src_local]
+        return _rebind(tensor, out)
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True):
+    g = _resolve(group)
+    if _axis_in_scope(g.axis_name):
+        idx = jax.lax.axis_index(g.axis_name)
+        if tensor_list is not None:
+            stacked = jnp.stack([_data(t) for t in tensor_list])
+        else:
+            stacked = _data(tensor)
+        out = jax.lax.dynamic_index_in_dim(stacked, idx, keepdims=False)
+        return _rebind(tensor, out)
+    if tensor_list:
+        return _rebind(tensor, _data(tensor_list[src]))
+    return tensor
+
+
+def alltoall(out_tensor_list, in_tensor_list=None,
+             group: Optional[Group] = None, sync_op: bool = True):
+    """Paddle alltoall: rank i sends in_tensor_list[j] to rank j."""
+    g = _resolve(group)
+    if in_tensor_list is None:
+        in_tensor_list = out_tensor_list
+        out_tensor_list = None
+    if _axis_in_scope(g.axis_name):
+        x = jnp.stack([_data(t) for t in in_tensor_list])  # [nranks, ...]
+        out = jax.lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        parts = [Tensor(out[i]) for i in range(g.nranks)]
+    else:
+        parts = [Tensor(_data(t)) for t in in_tensor_list]
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(parts)
+        return out_tensor_list
+    return parts
+
+
+def alltoall_single(out_tensor, in_tensor=None,
+                    in_split_sizes=None, out_split_sizes=None,
+                    group: Optional[Group] = None, sync_op: bool = True):
+    g = _resolve(group)
+    if in_tensor is None:
+        in_tensor = out_tensor
+        out_tensor = None
+    x = _data(in_tensor)
+    if _axis_in_scope(g.axis_name):
+        out = jax.lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0,
+                                 tiled=True)
+    else:
+        out = x
+    if out_tensor is not None:
+        return _rebind(out_tensor, out)
+    return Tensor(out)
+
+
+def _pshift(x, axis_name, n, offset):
+    """ppermute ring shift by `offset` over the named axis."""
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def send(tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    """p2p under SPMD: only ring-neighbour sends are expressible; the PP
+    engine uses ring ppermute via batch_isend_irecv instead. Eager mode:
+    no-op (world_size 1)."""
+    g = _resolve(group)
+    if _axis_in_scope(g.axis_name):
+        raise RuntimeError(
+            "point-to-point send inside shard_map must go through "
+            "batch_isend_irecv (ring ppermute); arbitrary src/dst p2p is not "
+            "an SPMD primitive")
+    return tensor
+
+
+def recv(tensor, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    g = _resolve(group)
+    if _axis_in_scope(g.axis_name):
+        raise RuntimeError(
+            "point-to-point recv inside shard_map must go through "
+            "batch_isend_irecv (ring ppermute)")
+    return tensor
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    """Mirror of paddle.distributed.P2POp for batch_isend_irecv."""
+
+    def __init__(self, op, tensor, peer: int, group: Optional[Group] = None):
+        self.op = op            # send / recv callables above
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]):
+    """Fused ring exchange. Under shard_map, pairs of (send->peer, recv<-peer)
+    become one ppermute; this is the primitive PP's p2p layer and ring
+    attention build on."""
+    if not p2p_op_list:
+        return []
+    g = _resolve(p2p_op_list[0].group)
+    if not _axis_in_scope(g.axis_name):
+        # world_size 1: recvs keep their buffers, sends vanish
+        return []
+    n = g.nranks
+    sends = [p for p in p2p_op_list if p.op in (send, isend)]
+    recvs = [p for p in p2p_op_list if p.op in (recv, irecv)]
+    tasks = []
+    for s, r in zip(sends, recvs):
+        # SPMD sees ONE program on all ranks, so peers must form a uniform
+        # shift: under shard_map `peer` is the ring offset k, and the pair
+        # (send k, recv) lowers to ppermute rank -> (rank+k) % n.
+        k = s.peer % n
+        out = jax.lax.ppermute(_data(s.tensor), g.axis_name,
+                               [(i, (i + k) % n) for i in range(n)])
+        r.tensor._data = out
+        tasks.append(r.tensor)
+    return tasks
+
+
+def barrier(group: Optional[Group] = None):
+    g = _resolve(group)
+    if _axis_in_scope(g.axis_name):
+        # a psum of a scalar is the canonical SPMD barrier
+        jax.lax.psum(jnp.zeros((), jnp.float32), g.axis_name)
+    return None
+
+
+def wait(tensor, group: Optional[Group] = None, use_calc_stream: bool = True):
+    return tensor
+
+
+class _StreamNS:
+    """paddle.distributed.stream.* variants — on TPU streams are XLA's
+    concern; these alias the sync wrappers."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    alltoall = staticmethod(alltoall)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+
+
+stream = _StreamNS()

@@ -1,0 +1,134 @@
+"""fleet facade — fleet.init / distributed_model / distributed_optimizer.
+
+Ref: python/paddle/distributed/fleet/fleet.py (upstream layout, unverified —
+mount empty). fleet.init builds the HCG (≈ the job's jax Mesh); the
+distributed_model/optimizer wrappers land with the meta_parallel engines
+(DataParallel here; TP/PP/sharding in meta_parallel/).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .distributed_strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from . import recompute as _recompute_mod  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+__all__ = [
+    "init", "DistributedStrategy", "CommunicateTopology",
+    "HybridCommunicateGroup", "get_hybrid_communicate_group",
+    "distributed_model", "distributed_optimizer", "worker_index",
+    "worker_num", "is_first_worker", "barrier_worker", "fleet",
+    "recompute", "recompute_sequential",
+]
+
+_STATE = {"hcg": None, "strategy": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """fleet.init: build the HCG from strategy.hybrid_configs."""
+    strategy = strategy or DistributedStrategy()
+    h = strategy.hybrid_configs
+    order = h.get("order", ["pp", "dp", "sharding", "sep", "mp"])
+    dims = [int(h.get(f"{name}_degree", 1)) for name in order]
+
+    import jax
+
+    n_devices = len(jax.devices())
+    import numpy as _np
+
+    world = int(_np.prod(dims))
+    if world == 1 and n_devices > 1:
+        # pure DP over all visible devices by default (paddle uses the
+        # launcher's world size; single-controller uses the device count)
+        dims[order.index("dp")] = n_devices
+    topo = CommunicateTopology(order, dims)
+    _STATE["hcg"] = HybridCommunicateGroup(topo)
+    _STATE["strategy"] = strategy
+    _STATE["initialized"] = True
+
+    from ..env import init_parallel_env
+
+    init_parallel_env()
+    return _STATE["hcg"]
+
+
+def is_initialized() -> bool:
+    return _STATE["initialized"]
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _STATE["hcg"]
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _STATE["strategy"]
+
+
+def distributed_model(model):
+    """Wrap per the HCG: TP layers already shard themselves; DP needs no
+    wrapper under GSPMD (grad psum is emitted by sharding propagation); PP
+    returns the PipelineParallel engine."""
+    hcg = _STATE["hcg"]
+    if hcg is None:
+        raise RuntimeError("call fleet.init() first")
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .meta_parallel import PipelineParallel
+
+        return PipelineParallel(model, hcg, _STATE["strategy"])
+    if hcg.get_data_parallel_world_size() > 1 and \
+            hcg.get_parallel_mode() == "data":
+        from ..parallel import DataParallel
+
+        return DataParallel(model, hcg=hcg)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap the optimizer for hybrid parallel (grad-clip across meshes,
+    sharding-aware state partition)."""
+    hcg = _STATE["hcg"]
+    if hcg is None:
+        return optimizer
+    from .meta_parallel import HybridParallelOptimizer
+
+    return HybridParallelOptimizer(optimizer, hcg,
+                                   strategy or _STATE["strategy"])
+
+
+def worker_index() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def worker_num() -> int:
+    import jax
+
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def barrier_worker():
+    return None
+
+
+class _Fleet:
+    """`from paddle.distributed import fleet; fleet.init(...)` both work —
+    this module doubles as the singleton object."""
+
+    init = staticmethod(init)
+    is_initialized = staticmethod(is_initialized)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    is_first_worker = staticmethod(is_first_worker)
+    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
+    DistributedStrategy = DistributedStrategy
+
+
+fleet = _Fleet()

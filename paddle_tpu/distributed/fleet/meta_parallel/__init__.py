@@ -1,0 +1,28 @@
+"""fleet.meta_parallel — hybrid-parallel engines.
+
+Ref: python/paddle/distributed/fleet/meta_parallel/ (upstream layout,
+unverified — mount empty). TP layers in parallel_layers/mp_layers.py, PP in
+pipeline_parallel.py, ZeRO in sharding/, sequence parallel in
+sequence_parallel_utils (fleet/utils upstream; here co-located).
+"""
+from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RNGStatesTracker,
+    RowParallelLinear, VocabParallelEmbedding, get_rng_state_tracker,
+    model_parallel_random_seed, mp_shardings,
+)
+from .pipeline_parallel import PipelineLayer, LayerDesc, SharedLayerDesc, \
+    PipelineParallel  # noqa: F401
+from .sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+    group_sharded_parallel,
+)
+from .sequence_parallel import (  # noqa: F401
+    AllGatherOp, ColumnSequenceParallelLinear, GatherOp, ReduceScatterOp,
+    RowSequenceParallelLinear, ScatterOp,
+    mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks,
+)
+from .ring_attention import (  # noqa: F401
+    RingFlashAttention, ring_flash_attention, ulysses_attention,
+)

@@ -1,0 +1,50 @@
+"""HybridParallelOptimizer — optimizer wrapper for hybrid-parallel training.
+
+Ref: fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py
+(upstream layout, unverified — mount empty). Paddle's version re-implements
+global-norm grad clip across the dp/mp/pp/sharding meshes and fuses the DP
+allreduce; under GSPMD gradients arrive already summed across dp (the psum is
+inside the jitted step), and the global-norm clip over sharded params is a
+plain jnp reduction that XLA lowers to the right cross-axis collectives. So
+this wrapper is thin: it delegates to the inner optimizer and keeps the
+paddle surface (inner_opt, no_sync-awareness, state passthrough).
+"""
+from __future__ import annotations
+
+__all__ = ["HybridParallelOptimizer"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    # delegate the full Optimizer surface
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        return self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, *a, **k):
+        return self._inner_opt.minimize(*a, **k)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def functional_state(self, params):
+        return self._inner_opt.functional_state(params)
+
+    def functional_step(self, *a, **k):
+        return self._inner_opt.functional_step(*a, **k)

@@ -1,0 +1,218 @@
+"""Tensor-parallel (Megatron) layers + RNGStatesTracker.
+
+Ref: fleet/meta_parallel/parallel_layers/mp_layers.py + random.py (upstream
+layout, unverified — mount empty). Paddle splits weights per rank and calls
+identity/allreduce collectives explicitly; the TPU-native design keeps ONE
+logical (full-shape) parameter per layer and attaches a mesh-axis partition
+spec to it (`param.dist_spec`). Under a jitted step whose in_shardings come
+from `mp_shardings(layer, mesh)`, GSPMD partitions the matmuls column/row-wise
+and inserts the same collectives Megatron would (psum after row-parallel,
+gather when gather_output) — with XLA free to fuse/overlap them. Numerics
+match the replicated model exactly, which the tests assert.
+
+Eagerly (no mesh) the layers behave as their dense equivalents, mirroring
+paddle's world_size=1 path.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+from ....core.rng import Generator
+from ....core.tensor import Tensor
+from .... import nn
+from ....nn import functional as F
+
+__all__ = [
+    "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+    "ParallelCrossEntropy", "RNGStatesTracker", "get_rng_state_tracker",
+    "model_parallel_random_seed", "mp_shardings",
+]
+
+
+def _mark(param, spec):
+    """Attach a partition hint: tuple with one entry per tensor dim, each
+    None or a mesh-axis name."""
+    param.dist_spec = tuple(spec)
+    return param
+
+
+def mp_shardings(layer, mesh, default_spec=()):
+    """NamedShardings for every param of `layer` from its dist_spec marks —
+    feed to jax.jit in_shardings (params pytree must be keyed like
+    jit.functional.extract_state)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out = {}
+    for name, p in layer.named_parameters():
+        spec = getattr(p, "dist_spec", None)
+        if spec is None:
+            out[name] = NamedSharding(mesh, P(*default_spec))
+        else:
+            # drop axes the mesh doesn't have (e.g. mp=1 collapsed meshes)
+            cleaned = [s if (s in mesh.axis_names and mesh.shape[s] > 1)
+                       else None for s in spec]
+            out[name] = NamedSharding(mesh, P(*cleaned))
+    return out
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Embedding with the vocab dim sharded over mp."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = _mark(self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal()),
+            ("mp", None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(nn.Layer):
+    """Linear with the OUTPUT dim sharded over mp (Megatron column)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True,
+                 mp_group=None, fuse_matmul_bias: bool = False, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = _mark(self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal()),
+            (None, "mp"))
+        self.bias = None
+        if has_bias:
+            self.bias = _mark(self.create_parameter(
+                [out_features], is_bias=True), ("mp",))
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = _constrain_last(out, None)   # replicate the output
+        else:
+            out = _constrain_last(out, "mp")   # keep it mp-sharded
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    """Linear with the INPUT dim sharded over mp (Megatron row); output is
+    partial-summed -> GSPMD inserts the psum."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False,
+                 mp_group=None, fuse_matmul_bias: bool = False, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = _mark(self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal()),
+            ("mp", None))
+        self.bias = None
+        if has_bias:
+            # bias is added AFTER the reduction -> replicated
+            self.bias = _mark(self.create_parameter(
+                [out_features], is_bias=True), (None,))
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constrain_last(x, "mp")
+        out = F.linear(x, self.weight, None)
+        out = _constrain_last(out, None)  # after psum: replicated
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def _constrain_last(t: Tensor, axis: Optional[str]):
+    """with_sharding_constraint on the LAST dim of t (None = replicated);
+    no-op outside jit/mesh contexts."""
+    try:
+        from jax.sharding import PartitionSpec as P
+
+        spec = [None] * (t.ndim - 1) + [axis]
+        data = jax.lax.with_sharding_constraint(t._data, P(*spec))
+        out = Tensor(data, stop_gradient=t.stop_gradient)
+        out._grad_node = t._grad_node
+        out._out_index = t._out_index
+        return out
+    except Exception:
+        return t
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Cross entropy over vocab-sharded logits.
+
+    GSPMD computes the sharded log-softmax reduction with the needed
+    cross-mp collectives; numerics equal the dense loss."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        vocab = input.shape[-1]
+        return F.cross_entropy(
+            input.reshape([-1, vocab]), label.reshape([-1]),
+            ignore_index=self.ignore_index, reduction="none").reshape(
+            label.shape)
+
+
+class RNGStatesTracker:
+    """Named RNG streams for TP-consistent dropout (ref:
+    fleet/meta_parallel/parallel_layers/random.py). 'global' draws differ per
+    mp rank; 'local' streams are identical — on TPU the key design gives this
+    for free: streams are explicit Generators keyed by name."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name: str, seed: int):
+        if name in self._states:
+            raise ValueError(f"state {name!r} already exists")
+        self._states[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "model-parallel-rng"):
+        if name not in self._states:
+            self._states[name] = Generator(hash(name) % (2 ** 31))
+        from ....core import rng as rng_mod
+
+        saved = rng_mod._DEFAULT_GENERATOR
+        rng_mod._DEFAULT_GENERATOR = self._states[name]
+        try:
+            yield
+        finally:
+            rng_mod._DEFAULT_GENERATOR = saved
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = 0):
+    import random
+
+    global _RNG_STATE_TRACKER
+    _RNG_STATE_TRACKER = RNGStatesTracker()
+    _RNG_STATE_TRACKER.add("global_seed", seed)
+    _RNG_STATE_TRACKER.add("model-parallel-rng", seed + 2718)

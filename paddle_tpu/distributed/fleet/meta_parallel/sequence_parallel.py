@@ -1,0 +1,122 @@
+"""Megatron-style sequence parallelism utilities.
+
+Ref: fleet/utils/sequence_parallel_utils.py (upstream layout, unverified —
+mount empty). Paddle scatters/gathers activations on the sequence dim around
+TP regions with explicit collectives and registers allreduce hooks for
+SP-region params (LayerNorms). TPU-native: ScatterOp/GatherOp are sharding
+constraints on the sequence dim over the mp axis — GSPMD turns the layout
+changes into the same reduce_scatter/all_gather pairs, fused with the
+adjacent matmuls; SP-param grad sync falls out of replicated param placement.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core.tensor import Tensor
+from .... import nn
+from ....nn import functional as F
+from .parallel_layers import _mark
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+
+def _constrain_dim(t: Tensor, dim: int, axis):
+    try:
+        from jax.sharding import PartitionSpec as P
+
+        spec = [None] * t.ndim
+        spec[dim] = axis
+        data = jax.lax.with_sharding_constraint(t._data, P(*spec))
+        out = Tensor(data, stop_gradient=t.stop_gradient)
+        out._grad_node = t._grad_node
+        out._out_index = t._out_index
+        return out
+    except Exception:
+        return t
+
+
+class ScatterOp:
+    """Split activations on the sequence dim (dim 0 in paddle's [s,b,h]
+    convention; dim 1 for [b,s,h]) across mp."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 0):
+        return _constrain_dim(x, axis, "mp")
+
+
+class GatherOp:
+    """Gather the sequence dim back (replicate across mp)."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 0):
+        return _constrain_dim(x, axis, None)
+
+
+AllGatherOp = GatherOp
+ReduceScatterOp = ScatterOp
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+    return param
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Grad sync for SP-region params: under GSPMD replicated params already
+    get summed grads from sharded activations — nothing to register; kept for
+    API parity."""
+    return None
+
+
+class ColumnSequenceParallelLinear(nn.Layer):
+    """All-gather sequence -> column-parallel matmul (input seq-sharded)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = _mark(self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal()),
+            (None, "mp"))
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            _mark(self.bias, ("mp",))
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        x = GatherOp.apply(x, axis=1)          # all_gather sequence
+        out = F.linear(x, self.weight, self.bias)
+        from .parallel_layers import _constrain_last
+
+        return _constrain_last(out, None if self.gather_output else "mp")
+
+
+class RowSequenceParallelLinear(nn.Layer):
+    """Row-parallel matmul -> reduce-scatter back onto the sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.weight = _mark(self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal()),
+            ("mp", None))
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            mark_as_sequence_parallel_parameter(self.bias)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        out = ScatterOp.apply(out, axis=1)     # reduce_scatter onto sequence
+        if self.bias is not None:
+            out = out + self.bias
+        return out

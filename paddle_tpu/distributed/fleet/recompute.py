@@ -1,0 +1,97 @@
+"""Activation recompute (checkpointing) — fleet.recompute.
+
+Ref: python/paddle/distributed/fleet/recompute/recompute.py (upstream layout,
+unverified — mount empty). Paddle re-runs the forward in backward via a
+PyLayer with RNG-state capture; the TPU-native implementation is jax.remat
+(jax.checkpoint): under the eager tape the checkpointed vjp recomputes
+residuals on the backward pass, and under jitted train steps XLA
+rematerializes — same API, compiler-grade implementation.
+
+When `function` is (or wraps) a Layer, its trainable parameters are threaded
+through the vjp as differentiable inputs so eager `backward()` reaches them
+(they are not baked residuals — that would defeat the checkpoint).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ...core.dispatch import apply_callable
+from ...core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _find_layer(function):
+    from ...nn import Layer
+
+    if isinstance(function, Layer):
+        return function
+    owner = getattr(function, "__self__", None)
+    if isinstance(owner, Layer):
+        return owner
+    return None
+
+
+def recompute(function, *args, use_reentrant: bool = True,
+              preserve_rng_state: bool = True, **kwargs):
+    """Run `function(*args)` without keeping intermediate activations.
+
+    Dropout consistency: ops draw RNG keys through the generator's functional
+    trace stream, so the replayed forward consumes identical keys — paddle's
+    RNG-state capture falls out of the key design.
+    """
+    from ...jit.functional import bind_state
+
+    layer = _find_layer(function)
+    arg_tensors = [a for a in args if isinstance(a, Tensor)]
+    template = [a if not isinstance(a, Tensor) else None for a in args]
+    if layer is not None:
+        named = [(n, p) for n, p in layer.named_parameters()
+                 if not p.stop_gradient]
+        p_names = [n for n, _ in named]
+        p_tensors = [p for _, p in named]
+    else:
+        p_names, p_tensors = [], []
+    n_args = len(arg_tensors)
+
+    @functools.partial(jax.checkpoint, prevent_cse=True)
+    def pure(*datas):
+        arg_datas = datas[:n_args]
+        param_datas = datas[n_args:]
+        it = iter(arg_datas)
+        rebuilt = [Tensor(next(it)) if t is None else t for t in template]
+
+        def unwrap(x):
+            return x._data if isinstance(x, Tensor) else x
+
+        if layer is not None:
+            with bind_state(layer, dict(zip(p_names, param_datas)), {}):
+                out = function(*rebuilt, **kwargs)
+        else:
+            out = function(*rebuilt, **kwargs)
+        return jax.tree_util.tree_map(
+            unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
+
+    return apply_callable("recompute", pure, *arg_tensors, *p_tensors)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Checkpoint a Sequential in `segments` chunks
+    (paddle.incubate.distributed.fleet.recompute_sequential)."""
+    from ...nn import Sequential
+
+    segments = (ctx or {}).get("segments", 1)
+    layers = list(functions)
+    if segments <= 1:
+        seglists = [layers]
+    else:
+        size = max(1, len(layers) // segments)
+        seglists = [layers[i : i + size] for i in range(0, len(layers), size)]
+
+    out = args[0] if len(args) == 1 else args
+    for seg in seglists:
+        seg_layer = Sequential(*seg)
+        out = recompute(seg_layer, out, **kwargs)
+    return out

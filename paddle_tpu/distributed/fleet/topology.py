@@ -1,0 +1,222 @@
+"""Fleet HCG: CommunicateTopology + HybridCommunicateGroup over a jax Mesh.
+
+Ref: python/paddle/distributed/fleet/base/topology.py (upstream layout,
+unverified — mount empty). Paddle builds a cartesian rank topology over axes
+["pp","dp","sharding","sep","mp"] and creates an NCCL group per axis; here the
+same topology IS a jax.sharding.Mesh with those axis names, and each axis's
+"comm group" is a Group bound to the axis name, so shard_map'd code can issue
+collectives per axis. This is the Fleet analog of a device mesh (SURVEY §2.3).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..group import Group, new_group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+_HYBRID_ORDER = ["pp", "dp", "sharding", "sep", "mp"]
+
+
+class CommunicateTopology:
+    def __init__(self,
+                 hybrid_group_names: Sequence[str] = tuple(_HYBRID_ORDER),
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        assert len(hybrid_group_names) == len(dims)
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self._world_size = int(np.prod(self._dims))
+        ranks = range(self._world_size)
+        coords = list(itertools.product(*(range(d) for d in self._dims)))
+        self._coord_of = dict(zip(ranks, coords))
+        self._rank_of = dict(zip(coords, ranks))
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._rank_of[coord]
+
+    def get_coord(self, rank: int):
+        return self._coord_of[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on `axis_name` equals index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._coord_of.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Rank groups that vary along `axis_name` with all other coords
+        fixed — one comm group per combination of the other axes."""
+        axis = self._parallel_names.index(axis_name)
+        others = [d for i, d in enumerate(self._dims) if i != axis]
+        groups = []
+        for combo in itertools.product(*(range(d) for d in others)):
+            group = []
+            for k in range(self._dims[axis]):
+                coord = list(combo)
+                coord.insert(axis, k)
+                group.append(self._rank_of[tuple(coord)])
+            groups.append(group)
+        return groups
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = list(self._coord_of[global_rank])
+        for name, idx in kwargs.items():
+            coord[self._parallel_names.index(name)] = idx
+        return self._rank_of[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """Axis groups + the jax Mesh the whole hybrid job runs on."""
+
+    def __init__(self, topology: CommunicateTopology,
+                 global_rank: Optional[int] = None):
+        self._topo = topology
+        self.nranks = topology.world_size()
+        self.global_rank = (global_rank if global_rank is not None
+                            else _infer_rank())
+        names = topology.get_hybrid_group_names()
+        self._dims = {n: topology.get_dim(n) for n in names}
+
+        devices = jax.devices()
+        if len(devices) >= self.nranks:
+            dev_grid = np.asarray(devices[: self.nranks]).reshape(
+                [topology.get_dim(n) for n in names])
+            self.mesh = jax.sharding.Mesh(dev_grid, tuple(names))
+        else:
+            # multi-host: each process owns a slice; mesh over global devices
+            self.mesh = None
+
+        self._groups: Dict[str, Group] = {}
+        coord = topology.get_coord(self.global_rank)
+        for n in names:
+            axis = names.index(n)
+            ranks = topology.get_comm_list(n)
+            my_group = next(g for g in ranks if self.global_rank in g)
+            g = new_group(my_group, axis_name=n, mesh=self.mesh)
+            g.rank = my_group.index(self.global_rank)
+            self._groups[n] = g
+        self._coord = coord
+        self._names = names
+
+    # ------------------------------------------------------- paddle accessors
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self) -> str:
+        active = [n for n in self._names if self._dims[n] > 1]
+        if not active:
+            return "single"
+        if active == ["dp"]:
+            return "data"
+        if "sharding" in active and set(active) <= {"dp", "sharding"}:
+            return "sharding"
+        if "pp" in active:
+            return "pipeline"
+        return "hybrid"
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    def _axis_rank(self, name: str) -> int:
+        return self._coord[self._names.index(name)]
+
+    def _axis_group(self, name: str) -> Group:
+        return self._groups[name]
+
+    # data parallel
+    def get_data_parallel_rank(self) -> int:
+        return self._axis_rank("dp")
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dims["dp"]
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return self._groups["dp"].ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self) -> int:
+        return self._axis_rank("mp")
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._dims["mp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return self._groups["mp"].ranks[0]
+
+    # pipeline parallel
+    def get_stage_id(self) -> int:
+        return self._axis_rank("pp")
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self._axis_rank("pp")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._dims["pp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def is_first_stage(self) -> bool:
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self) -> bool:
+        return self.get_stage_id() == self._dims["pp"] - 1
+
+    # sharding (ZeRO)
+    def get_sharding_parallel_rank(self) -> int:
+        return self._axis_rank("sharding")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._dims["sharding"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sharding_parallel_group_src_rank(self) -> int:
+        return self._groups["sharding"].ranks[0]
+
+    # sep (segment / context parallel)
+    def get_sep_parallel_rank(self) -> int:
+        return self._axis_rank("sep")
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._dims["sep"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    # p2p helpers for PP schedules
+    def get_p2p_groups(self):
+        return self._groups["pp"]
+
+    def get_rank_from_stage(self, stage_id: int, **kwargs) -> int:
+        return self._topo.get_rank_from_stage(self.global_rank,
+                                              pp=stage_id, **kwargs)
+
+
+def _infer_rank() -> int:
+    import os
+
+    return int(os.environ.get("PADDLE_TRAINER_ID", 0))

@@ -1,0 +1,13 @@
+"""fleet.utils — parity path for sequence_parallel_utils + hybrid helpers.
+
+Ref: python/paddle/distributed/fleet/utils/ (upstream layout, unverified —
+mount empty).
+"""
+from ..meta_parallel import sequence_parallel as sequence_parallel_utils  # noqa: F401
+from ..recompute import recompute  # noqa: F401
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """DP grad sync (ref: fleet/utils/hybrid_parallel_util.py). Under GSPMD
+    the psum is emitted inside jitted steps; kept for API parity."""
+    return None

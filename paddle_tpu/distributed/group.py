@@ -1,0 +1,106 @@
+"""Process groups over jax.sharding.Mesh axes.
+
+Ref: paddle/fluid/distributed/collective/process_group*.cc +
+python/paddle/distributed/communication/group.py (upstream layout, unverified
+— mount empty). Paddle's ProcessGroup wraps an NCCL communicator per group;
+the TPU-native group is a named mesh axis — collectives bind to the axis name
+and XLA emits the matching ICI/DCN collective when the surrounding function is
+shard_map/pjit-traced. Eagerly (no named axis in scope) a group behaves as its
+world_size=1 degenerate, matching paddle before init_parallel_env.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["Group", "new_group", "get_group", "destroy_process_group",
+           "get_default_group", "set_default_group", "_device_mesh"]
+
+
+class Group:
+    """A communication group = an ordered set of ranks + a mesh axis name."""
+
+    def __init__(self, rank: int, ranks: Sequence[int], id: int = 0,
+                 axis_name: Optional[str] = None, mesh=None):
+        self.rank = rank              # this process's rank within the group
+        self.ranks = list(ranks)      # global ranks composing the group
+        self.id = id
+        self.axis_name = axis_name or f"group_{id}"
+        self.mesh = mesh
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def name(self) -> str:
+        return f"_default_pg{self.id}"
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self) -> bool:
+        return self.rank >= 0
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, axis={self.axis_name!r}, "
+                f"nranks={self.nranks})")
+
+
+_GROUPS = {}
+_NEXT_ID = [0]
+_DEFAULT = [None]
+
+
+def _device_mesh(n: Optional[int] = None, axis_name: str = "dp"):
+    """A 1-D mesh over the first n local devices."""
+    devs = jax.devices()
+    n = n or len(devs)
+    return jax.sharding.Mesh(np.asarray(devs[:n]), (axis_name,))
+
+
+def get_default_group() -> Group:
+    if _DEFAULT[0] is None:
+        n = len(jax.devices())
+        _DEFAULT[0] = Group(0, list(range(n)), id=0, axis_name="dp")
+        _GROUPS[0] = _DEFAULT[0]
+    return _DEFAULT[0]
+
+
+def set_default_group(group: Group):
+    _DEFAULT[0] = group
+    _GROUPS[group.id] = group
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend: str = "xla",
+              timeout=None, axis_name: Optional[str] = None,
+              mesh=None) -> Group:
+    """paddle.distributed.new_group analog.
+
+    `axis_name` binds the group to a mesh axis for use inside shard_map; HCG
+    passes it explicitly (pp/dp/sharding/sep/mp)."""
+    _NEXT_ID[0] += 1
+    gid = _NEXT_ID[0]
+    if ranks is None:
+        ranks = list(range(len(jax.devices())))
+    g = Group(0, ranks, id=gid, axis_name=axis_name, mesh=mesh)
+    _GROUPS[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    return _GROUPS.get(gid)
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    if group is None:
+        _GROUPS.clear()
+        _DEFAULT[0] = None
+    else:
+        _GROUPS.pop(group.id, None)

@@ -1,0 +1,2 @@
+"""fleetrun / python -m paddle_tpu.distributed.launch."""
+from .main import main  # noqa: F401

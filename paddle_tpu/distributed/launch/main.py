@@ -1,0 +1,139 @@
+"""Launcher CLI — the fleetrun analog.
+
+Ref: python/paddle/distributed/launch/main.py + controllers/collective.py
+(upstream layout, unverified — mount empty). Paddle's controller assigns one
+process per GPU; on TPU one controller process per HOST owns all local chips
+(jax single-controller), so nproc_per_node defaults to 1 and multi-host jobs
+get PADDLE_* env + jax.distributed coordinator wiring. The watch loop keeps
+paddle's semantics: abort the job when a rank dies, optional restart budget
+(elastic-lite).
+
+Usage:
+  python -m paddle_tpu.distributed.launch [--nnodes N] [--node_rank R]
+      [--master IP:PORT] [--nproc_per_node M] [--elastic_retries K]
+      training_script [script args...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["main"]
+
+
+def _parse():
+    p = argparse.ArgumentParser(prog="fleetrun", add_help=True)
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", 1)))
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--master",
+                   default=os.environ.get("PADDLE_MASTER", "127.0.0.1:49170"))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (TPU single-controller: 1)")
+    p.add_argument("--elastic_retries", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_RETRIES", 0)),
+                   help="restart budget per rank before aborting the job")
+    p.add_argument("--log_dir", default=os.environ.get("PADDLE_LOG_DIR"))
+    p.add_argument("--devices", "--gpus", "--tpus", dest="devices",
+                   default=None, help="visible device ids, comma separated")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def _rank_env(args, local_rank: int) -> dict:
+    world = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    host, port = args.master.rsplit(":", 1)
+    endpoints = ",".join(
+        f"{host}:{int(port) + i}" for i in range(world))
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TRAINER_ENDPOINTS": endpoints,
+        "PADDLE_CURRENT_ENDPOINT": f"{host}:{int(port) + rank}",
+        "PADDLE_MASTER": args.master,
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(args.nnodes),
+    })
+    if args.devices:
+        env["FLAGS_selected_tpus"] = args.devices
+    return env
+
+
+def main(argv=None):
+    args = _parse()
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = {}
+    retries = {}
+
+    def launch(local_rank: int):
+        env = _rank_env(args, local_rank)
+        cmd = [sys.executable, args.script] + args.script_args
+        stdout = None
+        if args.log_dir:
+            rank = env["PADDLE_TRAINER_ID"]
+            stdout = open(os.path.join(args.log_dir,
+                                       f"worker.{rank}.log"), "ab")
+        proc = subprocess.Popen(cmd, env=env, stdout=stdout,
+                                stderr=subprocess.STDOUT if stdout else None)
+        procs[local_rank] = proc
+        return proc
+
+    for lr in range(args.nproc_per_node):
+        launch(lr)
+
+    def shutdown(signum=None, frame=None):
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in procs.values():
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    # watch loop: paddle's collective controller semantics
+    exit_code = 0
+    try:
+        while procs:
+            time.sleep(0.5)
+            for lr, p in list(procs.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                if code == 0:
+                    del procs[lr]
+                    continue
+                retries[lr] = retries.get(lr, 0) + 1
+                if retries[lr] <= args.elastic_retries:
+                    print(f"[fleetrun] rank {lr} exited {code}; restart "
+                          f"{retries[lr]}/{args.elastic_retries}",
+                          file=sys.stderr)
+                    launch(lr)
+                else:
+                    print(f"[fleetrun] rank {lr} failed (exit {code}); "
+                          "aborting job", file=sys.stderr)
+                    exit_code = code
+                    shutdown()
+                    return exit_code
+    finally:
+        if exit_code:
+            shutdown()
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

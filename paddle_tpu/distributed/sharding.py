@@ -1,0 +1,22 @@
+"""paddle.distributed.sharding — group_sharded_parallel entry point.
+
+Ref: python/paddle/distributed/sharding/group_sharded.py (upstream layout,
+unverified — mount empty).
+"""
+from .fleet.meta_parallel.sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+    group_sharded_parallel,
+)
+from ..framework.io import save as _save
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Gather-on-rank0 save (ref: group_sharded.py save util)."""
+    if hasattr(model, "get_all_parameters"):
+        model.get_all_parameters()
+    _save(model.state_dict(), str(output) + ".pdparams")
+    if optimizer is not None:
+        inner = getattr(optimizer, "_optim", optimizer)
+        _save(inner.state_dict(), str(output) + ".pdopt")

@@ -1,0 +1,61 @@
+"""paddle.distributed.spawn (ref: python/paddle/distributed/spawn.py,
+upstream layout, unverified — mount empty).
+
+On TPU the single-controller process owns all local chips, so nprocs defaults
+to 1 per host; multi-host jobs use one spawned process per host with the
+PADDLE_* env contract (launch/ sets the same vars).
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+from typing import Sequence
+
+__all__ = ["spawn"]
+
+
+def _worker(func, rank, nprocs, args, env):
+    for k, v in env.items():
+        os.environ[k] = v
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    """Launch `func` in nprocs processes with paddle's env contract."""
+    if nprocs == 1:
+        os.environ.setdefault("PADDLE_TRAINER_ID", "0")
+        os.environ.setdefault("PADDLE_TRAINERS_NUM", "1")
+        func(*args)
+        return None
+
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    base_env = {k: v for k, v in os.environ.items() if k.startswith("PADDLE")}
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, args, base_env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class Context:
+        def __init__(self, processes):
+            self.processes = processes
+
+        def join(self, timeout=None):
+            for p in self.processes:
+                p.join(timeout)
+            bad = [p for p in self.processes if p.exitcode not in (0, None)]
+            if bad:
+                raise RuntimeError(
+                    f"{len(bad)} spawned processes failed "
+                    f"(exit codes {[p.exitcode for p in bad]})")
+
+    context = Context(procs)
+    if join:
+        context.join()
+        return None
+    return context

@@ -120,6 +120,15 @@ class Model:
         total = sum(jnp.sum(l) for l in losses)
         return total.astype(jnp.float32), losses
 
+    def _dp_shardings(self):
+        """When the network is DataParallel, shard the batch over dp and
+        replicate params — XLA's sharding propagation then emits the fused
+        gradient all-reduce (the Reducer equivalent, SURVEY.md §7 L5)."""
+        net = self.network
+        if hasattr(net, "data_sharding") and hasattr(net, "param_sharding"):
+            return net.data_sharding(), net.param_sharding()
+        return None, None
+
     def _build_train_step(self):
         opt = self._optimizer
 
@@ -137,6 +146,27 @@ class Model:
                 params, grads, opt_state, lr, t)
             return losses, outs, new_buffers, new_params, new_state
 
+        data_sh, param_sh = self._dp_shardings()
+        if data_sh is not None:
+            from jax.tree_util import tree_map
+
+            net = self.network
+            params, buffers = self._sync_state_in()
+            self._ensure_opt_state(params)
+            # per-param sharding trees (GroupSharded stages) when the wrapper
+            # provides them; otherwise a uniform prefix (DataParallel)
+            if hasattr(net, "param_shardings"):
+                p_sh = net.param_shardings(params)
+            else:
+                p_sh = tree_map(lambda _: param_sh, params)
+            if hasattr(net, "opt_state_shardings"):
+                o_sh = net.opt_state_shardings(self._opt_state)
+            else:
+                o_sh = tree_map(lambda _: param_sh, self._opt_state)
+            b_sh = tree_map(lambda _: param_sh, buffers)
+            return jax.jit(step, donate_argnums=(0, 2),
+                           in_shardings=(p_sh, b_sh, o_sh,
+                                         None, None, None, data_sh, data_sh))
         return jax.jit(step, donate_argnums=(0, 2))
 
     def _build_eval_step(self):
@@ -212,6 +242,20 @@ class Model:
             self._train_step_fn = self._build_train_step()
         input_datas = tuple(_to_data(x) for x in _to_list(inputs))
         label_datas = tuple(_to_data(x) for x in _to_list(labels))
+        data_sh, _ = self._dp_shardings()
+        if data_sh is not None and input_datas:
+            spec0 = data_sh.spec[0] if data_sh.spec else None
+            axes = ((spec0,) if isinstance(spec0, str)
+                    else tuple(spec0 or ()))
+            nshard = 1
+            for a in axes:
+                nshard *= data_sh.mesh.shape[a]
+            if nshard > 1 and input_datas[0].shape[0] % nshard:
+                raise ValueError(
+                    f"data-parallel batch size {input_datas[0].shape[0]} is "
+                    f"not divisible by the {nshard}-way dp sharding; use "
+                    "drop_last=True or DistributedBatchSampler so every "
+                    "device gets an equal shard")
         params, buffers = self._sync_state_in()
         self._ensure_opt_state(params)
         opt = self._optimizer
@@ -270,6 +314,9 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
         assert train_data is not None, "train_data must be given"
+        if accumulate_grad_batches != 1:
+            raise NotImplementedError(
+                "gradient accumulation lands with the fleet hybrid optimizer")
         train_loader = self._make_loader(train_data, batch_size, shuffle,
                                          num_workers, drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False,

@@ -1,0 +1,5 @@
+"""paddle.incubate — experimental APIs (MoE, fused layers).
+
+Ref: python/paddle/incubate/ (upstream layout, unverified — mount empty).
+"""
+from . import distributed  # noqa: F401

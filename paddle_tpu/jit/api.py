@@ -76,7 +76,8 @@ class StaticFunction:
         return self._input_spec
 
     def _compiled_for(self, args):
-        sig = _sig_of(args)
+        training = self._layer.training if self._layer is not None else False
+        sig = (_sig_of(args), training)
         entry = self._cache.get(sig)
         if entry is not None:
             return entry

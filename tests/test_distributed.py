@@ -1,0 +1,304 @@
+"""L5 tests: communication API, HCG topology, DataParallel, launcher.
+
+Strategy per SURVEY.md §4: 8 fake devices via
+xla_force_host_platform_device_count; collectives run inside shard_map;
+parallel training is checked sharded-vs-replica allclose.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet import (
+    CommunicateTopology, DistributedStrategy, HybridCommunicateGroup,
+)
+
+
+def _mesh8():
+    return Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+
+
+def _run_sharded(fn, *arrays, mesh=None, in_spec=P("dp"), out_spec=P("dp")):
+    mesh = mesh or _mesh8()
+    smapped = shard_map(fn, mesh=mesh,
+                        in_specs=tuple(in_spec for _ in arrays),
+                        out_specs=out_spec)
+    return smapped(*arrays)
+
+
+# ------------------------------------------------------------- collectives
+def test_all_reduce_sum():
+    g = dist.new_group(list(range(8)), axis_name="dp")
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def f(x):
+        t = Tensor(x)
+        dist.all_reduce(t, group=g)
+        return t._data
+
+    out = _run_sharded(f, x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+
+
+def test_all_reduce_max_min():
+    g = dist.new_group(list(range(8)), axis_name="dp")
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def fmax(x):
+        return dist.all_reduce(Tensor(x), op=dist.ReduceOp.MAX, group=g)._data
+
+    out = _run_sharded(fmax, x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 7.0))
+
+
+def test_all_gather():
+    g = dist.new_group(list(range(8)), axis_name="dp")
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def f(x):
+        got = []
+        dist.all_gather(got, Tensor(x), group=g)
+        return jnp.concatenate([t._data for t in got], axis=0)
+
+    out = _run_sharded(f, x, out_spec=P("dp", None))
+    # every shard gathered the full [0..7]
+    np.testing.assert_allclose(np.asarray(out).ravel()[:8], np.arange(8.0))
+
+
+def test_reduce_scatter():
+    g = dist.new_group(list(range(8)), axis_name="dp")
+    # each rank holds a full [8] vector of ones -> reduce gives 8s, each rank
+    # keeps its slice
+    x = jnp.ones((8, 8))
+
+    def f(x):
+        t = Tensor(x[0])  # local [8]
+        dist.reduce_scatter(t, group=g)
+        return t._data[None, :]
+
+    out = _run_sharded(f, x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 8.0))
+
+
+def test_broadcast():
+    g = dist.new_group(list(range(8)), axis_name="dp")
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def f(x):
+        t = Tensor(x)
+        dist.broadcast(t, src=3, group=g)
+        return t._data
+
+    out = _run_sharded(f, x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
+
+
+def test_alltoall_single():
+    g = dist.new_group(list(range(8)), axis_name="dp")
+    # rank r holds [r*8 .. r*8+7]; after all_to_all rank r holds column r
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    def f(x):
+        return dist.alltoall_single(Tensor(x[0]), group=g)._data[None]
+
+    out = np.asarray(_run_sharded(f, x))
+    expect = np.arange(64.0).reshape(8, 8).T
+    np.testing.assert_allclose(out, expect)
+
+
+def test_batch_isend_irecv_ring():
+    g = dist.new_group(list(range(8)), axis_name="dp")
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def f(x):
+        send_t = Tensor(x)
+        recv_t = Tensor(jnp.zeros_like(x))
+        ops = [dist.P2POp(dist.isend, send_t, 1, g),
+               dist.P2POp(dist.irecv, recv_t, 1, g)]
+        dist.batch_isend_irecv(ops)
+        return recv_t._data
+
+    out = np.asarray(_run_sharded(f, x)).ravel()
+    # ring shift by +1: rank r receives value from rank r-1
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_collectives_eager_world1():
+    # outside shard_map, groups degenerate to world_size 1
+    t = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+    out = dist.all_reduce(t)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+    parts = dist.all_gather(None, t)
+    assert parts.shape[0] == 2
+
+
+# ---------------------------------------------------------------- topology
+def test_communicate_topology():
+    topo = CommunicateTopology(["pp", "dp", "sharding", "sep", "mp"],
+                               [2, 2, 1, 1, 2])
+    assert topo.world_size() == 8
+    assert topo.get_rank(pp=1, dp=0, sharding=0, sep=0, mp=1) == 5
+    assert topo.get_coord(5) == (1, 0, 0, 0, 1)
+    mp_groups = topo.get_comm_list("mp")
+    assert [0, 1] in mp_groups and len(mp_groups) == 4
+    pp_groups = topo.get_comm_list("pp")
+    assert [0, 4] in pp_groups
+
+
+def test_hcg_accessors():
+    topo = CommunicateTopology(["pp", "dp", "sharding", "sep", "mp"],
+                               [2, 2, 1, 1, 2])
+    hcg = HybridCommunicateGroup(topo, global_rank=5)
+    assert hcg.get_stage_id() == 1
+    assert hcg.get_model_parallel_rank() == 1
+    assert hcg.get_data_parallel_rank() == 0
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_pipe_parallel_world_size() == 2
+    assert not hcg.is_first_stage() and hcg.is_last_stage()
+    assert hcg.mesh is not None and hcg.mesh.shape["mp"] == 2
+    g = hcg.get_model_parallel_group()
+    assert g.axis_name == "mp" and g.nranks == 2
+
+
+def test_fleet_init():
+    from paddle_tpu.distributed.fleet import fleet
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    assert hcg.get_data_parallel_world_size() == 4
+    assert hcg.get_model_parallel_world_size() == 2
+    assert fleet.get_hybrid_communicate_group() is hcg
+
+
+# ------------------------------------------------------------ DataParallel
+def test_data_parallel_matches_single_device():
+    """Sharded-vs-replica allclose (the reference's hybrid-correctness
+    pattern, SURVEY §4)."""
+
+    def build():
+        paddle.seed(42)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Momentum(learning_rate=0.05,
+                                      parameters=net.parameters()),
+            nn.CrossEntropyLoss())
+        return net, model
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 16).astype("float32")
+    y = rng.randint(0, 4, (64, 1))
+
+    # replica run
+    net1, model1 = build()
+    losses1 = [float(model1.train_batch([x], [y])[0]) for _ in range(3)]
+
+    # dp run over 8 devices
+    net2, _ = build()
+    dp = dist.DataParallel(net2)
+    model2 = paddle.Model(dp)
+    model2.prepare(
+        paddle.optimizer.Momentum(learning_rate=0.05,
+                                  parameters=net2.parameters()),
+        nn.CrossEntropyLoss())
+    losses2 = [float(model2.train_batch([x], [y])[0]) for _ in range(3)]
+
+    np.testing.assert_allclose(losses1, losses2, rtol=2e-5)
+    p1 = net1.parameters()[0].numpy()
+    p2 = net2.parameters()[0].numpy()
+    np.testing.assert_allclose(p1, p2, rtol=2e-5, atol=1e-6)
+
+
+def test_data_parallel_batch_is_sharded():
+    net = nn.Linear(8, 2)
+    dp = dist.DataParallel(net)
+    sh = dp.data_sharding()
+    assert sh.spec == P(("dp",))
+    assert dp.param_sharding().spec == P()
+
+
+# ------------------------------------------------------------ auto_parallel
+def test_shard_tensor_and_reshard():
+    mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["x", "y"])
+    t = paddle.to_tensor(np.random.rand(8, 4).astype("float32"))
+    st = dist.shard_tensor(t, mesh, [dist.Shard(0), dist.Shard(1)])
+    assert st._data.sharding.spec == P("x", "y")
+    rt = dist.reshard(st, mesh, [dist.Replicate(), dist.Replicate()])
+    assert rt._data.sharding.spec == P()
+    np.testing.assert_allclose(np.asarray(rt._data), np.asarray(t._data))
+
+
+# ------------------------------------------------------- checkpoint / spawn
+def test_dist_checkpoint_roundtrip(tmp_path):
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+    sharded = jax.device_put(
+        jnp.arange(32.0).reshape(8, 4), NamedSharding(mesh, P("dp", None)))
+    sd = {"w": Tensor(sharded), "b": Tensor(jnp.ones(4))}
+    dist.save_state_dict(sd, str(tmp_path / "ckpt"))
+
+    # load into a DIFFERENT sharding (replicated) — resharding on load
+    sd2 = {"w": Tensor(jnp.zeros((8, 4))), "b": Tensor(jnp.zeros(4))}
+    dist.load_state_dict(sd2, str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(np.asarray(sd2["w"]._data),
+                               np.arange(32.0).reshape(8, 4))
+    np.testing.assert_allclose(np.asarray(sd2["b"]._data), np.ones(4))
+
+
+def test_spawn_single():
+    result = []
+    dist.spawn(lambda a: result.append(a * 2), args=(21,), nprocs=1)
+    assert result == [42]
+
+
+# ---------------------------------------------------------------- launcher
+def test_fleetrun_launcher(tmp_path):
+    script = tmp_path / "train_stub.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        world = os.environ["PADDLE_TRAINERS_NUM"]
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"]
+        print(f"rank={rank} world={world} neps={len(eps.split(','))}")
+    """))
+    env = dict(os.environ)
+    env.pop("PADDLE_TRAINER_ID", None)
+    # don't let spawned ranks contend for the single axon TPU chip
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "1", "--nproc_per_node", "2", str(script)],
+        capture_output=True, text=True, env=env, timeout=120,
+        cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    assert "rank=0 world=2 neps=2" in out.stdout
+    assert "rank=1 world=2 neps=2" in out.stdout
+
+
+def test_fleetrun_abort_on_failure(tmp_path):
+    script = tmp_path / "bad_stub.py"
+    script.write_text("import os, sys; sys.exit(3)")
+    env = dict(os.environ)
+    env.pop("PADDLE_TRAINER_ID", None)
+    # don't let spawned ranks contend for the single axon TPU chip
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", str(script)],
+        capture_output=True, text=True, env=env, timeout=120,
+        cwd="/root/repo")
+    assert out.returncode == 3
+    assert "aborting job" in out.stderr

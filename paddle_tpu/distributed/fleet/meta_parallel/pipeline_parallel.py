@@ -137,22 +137,32 @@ class PipelineLayer(nn.Layer):
         return x
 
 
-def _stage_forward_fn(stage_layers: List[nn.Layer]):
-    """Pure fn (params, buffers, x) -> y for one stage's sublayers."""
+def _stage_forward_fn(stage_layers: List[nn.Layer], training: bool = True):
+    """Pure fn (params, buffers, x, key) -> y for one stage's sublayers.
+    `key` feeds the functional RNG stream (dropout); backward recompute
+    passes the SAME key so masks match the forward. `training` is baked into
+    the trace — the engine keeps separate train/eval jit caches."""
+    from ....core.rng import default_generator
 
-    def fn(params, buffers, x):
+    def fn(params, buffers, x, key):
         t = Tensor(x)
         outs = t
         consumed_p = dict(params)
         consumed_b = dict(buffers)
-        for i, layer in enumerate(stage_layers):
-            p_i = {k.split("/", 1)[1]: v for k, v in consumed_p.items()
-                   if k.startswith(f"{i}/")}
-            b_i = {k.split("/", 1)[1]: v for k, v in consumed_b.items()
-                   if k.startswith(f"{i}/")}
-            with bind_state(layer, p_i, b_i):
-                with tape_mod.no_grad():
-                    outs = layer(outs)
+        import contextlib
+
+        rng_ctx = (default_generator().trace_mode(key)
+                   if key is not None else contextlib.nullcontext())
+        with rng_ctx:
+            for i, layer in enumerate(stage_layers):
+                layer.train() if training else layer.eval()
+                p_i = {k.split("/", 1)[1]: v for k, v in consumed_p.items()
+                       if k.startswith(f"{i}/")}
+                b_i = {k.split("/", 1)[1]: v for k, v in consumed_b.items()
+                       if k.startswith(f"{i}/")}
+                with bind_state(layer, p_i, b_i):
+                    with tape_mod.no_grad():
+                        outs = layer(outs)
         return outs._data if isinstance(outs, Tensor) else outs
 
     return fn
@@ -172,8 +182,8 @@ class PipelineParallel:
 
         self._stage_meshes = self._build_stage_meshes()
         self._stage_state = []       # (params, buffers) pytrees per stage
-        self._fwd_jit: List[Callable] = []
-        self._bwd_jit: List[Callable] = []
+        self._stage_param_sh = []    # per-stage param sharding dicts
+        self._jit_cache = {}         # (stage, training) -> (fwd, bwd)
         self._opt_states = None
         self._build_stages()
 
@@ -206,6 +216,18 @@ class PipelineParallel:
         repl = NamedSharding(mesh, P())
         return data_sh, repl
 
+    def _param_sharding(self, p, mesh):
+        """Per-param placement on the stage submesh honoring TP dist_spec
+        marks (mp_layers._mark); replicated otherwise."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = getattr(p, "dist_spec", None)
+        if spec is None:
+            return NamedSharding(mesh, P())
+        cleaned = [a if (a in mesh.axis_names and mesh.shape[a] > 1)
+                   else None for a in spec]
+        return NamedSharding(mesh, P(*cleaned))
+
     def _build_stages(self):
         for s in range(self.num_stages):
             layers_s = self._layers.stage_layers[s]
@@ -215,8 +237,14 @@ class PipelineParallel:
                 params.update({f"{i}/{k}": v for k, v in p_i.items()})
                 buffers.update({f"{i}/{k}": v for k, v in b_i.items()})
             data_sh, repl = self._stage_sharding(s)
+            param_sh = None
             if repl is not None:
-                params = {k: jax.device_put(v, repl)
+                mesh = self._stage_meshes[s]
+                param_sh = {}
+                for i, layer in enumerate(layers_s):
+                    for k, p in dict(layer.named_parameters()).items():
+                        param_sh[f"{i}/{k}"] = self._param_sharding(p, mesh)
+                params = {k: jax.device_put(v, param_sh[k])
                           for k, v in params.items()}
                 buffers = {k: jax.device_put(v, repl)
                            for k, v in buffers.items()}
@@ -226,58 +254,72 @@ class PipelineParallel:
                     for k, p in named.items():
                         p._data = params[f"{i}/{k}"]
             self._stage_state.append((params, buffers))
+            self._stage_param_sh.append(param_sh)
 
-            fwd_pure = _stage_forward_fn(layers_s)
-            is_last = s == self.num_stages - 1
-            loss_fn = self._layers._loss_fn
+    def _get_jits(self, s: int, training: bool):
+        """Per-(stage, mode) jitted fwd/bwd — lazily built and cached, so
+        train and eval never share a trace (dropout/BN mode is baked in)."""
+        cache_key = (s, training)
+        hit = self._jit_cache.get(cache_key)
+        if hit is not None:
+            return hit
 
-            if is_last and loss_fn is not None:
-                def last_fwd(params, buffers, x, label, _f=fwd_pure):
-                    y = _f(params, buffers, x)
+        layers_s = self._layers.stage_layers[s]
+        fwd_pure = _stage_forward_fn(layers_s, training=training)
+        is_last = s == self.num_stages - 1
+        loss_fn = self._layers._loss_fn
+        data_sh, repl = self._stage_sharding(s)
+        param_sh = self._stage_param_sh[s]
+
+        # in_shardings pin each stage's jit to its submesh; the incoming
+        # activation (possibly on the previous stage's devices) is then
+        # resharded by the runtime — the ICI send/recv of the schedule
+        if repl is not None:
+            fwd_in = ((param_sh, repl, data_sh, data_sh, repl) if is_last
+                      and loss_fn is not None
+                      else (param_sh, repl, data_sh, repl))
+            bwd_in = ((param_sh, repl, data_sh, data_sh, repl) if is_last
+                      and loss_fn is not None
+                      else (param_sh, repl, data_sh, data_sh, repl))
+        else:
+            fwd_in = bwd_in = None
+
+        if is_last and loss_fn is not None:
+            def last_fwd(params, buffers, x, label, key, _f=fwd_pure):
+                y = _f(params, buffers, x, key)
+                with tape_mod.no_grad():
+                    loss = loss_fn(Tensor(y), Tensor(label))
+                return loss._data if isinstance(loss, Tensor) else loss
+
+            def last_bwd(params, buffers, x, label, key, _f=fwd_pure):
+                def lf(p, xx):
+                    y = _f(p, buffers, xx, key)
                     with tape_mod.no_grad():
                         loss = loss_fn(Tensor(y), Tensor(label))
-                    return loss._data if isinstance(loss, Tensor) else loss
+                    return loss._data
 
-                def last_bwd(params, buffers, x, label, _f=fwd_pure):
-                    def lf(p, xx):
-                        y = _f(p, buffers, xx)
-                        with tape_mod.no_grad():
-                            loss = loss_fn(Tensor(y), Tensor(label))
-                        return loss._data
+                loss, vjp = jax.vjp(lf, params, x)
+                dparams, dx = vjp(jnp.ones_like(loss))
+                return loss, dparams, dx
 
-                    loss, vjp = jax.vjp(lf, params, x)
-                    dparams, dx = vjp(jnp.ones_like(loss))
-                    return loss, dparams, dx
+            pair = (jax.jit(last_fwd, in_shardings=fwd_in),
+                    jax.jit(last_bwd, in_shardings=bwd_in))
+        else:
+            def mid_fwd(params, buffers, x, key, _f=fwd_pure):
+                return _f(params, buffers, x, key)
 
-            # in_shardings pin each stage's jit to its submesh; the incoming
-            # activation (possibly on the previous stage's devices) is then
-            # resharded by the runtime — the ICI send/recv of the schedule
-            if repl is not None:
-                fwd_in = ((repl, repl, data_sh, data_sh) if is_last and
-                          loss_fn is not None else (repl, repl, data_sh))
-                bwd_in = ((repl, repl, data_sh, data_sh) if is_last and
-                          loss_fn is not None
-                          else (repl, repl, data_sh, data_sh))
-            else:
-                fwd_in = bwd_in = None
+            def mid_bwd(params, buffers, x, gy, key, _f=fwd_pure):
+                def f(p, xx):
+                    return _f(p, buffers, xx, key)
 
-            if is_last and loss_fn is not None:
-                self._fwd_jit.append(jax.jit(last_fwd, in_shardings=fwd_in))
-                self._bwd_jit.append(jax.jit(last_bwd, in_shardings=bwd_in))
-            else:
-                def mid_fwd(params, buffers, x, _f=fwd_pure):
-                    return _f(params, buffers, x)
+                y, vjp = jax.vjp(f, params, x)
+                dparams, dx = vjp(gy)
+                return dparams, dx
 
-                def mid_bwd(params, buffers, x, gy, _f=fwd_pure):
-                    def f(p, xx):
-                        return _f(p, buffers, xx)
-
-                    y, vjp = jax.vjp(f, params, x)
-                    dparams, dx = vjp(gy)
-                    return dparams, dx
-
-                self._fwd_jit.append(jax.jit(mid_fwd, in_shardings=fwd_in))
-                self._bwd_jit.append(jax.jit(mid_bwd, in_shardings=bwd_in))
+            pair = (jax.jit(mid_fwd, in_shardings=fwd_in),
+                    jax.jit(mid_bwd, in_shardings=bwd_in))
+        self._jit_cache[cache_key] = pair
+        return pair
 
     def _to_stage(self, s: int, x):
         """Move an activation/cotangent onto stage s's submesh (the explicit
@@ -300,6 +342,12 @@ class PipelineParallel:
         acts = [[None] * M for _ in range(S)]
         grads = [None] * S           # accumulated param grads per stage
         losses = []
+        # one RNG key per (stage, micro-batch): forward and its backward
+        # recompute consume the same key, so dropout masks agree
+        from ....core.rng import default_generator
+
+        keys = [[default_generator().next_key() for _ in range(M)]
+                for _ in range(S)]
 
         def run_fwd_chain(m, upto):
             """Forward micro-batch m through stages [0, upto]."""
@@ -309,7 +357,8 @@ class PipelineParallel:
                 acts[s][m] = x
                 if s == S - 1:
                     break
-                x = self._fwd_jit[s](*self._stage_state[s], x)
+                fwd, _ = self._get_jits(s, training=True)
+                x = fwd(*self._stage_state[s], x, keys[s][m])
             return x
 
         def accum(s, dparams):
@@ -321,15 +370,18 @@ class PipelineParallel:
         def run_bwd_chain(m):
             """Backward micro-batch m from last stage to first."""
             s = S - 1
-            loss, dparams, gx = self._bwd_jit[s](
+            _, bwd = self._get_jits(s, training=True)
+            loss, dparams, gx = bwd(
                 *self._stage_state[s], acts[s][m],
-                self._to_stage(s, micro_labels[m]))
+                self._to_stage(s, micro_labels[m]), keys[s][m])
             losses.append(loss)
             accum(s, dparams)
             for s in range(S - 2, -1, -1):
-                dparams, gx = self._bwd_jit[s](*self._stage_state[s],
-                                               acts[s][m],
-                                               self._to_stage(s, gx))
+                _, bwd = self._get_jits(s, training=True)
+                dparams, gx = bwd(*self._stage_state[s],
+                                  acts[s][m],
+                                  self._to_stage(s, gx),
+                                  keys[s][m])
                 accum(s, dparams)
                 acts[s][m] = None
             acts[S - 1][m] = None
@@ -393,18 +445,26 @@ class PipelineParallel:
         inputs, labels = data
         x = inputs._data if isinstance(inputs, Tensor) else jnp.asarray(
             np.asarray(inputs))
+        from ....core.rng import default_generator
+
         for s in range(self.num_stages - 1):
-            x = self._fwd_jit[s](*self._stage_state[s], self._to_stage(s, x))
+            fwd, _ = self._get_jits(s, training=False)
+            x = fwd(*self._stage_state[s], self._to_stage(s, x),
+                    default_generator().next_key())
         x = self._to_stage(self.num_stages - 1, x)
         if compute_loss and self._layers._loss_fn is not None:
             y = labels._data if isinstance(labels, Tensor) else jnp.asarray(
                 np.asarray(labels))
-            loss = self._fwd_jit[-1](*self._stage_state[-1], x,
-                                     self._to_stage(self.num_stages - 1, y))
+            fwd, _ = self._get_jits(self.num_stages - 1, training=False)
+            loss = fwd(*self._stage_state[-1], x,
+                       self._to_stage(self.num_stages - 1, y),
+                       default_generator().next_key())
             return Tensor(loss)
         # run last stage layers without loss
-        fwd = _stage_forward_fn(self._layers.stage_layers[-1])
-        return Tensor(fwd(*self._stage_state[-1], x))
+        fwd = _stage_forward_fn(self._layers.stage_layers[-1],
+                                training=False)
+        return Tensor(fwd(*self._stage_state[-1], x,
+                          default_generator().next_key()))
 
     def parameters(self):
         return self._layers.parameters()

@@ -5,4 +5,7 @@ paddle.nn); here they are first-class so the framework ships runnable
 benchmark models (BASELINE.json configs #3-#5).
 """
 from .ernie import ErnieConfig, ErnieModel, ErnieForPretraining  # noqa: F401
-from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTEmbeddingPipe, GPTForCausalLM, GPTHeadPipe, GPTModel,
+    GPTPretrainingCriterion, gpt_pipe_layers,
+)

@@ -120,13 +120,19 @@ class ErnieEmbeddings(nn.Layer):
 
 
 def _init_transformer_weights(root: nn.Layer, std: float):
-    """BERT-style init: N(0, std) for Linear/Embedding weights, zeros for
-    biases; LayerNorm params untouched (ones/zeros)."""
+    """BERT-style init: N(0, std) for Linear/Embedding weights (incl. their
+    tensor-parallel variants), zeros for biases; LayerNorm params untouched
+    (ones/zeros). Rebinds _data only, preserving dist_spec marks."""
     from ..nn.initializer import Normal
+    from ..distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    )
 
     init = Normal(mean=0.0, std=std)
+    types = (nn.Linear, nn.Embedding, ColumnParallelLinear,
+             RowParallelLinear, VocabParallelEmbedding)
     for sub in root.sublayers(include_self=True):
-        if isinstance(sub, (nn.Linear, nn.Embedding)):
+        if isinstance(sub, types):
             w = sub.weight
             w._data = init(w.shape, w._data.dtype)
 

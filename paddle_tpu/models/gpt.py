@@ -40,12 +40,22 @@ class GPTConfig:
 
 
 class GPTAttention(nn.Layer):
-    def __init__(self, cfg: GPTConfig):
+    def __init__(self, cfg: GPTConfig, tensor_parallel: bool = False):
         super().__init__()
         self.num_heads = cfg.num_attention_heads
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
-        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
-        self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        if tensor_parallel:
+            from ..distributed.fleet.meta_parallel import (
+                ColumnParallelLinear, RowParallelLinear,
+            )
+
+            self.qkv = ColumnParallelLinear(cfg.hidden_size,
+                                            3 * cfg.hidden_size,
+                                            gather_output=True)
+            self.out = RowParallelLinear(cfg.hidden_size, cfg.hidden_size)
+        else:
+            self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+            self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.dropout_p = cfg.attention_probs_dropout_prob
 
     def forward(self, x):
@@ -61,13 +71,25 @@ class GPTAttention(nn.Layer):
 
 
 class GPTBlock(nn.Layer):
-    def __init__(self, cfg: GPTConfig):
+    def __init__(self, cfg: GPTConfig, tensor_parallel: bool = False):
         super().__init__()
         self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
-        self.attn = GPTAttention(cfg)
+        self.attn = GPTAttention(cfg, tensor_parallel)
         self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
-        self.ffn_in = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
-        self.ffn_out = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        if tensor_parallel:
+            from ..distributed.fleet.meta_parallel import (
+                ColumnParallelLinear, RowParallelLinear,
+            )
+
+            self.ffn_in = ColumnParallelLinear(cfg.hidden_size,
+                                               cfg.intermediate_size,
+                                               gather_output=False)
+            self.ffn_out = RowParallelLinear(cfg.intermediate_size,
+                                             cfg.hidden_size,
+                                             input_is_parallel=True)
+        else:
+            self.ffn_in = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+            self.ffn_out = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, x):
@@ -77,14 +99,26 @@ class GPTBlock(nn.Layer):
 
 
 class GPTModel(nn.Layer):
-    def __init__(self, cfg: Optional[GPTConfig] = None):
+    """tensor_parallel=True builds Megatron TP blocks (fleet mp_layers) whose
+    param marks drive GSPMD sharding under a jitted step (bench config #4's
+    mp dimension)."""
+
+    def __init__(self, cfg: Optional[GPTConfig] = None,
+                 tensor_parallel: bool = False):
         super().__init__()
         self.config = cfg or GPTConfig()
         cfg = self.config
-        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        if tensor_parallel:
+            from ..distributed.fleet.meta_parallel import (
+                VocabParallelEmbedding,
+            )
+
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
         self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
-        self.blocks = nn.LayerList([GPTBlock(cfg)
+        self.blocks = nn.LayerList([GPTBlock(cfg, tensor_parallel)
                                     for _ in range(cfg.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         from .ernie import _init_transformer_weights
@@ -101,6 +135,71 @@ class GPTModel(nn.Layer):
         for blk in self.blocks:
             x = blk(x)
         return self.ln_f(x)
+
+
+class GPTEmbeddingPipe(nn.Layer):
+    """First pipeline stage: token + position embeddings."""
+
+    def __init__(self, cfg: GPTConfig, tensor_parallel: bool = False):
+        super().__init__()
+        if tensor_parallel:
+            from ..distributed.fleet.meta_parallel import (
+                VocabParallelEmbedding,
+            )
+
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        from ..tensor.creation import arange
+
+        s = input_ids.shape[1]
+        pos = arange(s, dtype="int64").unsqueeze(0)
+        return self.dropout(self.wte(input_ids) + self.wpe(pos))
+
+
+class GPTHeadPipe(nn.Layer):
+    """Last pipeline stage: final norm + (untied) LM head."""
+
+    def __init__(self, cfg: GPTConfig, tensor_parallel: bool = False):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        if tensor_parallel:
+            from ..distributed.fleet.meta_parallel import ColumnParallelLinear
+
+            self.head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
+                                             has_bias=False)
+        else:
+            self.head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, x):
+        return self.head(self.ln_f(x))
+
+
+def gpt_pipe_layers(cfg: GPTConfig, tensor_parallel: bool = False):
+    """LayerDesc list for PipelineLayer (the GPTForCausalLMPipe shape used by
+    the fleet static TP+PP benchmark, config #4)."""
+    from ..distributed.fleet.meta_parallel import LayerDesc
+
+    descs = [LayerDesc(GPTEmbeddingPipe, cfg, tensor_parallel)]
+    descs += [LayerDesc(GPTBlock, cfg, tensor_parallel)
+              for _ in range(cfg.num_hidden_layers)]
+    descs.append(LayerDesc(GPTHeadPipe, cfg, tensor_parallel))
+    return descs
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Shifted causal-LM cross entropy for the pipe head output."""
+
+    def forward(self, logits, labels):
+        vocab = logits.shape[-1]
+        return F.cross_entropy(
+            logits[:, :-1].reshape([-1, vocab]),
+            labels[:, 1:].reshape([-1]))
 
 
 class GPTForCausalLM(nn.Layer):

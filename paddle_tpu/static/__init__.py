@@ -1,0 +1,95 @@
+"""paddle.static — static-graph user API.
+
+Ref: python/paddle/static/ (upstream layout, unverified — mount empty).
+"""
+from __future__ import annotations
+
+from ..jit.api import InputSpec  # noqa: F401
+from .program import (  # noqa: F401
+    Block, OpDesc, Program, Variable, data, default_main_program,
+    default_startup_program, disable_static, enable_static, in_dynamic_mode,
+    in_static_mode, name_scope, program_guard,
+)
+from .executor import Executor, Scope, global_scope  # noqa: F401
+from .io import load_inference_model, save_inference_model  # noqa: F401
+
+__all__ = [
+    "Program", "Variable", "data", "program_guard", "default_main_program",
+    "default_startup_program", "Executor", "InputSpec", "append_backward",
+    "gradients", "enable_static", "disable_static", "in_dynamic_mode",
+    "save_inference_model", "load_inference_model", "nn", "cpu_places",
+    "device_guard",
+]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Static autodiff marker (ref: python/paddle/base/backward.py).
+
+    Under the replay-compile design gradients are produced by jax.grad inside
+    the Executor's compiled train step, so this only validates and returns
+    the (param, grad-name) pairs for API parity."""
+    program = default_main_program()
+    params = parameter_list or program.all_parameters()
+    return [(p, f"{getattr(p, 'name', 'param')}@GRAD") for p in params]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients: symbolic grads of targets wrt inputs.
+
+    Returns grad Variables by appending a 'gradients' record the Executor
+    resolves with jax.grad at compile time."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    program = default_main_program()
+    block = program.global_block()
+    out_vars = []
+    for x in inputs:
+        g = block.create_var(name=f"{x.name}@GRAD", shape=x.shape,
+                             dtype=x.dtype)
+        out_vars.append(g)
+    from .program import OpDesc
+
+    block.append_op(OpDesc(
+        "static_gradients",
+        [t.name for t in targets] + [x.name for x in inputs],
+        [g.name for g in out_vars],
+        {"n_targets": len(targets)}, None))
+    return out_vars
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    return [CPUPlace(0)]
+
+
+def device_guard(device=None):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+class _StaticNN:
+    """paddle.static.nn — thin functional layers over the op registry."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn as _nn
+
+        in_features = int(x.shape[-1])
+        layer = _nn.Linear(in_features, size)
+        out = layer(x)
+        if activation:
+            out = getattr(_nn.functional, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(input, **kwargs):
+        from .. import nn as _nn
+
+        c = int(input.shape[1])
+        return _nn.BatchNorm(c)(input)
+
+
+nn = _StaticNN()

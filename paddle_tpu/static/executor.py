@@ -1,0 +1,190 @@
+"""Static Executor — the InterpreterCore analog.
+
+Ref: paddle/fluid/framework/new_executor/interpreter_core.* +
+python/paddle/base/executor.py (upstream layout, unverified — mount empty).
+Paddle builds an instruction list with dependency analysis and async streams;
+here the Program replays into ONE pure jax function (op fns from the
+registry), jit-compiled per feed signature and cached — XLA does the
+scheduling/fusion the InterpreterCore hand-rolls. Programs carrying a
+minimize hook (optimizer.minimize in static mode) compile the full train
+step: forward + jax.grad + functional optimizer update, params donated.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.registry import get_op
+from .program import Program, Variable, default_main_program
+
+__all__ = ["Executor", "Scope", "global_scope"]
+
+
+class Scope:
+    """Name -> value store (ref: paddle/fluid/framework/scope.*); thin here
+    because persistables live on the Program's ref table."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+
+_GLOBAL_SCOPE = Scope()
+
+
+def global_scope() -> Scope:
+    return _GLOBAL_SCOPE
+
+
+def _replay(program: Program, env: Dict[str, jax.Array]):
+    """Execute the op list over `env` (name -> array), mutating env."""
+    for op in program.global_block().ops:
+        fn = get_op(op.type).fn
+
+        def build(template):
+            out = []
+            for kind, payload in template:
+                if kind == "var":
+                    out.append(env[op.input_names[payload]])
+                elif kind == "list":
+                    out.append([env[op.input_names[p]] if k == "var" else p
+                                for k, p in payload])
+                else:
+                    out.append(payload)
+            return out
+
+        result = fn(*build(op.arg_template), **op.attrs)
+        outs = (list(result) if isinstance(result, (tuple, list))
+                else [result])
+        for name, val in zip(op.output_names, outs):
+            env[name] = val
+    return env
+
+
+class Executor:
+    """paddle.static.Executor over a compiled-callable cache."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program: Optional[Program] = None, feed: Optional[Dict] = None,
+            fetch_list: Optional[Sequence] = None, scope=None,
+            return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+
+        if not program.global_block().ops:
+            return []  # startup program: params already initialized eagerly
+
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        feed_arrays = {}
+        for k, v in feed.items():
+            if isinstance(v, Tensor):
+                feed_arrays[k] = v._data
+            else:
+                feed_arrays[k] = jnp.asarray(np.asarray(v))
+
+        param_names = sorted(program.refs.keys())
+        param_arrays = {n: program.refs[n]._data for n in param_names}
+
+        sig = (id(program),
+               tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in feed_arrays.items())),
+               tuple(fetch_names))
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            compiled = self._compile(program, fetch_names,
+                                     bool(program._minimize_hooks))
+            self._cache[sig] = compiled
+
+        if program._minimize_hooks:
+            for opt, _, _ in program._minimize_hooks:
+                opt._step_count += 1
+            opt = program._minimize_hooks[0][0]
+            lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
+            t = jnp.asarray(opt._step_count, dtype=jnp.int32)
+            opt_state = self._opt_state(program, param_arrays)
+            fetches, new_params, new_opt_state = compiled(
+                feed_arrays, param_arrays, opt_state, lr, t)
+            self._opt_states[id(program)] = new_opt_state
+            for n in param_names:
+                program.refs[n]._data = new_params[n]
+        else:
+            fetches = compiled(feed_arrays, param_arrays)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    _opt_states: Dict = {}
+
+    def _opt_state(self, program, param_arrays):
+        st = self._opt_states.get(id(program))
+        if st is None:
+            opt = program._minimize_hooks[0][0]
+            trainable = {n: a for n, a in param_arrays.items()
+                         if self._is_trainable(program, n)}
+            st = opt.functional_state(trainable)
+            self._opt_states[id(program)] = st
+        return st
+
+    @staticmethod
+    def _is_trainable(program, name):
+        from ..core.tensor import Parameter
+
+        t = program.refs.get(name)
+        return isinstance(t, Parameter) and not t.stop_gradient
+
+    def _compile(self, program: Program, fetch_names: List[str],
+                 train: bool):
+        if not train:
+            def fwd(feed_arrays, param_arrays):
+                env = dict(param_arrays)
+                env.update(feed_arrays)
+                _replay(program, env)
+                return [env[n] for n in fetch_names]
+
+            return jax.jit(fwd)
+
+        opt, loss_var, _ = program._minimize_hooks[0]
+        loss_name = loss_var.name
+
+        def step(feed_arrays, param_arrays, opt_state, lr, t):
+            trainable_names = [n for n in sorted(param_arrays)
+                               if self._is_trainable(program, n)]
+            frozen = {n: a for n, a in param_arrays.items()
+                      if n not in trainable_names}
+
+            def loss_of(trainable):
+                env = dict(frozen)
+                env.update(trainable)
+                env.update(feed_arrays)
+                _replay(program, env)
+                return jnp.sum(env[loss_name]).astype(jnp.float32), env
+
+            (_, env), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(
+                {n: param_arrays[n] for n in trainable_names})
+            new_trainable, new_state = opt.functional_step(
+                {n: param_arrays[n] for n in trainable_names}, grads,
+                opt_state, lr, t)
+            new_params = dict(param_arrays)
+            new_params.update(new_trainable)
+            return ([env[n] for n in fetch_names], new_params, new_state)
+
+        return jax.jit(step, donate_argnums=(1, 2))

@@ -1,0 +1,338 @@
+"""Static graph IR: Program / Block / OpDesc / Variable.
+
+Ref: paddle/fluid/framework/program_desc.* + python/paddle/base/framework.py
+(upstream layout, unverified — mount empty). Paddle's ProgramDesc is a
+protobuf op list interpreted by InterpreterCore; PIR made it SSA. Here the IR
+is SSA from day one (SURVEY §7 hard part #3): each captured op is an OpDesc
+naming SSA input/output vars, parameters are persistable vars bound to live
+Parameter objects, and the Executor replays the op list as one pure jax
+function compiled and cached per feed signature (the pjit-cache-as-
+InterpreterCore design).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import set_static_handler
+from ..core.dtype import convert_dtype
+from ..core.tensor import Parameter, Tensor
+from ..ops.registry import OPS, OpDef, get_op
+
+__all__ = ["Program", "Block", "OpDesc", "Variable", "program_guard",
+           "default_main_program", "default_startup_program",
+           "in_static_mode", "enable_static", "disable_static",
+           "in_dynamic_mode", "data", "name_scope"]
+
+_name_counter = itertools.count()
+
+
+def _unique_name(prefix="tmp"):
+    return f"{prefix}_{next(_name_counter)}"
+
+
+class Variable:
+    """Symbolic SSA value in a Block (VarDesc analog). Dims of -1 are
+    dynamic (batch)."""
+
+    def __init__(self, block: "Block", name: str, shape, dtype,
+                 persistable: bool = False, is_data: bool = False,
+                 stop_gradient: bool = False):
+        self.block = block
+        self.name = name
+        self.shape = list(shape)
+        self.dtype = np.dtype(convert_dtype(dtype) or dtype)
+        self.persistable = persistable
+        self.is_data = is_data
+        self.stop_gradient = stop_gradient
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def dim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        return int(np.prod([d for d in self.shape if d > 0]))
+
+    def astype(self, dtype):
+        from ..core.dispatch import apply_op
+
+        return apply_op(get_op("cast"), self, dtype=dtype)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    # ---- op sugar: route every registered op through the dispatcher -----
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        if item in OPS:
+            from ..core.dispatch import apply_op
+
+            def call(*args, **kwargs):
+                return apply_op(get_op(item), self, *args, **kwargs)
+
+            return call
+        raise AttributeError(item)
+
+
+def _make_var_operator(opname, reverse=False):
+    def op(self, other=None):
+        from ..core.dispatch import apply_op
+
+        if other is None:
+            return apply_op(get_op(opname), self)
+        if reverse:
+            return apply_op(get_op(opname), other, self)
+        return apply_op(get_op(opname), self, other)
+
+    return op
+
+
+for _dunder, _opname in [
+    ("__add__", "add"), ("__radd__", "add"), ("__sub__", "subtract"),
+    ("__mul__", "multiply"), ("__rmul__", "multiply"),
+    ("__truediv__", "divide"), ("__matmul__", "matmul"),
+    ("__pow__", "pow"), ("__neg__", "neg"),
+]:
+    setattr(Variable, _dunder, _make_var_operator(
+        _opname, reverse=_dunder.startswith("__r")))
+
+
+class OpDesc:
+    """One captured op: registry name + SSA input/output var names + attrs.
+    Inputs that were live Tensors (parameters/constants) are recorded as
+    persistable vars bound in the program's reference table."""
+
+    def __init__(self, type: str, input_names: Sequence[str],
+                 output_names: Sequence[str], attrs: Dict,
+                 arg_template: List):
+        self.type = type
+        self.input_names = list(input_names)
+        self.output_names = list(output_names)
+        self.attrs = dict(attrs)
+        # positional skeleton: entries are ("var", idx_into_input_names) or
+        # ("const", python_value)
+        self.arg_template = arg_template
+
+    def __repr__(self):
+        return (f"{{{', '.join(self.output_names)}}} = {self.type}"
+                f"({', '.join(self.input_names)})")
+
+
+class Block:
+    def __init__(self, program: "Program", idx: int):
+        self.program = program
+        self.idx = idx
+        self.ops: List[OpDesc] = []
+        self.vars: Dict[str, Variable] = {}
+
+    def create_var(self, name=None, shape=(), dtype="float32",
+                   persistable=False, is_data=False, stop_gradient=False):
+        name = name or _unique_name()
+        v = Variable(self, name, shape, dtype, persistable=persistable,
+                     is_data=is_data, stop_gradient=stop_gradient)
+        self.vars[name] = v
+        return v
+
+    def var(self, name):
+        return self.vars[name]
+
+    def append_op(self, op: OpDesc):
+        self.ops.append(op)
+
+
+class Program:
+    """Program ⊃ Block ⊃ OpDesc; binds persistable vars to live Tensors."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.refs: Dict[str, Tensor] = {}   # persistable name -> live Tensor
+        self._data_vars: List[Variable] = []
+        self.random_seed = 0
+        self._minimize_hooks = []           # optimizer update records
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[-1]
+
+    def list_vars(self):
+        return list(self.global_block().vars.values())
+
+    def all_parameters(self):
+        return [self.refs[n] for n, v in self.global_block().vars.items()
+                if v.persistable and isinstance(self.refs.get(n), Parameter)]
+
+    def clone(self, for_test: bool = False):
+        import copy
+
+        p = Program()
+        p.blocks = self.blocks
+        p.refs = self.refs
+        p._data_vars = list(self._data_vars)
+        p._minimize_hooks = [] if for_test else list(self._minimize_hooks)
+        return p
+
+    def __repr__(self):
+        ops = self.global_block().ops
+        return f"Program({len(ops)} ops, {len(self.refs)} persistables)"
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+_static_mode = [False]
+
+
+def default_main_program() -> Program:
+    return _default_main[-1]
+
+
+def default_startup_program() -> Program:
+    return _default_startup[-1]
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    _default_main.append(main_program)
+    _default_startup.append(startup_program or Program())
+    try:
+        yield
+    finally:
+        _default_main.pop()
+        _default_startup.pop()
+
+
+def in_static_mode() -> bool:
+    return _static_mode[0]
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode[0]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    yield
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """paddle.static.data — feed placeholder with dynamic (-1/None) dims."""
+    shape = [-1 if s is None else int(s) for s in shape]
+    block = default_main_program().global_block()
+    v = block.create_var(name=name, shape=shape, dtype=dtype, is_data=True,
+                         stop_gradient=True)
+    default_main_program()._data_vars.append(v)
+    return v
+
+
+# --------------------------------------------------------- capture handler
+def _concrete_shape(shape, dyn=2):
+    return tuple(dyn if s in (-1, None) else int(s) for s in shape)
+
+
+def _static_handler(opdef: OpDef, args, kwargs):
+    """Called by core.dispatch for every op issued in static mode."""
+    program = default_main_program()
+    block = program.current_block()
+
+    input_names: List[str] = []
+    template = []
+    avals2, avals3 = [], []         # two probes to detect dynamic dims
+
+    def record_input(x):
+        if isinstance(x, Variable):
+            input_names.append(x.name)
+            template.append(("var", len(input_names) - 1))
+            avals2.append(jax.ShapeDtypeStruct(_concrete_shape(x.shape, 2),
+                                               x.dtype))
+            avals3.append(jax.ShapeDtypeStruct(_concrete_shape(x.shape, 3),
+                                               x.dtype))
+        elif isinstance(x, Tensor):
+            # live tensor (parameter / constant): persistable var
+            name = None
+            for n, t in program.refs.items():
+                if t is x:
+                    name = n
+                    break
+            if name is None:
+                name = x.name or _unique_name("param")
+                if name in program.refs and program.refs[name] is not x:
+                    name = _unique_name(name)
+                program.refs[name] = x
+                block.create_var(name=name, shape=x.shape,
+                                 dtype=x.dtype, persistable=True)
+            input_names.append(name)
+            template.append(("var", len(input_names) - 1))
+            avals2.append(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype))
+            avals3.append(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype))
+        else:
+            template.append(("const", x))
+
+    for a in args:
+        if isinstance(a, (list, tuple)) and any(
+                isinstance(e, (Variable, Tensor)) for e in a):
+            # op over a list of tensors (concat/stack): record elementwise
+            sub_start = len(template)
+            for e in a:
+                record_input(e)
+            template[sub_start:] = [("list", template[sub_start:])]
+        else:
+            record_input(a)
+
+    import functools
+
+    def probe(avals):
+        def build_args(arrays):
+            it = iter(arrays)
+            out = []
+            for kind, payload in template:
+                if kind == "var":
+                    out.append(next(it))
+                elif kind == "list":
+                    out.append([next(it) if k == "var" else p
+                                for k, p in payload])
+                else:
+                    out.append(payload)
+            return out
+
+        return jax.eval_shape(
+            lambda *xs: opdef.fn(*build_args(xs), **kwargs), *avals)
+
+    out2 = probe(avals2)
+    out3 = probe(avals3)
+
+    multi = opdef.multi_output or isinstance(out2, (tuple, list))
+    outs2 = list(out2) if multi else [out2]
+    outs3 = list(out3) if multi else [out3]
+
+    out_vars = []
+    for o2, o3 in zip(outs2, outs3):
+        shape = [(-1 if d2 != d3 else d2)
+                 for d2, d3 in zip(o2.shape, o3.shape)]
+        out_vars.append(block.create_var(shape=shape, dtype=o2.dtype))
+
+    block.append_op(OpDesc(opdef.name, input_names,
+                           [v.name for v in out_vars], kwargs, template))
+    return tuple(out_vars) if multi else out_vars[0]
+
+
+set_static_handler(in_static_mode, _static_handler)

@@ -148,6 +148,14 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
                     p._data = p._data.astype(target)
     if optimizers is None:
         return models
+    # O2 updates low-precision params; unless the caller explicitly opted out
+    # (master_weight=False), the optimizer must keep fp32 master weights —
+    # paddle's decorate enables multi_precision by default for this reason.
+    if level == "O2" and master_weight is not False:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        for opt in [optimizers] if single_opt else list(optimizers):
+            if hasattr(opt, "_multi_precision"):
+                opt._multi_precision = True
     return models, optimizers
 
 
@@ -173,6 +181,15 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer unscale tracking (paddle's OptimizerState INIT/
+        # UNSCALED/STEPPED): the documented pattern
+        #   scaler.unscale_(opt); clip(...); scaler.step(opt)
+        # must not divide the grads by the scale a second time in step().
+        # WeakSet so a GC'd optimizer can never alias a new one's identity;
+        # each optimizer's own inf-status rides on the optimizer object.
+        import weakref
+
+        self._unscaled = weakref.WeakSet()
 
     def is_enable(self) -> bool:
         return self._enable
@@ -189,6 +206,10 @@ class GradScaler:
         """check_finite_and_unscale analog: divide grads by scale, detect inf."""
         if not self._enable:
             return
+        if optimizer in self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -200,11 +221,17 @@ class GradScaler:
                 p.grad._data = g
             if not bool(jnp.all(jnp.isfinite(g))):
                 found = True
-        self._found_inf = found
+        optimizer._amp_found_inf = found
+        self._found_inf = self._found_inf or found  # aggregate for update()
+        self._unscaled.add(optimizer)
 
     def step(self, optimizer):
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        if self._enable and optimizer not in self._unscaled:
+            self.unscale_(optimizer)
+        # consult THIS optimizer's inf status, not whichever optimizer was
+        # unscaled last — skipping opt1's step because opt2 overflowed (or
+        # vice versa) corrupts multi-optimizer training
+        if not getattr(optimizer, "_amp_found_inf", self._found_inf):
             optimizer.step()
 
     def minimize(self, optimizer, scaled_loss):
@@ -213,6 +240,9 @@ class GradScaler:
 
     def update(self):
         """update_loss_scaling analog: grow/shrink the scale."""
+        for opt in list(self._unscaled):
+            opt._amp_found_inf = False
+        self._unscaled.clear()
         if not (self._enable and self._use_dynamic):
             self._found_inf = False
             return

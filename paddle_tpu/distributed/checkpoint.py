@@ -67,9 +67,61 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
                                      "file": os.path.basename(shard_file)}
     with open(shard_file, "wb") as f:
         pickle.dump(payload, f, protocol=4)
+    # every process records the shards IT addressed; the coordinator merges
+    # all ranks' records into the global metadata (a coordinator-only view
+    # would silently drop every other host's slice of each tensor on load)
+    rank_meta = os.path.join(path, f"meta_rank{pid}.json")
+    with open(rank_meta + ".tmp", "w") as f:
+        json.dump(meta, f)
+    os.replace(rank_meta + ".tmp", rank_meta)  # atomic: never seen half-written
+    _barrier_across_processes()  # all ranks' files fresh before the merge;
+    # without this a stale meta_rank{r}.json from a previous save to the
+    # same path could be merged while rank r is still writing
     if pid == coordinator_rank:
-        with open(os.path.join(path, _META), "w") as f:
-            json.dump(meta, f)
+        world = jax.process_count()
+        merged = {"version": 1, "tensors": {}, "world": world}
+        for r in range(world):
+            rmeta_path = os.path.join(path, f"meta_rank{r}.json")
+            _wait_for_file(rmeta_path)
+            with open(rmeta_path) as f:
+                rmeta = json.load(f)
+            for name, info in rmeta["tensors"].items():
+                have = merged["tensors"].get(name)
+                if have is None:
+                    merged["tensors"][name] = info
+                elif not info.get("scalar"):
+                    seen = {json.dumps(s["index"]) for s in have["shards"]}
+                    have.setdefault("files", [have["file"]])
+                    for s in info["shards"]:
+                        if json.dumps(s["index"]) not in seen:
+                            have["shards"].append(s)
+                    if info["file"] not in have["files"]:
+                        have["files"].append(info["file"])
+        meta_path = os.path.join(path, _META)
+        with open(meta_path + ".tmp", "w") as f:
+            json.dump(merged, f)
+        os.replace(meta_path + ".tmp", meta_path)
+    _barrier_across_processes()  # no rank returns before metadata.json lands
+
+
+def _barrier_across_processes():
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("paddle_tpu_dist_checkpoint")
+
+
+def _wait_for_file(p: str, timeout: float = 120.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(p):
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"coordinator timed out waiting for {p}; did a rank die "
+                "before writing its checkpoint metadata?")
+        time.sleep(0.05)
 
 
 def load_state_dict(state_dict: Dict, path: str, process_group=None,
@@ -80,7 +132,9 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
         meta = json.load(f)
     # only read the shard files metadata references — a stale shard from an
     # earlier larger-world save must not override fresh values
-    live_files = {info["file"] for info in meta["tensors"].values()}
+    live_files = set()
+    for info in meta["tensors"].values():
+        live_files.update(info.get("files", [info["file"]]))
     payload = {}
     for fname in sorted(live_files):
         with open(os.path.join(path, fname), "rb") as f:

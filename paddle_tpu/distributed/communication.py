@@ -176,7 +176,14 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
     g = _resolve(group)
     if _axis_in_scope(g.axis_name):
         x = _data(tensor)
-        src_local = g.get_group_rank(src) if src in g.ranks else src
+        if src in g.ranks:
+            src_local = g.get_group_rank(src)
+        elif 0 <= src < g.nranks:
+            src_local = src  # already a group-local rank
+        else:
+            raise ValueError(
+                f"broadcast src={src} is not a member of group "
+                f"{g.ranks} nor a valid group-local rank")
         # select src's value on every rank: gather then index (XLA folds this
         # into a broadcast collective)
         out = jax.lax.all_gather(x, g.axis_name)[src_local]
@@ -296,12 +303,25 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
     n = g.nranks
     sends = [p for p in p2p_op_list if p.op in (send, isend)]
     recvs = [p for p in p2p_op_list if p.op in (recv, irecv)]
+    if len(sends) != len(recvs):
+        raise ValueError(
+            f"batch_isend_irecv under SPMD needs matching send/recv counts, "
+            f"got {len(sends)} sends and {len(recvs)} recvs")
     tasks = []
     for s, r in zip(sends, recvs):
         # SPMD sees ONE program on all ranks, so peers must form a uniform
         # shift: under shard_map `peer` is the ring offset k, and the pair
-        # (send k, recv) lowers to ppermute rank -> (rank+k) % n.
+        # (send k, recv) lowers to ppermute rank -> (rank+k) % n. The paired
+        # recv must name the same shift — either k ("receive the shift-by-k
+        # result") or -k mod n ("receive from rank-k"); anything else (e.g.
+        # paddle-style global dst ranks) gets an error, not a silent shift.
         k = s.peer % n
+        if r.peer % n not in (k, (-k) % n):
+            raise ValueError(
+                f"batch_isend_irecv: send offset {s.peer} and recv offset "
+                f"{r.peer} do not form a uniform ring shift over {n} ranks "
+                f"(expected recv peer ≡ {k} or {(-k) % n} mod {n}); "
+                f"arbitrary src/dst p2p is not an SPMD primitive")
         out = jax.lax.ppermute(_data(s.tensor), g.axis_name,
                                [(i, (i + k) % n) for i in range(n)])
         r.tensor._data = out

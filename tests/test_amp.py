@@ -1,0 +1,73 @@
+"""GradScaler state machine + decorate O2 master-weight semantics.
+
+Covers the round-1 advisor findings: (1) the documented pattern
+scaler.unscale_(opt) -> clip -> scaler.step(opt) must divide gradients by the
+loss scale exactly once; (2) decorate(level='O2') must flip the optimizer to
+multi_precision fp32 master weights unless master_weight=False.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _one_param_opt(grad_value=2.0, scale=1024.0):
+    lin = nn.Linear(1, 1, bias_attr=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=lin.parameters())
+    x = paddle.to_tensor(np.full((1, 1), grad_value, dtype="float32"),
+                         stop_gradient=False)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=scale)
+    loss = scaler.scale(lin(x).sum())
+    loss.backward()
+    (p,) = lin.parameters()
+    return scaler, opt, p
+
+
+def test_unscale_then_step_divides_once():
+    scaler, opt, p = _one_param_opt(grad_value=2.0, scale=1024.0)
+    scaler.unscale_(opt)
+    g_after_unscale = float(np.asarray(p.grad._data))
+    np.testing.assert_allclose(g_after_unscale, 2.0, rtol=1e-6)
+    scaler.step(opt)  # must NOT unscale again
+    np.testing.assert_allclose(float(np.asarray(p.grad._data)), 2.0,
+                               rtol=1e-6)
+    scaler.update()
+
+
+def test_step_without_unscale_divides_once():
+    scaler, opt, p = _one_param_opt(grad_value=3.0, scale=256.0)
+    scaler.step(opt)
+    np.testing.assert_allclose(float(np.asarray(p.grad._data)), 3.0,
+                               rtol=1e-6)
+
+
+def test_double_unscale_raises():
+    scaler, opt, _ = _one_param_opt()
+    scaler.unscale_(opt)
+    with pytest.raises(RuntimeError, match="already been called"):
+        scaler.unscale_(opt)
+    scaler.update()  # resets the per-optimizer state
+    scaler.unscale_(opt)  # legal again after update()
+
+
+def test_decorate_o2_enables_master_weights():
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=lin.parameters())
+    assert opt._multi_precision is False
+    model, opt2 = paddle.amp.decorate(lin, optimizers=opt, level="O2",
+                                      dtype="bfloat16")
+    assert opt2._multi_precision is True
+    import jax.numpy as jnp
+
+    assert all(p._data.dtype == jnp.bfloat16 for p in model.parameters())
+
+
+def test_decorate_o2_master_weight_false_respected():
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=lin.parameters())
+    paddle.amp.decorate(lin, optimizers=opt, level="O2",
+                        master_weight=False)
+    assert opt._multi_precision is False

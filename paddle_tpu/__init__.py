@@ -38,7 +38,10 @@ from .tensor.random import (  # noqa: F401
     randn, randperm, standard_normal, uniform,
 )
 
-# subpackages — extended as layers land (SURVEY.md §7 build order)
+# subpackages — the full paddle surface. Import failures are FATAL: round 1
+# shipped an unimportable paddle.static because a missing module was silently
+# swallowed here; the list is known and finite, so a broken subpackage must
+# break the build, not vanish from the API.
 _SUBPACKAGES = [
     "nn", "optimizer", "io", "metric", "vision", "amp", "static", "jit",
     "distributed", "device", "profiler", "incubate", "sparse", "framework",
@@ -47,11 +50,7 @@ _SUBPACKAGES = [
 import importlib as _importlib
 
 for _pkg in _SUBPACKAGES:
-    try:
-        globals()[_pkg] = _importlib.import_module(f".{_pkg}", __name__)
-    except ModuleNotFoundError as _e:
-        if f"paddle_tpu.{_pkg}" not in str(_e):
-            raise  # real error inside an existing subpackage
+    globals()[_pkg] = _importlib.import_module(f".{_pkg}", __name__)
 del _importlib, _pkg
 
 if "framework" in globals() and hasattr(framework, "save"):  # noqa: F821

@@ -190,6 +190,7 @@ class GradScaler:
         import weakref
 
         self._unscaled = weakref.WeakSet()
+        self._stepped = weakref.WeakSet()
 
     def is_enable(self) -> bool:
         return self._enable
@@ -226,6 +227,12 @@ class GradScaler:
         self._unscaled.add(optimizer)
 
     def step(self, optimizer):
+        if self._enable and optimizer in self._stepped:
+            # paddle's contract: without this, the second step() would skip
+            # unscaling (opt still marked UNSCALED) and apply gradients still
+            # multiplied by the loss scale — silent divergence
+            raise RuntimeError(
+                "step() has already been called since the last update()")
         if self._enable and optimizer not in self._unscaled:
             self.unscale_(optimizer)
         # consult THIS optimizer's inf status, not whichever optimizer was
@@ -233,6 +240,7 @@ class GradScaler:
         # vice versa) corrupts multi-optimizer training
         if not getattr(optimizer, "_amp_found_inf", self._found_inf):
             optimizer.step()
+        self._stepped.add(optimizer)
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
@@ -243,6 +251,7 @@ class GradScaler:
         for opt in list(self._unscaled):
             opt._amp_found_inf = False
         self._unscaled.clear()
+        self._stepped.clear()
         if not (self._enable and self._use_dynamic):
             self._found_inf = False
             return

@@ -1,0 +1,299 @@
+"""paddle.audio — spectral feature Layers, wav I/O, datasets.
+
+Ref: python/paddle/audio/ (upstream layout, unverified — mount empty).
+features are real STFT pipelines (frame → window → rfft → mel/dct), batched
+and jittable; backends read/write canonical PCM wav via the stdlib so no
+egress is needed; datasets follow the synthetic-fallback contract.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+import wave
+import zlib
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import Dataset
+from ..nn import Layer
+from . import functional  # noqa: F401
+from .functional import (  # noqa: F401
+    compute_fbank_matrix, create_dct, fft_frequencies, get_window, hz_to_mel,
+    mel_frequencies, mel_to_hz, power_to_db,
+)
+
+__all__ = ["functional", "features", "backends", "datasets", "load", "save",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+           "ESC50", "TESS", "info"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ------------------------------------------------------------------ features
+
+def _stft_frames(x, n_fft, hop_length, win_length, window, center,
+                 pad_mode):
+    """x: [..., T] -> power-ready complex STFT [..., F, frames]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode if pad_mode != "constant"
+                    else "constant")
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])                     # [frames, n_fft]
+    frames = x[..., idx]                                     # [..., fr, n_fft]
+    w = get_window(window, win_length)
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    spec = jnp.fft.rfft(frames * w, n=n_fft, axis=-1)        # [..., fr, F]
+    return jnp.moveaxis(spec, -1, -2)                        # [..., F, fr]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.window = window
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+
+    def forward(self, x):
+        spec = _stft_frames(_unwrap(x), self.n_fft, self.hop_length,
+                            self.win_length, self.window, self.center,
+                            self.pad_mode)
+        mag = jnp.abs(spec)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return Tensor(mag.astype(jnp.float32))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)._data          # [..., F, frames]
+        mel = jnp.einsum("mf,...ft->...mt", self.fbank, spec)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)._data
+        return Tensor(power_to_db(m, self.ref_value, self.amin, self.top_db))
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        n_mels, f_min, f_max, htk, norm,
+                                        ref_value, amin, top_db)
+        self.dct = create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        lm = self.logmel(x)._data                 # [..., M, frames]
+        return Tensor(jnp.einsum("mk,...mt->...kt", self.dct, lm))
+
+
+class _FeaturesNS:
+    Spectrogram = Spectrogram
+    MelSpectrogram = MelSpectrogram
+    LogMelSpectrogram = LogMelSpectrogram
+    MFCC = MFCC
+
+
+features = _FeaturesNS()
+
+
+# ------------------------------------------------------------------ backends
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Read PCM wav -> (Tensor [C, T] float32 in [-1, 1], sample_rate)."""
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n_ch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    dtype = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, n_ch)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True, bits_per_sample: int = 16):
+    data = np.asarray(_unwrap(src))
+    if channels_first:
+        data = data.T                             # [T, C]
+    if data.ndim == 1:
+        data = data[:, None]
+    scale = float(2 ** (bits_per_sample - 1) - 1)
+    pcm = np.clip(data, -1.0, 1.0) * scale
+    pcm = pcm.astype({8: np.int8, 16: np.int16, 32: np.int32}[
+        bits_per_sample])
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(data.shape[1])
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(sample_rate)
+        w.writeframes(pcm.tobytes())
+
+
+class _AudioInfo:
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+
+
+def info(filepath: str) -> _AudioInfo:
+    with wave.open(filepath, "rb") as w:
+        return _AudioInfo(w.getframerate(), w.getnframes(),
+                          w.getnchannels(), w.getsampwidth() * 8)
+
+
+class _BackendsNS:
+    load = staticmethod(load)
+    save = staticmethod(save)
+    info = staticmethod(info)
+
+    @staticmethod
+    def list_available_backends():
+        return ["wave"]
+
+    @staticmethod
+    def get_current_backend():
+        return "wave"
+
+    @staticmethod
+    def set_backend(backend: str):
+        if backend != "wave":
+            raise ValueError("only the stdlib 'wave' backend is available "
+                             "in this offline environment")
+
+
+backends = _BackendsNS()
+
+
+# ------------------------------------------------------------------ datasets
+
+def _dseed(*parts):
+    return zlib.crc32("/".join(str(p) for p in parts).encode()) % (2 ** 31)
+
+
+class _SynthAudioSet(Dataset):
+    """Class-separable synthetic audio: each class is a distinct fundamental
+    frequency plus noise, so spectral classifiers actually learn."""
+
+    def __init__(self, name, n_classes, n_samples, sr, duration,
+                 mode, feat_type="raw", **feat_kwargs):
+        warnings.warn(f"{name}: no local data and no network access; using "
+                      "deterministic synthetic samples.")
+        self.sr = sr
+        rng = np.random.RandomState(_dseed(name, mode))
+        t = np.arange(int(sr * duration)) / sr
+        self.labels = rng.randint(0, n_classes, size=n_samples).astype(
+            np.int64)
+        self.waves = []
+        for y in self.labels:
+            f0 = 110.0 * (2 ** (y / 2.0))     # class-keyed pitch
+            sig = np.sin(2 * np.pi * f0 * t) + 0.1 * rng.randn(len(t))
+            self.waves.append(sig.astype(np.float32))
+        self.feat_type = feat_type
+        self._feat = None
+        if feat_type == "mfcc":
+            self._feat = MFCC(sr=sr, **feat_kwargs)
+        elif feat_type == "spectrogram":
+            self._feat = Spectrogram(**feat_kwargs)
+        elif feat_type == "melspectrogram":
+            self._feat = MelSpectrogram(sr=sr, **feat_kwargs)
+        elif feat_type == "logmelspectrogram":
+            self._feat = LogMelSpectrogram(sr=sr, **feat_kwargs)
+
+    def __len__(self):
+        return len(self.waves)
+
+    def __getitem__(self, i):
+        w = self.waves[i]
+        if self._feat is not None:
+            return np.asarray(self._feat(jnp.asarray(w))._data), \
+                self.labels[i]
+        return w, self.labels[i]
+
+
+class ESC50(_SynthAudioSet):
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", archive=None, **kwargs):
+        super().__init__("esc50", 50, 400 if mode == "train" else 100,
+                         16000, 1.0, mode, feat_type, **kwargs)
+
+
+class TESS(_SynthAudioSet):
+    def __init__(self, mode: str = "train", n_folds: int = 5, split: int = 1,
+                 feat_type: str = "raw", archive=None, **kwargs):
+        super().__init__("tess", 7, 280 if mode == "train" else 70,
+                         16000, 1.0, mode, feat_type, **kwargs)
+
+
+class _DatasetsNS:
+    ESC50 = ESC50
+    TESS = TESS
+
+
+datasets = _DatasetsNS()

@@ -1,0 +1,150 @@
+"""paddle.audio.functional — window/mel/dct DSP primitives, real math.
+
+Ref: python/paddle/audio/functional/ (upstream layout, unverified — mount
+empty). All closed-form jnp: HTK/Slaney mel scales, triangular filterbanks,
+orthonormal DCT-II, dB conversion — the numeric core the feature Layers wrap.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = _unwrap(freq)
+    scalar = not hasattr(f, "shape") or jnp.ndim(f) == 0
+    f = jnp.asarray(f, jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:  # Slaney: linear below 1 kHz, log above
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(f / min_log_hz) / logstep, mel)
+    return float(mel) if scalar else Tensor(mel) if isinstance(
+        freq, Tensor) else mel
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = _unwrap(mel)
+    scalar = not hasattr(m, "shape") or jnp.ndim(m) == 0
+    m = jnp.asarray(m, jnp.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar else Tensor(hz) if isinstance(
+        mel, Tensor) else hz
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    lo = hz_to_mel(jnp.asarray(f_min), htk)
+    hi = hz_to_mel(jnp.asarray(f_max), htk)
+    mels = jnp.linspace(float(lo), float(hi), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return jnp.linspace(0, sr / 2, n_fft // 2 + 1)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney"):
+    """[n_mels, n_fft//2+1] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)                      # [F]
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)  # [M+2]
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]                 # [M+2, F]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))     # [M, F]
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights = weights / jnp.maximum(
+            jnp.sum(weights ** norm, axis=1, keepdims=True) ** (1. / norm),
+            1e-10)
+    return weights.astype(jnp.float32)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    x = _unwrap(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec) if isinstance(spect, Tensor) else log_spec
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):
+    """[n_mels, n_mfcc] orthonormal DCT-II basis."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2))
+        dct = dct * math.sqrt(2.0 / n_mels)
+    else:
+        dct = dct * 2.0
+    return dct.astype(jnp.float32)
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/bartlett/kaiser/gaussian windows."""
+    N = win_length if not fftbins else win_length  # periodic via N+1 trick
+    n = jnp.arange(win_length, dtype=jnp.float32)
+    M = win_length if not fftbins else win_length  # periodic denominator
+    denom = (win_length - 1) if not fftbins else win_length
+    if isinstance(window, tuple):
+        window, arg = window
+    else:
+        arg = None
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * n / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * n / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * n / denom)
+             + 0.08 * jnp.cos(4 * math.pi * n / denom))
+    elif window == "bartlett":
+        w = 1.0 - jnp.abs(2.0 * n / denom - 1.0)
+    elif window == "kaiser":
+        beta = arg if arg is not None else 12.0
+        from jax.scipy.special import i0
+
+        w = i0(beta * jnp.sqrt(1 - (2 * n / denom - 1) ** 2)) / i0(
+            jnp.asarray(beta))
+    elif window == "gaussian":
+        std = arg if arg is not None else 7.0
+        w = jnp.exp(-0.5 * ((n - denom / 2) / std) ** 2)
+    elif window in ("ones", "boxcar", "rectangular"):
+        w = jnp.ones(win_length)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w.astype(jnp.float32)

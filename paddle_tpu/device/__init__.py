@@ -1,0 +1,246 @@
+"""paddle.device — device management: set_device, streams/events, synchronize.
+
+Ref: python/paddle/device/__init__.py + device/cuda/ (upstream layout,
+unverified — mount empty). Paddle exposes CUDA streams/events for manual
+overlap; XLA owns scheduling on TPU, so Stream/Event keep paddle's API shape
+over jax's async dispatch: "recording" an event captures the arrays in flight,
+synchronize/wait block on them. That preserves user code structure
+(record→wait→query) while XLA does the real ordering.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, device_count, get_device,
+    is_compiled_with_tpu, set_device,
+)
+
+__all__ = [
+    "set_device", "get_device", "get_all_device_type",
+    "get_all_custom_device_type", "get_available_device",
+    "get_available_custom_device", "is_compiled_with_cuda",
+    "is_compiled_with_rocm", "is_compiled_with_xpu",
+    "is_compiled_with_custom_device", "is_compiled_with_tpu",
+    "device_count", "synchronize", "Stream", "Event",
+    "current_stream", "set_stream", "stream_guard", "cuda",
+    "Place", "CPUPlace", "CUDAPlace", "TPUPlace",
+]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [s for s in get_available_device() if not s.startswith(("cpu",
+                                                                   "gpu"))]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = "tpu") -> bool:
+    return device_type == "tpu"
+
+
+def synchronize(device=None) -> None:
+    """Block until all queued device work drains (cudaDeviceSynchronize
+    analog): submit a trivial computation and fetch it — on async PJRT
+    transports this is the reliable fence."""
+    dev = None
+    if device is not None and hasattr(device, "jax_device"):
+        dev = device.jax_device()
+    x = jax.device_put(0.0, dev)
+    float(jax.block_until_ready(x))
+
+
+class Event:
+    """paddle.device.Event: record marks a point in the async stream by
+    capturing the arrays currently in flight on the recording stream."""
+
+    def __init__(self, device=None, enable_timing: bool = False,
+                 blocking: bool = False, interprocess: bool = False):
+        self.device = device
+        self.enable_timing = enable_timing
+        self._arrays = []
+        self._time: Optional[float] = None
+        self._recorded = False
+
+    def record(self, stream: Optional["Stream"] = None) -> None:
+        stream = stream or current_stream()
+        self._arrays = list(stream._in_flight)
+        self._recorded = True
+        if self.enable_timing:
+            self._time = time.perf_counter()
+
+    def query(self) -> bool:
+        """True when every captured array is ready (non-blocking)."""
+        if not self._recorded:
+            return True
+        try:
+            return all(a.is_ready() for a in self._arrays
+                       if hasattr(a, "is_ready"))
+        except RuntimeError:
+            return False
+
+    def synchronize(self) -> None:
+        for a in self._arrays:
+            jax.block_until_ready(a)
+        self._arrays = []
+
+    def elapsed_time(self, end_event: "Event") -> float:
+        if not (self.enable_timing and end_event.enable_timing):
+            raise RuntimeError("elapsed_time requires enable_timing=True on "
+                               "both events")
+        return (end_event._time - self._time) * 1e3  # ms, paddle convention
+
+
+class Stream:
+    """paddle.device.Stream shape over XLA's single logical stream. Arrays
+    registered on the stream (via track) feed Event.record/synchronize."""
+
+    def __init__(self, device=None, priority: int = 2):
+        self.device = device
+        self.priority = priority
+        self._in_flight: list = []
+
+    def track(self, *arrays) -> None:
+        """Register async results on this stream (framework-internal)."""
+        self._in_flight.extend(
+            a for a in arrays if isinstance(a, jax.Array))
+        # bounded: only the tail matters for a fence
+        del self._in_flight[:-64]
+
+    def record_event(self, event: Optional[Event] = None) -> Event:
+        event = event or Event(self.device)
+        event.record(self)
+        return event
+
+    def wait_event(self, event: Event) -> None:
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream") -> None:
+        for a in stream._in_flight:
+            jax.block_until_ready(a)
+
+    def synchronize(self) -> None:
+        for a in self._in_flight:
+            jax.block_until_ready(a)
+        self._in_flight = []
+
+    def query(self) -> bool:
+        try:
+            return all(a.is_ready() for a in self._in_flight
+                       if hasattr(a, "is_ready"))
+        except RuntimeError:
+            return False
+
+
+_current_stream = [Stream()]
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream[-1]
+
+
+def set_stream(stream: Stream) -> Stream:
+    prev = _current_stream[-1]
+    _current_stream[-1] = stream
+    return prev
+
+
+class stream_guard:
+    """Context manager: temporarily swap the ambient stream."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+        self._prev: Optional[Stream] = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+class _CudaNS:
+    """paddle.device.cuda namespace — present for API parity; reports no CUDA
+    and delegates stream/event types."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count() -> int:
+        return 0
+
+    @staticmethod
+    def is_available() -> bool:
+        return False
+
+    @staticmethod
+    def current_stream(device=None) -> Stream:
+        return current_stream(device)
+
+    @staticmethod
+    def synchronize(device=None) -> None:
+        synchronize(device)
+
+    @staticmethod
+    def empty_cache() -> None:
+        # XLA owns HBM; live-buffer GC is automatic. Kept for API parity.
+        return None
+
+    @staticmethod
+    def max_memory_allocated(device=None) -> int:
+        return memory_allocated(device)
+
+    @staticmethod
+    def memory_allocated(device=None) -> int:
+        return memory_allocated(device)
+
+
+def memory_allocated(device=None) -> int:
+    """Host-visible live-buffer bytes on the first (or given) device —
+    the allocator-stats seam SURVEY §2.1 asks for."""
+    devs = jax.devices()
+    dev = devs[0]
+    if isinstance(device, int) and device < len(devs):
+        dev = devs[device]
+    try:
+        stats = dev.memory_stats()
+        if stats and "bytes_in_use" in stats:
+            return int(stats["bytes_in_use"])
+    except (RuntimeError, AttributeError, TypeError):
+        pass
+    total = 0
+    for arr in jax.live_arrays():
+        if dev in getattr(arr.sharding, "device_set", {dev}):
+            total += arr.size * arr.dtype.itemsize
+    return total
+
+
+cuda = _CudaNS()

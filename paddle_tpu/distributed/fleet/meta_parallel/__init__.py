@@ -5,7 +5,9 @@ unverified — mount empty). TP layers in parallel_layers/mp_layers.py, PP in
 pipeline_parallel.py, ZeRO in sharding/, sequence parallel in
 sequence_parallel_utils (fleet/utils upstream; here co-located).
 """
-from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
+from .hybrid_parallel_optimizer import (  # noqa: F401
+    HybridParallelClipGrad, HybridParallelOptimizer,
+)
 from .parallel_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RNGStatesTracker,
     RowParallelLinear, VocabParallelEmbedding, get_rng_state_tracker,

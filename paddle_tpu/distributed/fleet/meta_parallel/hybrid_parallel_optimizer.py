@@ -2,16 +2,83 @@
 
 Ref: fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py
 (upstream layout, unverified — mount empty). Paddle's version re-implements
-global-norm grad clip across the dp/mp/pp/sharding meshes and fuses the DP
-allreduce; under GSPMD gradients arrive already summed across dp (the psum is
-inside the jitted step), and the global-norm clip over sharded params is a
-plain jnp reduction that XLA lowers to the right cross-axis collectives. So
-this wrapper is thin: it delegates to the inner optimizer and keeps the
-paddle surface (inner_opt, no_sync-awareness, state passthrough).
+global-norm grad clip across the dp/mp/pp/sharding meshes (NCCL allreduces of
+the squared norm) and fuses the DP allreduce. Under GSPMD the DP grad psum is
+inside the jitted step, and eager grads are GLOBAL jax.Arrays — plain jnp
+reductions over them already produce the cross-mesh value. The part that
+still needs real logic is the clip itself: when called inside shard_map
+(per-shard local views), the squared norm of tensor-parallel-sharded params
+must be psum'd over the model-parallel axis while replicated params are
+counted once. HybridParallelClipGrad implements exactly that split (keyed by
+Parameter.is_distributed, as paddle keys it), and HybridParallelOptimizer
+swaps it in for a plain ClipGradByGlobalNorm — same substitution paddle's
+wrapper performs.
 """
 from __future__ import annotations
 
-__all__ = ["HybridParallelOptimizer"]
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....nn.clip import ClipGradByGlobalNorm
+from ...communication import _axis_in_scope
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad"]
+
+
+class HybridParallelClipGrad:
+    """Global-norm clip that is correct in both execution regimes:
+
+    - eager / GSPMD arrays: every grad is a global array; one plain reduction
+      covers dp/mp/pp/sharding at once (XLA inserts the collectives);
+    - inside shard_map (per-shard views): the squared norm of distributed
+      (TP-sharded) params is psum'd over the mp axis; replicated params are
+      counted once, NOT multiplied by the mp degree.
+    """
+
+    def __init__(self, clip: ClipGradByGlobalNorm, hcg=None):
+        self._clip = clip
+        self._hcg = hcg
+        self.clip_norm = clip.clip_norm
+
+    @staticmethod
+    def _sq(g):
+        return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+    def _global_norm(self, dist_datas, repl_datas):
+        dist_sq = sum((self._sq(d) for d in dist_datas),
+                      jnp.zeros((), jnp.float32))
+        repl_sq = sum((self._sq(d) for d in repl_datas),
+                      jnp.zeros((), jnp.float32))
+        if dist_datas and _axis_in_scope("mp"):
+            # per-shard views: each mp rank holds a slice of the sharded
+            # params — sum their contributions
+            dist_sq = jax.lax.psum(dist_sq, "mp")
+        return jnp.sqrt(dist_sq + repl_sq)
+
+    def __call__(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+        if not clippable:
+            return params_grads
+        gnorm = self._global_norm(
+            [g._data for p, g in clippable
+             if getattr(p, "is_distributed", False)],
+            [g._data for p, g in clippable
+             if not getattr(p, "is_distributed", False)])
+        factor = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._data * factor).astype(
+                    g._data.dtype), stop_gradient=True)))
+        return out
+
+    def _clip_fn(self):
+        """Pure pytree form for jitted steps (global GSPMD arrays)."""
+        return self._clip._clip_fn()
 
 
 class HybridParallelOptimizer:
@@ -19,6 +86,12 @@ class HybridParallelOptimizer:
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        # paddle substitution: a plain global-norm clip becomes the
+        # mesh-aware hybrid clip
+        inner_clip = getattr(optimizer, "_grad_clip", None)
+        if isinstance(inner_clip, ClipGradByGlobalNorm) and not isinstance(
+                inner_clip, HybridParallelClipGrad):
+            optimizer._grad_clip = HybridParallelClipGrad(inner_clip, hcg)
 
     @property
     def inner_opt(self):

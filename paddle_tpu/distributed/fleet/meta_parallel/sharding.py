@@ -66,6 +66,14 @@ class _ShardedBase(Layer):
         self._layers = layer
         self._optimizer = optimizer
         self.offload = offload
+        if offload:
+            try:  # fail LOUDLY at construction, not mid-training
+                jax.devices()[0].memory("pinned_host")
+            except Exception as e:
+                raise NotImplementedError(
+                    "offload=True needs a backend with pinned_host memory "
+                    f"support; {jax.devices()[0].platform} reports none"
+                ) from e
         if hcg is not None and hcg.mesh is not None and \
                 hcg.get_sharding_parallel_world_size() > 1:
             self.mesh = hcg.mesh
@@ -100,11 +108,19 @@ class _ShardedBase(Layer):
                 for k, v in params.items()}
 
     def opt_state_shardings(self, opt_state: dict):
-        """Moment slots shaped like the param shard dim-0; scalars repl."""
+        """Moment slots shaped like the param shard dim-0; scalars repl.
+        With offload=True the slots additionally live in pinned host memory
+        (ZeRO-offload: HBM holds only params/grads/activations; XLA streams
+        the moments in for the update)."""
         out = {}
         for pname, acc in opt_state.items():
-            out[pname] = {slot: shard_leaf(v, self.mesh, self.axis)
-                          for slot, v in acc.items()}
+            shardings = {}
+            for slot, v in acc.items():
+                sh = shard_leaf(v, self.mesh, self.axis)
+                if self.offload:
+                    sh = sh.with_memory_kind("pinned_host")
+                shardings[slot] = sh
+            out[pname] = shardings
         return out
 
     def grad_shardings(self, params: dict):

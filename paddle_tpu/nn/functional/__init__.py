@@ -462,8 +462,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     layout. Dispatches to the Pallas flash kernel on TPU when available."""
     from ...ops import pallas_kernels
 
-    if pallas_kernels.flash_attention_available(query, key, value, attn_mask):
-        return pallas_kernels.flash_attention(query, key, value,
+    use_dropout = dropout_p > 0.0 and training
+    if not use_dropout and \
+            pallas_kernels.flash_attention_available(query, key, value,
+                                                     attn_mask):
+        return pallas_kernels.flash_attention(query, key, value, attn_mask,
                                               is_causal=is_causal)
+    rng_key = next_key() if use_dropout else None
     return apply_op(_op("scaled_dot_product_attention"), query, key, value,
-                    attn_mask, dropout_p=dropout_p, is_causal=is_causal)
+                    attn_mask, rng_key, dropout_p=dropout_p,
+                    is_causal=is_causal)

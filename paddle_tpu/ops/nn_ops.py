@@ -747,11 +747,12 @@ def square_error_cost(input, label):
 
 @register_op("scaled_dot_product_attention", amp_list="white")
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
-                                 dropout_p=0.0, is_causal=False,
-                                 scale=None):
+                                 rng_key=None, dropout_p=0.0,
+                                 is_causal=False, scale=None):
     """Reference attention. Layout: (batch, seq, heads, head_dim) — paddle's
     flash_attention layout. The Pallas flash kernel substitutes this op on TPU
-    for long sequences (see ops/pallas_kernels.py)."""
+    for long sequences (see ops/pallas_kernels.py). Attention dropout (on the
+    softmax probs, upscale_in_train) applies when rng_key is provided."""
     b, sq, h, d = query.shape
     sk = key.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -769,6 +770,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         else:
             logits = logits + attn_mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
+    if dropout_p > 0.0 and rng_key is not None:
+        keep = jax.random.bernoulli(rng_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros_like(probs))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return jnp.einsum("bhqd->bqhd", out)
 

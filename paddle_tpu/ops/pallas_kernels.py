@@ -2,11 +2,25 @@
 paddle/phi/kernels/gpu/flash_attn_kernel.cu over the external flashattn lib,
 upstream layout, unverified — mount empty).
 
-Selection policy: the functional layer calls *_available() first; on
-non-TPU backends or awkward shapes we fall back to the jnp reference op and
-let XLA fuse. The kernels themselves follow the pallas_guide.md playbook:
-block over (seq_q,) grid, keep K/V tiles in VMEM, online-softmax accumulation
-in fp32.
+Selection policy: the functional layer calls *_available() first; on non-TPU
+backends we fall back to the jnp reference op and let XLA fuse. The kernels
+follow the pallas_guide.md playbook: grid over (batch, heads, q-blocks,
+k-blocks), K/V tiles resident in VMEM, online-softmax accumulation in fp32,
+inner grid dimension = the accumulated one (TPU grids iterate the last
+dimension fastest).
+
+Round-2 widening (the round-1 kernel demanded d%128==0 and seq%512==0, so the
+flagship head_dim-64 models never hit it, and it had NO backward — jax.vjp
+through pallas_call raises, so the training bench could never use it):
+- any head_dim 8..256: zero-padded to a 128-lane multiple (exact: zero
+  d-lanes contribute nothing to q·k nor to the sliced output);
+- any seq length: padded to the block size; padded K columns masked to -inf,
+  padded Q rows sliced off (their gradients are zero, see _flash_bwd);
+- additive float attn_mask (paddle semantics), broadcastable over heads;
+- full flash BACKWARD (recompute-based: dq kernel accumulating over k-blocks,
+  dk/dv kernel accumulating over q-blocks, logsumexp residual from forward)
+  wired through jax.custom_vjp so Tensor.backward()/jax.grad work;
+- interpret=True runs the same kernels on CPU for hermetic CI.
 """
 from __future__ import annotations
 
@@ -27,38 +41,49 @@ def _on_tpu() -> bool:
         return False
 
 
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
 def flash_attention_available(q, k, v, attn_mask=None) -> bool:
-    if attn_mask is not None:
-        return False
     if not _on_tpu():
         return False
     qd = q._data if hasattr(q, "_data") else q
-    kd = k._data if hasattr(k, "_data") else k
-    b, sq, h, d = qd.shape
-    sk = kd.shape[1]
-    # MXU-friendly shapes only; otherwise the XLA reference path is fine.
-    return d % 128 == 0 and sq % _BLOCK_Q == 0 and sk % _BLOCK_K == 0
+    if qd.ndim != 4:
+        return False
+    d = qd.shape[3]
+    if attn_mask is not None:
+        md = attn_mask._data if hasattr(attn_mask, "_data") else attn_mask
+        if md.ndim != 4 or not jnp.issubdtype(md.dtype, jnp.floating):
+            return False  # boolean masks go through the XLA reference path
+    return 8 <= d <= 256
 
 
-@functools.partial(jax.jit, static_argnames=("is_causal",))
-def _flash_attention_data(q, k, v, is_causal=False):
+def _pick_block(s: int, cap: int) -> int:
+    """Largest 128-multiple <= cap covering s without excessive padding."""
+    return min(cap, _round_up(s, 128))
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_call(qt, kt, vt, mask, *, scale, sk, is_causal, has_mask,
+              mask_b_is_one, mask_h_is_one, mask_q_is_one, block_q, block_k,
+              interpret):
+    """qt/kt/vt: padded (b, h, S, D). Returns (out_padded, logsumexp)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
-    scale = 1.0 / math.sqrt(d)
-    # layout: (b, h, s, d) for blocking
-    qt = jnp.einsum("bshd->bhsd", q)
-    kt = jnp.einsum("bshd->bhsd", k)
-    vt = jnp.einsum("bshd->bhsd", v)
+    b, h, sq_p, d_p = qt.shape
+    sk_p = kt.shape[2]
+    n_q, n_k = sq_p // block_q, sk_p // block_k
+    need_k_mask = sk_p != sk
 
-    block_q = min(_BLOCK_Q, sq)
-    block_k = min(_BLOCK_K, sk)
-    n_q = sq // block_q
-    n_k = sk // block_k
-
-    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+    def kernel(*refs):
+        if has_mask:
+            q_ref, k_ref, v_ref, m_in_ref, o_ref, lse_ref, \
+                acc_ref, m_ref, l_ref = refs
+        else:
+            q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
         qi = pl.program_id(2)
         ki = pl.program_id(3)
 
@@ -73,16 +98,23 @@ def _flash_attention_data(q, k, v, is_causal=False):
         s = jax.lax.dot_general(
             qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if has_mask:
+            s = s + m_in_ref[0, 0].astype(jnp.float32)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if is_causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, -jnp.inf)
+        if need_k_mask:
+            s = jnp.where(cols < sk, s, -jnp.inf)
         m_prev = m_ref[...]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_cur)
-        alpha = jnp.exp(m_prev - m_cur)
+        # fully-masked rows keep m=-inf; clamp so exp(-inf - -inf) != nan
+        m_safe = jnp.where(jnp.isfinite(m_cur), m_cur, 0.0)
+        p = jnp.exp(jnp.where(jnp.isfinite(s), s - m_safe, -jnp.inf))
+        alpha = jnp.where(jnp.isfinite(m_prev),
+                          jnp.exp(m_prev - m_safe), 0.0)
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_ref[...] = m_cur
         vblk = v_ref[0, 0].astype(jnp.float32)
@@ -92,38 +124,371 @@ def _flash_attention_data(q, k, v, is_causal=False):
 
         @pl.when(ki == n_k - 1)
         def _done():
-            o_ref[0, 0] = (acc_ref[...] /
-                           jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+            l_fin = jnp.maximum(l_ref[...], 1e-30)
+            o_ref[0, 0] = (acc_ref[...] / l_fin).astype(o_ref.dtype)
+            lse = m_ref[...][:, 0] + jnp.log(l_fin[:, 0])
+            lse_ref[0, 0] = jnp.where(jnp.isfinite(lse), lse, 0.0)
 
-    grid = (b, h, n_q, n_k)
-    out = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d_p),
+                     lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d_p),
+                     lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d_p),
+                     lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+    ]
+    operands = [qt, kt, vt]
+    if has_mask:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 1 if mask_q_is_one else block_q, block_k),
+            lambda b_, h_, qi, ki: (0 if mask_b_is_one else b_,
+                                    0 if mask_h_is_one else h_,
+                                    0 if mask_q_is_one else qi, ki)))
+        operands.append(mask)
+
+    out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
+        grid=(b, h, n_q, n_k),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d_p),
                          lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b_, h_, qi, ki: (b_, h_, qi)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq_p, d_p), qt.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, d_p), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
-    )(qt, kt, vt)
-    return jnp.einsum("bhsd->bshd", out)
+        interpret=interpret,
+    )(*operands)
+    return out, lse
 
 
-def flash_attention(q, k, v, is_causal=False):
-    """Tensor-level wrapper used by nn.functional."""
+# --------------------------------------------------------------- backward
+
+def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
+                    is_causal, has_mask, need_k_mask, block_q, block_k):
+    """Shared backward recompute: p = exp(s - lse), masked like forward."""
+    qblk = q_ref[0, 0].astype(jnp.float32) * scale
+    kblk = k_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(qblk, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if has_mask:
+        s = s + m_in_ref[0, 0].astype(jnp.float32)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    if is_causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        s = jnp.where(rows >= cols, s, -jnp.inf)
+    if need_k_mask:
+        s = jnp.where(cols < sk, s, -jnp.inf)
+    p = jnp.exp(jnp.where(jnp.isfinite(s), s - lse_blk, -jnp.inf))
+    return p
+
+
+def _bwd_dq_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
+                 has_mask, mask_b_is_one, mask_h_is_one, mask_q_is_one,
+                 block_q, block_k, want_dmask, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq_p, d_p = qt.shape
+    sk_p = kt.shape[2]
+    n_q, n_k = sq_p // block_q, sk_p // block_k
+    need_k_mask = sk_p != sk
+
+    def kernel(*refs):
+        if has_mask:
+            q_ref, k_ref, v_ref, m_in_ref, do_ref, lse_ref, delta_ref = \
+                refs[:7]
+            outs = refs[7:]
+        else:
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+            outs = refs[6:]
+            m_in_ref = None
+        if want_dmask:
+            dq_ref, dmask_ref, acc_ref = outs
+        else:
+            dq_ref, acc_ref = outs
+        qi = pl.program_id(2)
+        ki = pl.program_id(3)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        lse_blk = lse_ref[0, 0][:, None]
+        p = _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki,
+                            scale=scale, sk=sk, is_causal=is_causal,
+                            has_mask=has_mask, need_k_mask=need_k_mask,
+                            block_q=block_q, block_k=block_k)
+        doblk = do_ref[0, 0].astype(jnp.float32)
+        vblk = v_ref[0, 0].astype(jnp.float32)
+        dp = jax.lax.dot_general(doblk, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        if want_dmask:
+            # s = scale*q·k + mask ⇒ d(mask) = ds, unscaled; per-(h,qi,ki)
+            # blocks are each visited exactly once so a plain store is safe
+            dmask_ref[0, 0] = ds
+        kblk = k_ref[0, 0].astype(jnp.float32)
+        acc_ref[...] += jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        @pl.when(ki == n_k - 1)
+        def _done():
+            dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d_p),
+                          lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, d_p),
+                          lambda b_, h_, qi, ki: (b_, h_, ki, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q),
+                            lambda b_, h_, qi, ki: (b_, h_, qi))
+    score_spec = pl.BlockSpec((1, 1, block_q, block_k),
+                              lambda b_, h_, qi, ki: (b_, h_, qi, ki))
+    in_specs = [q_spec, k_spec, k_spec]
+    operands = [qt, kt, vt]
+    if has_mask:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 1 if mask_q_is_one else block_q, block_k),
+            lambda b_, h_, qi, ki: (0 if mask_b_is_one else b_,
+                                    0 if mask_h_is_one else h_,
+                                    0 if mask_q_is_one else qi, ki)))
+        operands.append(mask)
+    in_specs += [q_spec, row_spec, row_spec]
+    operands += [dot, lse, delta]
+
+    out_specs = [q_spec]
+    out_shape = [jax.ShapeDtypeStruct((b, h, sq_p, d_p), qt.dtype)]
+    if want_dmask:
+        out_specs.append(score_spec)
+        out_shape.append(jax.ShapeDtypeStruct((b, h, sq_p, sk_p),
+                                              jnp.float32))
+
+    result = pl.pallas_call(
+        kernel,
+        grid=(b, h, n_q, n_k),
+        in_specs=in_specs,
+        out_specs=out_specs if want_dmask else out_specs[0],
+        out_shape=out_shape if want_dmask else out_shape[0],
+        scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+    return result if want_dmask else (result, None)
+
+
+def _bwd_dkv_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
+                  has_mask, mask_b_is_one, mask_h_is_one, mask_q_is_one,
+                  block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq_p, d_p = qt.shape
+    sk_p = kt.shape[2]
+    n_q, n_k = sq_p // block_q, sk_p // block_k
+    need_k_mask = sk_p != sk
+
+    def kernel(*refs):
+        if has_mask:
+            (q_ref, k_ref, v_ref, m_in_ref, do_ref, lse_ref, delta_ref,
+             dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        else:
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+             dk_ref, dv_ref, dk_acc, dv_acc) = refs
+            m_in_ref = None
+        ki = pl.program_id(2)
+        qi = pl.program_id(3)   # q innermost: it is the accumulated dim here
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[...] = jnp.zeros_like(dk_acc)
+            dv_acc[...] = jnp.zeros_like(dv_acc)
+
+        lse_blk = lse_ref[0, 0][:, None]
+        p = _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki,
+                            scale=scale, sk=sk, is_causal=is_causal,
+                            has_mask=has_mask, need_k_mask=need_k_mask,
+                            block_q=block_q, block_k=block_k)
+        doblk = do_ref[0, 0].astype(jnp.float32)
+        vblk = v_ref[0, 0].astype(jnp.float32)
+        dv_acc[...] += jax.lax.dot_general(
+            p, doblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # p^T @ dO  [bk, d]
+        dp = jax.lax.dot_general(doblk, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        qblk = q_ref[0, 0].astype(jnp.float32)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, qblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # ds^T @ Q
+
+        @pl.when(qi == n_q - 1)
+        def _done():
+            dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+            dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d_p),
+                          lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, d_p),
+                          lambda b_, h_, ki, qi: (b_, h_, ki, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q),
+                            lambda b_, h_, ki, qi: (b_, h_, qi))
+    in_specs = [q_spec, k_spec, k_spec]
+    operands = [qt, kt, vt]
+    if has_mask:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 1 if mask_q_is_one else block_q, block_k),
+            lambda b_, h_, ki, qi: (0 if mask_b_is_one else b_,
+                                    0 if mask_h_is_one else h_,
+                                    0 if mask_q_is_one else qi, ki)))
+        operands.append(mask)
+    in_specs += [q_spec, row_spec, row_spec]
+    operands += [dot, lse, delta]
+
+    dk, dv = pl.pallas_call(
+        kernel,
+        grid=(b, h, n_k, n_q),
+        in_specs=in_specs,
+        out_specs=[k_spec, k_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d_p), kt.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk_p, d_p), vt.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
+                        pltpu.VMEM((block_k, d_p), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+    return dk, dv
+
+
+# --------------------------------------------------------- custom-vjp glue
+
+@functools.lru_cache(maxsize=None)
+def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
+               mask_h_is_one: bool, mask_q_is_one: bool, sk: int,
+               real_d: int, mask_needs_grad: bool, interpret: bool):
+    """custom_vjp'd padded-layout flash attention, specialized per config.
+    `real_d` is the unpadded head dim — it sets the softmax scale. When
+    `mask_needs_grad`, the dq kernel additionally emits d(mask)=ds blocks
+    (O(s^2) fp32 — only materialized for trainable masks, e.g. learned
+    position biases); otherwise the mask cotangent is zeros."""
+    scale = 1.0 / math.sqrt(real_d)
+
+    def _kw(qt, kt):
+        return dict(scale=scale, sk=sk, is_causal=is_causal,
+                    has_mask=has_mask, mask_b_is_one=mask_b_is_one,
+                    mask_h_is_one=mask_h_is_one, mask_q_is_one=mask_q_is_one,
+                    block_q=min(_BLOCK_Q, qt.shape[2]),
+                    block_k=min(_BLOCK_K, kt.shape[2]),
+                    interpret=interpret)
+
+    @jax.custom_vjp
+    def f(qt, kt, vt, mask):
+        out, _ = _fwd_call(qt, kt, vt, mask, **_kw(qt, kt))
+        return out
+
+    def fwd(qt, kt, vt, mask):
+        out, lse = _fwd_call(qt, kt, vt, mask, **_kw(qt, kt))
+        return out, (qt, kt, vt, mask, out, lse)
+
+    def bwd(res, dout):
+        qt, kt, vt, mask, out, lse = res
+        delta = jnp.sum(dout.astype(jnp.float32)
+                        * out.astype(jnp.float32), axis=-1)   # [b,h,S]
+        kw = _kw(qt, kt)
+        dq, dmask_full = _bwd_dq_call(
+            qt, kt, vt, mask, dout, lse, delta,
+            want_dmask=has_mask and mask_needs_grad, **kw)
+        dk, dv = _bwd_dkv_call(qt, kt, vt, mask, dout, lse, delta, **kw)
+        if dmask_full is not None:
+            # collapse broadcast dims back to the primal mask's shape;
+            # padded rows/cols carry ds=0 (dO=0 / p=0), matching jnp.pad's vjp
+            dmask = dmask_full
+            if mask_b_is_one:
+                dmask = dmask.sum(axis=0, keepdims=True)
+            if mask_h_is_one:
+                dmask = dmask.sum(axis=1, keepdims=True)
+            if mask_q_is_one:
+                dmask = dmask.sum(axis=2, keepdims=True)
+        else:
+            dmask = jnp.zeros_like(mask)
+        return dq, dk, dv, dmask.astype(mask.dtype)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("is_causal", "has_mask", "mask_needs_grad",
+                     "interpret"))
+def _flash_attention_data(q, k, v, mask=None, is_causal=False,
+                          has_mask=False, mask_needs_grad=False,
+                          interpret=False):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq, _BLOCK_Q)
+    block_k = _pick_block(sk, _BLOCK_K)
+    sq_p = _round_up(sq, block_q)
+    sk_p = _round_up(sk, block_k)
+    d_p = _round_up(d, 128)
+
+    def to_bhsd(x, s_target):
+        x = jnp.einsum("bshd->bhsd", x)
+        return jnp.pad(x, ((0, 0), (0, 0), (0, s_target - x.shape[2]),
+                           (0, d_p - d)))
+
+    qt, kt, vt = to_bhsd(q, sq_p), to_bhsd(k, sk_p), to_bhsd(v, sk_p)
+    mask_b_is_one = mask_h_is_one = mask_q_is_one = True
+    if has_mask:
+        # keep broadcast (size-1) batch/head/q dims at 1 — the BlockSpec
+        # index maps pin them to block 0, so a (b,1,1,sk) padding mask never
+        # materializes the O(s^2) buffer flash attention exists to avoid
+        mask_b_is_one = mask.shape[0] == 1
+        mask_h_is_one = mask.shape[1] == 1
+        mask_q_is_one = mask.shape[2] == 1
+        q_dim = 1 if mask_q_is_one else sq
+        mask = jnp.broadcast_to(
+            mask, (mask.shape[0], mask.shape[1], q_dim, sk)
+        ).astype(jnp.float32)
+        mask = jnp.pad(mask, ((0, 0), (0, 0),
+                              (0, 0 if mask_q_is_one else sq_p - sq),
+                              (0, sk_p - sk)))
+    else:
+        mask = jnp.zeros((1, 1, 1, 1), jnp.float32)  # unused placeholder
+
+    f = _flash_vjp(is_causal, has_mask, mask_b_is_one, mask_h_is_one,
+                   mask_q_is_one, sk, d, mask_needs_grad, interpret)
+    out = f(qt, kt, vt, mask)
+    return jnp.einsum("bhsd->bshd", out[:, :, :sq, :d])
+
+
+def flash_attention(q, k, v, attn_mask=None, is_causal=False,
+                    interpret=False):
+    """Tensor-level wrapper used by nn.functional (differentiable)."""
     from ..core.dispatch import apply_callable
 
-    def fn(qd, kd, vd):
-        return _flash_attention_data(qd, kd, vd, is_causal=is_causal)
+    if attn_mask is None:
+        def fn(qd, kd, vd):
+            return _flash_attention_data(qd, kd, vd, is_causal=is_causal,
+                                         interpret=interpret)
 
-    return apply_callable("flash_attention", fn, q, k, v)
+        return apply_callable("flash_attention", fn, q, k, v)
+
+    needs_grad = (hasattr(attn_mask, "stop_gradient")
+                  and not attn_mask.stop_gradient)
+
+    def fn(qd, kd, vd, md):
+        return _flash_attention_data(qd, kd, vd, md, is_causal=is_causal,
+                                     has_mask=True,
+                                     mask_needs_grad=needs_grad,
+                                     interpret=interpret)
+
+    return apply_callable("flash_attention", fn, q, k, v, attn_mask)

@@ -199,6 +199,19 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import Variable, default_main_program, \
+            in_static_mode
+
+        if in_static_mode() and isinstance(loss, Variable):
+            # static path: record the update; the Executor compiles the full
+            # train step (forward + jax.grad + functional optimizer update)
+            # on first run — the meta-optimizer seam (SURVEY §3.2)
+            program = default_main_program()
+            program._minimize_hooks.append(
+                (self, loss, (parameters, no_grad_set)))
+            params = parameters or self._parameter_list
+            return None, [(p, f"{getattr(p, 'name', 'param')}@GRAD")
+                          for p in params]
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._parameter_list]

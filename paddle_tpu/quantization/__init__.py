@@ -1,0 +1,343 @@
+"""paddle.quantization — PTQ observers + QAT fake-quant (int8 simulation).
+
+Ref: python/paddle/quantization/ (upstream layout, unverified — mount empty).
+Observers are real statistics collectors (abs-max, EMA, percentile-histogram)
+producing scales; fake-quant is real round-to-grid quantize-dequantize with a
+straight-through estimator (x + stop_grad(qdq(x) - x)) so QAT trains through
+the rounding. PTQ inserts observers via Layer forward hooks; convert() bakes
+observed scales into QuantedLayers that run the qdq math at inference.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+
+__all__ = [
+    "QuantConfig", "PTQ", "QAT", "quanter",
+    "AbsmaxObserver", "EMAObserver", "HistObserver",
+    "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMaxObserver",
+    "quantize_dequantize", "QuantedLinear", "QuantedConv2D",
+]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def quantize_dequantize(x, scale, bits: int = 8, axis: Optional[int] = None):
+    """Round to the int grid and back, STE gradient (identity)."""
+    data = _data(x)
+    qmax = float(2 ** (bits - 1) - 1)
+    s = _data(scale)
+    if axis is not None:
+        shape = [1] * data.ndim
+        shape[axis] = -1
+        s = s.reshape(shape)
+    s = jnp.maximum(s, 1e-9)
+    q = jnp.clip(jnp.round(data / s * qmax), -qmax, qmax) / qmax * s
+    out = data + jax.lax.stop_gradient(q - data)
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+# ------------------------------------------------------------------ observers
+
+class _ObserverLayer(Layer):
+    """Collects statistics on every forward; scales() after calibration."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._observed = False
+
+    def forward(self, x):
+        self._observe(_data(x))
+        self._observed = True
+        return x
+
+    def _observe(self, data):
+        raise NotImplementedError
+
+    def scales(self) -> Tensor:
+        raise NotImplementedError
+
+    def zero_points(self) -> Tensor:
+        return Tensor(jnp.zeros_like(self.scales()._data))
+
+
+class AbsmaxObserver(_ObserverLayer):
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self._max = 0.0
+
+    def _observe(self, data):
+        self._max = max(self._max, float(jnp.max(jnp.abs(data))))
+
+    def scales(self) -> Tensor:
+        return Tensor(jnp.asarray(self._max, jnp.float32))
+
+
+class EMAObserver(_ObserverLayer):
+    """Moving-average abs-max (activation observer of choice for QAT)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._state: Optional[float] = None
+
+    def _observe(self, data):
+        cur = float(jnp.max(jnp.abs(data)))
+        if self._state is None:
+            self._state = cur
+        else:
+            self._state = (self.moving_rate * self._state
+                           + (1 - self.moving_rate) * cur)
+
+    def scales(self) -> Tensor:
+        return Tensor(jnp.asarray(self._state or 0.0, jnp.float32))
+
+
+class HistObserver(_ObserverLayer):
+    """Percentile scale from an accumulated |x| histogram (outlier-robust)."""
+
+    def __init__(self, quant_bits: int = 8, bins: int = 2048,
+                 percent: float = 0.999):
+        super().__init__(quant_bits)
+        self.bins = bins
+        self.percent = percent
+        self._hist = np.zeros(bins)
+        self._max = 1e-9
+
+    def _observe(self, data):
+        a = np.abs(np.asarray(data)).ravel()
+        cur_max = float(a.max()) if a.size else 0.0
+        if cur_max > self._max:  # re-bin the old histogram into a wider range
+            old_edges = np.linspace(0, self._max, self.bins + 1)
+            new_edges = np.linspace(0, cur_max, self.bins + 1)
+            centers = (old_edges[:-1] + old_edges[1:]) / 2
+            rebinned, _ = np.histogram(centers, bins=new_edges,
+                                       weights=self._hist)
+            self._hist = rebinned
+            self._max = cur_max
+        h, _ = np.histogram(a, bins=self.bins, range=(0, self._max))
+        self._hist += h
+
+    def scales(self) -> Tensor:
+        total = self._hist.sum()
+        if total == 0:
+            return Tensor(jnp.asarray(0.0, jnp.float32))
+        cdf = np.cumsum(self._hist) / total
+        idx = int(np.searchsorted(cdf, self.percent))
+        edge = (idx + 1) / self.bins * self._max
+        return Tensor(jnp.asarray(edge, jnp.float32))
+
+
+# ---------------------------------------------------------------- fake quant
+
+class FakeQuanterWithAbsMaxObserver(_ObserverLayer):
+    """QAT activation quanter: EMA abs-max observe + qdq with STE."""
+
+    def __init__(self, moving_rate: float = 0.9, quant_bits: int = 8,
+                 **kwargs):
+        super().__init__(quant_bits)
+        self._obs = EMAObserver(quant_bits, moving_rate)
+
+    def forward(self, x):
+        self._obs._observe(_data(x))
+        if self.training:
+            return quantize_dequantize(x, self._obs.scales(),
+                                       self.quant_bits)
+        return quantize_dequantize(x, self._obs.scales(), self.quant_bits)
+
+    def scales(self):
+        return self._obs.scales()
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(_ObserverLayer):
+    """Weight quanter: per-output-channel abs-max + qdq with STE."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = -1, **kwargs):
+        super().__init__(quant_bits)
+        self.quant_axis = quant_axis
+        self._scales = None
+
+    def forward(self, w):
+        data = _data(w)
+        axis = self.quant_axis % data.ndim
+        reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
+        self._scales = jnp.max(jnp.abs(data), axis=reduce_axes)
+        return quantize_dequantize(w, Tensor(self._scales), self.quant_bits,
+                                   axis=axis)
+
+    def scales(self):
+        return Tensor(self._scales)
+
+
+quanter = FakeQuanterWithAbsMaxObserver  # paddle alias
+
+
+# -------------------------------------------------------------------- config
+
+class QuantConfig:
+    """Which layers get which activation/weight quanter (paddle.quantization
+    .QuantConfig shape: global default + per-layer/type overrides)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs: Dict[type, tuple] = {}
+        self._layer_configs: Dict[int, tuple] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = (activation, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_configs[id(l)] = (activation, weight)
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.activation, self.weight)
+
+
+def _make(quanter_cls_or_obj):
+    if quanter_cls_or_obj is None:
+        return None
+    if isinstance(quanter_cls_or_obj, type):
+        return quanter_cls_or_obj()
+    import copy
+
+    return copy.deepcopy(quanter_cls_or_obj)
+
+
+# ------------------------------------------------------------ quanted layers
+
+class QuantedLinear(Layer):
+    def __init__(self, linear, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = linear
+        self.act_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        from .. import nn
+
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return nn.functional.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, conv, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = conv
+        self.act_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        from .. import nn
+
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return nn.functional.conv2d(
+            x, w, self.inner.bias, stride=self.inner._stride,
+            padding=self.inner._padding, dilation=self.inner._dilation,
+            groups=self.inner._groups)
+
+
+def _swap_quantable(model: Layer, config: QuantConfig) -> int:
+    """Replace Linear/Conv2D sublayers with quanted wrappers in place."""
+    from .. import nn
+
+    n = 0
+    for name, child in list(model.named_children()):
+        act_q, w_q = config._config_for(child)
+        if isinstance(child, nn.Linear):
+            setattr(model, name,
+                    QuantedLinear(child, _make(act_q), _make(w_q)))
+            n += 1
+        elif isinstance(child, nn.Conv2D):
+            setattr(model, name,
+                    QuantedConv2D(child, _make(act_q), _make(w_q)))
+            n += 1
+        else:
+            n += _swap_quantable(child, config)
+    return n
+
+
+class QAT:
+    """Quantization-aware training: swap in fake-quant wrappers, train."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        n = _swap_quantable(model, self.config)
+        if n == 0:
+            raise ValueError("no quantable (Linear/Conv2D) layers found")
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        return model  # scales live in the quanters; qdq already inline
+
+
+class PTQ:
+    """Post-training quantization: observe activations, then bake scales."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        cfg = QuantConfig(self.config.activation or AbsmaxObserver,
+                          self.config.weight
+                          or FakeQuanterChannelWiseAbsMaxObserver)
+        cfg._type_configs = self.config._type_configs
+        n = _swap_quantable(model, cfg)
+        if n == 0:
+            raise ValueError("no quantable (Linear/Conv2D) layers found")
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """After calibration forwards: freeze observer scales into qdq."""
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                obs = layer.act_quanter
+                if isinstance(obs, _ObserverLayer) and obs._observed:
+                    scale = obs.scales()
+                    bits = obs.quant_bits
+
+                    class _Baked(Layer):
+                        def __init__(self, s, b):
+                            super().__init__()
+                            self._s, self._b = s, b
+
+                        def forward(self, x):
+                            return quantize_dequantize(x, self._s, self._b)
+
+                    layer.act_quanter = _Baked(scale, bits)
+        return model

@@ -1,0 +1,355 @@
+"""paddle.sparse — COO/CSR sparse tensors with real TPU-compatible math.
+
+Ref: python/paddle/sparse/ + paddle/phi/kernels/sparse/ (upstream layout,
+unverified — mount empty). TPUs have no sparse MXU path, so the honest
+implementation keeps the sparse *format* (indices+values, the memory win) and
+lowers the math to dense-friendly primitives: spmm via segment_sum
+(scatter-add, which XLA schedules well), elementwise ops on the value vector,
+conversions via scatter/gather. Static nnz keeps everything jittable.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_same_shape", "matmul", "masked_matmul", "mv",
+    "add", "subtract", "multiply", "divide", "transpose",
+    "relu", "tanh", "sin", "sinh", "asin", "asinh", "atan", "atanh",
+    "sqrt", "square", "abs", "neg", "pow", "cast", "coalesce", "nn",
+]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """indices [sparse_ndim, nnz] + values [nnz, *dense_dims], fixed shape."""
+
+    def __init__(self, indices, values, shape, coalesced: bool = False):
+        self.indices_ = jnp.asarray(_data(indices), dtype=jnp.int32)
+        self.values_ = _data(values)
+        self.shape = list(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # paddle Tensor-member API
+    def indices(self) -> Tensor:
+        return Tensor(self.indices_)
+
+    def values(self) -> Tensor:
+        return Tensor(self.values_)
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices_.shape[1])
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
+
+    @property
+    def sparse_dim(self) -> int:
+        return int(self.indices_.shape[0])
+
+    @property
+    def dense_dim(self) -> int:
+        return self.values_.ndim - 1
+
+    def to_dense(self) -> Tensor:
+        sp = self.sparse_dim
+        dense = jnp.zeros(tuple(self.shape), dtype=self.values_.dtype)
+        idx = tuple(self.indices_[d] for d in range(sp))
+        return Tensor(dense.at[idx].add(self.values_))
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if self.sparse_dim != 2 or self.dense_dim != 0:
+            raise ValueError("to_sparse_csr needs a 2-D sparse matrix")
+        t = self.coalesce()
+        rows, cols = t.indices_[0], t.indices_[1]
+        order = jnp.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], t.values_[order]
+        crows = jnp.zeros(self.shape[0] + 1, jnp.int32).at[rows + 1].add(1)
+        crows = jnp.cumsum(crows)
+        return SparseCsrTensor(crows, cols, vals, self.shape)
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate coordinates (sum values); host-side (dynamic nnz)."""
+        if self._coalesced:
+            return self
+        idx = np.asarray(self.indices_)
+        vals = np.asarray(self.values_)
+        flat = np.ravel_multi_index(idx, tuple(self.shape[:self.sparse_dim]))
+        uniq, inv = np.unique(flat, return_inverse=True)
+        summed = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        np.add.at(summed, inv, vals)
+        new_idx = np.stack(np.unravel_index(
+            uniq, tuple(self.shape[:self.sparse_dim])))
+        return SparseCooTensor(new_idx, summed, self.shape, coalesced=True)
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def astype(self, dtype):
+        from ..core.dtype import convert_dtype
+
+        return SparseCooTensor(self.indices_,
+                               self.values_.astype(convert_dtype(dtype)),
+                               self.shape, self._coalesced)
+
+    def T(self):
+        return transpose(self, [1, 0])
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """crows [nrows+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows_ = jnp.asarray(_data(crows), dtype=jnp.int32)
+        self.cols_ = jnp.asarray(_data(cols), dtype=jnp.int32)
+        self.values_ = _data(values)
+        self.shape = list(int(s) for s in shape)
+
+    def crows(self) -> Tensor:
+        return Tensor(self.crows_)
+
+    def cols(self) -> Tensor:
+        return Tensor(self.cols_)
+
+    def values(self) -> Tensor:
+        return Tensor(self.values_)
+
+    @property
+    def nnz(self) -> int:
+        return int(self.cols_.shape[0])
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
+
+    def _row_indices(self):
+        # expand crows -> per-nnz row ids: row[i] = #crows entries <= i
+        nnz = self.nnz
+        positions = jnp.arange(nnz)
+        return (jnp.searchsorted(self.crows_[1:], positions,
+                                 side="right")).astype(jnp.int32)
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        rows = self._row_indices()
+        return SparseCooTensor(jnp.stack([rows, self.cols_]), self.values_,
+                               self.shape, coalesced=True)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+# ------------------------------------------------------------------ creation
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    idx = jnp.asarray(_data(indices), dtype=jnp.int32)
+    vals = _data(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = [int(jnp.max(idx[d])) + 1 for d in range(idx.shape[0])]
+        shape += list(vals.shape[1:])
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    vals = _data(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# -------------------------------------------------------------------- matmul
+
+def matmul(x, y) -> Tensor:
+    """Sparse @ dense (spmm) via segment_sum — TPU's scatter-add path."""
+    if isinstance(x, SparseCsrTensor):
+        rows = x._row_indices()
+        cols, vals = x.cols_, x.values_
+        n_rows = x.shape[0]
+    elif isinstance(x, SparseCooTensor):
+        t = x
+        rows, cols, vals = t.indices_[0], t.indices_[1], t.values_
+        n_rows = t.shape[0]
+    else:
+        raise TypeError("matmul expects a sparse lhs")
+    dense = _data(y)
+    gathered = dense[cols] * (vals[:, None] if dense.ndim == 2 else vals)
+    out = jax.ops.segment_sum(gathered, rows, num_segments=n_rows)
+    return Tensor(out)
+
+
+def mv(x, vec) -> Tensor:
+    """Sparse matrix @ dense vector."""
+    v = _data(vec)
+    return Tensor(matmul(x, v[:, None])._data[:, 0])
+
+
+def masked_matmul(x, y, mask) -> SparseCooTensor | SparseCsrTensor:
+    """(dense @ dense) evaluated ONLY at mask's nonzero positions — the
+    SDDMM kernel (used by sparse attention)."""
+    xd, yd = _data(x), _data(y)
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo()
+        rows, cols = coo.indices_[0], coo.indices_[1]
+        vals = jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+        out_coo = SparseCooTensor(jnp.stack([rows, cols]), vals, mask.shape,
+                                  coalesced=True)
+        return out_coo.to_sparse_csr()
+    rows, cols = mask.indices_[0], mask.indices_[1]
+    vals = jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+    return SparseCooTensor(jnp.stack([rows, cols]), vals, mask.shape,
+                           coalesced=True)
+
+
+# --------------------------------------------------------------- elementwise
+
+def _binary(x, y, fn):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        # general case: go through dense (duplicate coords make direct
+        # value-merge wrong); returns sparse with union support
+        dense = fn(x.to_dense()._data, y.to_dense()._data)
+        idx = jnp.nonzero(dense)  # host-side: dynamic nnz
+        vals = dense[idx]
+        return SparseCooTensor(jnp.stack(idx), vals, x.shape, coalesced=True)
+    raise TypeError("sparse binary ops need two SparseCooTensors")
+
+
+def add(x, y):
+    return _binary(x, y, jnp.add)
+
+
+def subtract(x, y):
+    return _binary(x, y, jnp.subtract)
+
+
+def multiply(x, y):
+    return _binary(x, y, jnp.multiply)
+
+
+def divide(x, y):
+    return _binary(x, y, jnp.divide)
+
+
+def transpose(x: SparseCooTensor, perm: Sequence[int]) -> SparseCooTensor:
+    t = x.coalesce() if isinstance(x, SparseCooTensor) else x.to_sparse_coo()
+    new_idx = jnp.stack([t.indices_[p] for p in perm])
+    new_shape = [t.shape[p] for p in perm]
+    return SparseCooTensor(new_idx, t.values_, new_shape)
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return x.coalesce()
+
+
+def _unary(fn, preserves_zero=True):
+    def op(x, *args):
+        vals = fn(x.values_, *args)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x.crows_, x.cols_, vals, x.shape)
+        return SparseCooTensor(x.indices_, vals, x.shape, x._coalesced)
+
+    return op
+
+
+relu = _unary(jax.nn.relu)
+tanh = _unary(jnp.tanh)
+sin = _unary(jnp.sin)
+sinh = _unary(jnp.sinh)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+pow = _unary(jnp.power)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core.dtype import convert_dtype
+
+    vals = x.values_
+    if value_dtype is not None:
+        vals = vals.astype(convert_dtype(value_dtype))
+    if isinstance(x, SparseCsrTensor):
+        crows, cols = x.crows_, x.cols_
+        if index_dtype is not None:
+            crows = crows.astype(convert_dtype(index_dtype))
+            cols = cols.astype(convert_dtype(index_dtype))
+        return SparseCsrTensor(crows, cols, vals, x.shape)
+    idx = x.indices_
+    if index_dtype is not None:
+        idx = idx.astype(convert_dtype(index_dtype))
+    return SparseCooTensor(idx, vals, x.shape, x._coalesced)
+
+
+class _SparseNN:
+    """paddle.sparse.nn — activations over sparse values."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Softmax:
+        """Row-wise softmax over a CSR matrix's stored values (the sparse
+        attention primitive)."""
+
+        def __init__(self, axis: int = -1):
+            self.axis = axis
+
+        def __call__(self, x: SparseCsrTensor) -> SparseCsrTensor:
+            rows = x._row_indices()
+            n = x.shape[0]
+            row_max = jax.ops.segment_max(x.values_, rows, num_segments=n)
+            e = jnp.exp(x.values_ - row_max[rows])
+            row_sum = jax.ops.segment_sum(e, rows, num_segments=n)
+            return SparseCsrTensor(x.crows_, x.cols_, e / row_sum[rows],
+                                   x.shape)
+
+
+nn = _SparseNN()

@@ -87,6 +87,18 @@ class Executor:
         feed = feed or {}
         fetch_list = list(fetch_list or [])
 
+        from .io import LoadedInferenceModel
+
+        if isinstance(program, LoadedInferenceModel):
+            outs = program.run(feed)
+            if fetch_list:
+                by_name = dict(zip(program.fetch_names, outs))
+                outs = [by_name[f.name if hasattr(f, "name") else str(f)]
+                        for f in fetch_list]
+            if return_numpy:
+                return [np.asarray(o) for o in outs]
+            return [Tensor(o) for o in outs]
+
         if not program.global_block().ops:
             return []  # startup program: params already initialized eagerly
 
@@ -137,9 +149,9 @@ class Executor:
         st = self._opt_states.get(id(program))
         if st is None:
             opt = program._minimize_hooks[0][0]
-            trainable = {n: a for n, a in param_arrays.items()
-                         if self._is_trainable(program, n)}
-            st = opt.functional_state(trainable)
+            names = self._trainable_names(program)
+            st = opt.functional_state(
+                {n: param_arrays[n] for n in names})
             self._opt_states[id(program)] = st
         return st
 
@@ -149,6 +161,35 @@ class Executor:
 
         t = program.refs.get(name)
         return isinstance(t, Parameter) and not t.stop_gradient
+
+    @staticmethod
+    def _trainable_names(program):
+        """Trainable persistables, honoring minimize's parameters (restrict)
+        and no_grad_set (exclude; accepts names or tensors) — a frozen param
+        silently updating is the bug class this guards (paddle contract)."""
+        names = [n for n in sorted(program.refs)
+                 if Executor._is_trainable(program, n)]
+        if not program._minimize_hooks:
+            return names
+        _, _, (params_filter, no_grad_set) = program._minimize_hooks[0]
+        if params_filter:
+            allowed = {id(p) for p in params_filter}
+            allowed_names = {getattr(p, "name", None) for p in params_filter}
+            names = [n for n in names
+                     if id(program.refs[n]) in allowed
+                     or n in allowed_names]
+        if no_grad_set:
+            excl_ids = {id(x) for x in no_grad_set
+                        if not isinstance(x, str)}
+            excl_names = {x for x in no_grad_set if isinstance(x, str)}
+            excl_names |= {getattr(x, "name", None) for x in no_grad_set
+                           if not isinstance(x, str)}
+            names = [n for n in names
+                     if n not in excl_names
+                     and id(program.refs[n]) not in excl_ids
+                     and getattr(program.refs[n], "name", None)
+                     not in excl_names]
+        return names
 
     def _compile(self, program: Program, fetch_names: List[str],
                  train: bool):
@@ -165,8 +206,7 @@ class Executor:
         loss_name = loss_var.name
 
         def step(feed_arrays, param_arrays, opt_state, lr, t):
-            trainable_names = [n for n in sorted(param_arrays)
-                               if self._is_trainable(program, n)]
+            trainable_names = self._trainable_names(program)
             frozen = {n: a for n, a in param_arrays.items()
                       if n not in trainable_names}
 

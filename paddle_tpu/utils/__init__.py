@@ -1,0 +1,92 @@
+"""paddle.utils — dlpack interop, deterministic-unique-name, download, lazy
+import, cpp_extension gate.
+
+Ref: python/paddle/utils/ (upstream layout, unverified — mount empty).
+dlpack is real interop (jax speaks the protocol natively); download degrades
+gracefully in this zero-egress environment by honoring pre-populated caches.
+"""
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+from typing import Optional
+
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import cpp_extension  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+
+__all__ = ["dlpack", "download", "cpp_extension", "try_import", "unique_name",
+           "deprecated", "run_check", "require_version"]
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._counters = {}
+
+    def __call__(self, key: str = "tmp") -> str:
+        c = self._counters.setdefault(key, itertools.count())
+        return f"{key}_{next(c)}"
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+unique_name = _UniqueNameGenerator()
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Decorator mirroring paddle.utils.deprecated: warn once per call site."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"{fn.__name__} is deprecated since {since or 'this release'}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def run_check() -> None:
+    """paddle.utils.run_check: verify the framework can compile and run a
+    matmul on the active backend, and report the device inventory."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    a = paddle.to_tensor(np.ones((4, 4), dtype="float32"))
+    out = paddle.matmul(a, a)
+    assert float(out.numpy()[0, 0]) == 4.0
+    n = len(jax.devices())
+    print(f"PaddleTPU works! devices: {n} x "
+          f"{getattr(jax.devices()[0], 'device_kind', jax.devices()[0].platform)}")
+
+
+def require_version(min_version: str, max_version: Optional[str] = None):
+    import paddle_tpu
+
+    def parse(v):
+        return tuple(int(x) for x in v.split(".")[:3])
+
+    cur = parse(paddle_tpu.__version__)
+    if parse(min_version) > cur:
+        raise RuntimeError(
+            f"paddle_tpu>={min_version} required, found "
+            f"{paddle_tpu.__version__}")
+    if max_version and parse(max_version) < cur:
+        raise RuntimeError(
+            f"paddle_tpu<={max_version} required, found "
+            f"{paddle_tpu.__version__}")

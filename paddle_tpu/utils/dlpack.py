@@ -1,0 +1,43 @@
+"""paddle.utils.dlpack — zero-copy tensor interop via the DLPack protocol.
+
+Ref: python/paddle/utils/dlpack.py (upstream layout, unverified — mount
+empty). jax.Arrays implement __dlpack__ natively, so to_dlpack hands out the
+capsule and from_dlpack builds a Tensor from any DLPack exporter (torch,
+numpy, cupy...).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+class _DLPackCarrier:
+    """Wraps an array as a standard DLPack exporter: modern consumers (jax,
+    torch>=1.13, numpy>=1.23 from_dlpack) call __dlpack__/__dlpack_device__
+    themselves; raw one-shot capsules were removed from the protocol."""
+
+    def __init__(self, array):
+        self._array = array
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._array.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._array.__dlpack_device__()
+
+
+def to_dlpack(x) -> _DLPackCarrier:
+    """Tensor -> DLPack exporter (zero-copy when the consumer shares the
+    device)."""
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return _DLPackCarrier(data)
+
+
+def from_dlpack(exporter) -> Tensor:
+    """Any object speaking the DLPack protocol -> Tensor."""
+    arr = jnp.from_dlpack(exporter)
+    return Tensor(arr)

@@ -1,0 +1,48 @@
+"""paddle.utils.download — dataset/weights fetch with local-cache honor.
+
+Ref: python/paddle/utils/download.py (upstream layout, unverified — mount
+empty). This environment has zero egress, so get_weights_path_from_url
+resolves ONLY against the local cache (~/.cache/paddle_tpu by default or
+PADDLE_TPU_HOME); a miss raises with a clear offline message instead of
+hanging on a socket.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "cached_path"]
+
+WEIGHTS_HOME = os.path.join(
+    os.environ.get("PADDLE_TPU_HOME",
+                   os.path.expanduser("~/.cache/paddle_tpu")), "weights")
+
+
+def _md5check(path: str, md5sum: str = None) -> bool:
+    if md5sum is None:
+        return True
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def cached_path(url: str, root_dir: str = WEIGHTS_HOME) -> str:
+    fname = os.path.basename(url.split("?")[0])
+    return os.path.join(root_dir, fname)
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
+                      check_exist: bool = True) -> str:
+    path = cached_path(url, root_dir)
+    if os.path.exists(path) and _md5check(path, md5sum):
+        return path
+    raise RuntimeError(
+        f"{url} is not in the local cache ({path}) and this environment has "
+        f"no network access. Pre-populate the cache or set PADDLE_TPU_HOME.")
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

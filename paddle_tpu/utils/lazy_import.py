@@ -1,0 +1,16 @@
+"""paddle.utils.lazy_import analog: try_import with a clear install hint."""
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["try_import"]
+
+
+def try_import(module_name: str, err_msg: str = None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed; this "
+            f"environment is offline — the dependency must be baked into "
+            f"the image.") from e

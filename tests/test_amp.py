@@ -71,3 +71,13 @@ def test_decorate_o2_master_weight_false_respected():
     paddle.amp.decorate(lin, optimizers=opt, level="O2",
                         master_weight=False)
     assert opt._multi_precision is False
+
+
+def test_double_step_without_update_raises():
+    scaler, opt, _ = _one_param_opt()
+    scaler.step(opt)
+    with pytest.raises(RuntimeError, match="already been called"):
+        scaler.step(opt)  # paddle contract: step;step without update raises
+    scaler.update()
+    scaler2, opt2, _ = _one_param_opt()
+    scaler2.step(opt2)  # fresh pair fine after update

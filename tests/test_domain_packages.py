@@ -1,0 +1,413 @@
+"""distribution / sparse / quantization / text / audio / device / utils /
+profiler — the aux subpackages filled in round 2 (verdict items #4, #9)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ------------------------------------------------------------- distribution
+class TestDistribution:
+    def test_normal_moments_and_logprob(self):
+        from paddle_tpu.distribution import Normal
+
+        d = Normal(loc=1.0, scale=2.0)
+        s = d.sample((20000,))
+        assert abs(float(s.numpy().mean()) - 1.0) < 0.1
+        assert abs(float(s.numpy().std()) - 2.0) < 0.1
+        # log_prob matches the closed form at the mean
+        lp = float(d.log_prob(paddle.to_tensor(1.0)).numpy())
+        np.testing.assert_allclose(lp, -np.log(2.0 * np.sqrt(2 * np.pi)),
+                                   rtol=1e-5)
+
+    def test_normal_rsample_differentiable(self):
+        from paddle_tpu.distribution import Normal
+
+        loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        d = Normal(loc=loc, scale=1.0)
+        # rsample flows gradient to loc through the reparameterization
+        out = d.rsample((16,))
+        assert out.numpy().shape == (16,)
+
+    def test_kl_normal(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+
+        p = Normal(0.0, 1.0)
+        q = Normal(1.0, 2.0)
+        kl = float(kl_divergence(p, q).numpy())
+        expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+        assert float(kl_divergence(p, p).numpy()) == pytest.approx(0.0)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+
+        probs = np.array([0.1, 0.2, 0.7], dtype="float32")
+        d = Categorical(probs=probs)
+        s = d.sample((5000,))
+        freq = np.bincount(np.asarray(s.numpy()).astype(int),
+                           minlength=3) / 5000
+        np.testing.assert_allclose(freq, probs, atol=0.05)
+        ent = float(d.entropy().numpy())
+        np.testing.assert_allclose(ent, -(probs * np.log(probs)).sum(),
+                                   rtol=1e-4)
+
+    def test_bernoulli_gamma_beta(self):
+        from paddle_tpu.distribution import Bernoulli, Beta, Gamma
+
+        b = Bernoulli(probs=0.3)
+        np.testing.assert_allclose(float(b.mean.numpy()), 0.3, rtol=1e-6)
+        g = Gamma(concentration=2.0, rate=0.5)
+        np.testing.assert_allclose(float(g.mean.numpy()), 4.0, rtol=1e-6)
+        s = g.sample((8000,))
+        assert abs(float(s.numpy().mean()) - 4.0) < 0.3
+        be = Beta(2.0, 3.0)
+        np.testing.assert_allclose(float(be.mean.numpy()), 0.4, rtol=1e-6)
+
+    def test_transformed_lognormal_consistency(self):
+        from paddle_tpu.distribution import (
+            ExpTransform, LogNormal, Normal, TransformedDistribution,
+        )
+
+        base = Normal(0.0, 0.5)
+        td = TransformedDistribution(base, [ExpTransform()])
+        ln = LogNormal(0.0, 0.5)
+        for v in (0.5, 1.0, 2.3):
+            np.testing.assert_allclose(
+                float(td.log_prob(paddle.to_tensor(v)).numpy()),
+                float(ln.log_prob(paddle.to_tensor(v)).numpy()), rtol=1e-5)
+
+    def test_independent_sums_event_dims(self):
+        from paddle_tpu.distribution import Independent, Normal
+
+        d = Independent(Normal(np.zeros(3, "float32"),
+                               np.ones(3, "float32")), 1)
+        lp = d.log_prob(paddle.to_tensor(np.zeros(3, "float32")))
+        np.testing.assert_allclose(
+            float(lp.numpy()), 3 * -0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+
+# ------------------------------------------------------------------- sparse
+class TestSparse:
+    def test_coo_roundtrip(self):
+        from paddle_tpu import sparse
+
+        dense = np.array([[0, 1, 0], [2, 0, 3]], dtype="float32")
+        idx = np.array([[0, 1, 1], [1, 0, 2]])
+        vals = np.array([1, 2, 3], dtype="float32")
+        t = sparse.sparse_coo_tensor(idx, vals, [2, 3])
+        np.testing.assert_array_equal(np.asarray(t.to_dense().numpy()),
+                                      dense)
+        assert t.nnz == 3
+
+    def test_coo_csr_conversion(self):
+        from paddle_tpu import sparse
+
+        idx = np.array([[0, 1, 1], [1, 0, 2]])
+        vals = np.array([1, 2, 3], dtype="float32")
+        coo = sparse.sparse_coo_tensor(idx, vals, [2, 3])
+        csr = coo.to_sparse_csr()
+        np.testing.assert_array_equal(np.asarray(csr.crows().numpy()),
+                                      [0, 1, 3])
+        back = csr.to_sparse_coo()
+        np.testing.assert_array_equal(np.asarray(back.to_dense().numpy()),
+                                      np.asarray(coo.to_dense().numpy()))
+
+    def test_spmm_matches_dense(self):
+        from paddle_tpu import sparse
+
+        rng = np.random.RandomState(0)
+        dense_a = (rng.rand(8, 6) * (rng.rand(8, 6) > 0.7)).astype("float32")
+        b = rng.randn(6, 5).astype("float32")
+        idx = np.stack(np.nonzero(dense_a))
+        coo = sparse.sparse_coo_tensor(idx, dense_a[tuple(idx)], [8, 6])
+        out = sparse.matmul(coo, b)
+        np.testing.assert_allclose(np.asarray(out.numpy()), dense_a @ b,
+                                   rtol=1e-5, atol=1e-5)
+        csr = coo.to_sparse_csr()
+        out2 = sparse.matmul(csr, b)
+        np.testing.assert_allclose(np.asarray(out2.numpy()), dense_a @ b,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_coalesce_and_unary(self):
+        from paddle_tpu import sparse
+
+        idx = np.array([[0, 0, 1], [1, 1, 0]])  # duplicate (0,1)
+        vals = np.array([1.0, 2.0, -4.0], dtype="float32")
+        t = sparse.sparse_coo_tensor(idx, vals, [2, 2]).coalesce()
+        assert t.nnz == 2
+        dense = np.asarray(t.to_dense().numpy())
+        np.testing.assert_allclose(dense, [[0, 3], [-4, 0]])
+        r = sparse.relu(t)
+        np.testing.assert_allclose(np.asarray(r.to_dense().numpy()),
+                                   [[0, 3], [0, 0]])
+
+    def test_csr_softmax_rows(self):
+        from paddle_tpu import sparse
+
+        crows = [0, 2, 3]
+        cols = [0, 2, 1]
+        vals = np.array([1.0, 1.0, 5.0], dtype="float32")
+        csr = sparse.sparse_csr_tensor(crows, cols, vals, [2, 3])
+        sm = sparse.nn.Softmax()(csr)
+        out = np.asarray(sm.values().numpy())
+        np.testing.assert_allclose(out[:2], [0.5, 0.5], rtol=1e-5)
+        np.testing.assert_allclose(out[2], 1.0, rtol=1e-5)
+
+
+# ------------------------------------------------------------- quantization
+class TestQuantization:
+    def test_qdq_grid(self):
+        from paddle_tpu.quantization import quantize_dequantize
+
+        x = paddle.to_tensor(np.array([-1.0, -0.5, 0.0, 0.3, 1.0],
+                                      dtype="float32"))
+        out = np.asarray(quantize_dequantize(x, 1.0, bits=8).numpy())
+        # values land on the int8 grid: x*127 integral
+        np.testing.assert_allclose(out * 127, np.round(out * 127),
+                                   atol=1e-4)
+        np.testing.assert_allclose(out, np.asarray(x.numpy()), atol=1 / 127)
+
+    def test_observers(self):
+        from paddle_tpu.quantization import AbsmaxObserver, HistObserver
+
+        obs = AbsmaxObserver()
+        obs(paddle.to_tensor(np.array([1.0, -3.0], "float32")))
+        obs(paddle.to_tensor(np.array([2.0], "float32")))
+        assert float(obs.scales().numpy()) == 3.0
+        h = HistObserver(percent=1.0)
+        h(paddle.to_tensor(np.linspace(-2, 2, 1000).astype("float32")))
+        assert abs(float(h.scales().numpy()) - 2.0) < 0.01
+
+    def test_qat_swaps_and_runs(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import (
+            FakeQuanterChannelWiseAbsMaxObserver,
+            FakeQuanterWithAbsMaxObserver, QAT, QuantConfig, QuantedLinear,
+        )
+
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                          weight=FakeQuanterChannelWiseAbsMaxObserver)
+        q = QAT(cfg).quantize(model)
+        assert any(isinstance(l, QuantedLinear)
+                   for l in q.sublayers(include_self=True))
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(3, 4).astype("float32"))
+        out_q = q(x)
+        out_f = model(x)
+        assert out_q.numpy().shape == (3, 2)
+        # int8 qdq stays close to the float path
+        np.testing.assert_allclose(np.asarray(out_q.numpy()),
+                                   np.asarray(out_f.numpy()), atol=0.15)
+
+    def test_ptq_calibrate_convert(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import PTQ, QuantConfig
+
+        model = nn.Sequential(nn.Linear(4, 4))
+        ptq = PTQ(QuantConfig(None, None))
+        q = ptq.quantize(model)
+        for _ in range(3):
+            q(paddle.to_tensor(np.random.RandomState(0)
+                               .randn(2, 4).astype("float32")))
+        converted = ptq.convert(q)
+        out = converted(paddle.to_tensor(np.ones((1, 4), "float32")))
+        assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+# --------------------------------------------------------------------- text
+class TestText:
+    def test_datasets_shapes(self):
+        import warnings
+
+        from paddle_tpu.text import Imdb, UCIHousing
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ds = Imdb(mode="train")
+            doc, label = ds[0]
+            assert doc.dtype == np.int64 and label in (0, 1)
+            uci = UCIHousing(mode="test")
+            x, y = uci[0]
+            assert x.shape == (13,) and y.shape == (1,)
+
+    def test_viterbi_matches_bruteforce(self):
+        from itertools import product
+
+        from paddle_tpu.text import viterbi_decode
+
+        rng = np.random.RandomState(0)
+        B, T, N = 2, 4, 3
+        emit = rng.randn(B, T, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        scores, paths = viterbi_decode(emit, trans,
+                                       include_bos_eos_tag=False)
+        for b in range(B):
+            best, best_path = -1e9, None
+            for path in product(range(N), repeat=T):
+                s = emit[b, 0, path[0]] + sum(
+                    trans[path[t - 1], path[t]] + emit[b, t, path[t]]
+                    for t in range(1, T))
+                if s > best:
+                    best, best_path = s, path
+            np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                       rtol=1e-5)
+            np.testing.assert_array_equal(np.asarray(paths.numpy()[b]),
+                                          best_path)
+
+
+# -------------------------------------------------------------------- audio
+class TestAudio:
+    def test_mel_fbank_shape_and_coverage(self):
+        from paddle_tpu.audio import compute_fbank_matrix
+
+        fb = np.asarray(compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()  # every filter covers some bins
+
+    def test_spectrogram_sine_peak(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.audio import Spectrogram
+
+        sr, f0 = 16000, 1000.0
+        t = np.arange(sr) / sr
+        sig = np.sin(2 * np.pi * f0 * t).astype("float32")
+        spec = Spectrogram(n_fft=512, hop_length=256)(jnp.asarray(sig))
+        mag = np.asarray(spec.numpy())  # [F, frames]
+        peak_bin = mag.mean(axis=1).argmax()
+        expect_bin = round(f0 / (sr / 512))
+        assert abs(int(peak_bin) - expect_bin) <= 1
+
+    def test_mfcc_pipeline_shapes(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.audio import MFCC
+
+        sig = np.random.RandomState(0).randn(2, 8000).astype("float32")
+        out = MFCC(sr=16000, n_mfcc=13, n_fft=512)(jnp.asarray(sig))
+        arr = np.asarray(out.numpy())
+        assert arr.shape[0] == 2 and arr.shape[1] == 13
+
+    def test_wav_roundtrip(self, tmp_path):
+        import warnings
+
+        from paddle_tpu import audio
+
+        sig = (np.sin(np.linspace(0, 100, 1600))[None]
+               .astype("float32") * 0.5)
+        path = str(tmp_path / "t.wav")
+        audio.save(path, sig, 16000)
+        loaded, sr = audio.load(path)
+        assert sr == 16000
+        np.testing.assert_allclose(np.asarray(loaded.numpy()), sig,
+                                   atol=1e-3)
+        meta = audio.info(path)
+        assert meta.num_frames == 1600 and meta.num_channels == 1
+
+    def test_hz_mel_inverse(self):
+        from paddle_tpu.audio import hz_to_mel, mel_to_hz
+
+        for hz in (100.0, 440.0, 4000.0):
+            np.testing.assert_allclose(mel_to_hz(hz_to_mel(hz)), hz,
+                                       rtol=1e-4)
+            np.testing.assert_allclose(
+                mel_to_hz(hz_to_mel(hz, htk=True), htk=True), hz, rtol=1e-4)
+
+
+# ----------------------------------------------------------- device / utils
+class TestDeviceUtils:
+    def test_device_synchronize_and_streams(self):
+        dev = paddle.device
+        dev.synchronize()
+        s = dev.Stream()
+        import jax.numpy as jnp
+
+        x = jnp.ones((8,)) * 2
+        s.track(x)
+        e = s.record_event()
+        e.synchronize()
+        assert s.query() in (True, False)
+        with dev.stream_guard(dev.Stream()) as s2:
+            assert dev.current_stream() is s2
+
+    def test_memory_allocated_nonzero(self):
+        import jax.numpy as jnp
+
+        keep = jnp.ones((1024, 1024), jnp.float32)  # noqa: F841
+        assert paddle.device.memory_allocated() > 0
+
+    def test_dlpack_roundtrip(self):
+        t = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+        cap = paddle.utils.dlpack.to_dlpack(t)
+        back = paddle.utils.dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(np.asarray(back.numpy()),
+                                      np.asarray(t.numpy()))
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "works" in capsys.readouterr().out
+
+    def test_cpp_extension_builds_and_runs(self, tmp_path):
+        src = tmp_path / "myop.cc"
+        src.write_text(
+            '#include <cstdint>\n'
+            'extern "C" void double_op(const float* in, float* out, '
+            'int64_t n) { for (int64_t i = 0; i < n; ++i) out[i] = '
+            '2.0f * in[i]; }\n')
+        from paddle_tpu.utils import cpp_extension
+
+        mod = cpp_extension.load(
+            "double_op", [str(src)], functions=["double_op"],
+            build_directory=str(tmp_path))
+        x = paddle.to_tensor(np.array([1.0, 2.5], dtype="float32"))
+        out = mod.double_op(x)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2.0, 5.0])
+
+
+# ----------------------------------------------------------------- profiler
+class TestProfiler:
+    def test_scheduler_states(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+
+        sch = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sch(i) for i in range(5)]
+        assert states[0] == ProfilerState.CLOSED
+        assert states[1] == ProfilerState.READY
+        assert states[2] == ProfilerState.RECORD
+        assert states[3] == ProfilerState.RECORD_AND_RETURN
+        assert states[4] == ProfilerState.CLOSED
+
+    def test_record_events_and_summary(self, tmp_path):
+        import time
+
+        from paddle_tpu import profiler
+
+        traces = str(tmp_path / "traces")
+        with profiler.Profiler(
+                scheduler=profiler.make_scheduler(closed=0, ready=0,
+                                                  record=3, repeat=1),
+                on_trace_ready=profiler.export_chrome_tracing(traces),
+                timer_only=True) as p:
+            for _ in range(3):
+                with profiler.RecordEvent("work"):
+                    time.sleep(0.002)
+                p.step()
+        s = p.summary()
+        assert "work" in s
+        files = os.listdir(traces)
+        assert len(files) == 1
+        loaded = profiler.load_profiler_result(os.path.join(traces,
+                                                            files[0]))
+        names = {ev["name"] for ev in loaded["traceEvents"]}
+        assert "work" in names
+
+    def test_record_event_outside_profiler_is_noop(self):
+        from paddle_tpu import profiler
+
+        with profiler.RecordEvent("orphan"):
+            pass  # must not raise or leak into any profiler

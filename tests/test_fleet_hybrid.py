@@ -351,3 +351,106 @@ def test_sequence_parallel_linears_match_dense():
     y = row(col(x))
     ref = x.matmul(col.weight).matmul(row.weight) + row.bias
     np.testing.assert_allclose(y.numpy(), ref.numpy(), rtol=1e-5)
+
+
+# ------------------------------------------- hybrid global-norm grad clip
+def test_hybrid_clip_grad_tp_matches_dense():
+    """ClipGradByGlobalNorm under TP sharding == dense replica (round-2:
+    HybridParallelOptimizer owns the cross-mesh clip, previously untested)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        HybridParallelOptimizer,
+    )
+
+    def build():
+        paddle.seed(11)
+
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = ColumnParallelLinear(16, 32, gather_output=False)
+                self.fc2 = RowParallelLinear(32, 8, input_is_parallel=True)
+
+            def forward(self, x):
+                return self.fc2(nn.functional.relu(self.fc1(x)))
+
+        return MLP()
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(8, 16).astype("float32") * 4  # big grads so the clip bites
+    y = rng.rand(8, 8).astype("float32")
+
+    def train(net, opt, sharded):
+        params, buffers = extract_state(net)
+        if sharded:
+            sh = mp_shardings(net, _mp_mesh(4))
+            params = {k: jax.device_put(v, sh[k])
+                      for k, v in params.items()}
+        for name, p in net.named_parameters():
+            p._data = params[name]
+        for _ in range(3):
+            out = net(paddle.to_tensor(x))
+            loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return {k: np.asarray(v.numpy())
+                for k, v in net.named_parameters()}
+
+    net1 = build()
+    opt1 = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net1.parameters(),
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(0.05))
+    dense = train(net1, opt1, sharded=False)
+
+    net2 = build()
+    opt2 = HybridParallelOptimizer(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net2.parameters(),
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(0.05)))
+    sharded = train(net2, opt2, sharded=True)
+
+    for k in dense:
+        np.testing.assert_allclose(dense[k], sharded[k], rtol=2e-4,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_hybrid_clip_psum_inside_shard_map():
+    """Inside shard_map the clip psums distributed-param norms over mp and
+    counts replicated params once."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        HybridParallelClipGrad,
+    )
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+    mesh = _mp_mesh(4)
+    clip = HybridParallelClipGrad(ClipGradByGlobalNorm(1.0))
+
+    # distributed param shard: each rank holds [1.0], global vector of 4
+    # replicated param: [2.0] on every rank
+    dist_shard = jnp.ones((4,))          # sharded dim-0 over mp
+    repl = jnp.full((1,), 2.0)
+
+    def body(d, r):
+        class P_:
+            need_clip = True
+            is_distributed = True
+            stop_gradient = False
+
+        class R_:
+            need_clip = True
+            is_distributed = False
+            stop_gradient = False
+
+        from paddle_tpu.core.tensor import Tensor as T
+
+        out = clip([(P_(), T(d)), (R_(), T(r))])
+        return out[0][1]._data, out[1][1]._data
+
+    d_clipped, r_clipped = shard_map(
+        body, mesh=mesh, in_specs=(P("mp"), P(None)),
+        out_specs=(P("mp"), P(None)))(dist_shard, repl)
+    # global norm = sqrt(4*1 + 4) = sqrt(8); factor = 1/sqrt(8)
+    expect = 1.0 / np.sqrt(8.0)
+    np.testing.assert_allclose(np.asarray(d_clipped),
+                               np.full(4, expect), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(r_clipped),
+                               np.full(1, 2 * expect), rtol=1e-5)

@@ -1,0 +1,218 @@
+"""Pallas flash attention — interpret-mode CI (verdict item #4).
+
+The round-1 kernel never ran in CI (CPU always took the jnp fallback) and had
+no backward. These tests run the REAL kernel via pallas_call(interpret=True)
+on CPU, forward and backward, against the jnp reference, across the widened
+shape space: head_dim 64 (flagship), seq not a multiple of the block, causal,
+additive masks (broadcast and per-head).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas_kernels import _flash_attention_data
+
+
+def _ref_attention(q, k, v, mask=None, is_causal=False):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if mask is not None:
+        s = s + mask
+    if is_causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(causal, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _rand_qkv(rng, b, sq, sk, h, d):
+    q = jnp.asarray(rng.randn(b, sq, h, d).astype("float32"))
+    k = jnp.asarray(rng.randn(b, sk, h, d).astype("float32"))
+    v = jnp.asarray(rng.randn(b, sk, h, d).astype("float32"))
+    return q, k, v
+
+
+CASES = [
+    # (sq, sk, h, d, causal) — d=64 is the ERNIE/GPT-base flagship shape
+    (128, 128, 2, 64, False),
+    (128, 128, 2, 64, True),
+    (200, 200, 1, 64, True),     # seq not a multiple of 128
+    (256, 384, 2, 32, False),    # cross-attention, small head
+    (96, 96, 1, 80, False),      # d not a power of two
+]
+
+
+@pytest.mark.parametrize("sq,sk,h,d,causal", CASES)
+def test_forward_matches_reference(sq, sk, h, d, causal):
+    rng = np.random.RandomState(0)
+    q, k, v = _rand_qkv(rng, 2, sq, sk, h, d)
+    out = _flash_attention_data(q, k, v, is_causal=causal, interpret=True)
+    ref = _ref_attention(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_forward_with_additive_mask():
+    rng = np.random.RandomState(1)
+    q, k, v = _rand_qkv(rng, 2, 128, 128, 2, 64)
+    # block half the keys for the first batch element, broadcast over heads
+    mask = np.zeros((2, 1, 128, 128), dtype="float32")
+    mask[0, :, :, 64:] = -1e9
+    mask = jnp.asarray(mask)
+    out = _flash_attention_data(q, k, v, mask, has_mask=True,
+                                interpret=True)
+    ref = _ref_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_forward_per_head_mask():
+    rng = np.random.RandomState(2)
+    q, k, v = _rand_qkv(rng, 1, 128, 128, 2, 64)
+    mask = jnp.asarray(
+        rng.choice([0.0, -1e9], size=(1, 2, 128, 128),
+                   p=[0.9, 0.1]).astype("float32"))
+    out = _flash_attention_data(q, k, v, mask, has_mask=True,
+                                interpret=True)
+    ref = _ref_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("sq,sk,h,d,causal", [
+    (128, 128, 2, 64, False),
+    (128, 128, 1, 64, True),
+    (200, 200, 1, 32, True),
+])
+def test_backward_matches_reference(sq, sk, h, d, causal):
+    rng = np.random.RandomState(3)
+    q, k, v = _rand_qkv(rng, 1, sq, sk, h, d)
+
+    def loss_pallas(q, k, v):
+        out = _flash_attention_data(q, k, v, is_causal=causal,
+                                    interpret=True)
+        return jnp.sum(out * jnp.cos(out))  # nontrivial cotangent
+
+    def loss_ref(q, k, v):
+        out = _ref_attention(q, k, v, is_causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_backward_with_mask():
+    rng = np.random.RandomState(4)
+    q, k, v = _rand_qkv(rng, 1, 128, 128, 2, 64)
+    mask = np.zeros((1, 1, 128, 128), dtype="float32")
+    mask[..., 100:] = -1e9
+    mask = jnp.asarray(mask)
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(_flash_attention_data(
+            q, k, v, mask, has_mask=True, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v, mask=mask) ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
+
+
+def test_tensor_level_wrapper_backward():
+    """flash_attention through the framework tape (Tensor.backward)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.pallas_kernels import flash_attention
+
+    rng = np.random.RandomState(5)
+    q = paddle.to_tensor(rng.randn(1, 128, 2, 64).astype("float32"),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.randn(1, 128, 2, 64).astype("float32"),
+                         stop_gradient=False)
+    v = paddle.to_tensor(rng.randn(1, 128, 2, 64).astype("float32"),
+                         stop_gradient=False)
+    out = flash_attention(q, k, v, is_causal=True, interpret=True)
+    out.sum().backward()
+    assert q.grad is not None and np.isfinite(
+        np.asarray(q.grad.numpy())).all()
+    assert k.grad is not None and v.grad is not None
+
+
+def test_trainable_mask_gets_gradient():
+    """A learned additive bias passed as attn_mask must receive d(mask)=ds,
+    not silent zeros (round-2 review finding)."""
+    rng = np.random.RandomState(6)
+    q, k, v = _rand_qkv(rng, 2, 128, 128, 2, 64)
+    mask = jnp.asarray(rng.randn(1, 1, 128, 128).astype("float32") * 0.1)
+
+    def loss_pallas(m):
+        return jnp.sum(_flash_attention_data(
+            q, k, v, m, has_mask=True, mask_needs_grad=True,
+            interpret=True) ** 2)
+
+    def loss_ref(m):
+        return jnp.sum(_ref_attention(q, k, v, mask=m) ** 2)
+
+    gp = jax.grad(loss_pallas)(mask)
+    gr = jax.grad(loss_ref)(mask)
+    assert float(jnp.abs(gr).max()) > 1e-4  # reference grad is nonzero
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               rtol=5e-3, atol=1e-5)
+
+
+def test_attention_dropout_applied():
+    """dropout_p>0 in training must actually drop attention probs."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(7)
+    q = paddle.to_tensor(rng.randn(1, 16, 2, 8).astype("float32"))
+    out_nodrop = F.scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+    out_drop = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                              training=True)
+    # with p=0.5 over 16 keys, outputs must differ from the dense result
+    assert not np.allclose(np.asarray(out_drop.numpy()),
+                           np.asarray(out_nodrop.numpy()))
+    # eval mode: no dropout regardless of p
+    out_eval = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                              training=False)
+    np.testing.assert_allclose(np.asarray(out_eval.numpy()),
+                               np.asarray(out_nodrop.numpy()), rtol=1e-6)
+
+
+def test_padding_mask_broadcast_q_dim():
+    """(b,1,1,sk) padding mask — must not materialize O(s^2); numerics match."""
+    rng = np.random.RandomState(8)
+    q, k, v = _rand_qkv(rng, 2, 128, 128, 2, 64)
+    mask = np.zeros((2, 1, 1, 128), dtype="float32")
+    mask[0, :, :, 100:] = -1e9  # pad out the first element's tail keys
+    mask = jnp.asarray(mask)
+    out = _flash_attention_data(q, k, v, mask, has_mask=True,
+                                interpret=True)
+    ref = _ref_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_pallas(m):
+        return jnp.sum(_flash_attention_data(
+            q, k, v, m, has_mask=True, mask_needs_grad=True,
+            interpret=True) ** 2)
+
+    def loss_ref(m):
+        return jnp.sum(_ref_attention(q, k, v, mask=m) ** 2)
+
+    gp = jax.grad(loss_pallas)(mask)
+    gr = jax.grad(loss_ref)(mask)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               rtol=5e-3, atol=1e-4)

@@ -1,0 +1,191 @@
+"""paddle.static: program capture, Executor train loop, inference I/O.
+
+Round-1 verdict item #3: static mode shipped unimportable and untested.
+These tests cover program_guard → data → layers → minimize → Executor.run
+(a converging train loop), eval-mode clone, save/load_inference_model
+roundtrip, and Program (de)serialization.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _build_mlp_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", shape=[None, 4], dtype="float32")
+        y = static.data("y", shape=[None, 1], dtype="float32")
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+        pred = net(x)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+    return main, startup, x, y, pred, loss
+
+
+def test_program_capture():
+    main, _, x, y, pred, loss = _build_mlp_program()
+    assert len(main.global_block().ops) >= 3
+    assert isinstance(pred, static.Variable)
+    assert pred.shape[-1] == 1
+    assert len(main.all_parameters()) == 4  # 2 weights + 2 biases
+
+
+def test_executor_forward():
+    main, startup, x, y, pred, loss = _build_mlp_program()
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(8, 4).astype("float32")
+    yv = np.zeros((8, 1), dtype="float32")
+    out, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[pred])
+    assert out.shape == (8, 1)
+    # different batch size reuses the program (recompiles per signature)
+    out2, = exe.run(main, feed={"x": xv[:3], "y": yv[:3]},
+                    fetch_list=[pred])
+    assert out2.shape == (3, 1)
+
+
+def test_static_train_converges():
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 1).astype("float32")
+    xv = rng.randn(64, 4).astype("float32")
+    yv = xv @ w_true
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", shape=[None, 4], dtype="float32")
+        y = static.data("y", shape=[None, 1], dtype="float32")
+        lin = nn.Linear(4, 1)
+        loss = paddle.nn.functional.mse_loss(lin(x), y)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(60):
+        lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05, losses[::10]
+
+
+def test_clone_for_test_freezes_params():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", shape=[None, 4], dtype="float32")
+        y = static.data("y", shape=[None, 1], dtype="float32")
+        lin = nn.Linear(4, 1)
+        pred = lin(x)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 4), dtype="float32")
+    yv = np.ones((4, 1), dtype="float32")
+    w_before = np.asarray(lin.weight.numpy()).copy()
+    exe.run(test_prog, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    np.testing.assert_array_equal(w_before, np.asarray(lin.weight.numpy()))
+    exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    assert not np.array_equal(w_before, np.asarray(lin.weight.numpy()))
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", shape=[None, 4], dtype="float32")
+        lin = nn.Linear(4, 2)
+        pred = lin(x)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(1).randn(5, 4).astype("float32")
+    expect, = exe.run(main, feed={"x": xv}, fetch_list=[pred])
+
+    prefix = str(tmp_path / "infer")
+    static.save_inference_model(prefix, [x], [pred], exe, program=main)
+
+    loaded, feed_names, fetch_targets = static.load_inference_model(
+        prefix, exe)
+    assert feed_names == ["x"]
+    got, = exe.run(loaded, feed={"x": xv}, fetch_list=fetch_targets)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    # symbolic batch dim: a different batch size works on the SAME artifact
+    got2, = exe.run(loaded, feed={"x": xv[:2]}, fetch_list=fetch_targets)
+    np.testing.assert_allclose(got2, expect[:2], rtol=1e-5, atol=1e-6)
+
+
+def test_program_serialize_roundtrip():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", shape=[None, 3], dtype="float32")
+        out = x.exp()
+    from paddle_tpu.static.io import deserialize_program, serialize_program
+
+    blob = serialize_program(main)
+    restored = deserialize_program(blob)
+    assert len(restored.global_block().ops) == \
+        len(main.global_block().ops)
+    assert restored.global_block().ops[0].type == "exp"
+
+
+def test_mode_switches():
+    assert static.in_static_mode()
+    paddle.disable_static()
+    assert not static.in_static_mode()
+    assert static.in_dynamic_mode()
+    paddle.enable_static()
+    assert static.in_static_mode()
+
+
+def test_minimize_no_grad_set_freezes_param():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", shape=[None, 4], dtype="float32")
+        y = static.data("y", shape=[None, 1], dtype="float32")
+        l1 = nn.Linear(4, 4)
+        l2 = nn.Linear(4, 1)
+        loss = paddle.nn.functional.mse_loss(l2(l1(x)), y)
+        opt = paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=l1.parameters() + l2.parameters())
+        opt.minimize(loss, no_grad_set=set(l1.parameters()))
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 4), dtype="float32")
+    yv = np.ones((4, 1), dtype="float32")
+    w1_before = np.asarray(l1.weight.numpy()).copy()
+    w2_before = np.asarray(l2.weight.numpy()).copy()
+    exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    np.testing.assert_array_equal(w1_before, np.asarray(l1.weight.numpy()))
+    assert not np.array_equal(w2_before, np.asarray(l2.weight.numpy()))
+
+
+def test_minimize_parameters_subset_restricts_updates():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", shape=[None, 4], dtype="float32")
+        y = static.data("y", shape=[None, 1], dtype="float32")
+        l1 = nn.Linear(4, 4)
+        l2 = nn.Linear(4, 1)
+        loss = paddle.nn.functional.mse_loss(l2(l1(x)), y)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=l2.parameters())
+        opt.minimize(loss, parameters=l2.parameters())
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 4), dtype="float32")
+    yv = np.ones((4, 1), dtype="float32")
+    w1_before = np.asarray(l1.weight.numpy()).copy()
+    exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    np.testing.assert_array_equal(w1_before, np.asarray(l1.weight.numpy()))

@@ -1,0 +1,196 @@
+"""ZeRO (GroupSharded) placement depth — verdict item #5.
+
+Round 1 asserted numerics parity only; these tests assert the actual ZeRO
+claims inside a jitted train step on the 8-fake-device mesh:
+- stage 1: optimizer state sharded, grads + params replicated;
+- stage 2: + gradients constrained to the sharded (reduce-scattered) layout;
+- stage 3: + params sharded, with per-device live bytes ~ 1/N of the full
+  parameter footprint;
+- offload=True places moment slots in pinned host memory (ZeRO-offload).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    GroupShardedStage2, GroupShardedStage3, group_sharded_parallel,
+)
+from paddle_tpu.jit.functional import call_functional, extract_state
+
+
+def _build(hidden=64):
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(16, hidden), nn.ReLU(),
+                         nn.Linear(hidden, 8))
+
+
+def _make_step(wrapped, opt, params):
+    """Jitted step constrained by the wrapper's sharding trees; returns
+    (loss, grads, new_params, new_opt_state) so the test can inspect every
+    layout the ZeRO stage claims."""
+    net = wrapped._layers
+    p_sh = wrapped.param_shardings(params)
+    g_sh = wrapped.grad_shardings(params)
+    opt_state = opt.functional_state(params)
+    os_sh = wrapped.opt_state_shardings(opt_state)
+    # place initial state per the stage contract
+    params = {k: jax.device_put(v, p_sh[k]) for k, v in params.items()}
+    opt_state = jax.tree_util.tree_map(
+        jax.device_put, opt_state, os_sh,
+        is_leaf=lambda x: isinstance(x, jax.Array))
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_of(p):
+            out, _ = call_functional(net, p, {}, (x,), training=True)
+            return jnp.mean((out - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        grads = {k: jax.lax.with_sharding_constraint(g, g_sh[k])
+                 for k, g in grads.items()}
+        new_params, new_state = opt.functional_step(
+            params, grads, opt_state, jnp.float32(0.01), jnp.int32(1))
+        new_params = {k: jax.lax.with_sharding_constraint(v, p_sh[k])
+                      for k, v in new_params.items()}
+        new_state = jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, new_state, os_sh,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+        return loss, grads, new_params, new_state
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 16).astype("float32"))
+    y = jnp.asarray(rng.randn(32, 8).astype("float32"))
+    return step(params, opt_state, x, y)
+
+
+def _spec_of(arr):
+    return arr.sharding.spec
+
+
+def _is_dim0_sharded(arr):
+    spec = tuple(_spec_of(arr))
+    return len(spec) >= 1 and spec[0] in ("sharding", ("sharding",))
+
+
+@pytest.mark.parametrize("level,stage", [("os", 1), ("os_g", 2)])
+def test_stage12_placement(level, stage):
+    net = _build()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    wrapped, _ = group_sharded_parallel(net, opt, level=level)
+    assert wrapped.stage == stage
+    params, _ = extract_state(net)
+    loss, grads, new_params, new_state = _make_step(wrapped, opt, params)
+
+    big = "0.weight"  # (16, 64): dim0 divisible by 8
+    # params replicated in stages 1/2
+    assert _spec_of(new_params[big]) == P()
+    # optimizer moments sharded dim-0
+    assert _is_dim0_sharded(new_state[big]["moment1"])
+    if stage >= 2:
+        assert _is_dim0_sharded(grads[big])  # reduce-scattered layout
+    else:
+        assert _spec_of(grads[big]) == P()
+    assert np.isfinite(float(loss))
+
+
+def test_stage3_placement_and_memory():
+    net = _build(hidden=64)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    wrapped, _ = group_sharded_parallel(net, opt, level="p_g_os")
+    params, _ = extract_state(net)
+    loss, grads, new_params, new_state = _make_step(wrapped, opt, params)
+
+    big = "0.weight"
+    assert _is_dim0_sharded(new_params[big])
+    assert _is_dim0_sharded(new_state[big]["moment1"])
+    assert _is_dim0_sharded(grads[big])
+
+    # the ZeRO-3 memory claim: per-device bytes of the sharded param are
+    # ~1/8 of the full tensor
+    arr = new_params[big]
+    full_bytes = arr.size * arr.dtype.itemsize
+    shard_bytes = max(s.data.size * s.data.dtype.itemsize
+                      for s in arr.addressable_shards)
+    assert shard_bytes * 8 == full_bytes
+    assert np.isfinite(float(loss))
+
+
+def test_stage_memory_footprints_differ():
+    """Per-device optimizer-state bytes: stage3 < replicated baseline."""
+    def per_device_bytes(tree):
+        total = 0
+        for arr in jax.tree_util.tree_leaves(tree):
+            total += max(s.data.size * s.data.dtype.itemsize
+                         for s in arr.addressable_shards)
+        return total
+
+    net = _build(hidden=64)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    params, _ = extract_state(net)
+
+    wrapped, _ = group_sharded_parallel(net, opt, level="os")
+    opt_state = opt.functional_state(params)
+    sharded = jax.tree_util.tree_map(
+        jax.device_put, opt_state, wrapped.opt_state_shardings(opt_state),
+        is_leaf=lambda x: isinstance(x, jax.Array))
+    repl = jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, NamedSharding(wrapped.mesh, P())), opt_state,
+        is_leaf=lambda x: isinstance(x, jax.Array))
+    assert per_device_bytes(sharded) < per_device_bytes(repl)
+
+
+def test_offload_places_opt_state_on_host():
+    net = _build()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    wrapped, _ = group_sharded_parallel(net, opt, level="os_g",
+                                        offload=True)
+    params, _ = extract_state(net)
+    opt_state = opt.functional_state(params)
+    shardings = wrapped.opt_state_shardings(opt_state)
+    sh = shardings["0.weight"]["moment1"]
+    assert sh.memory_kind == "pinned_host"
+    placed = jax.device_put(opt_state["0.weight"]["moment1"], sh)
+    assert placed.sharding.memory_kind == "pinned_host"
+
+
+def test_stage2_numerics_match_replica():
+    """Sharded-placement step == plain replicated step, bit-for-bit-ish."""
+    def run(level):
+        net = _build()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        params, _ = extract_state(net)
+        if level is None:
+            class _Repl:
+                mesh = None
+            wrapped, _ = group_sharded_parallel(net, opt, level="os")
+            wrapped.stage = 1
+
+            # replicate everything: baseline
+            class _Base(GroupShardedStage2):
+                pass
+            wrapped.grad_shardings = lambda p: {
+                k: NamedSharding(wrapped.mesh, P()) for k in p}
+            wrapped.opt_state_shardings = lambda st: {
+                k: {s: NamedSharding(wrapped.mesh, P()) for s in acc}
+                for k, acc in st.items()}
+        else:
+            wrapped, _ = group_sharded_parallel(net, opt, level=level)
+        loss, _, new_params, _ = _make_step(wrapped, opt, params)
+        return float(loss), {k: np.asarray(v) for k, v in new_params.items()}
+
+    loss_base, params_base = run(None)
+    loss_s2, params_s2 = run("os_g")
+    np.testing.assert_allclose(loss_base, loss_s2, rtol=1e-6)
+    for k in params_base:
+        np.testing.assert_allclose(params_base[k], params_s2[k], rtol=1e-5,
+                                   atol=1e-6)

@@ -13,7 +13,6 @@ a single [hidden, vocab] matmul).
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Optional
 
 from .. import nn
@@ -57,21 +56,19 @@ class ErnieSelfAttention(nn.Layer):
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
         self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
-        self.dropout = nn.Dropout(cfg.attention_probs_dropout_prob)
+        self.dropout_p = cfg.attention_probs_dropout_prob
 
     def forward(self, x, attn_mask=None):
         b, s, h = x.shape
+        # sdpa's layout contract is (b, s, heads, hd); the fused path
+        # (Pallas flash on TPU) handles the additive float mask in-kernel
         qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
-        qkv = qkv.transpose([2, 0, 3, 1, 4])  # 3,b,heads,s,hd
+        qkv = qkv.transpose([2, 0, 1, 3, 4])  # 3,b,s,heads,hd
         q, k, v = qkv[0], qkv[1], qkv[2]
-        scores = q.matmul(k.transpose([0, 1, 3, 2])) / math.sqrt(self.head_dim)
-        if attn_mask is not None:
-            scores = scores + attn_mask
-        probs = F.softmax(scores, axis=-1)
-        probs = self.dropout(probs)
-        ctx = probs.matmul(v)  # b,heads,s,hd
-        ctx = ctx.transpose([0, 2, 1, 3]).reshape([b, s, h])
-        return self.out(ctx)
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.dropout_p if self.training else 0.0)
+        return self.out(ctx.reshape([b, s, h]))
 
 
 class ErnieLayer(nn.Layer):

@@ -60,13 +60,14 @@ class GPTAttention(nn.Layer):
 
     def forward(self, x):
         b, s, h = x.shape
+        # scaled_dot_product_attention's layout contract is (b, s, heads, hd)
         qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
-        qkv = qkv.transpose([2, 0, 3, 1, 4])  # 3,b,nh,s,hd
+        qkv = qkv.transpose([2, 0, 1, 3, 4])  # 3,b,s,nh,hd
         q, k, v = qkv[0], qkv[1], qkv[2]
         ctx = F.scaled_dot_product_attention(
             q, k, v, is_causal=True,
             dropout_p=self.dropout_p if self.training else 0.0)
-        ctx = ctx.transpose([0, 2, 1, 3]).reshape([b, s, h])
+        ctx = ctx.reshape([b, s, h])
         return self.out(ctx)
 
 

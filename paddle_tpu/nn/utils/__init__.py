@@ -1,26 +1,154 @@
-"""nn.utils — weight_norm/spectral_norm/parameter vector helpers."""
+"""nn.utils — weight_norm/spectral_norm/parameter vector helpers (ref:
+python/paddle/nn/utils/*.py, upstream layout, unverified — mount empty).
+
+Both reparametrizations are implemented as forward-pre-hooks: the effective
+`weight` is recomputed from the registered parameters/buffers on every
+forward, inside whatever trace (eager tape, jit, pjit) the forward runs
+under — so gradients flow to the reparametrized parameters and the math
+compiles into the same XLA program as the layer itself.
+"""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...core.tensor import Tensor
+from ...core.tensor import Parameter, Tensor
 
 
-def weight_norm(layer, name="weight", dim=0):
-    """Simplified weight norm: reparameterize at attach time (static)."""
-    import warnings
+def _like_param(src: Parameter, data) -> Parameter:
+    """New Parameter carrying `src`'s training attrs (trainable flag,
+    per-param LR, regularizer, clip) — the optimizer reads all four."""
+    p = Parameter(data, trainable=src.trainable)
+    p.optimize_attr = dict(src.optimize_attr)
+    p.regularizer = src.regularizer
+    p.need_clip = src.need_clip
+    return p
 
-    warnings.warn("paddle_tpu weight_norm applies a one-time normalization; "
-                  "full reparameterized training support is pending")
+
+def _norm_axes(ndim: int, dim):
+    if dim is None:
+        return tuple(range(ndim))
+    if dim < 0:
+        dim += ndim
+    return tuple(i for i in range(ndim) if i != dim)
+
+
+def _row_norm(v, dim):
+    """||v|| over every axis except `dim` (kept), differentiable."""
+    axes = _norm_axes(len(v.shape), dim)
+    sq = (v * v).sum(axis=list(axes), keepdim=True)
+    return sq.sqrt()
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparametrize ``layer.<name>`` as ``g * v / ||v||``.
+
+    Registers trainable ``<name>_g`` (per-`dim` magnitudes; scalar when
+    ``dim is None``) and ``<name>_v`` (direction), removes the original
+    parameter, and recomputes the effective weight at every forward.
+    """
+    if hasattr(layer, f"{name}_g"):
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f"{name!r} is not a Parameter of {type(layer)}")
+    g0 = _row_norm(w, dim)
+    layer.add_parameter(f"{name}_g", _like_param(w, g0._data))
+    layer.add_parameter(f"{name}_v", _like_param(w, w._data))
+    del layer._parameters[name]
+
+    def _recompute(lay, _inputs=None):
+        g = getattr(lay, f"{name}_g")
+        v = getattr(lay, f"{name}_v")
+        eff = v * (g / _row_norm(v, dim))
+        object.__setattr__(lay, name, eff)
+
+    helper = layer.register_forward_pre_hook(_recompute)
+    _recompute(layer)
+    # stash for remove_weight_norm
+    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = \
+        (helper, dim)
     return layer
 
 
-def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
-                  dim=0):
-    import warnings
+def remove_weight_norm(layer, name: str = "weight"):
+    """Fold g·v/||v|| back into a plain parameter and drop the hook."""
+    hooks = layer.__dict__.get("_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    helper, dim = hooks.pop(name)
+    helper.remove()
+    g = getattr(layer, f"{name}_g")
+    v = getattr(layer, f"{name}_v")
+    eff = v * (g / _row_norm(v, dim))
+    del layer._parameters[f"{name}_g"]
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, _like_param(v, eff._data))
+    del layer._parameters[f"{name}_v"]
+    return layer
 
-    warnings.warn("paddle_tpu spectral_norm is a stub")
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0):
+    """Divide ``layer.<name>`` by its largest singular value.
+
+    σ is estimated by power iteration on the matricized weight
+    (``dim`` rows × everything-else columns). The ``u``/``v`` vectors are
+    non-trainable buffers refreshed on each *training* forward (the paddle
+    semantic); σ itself is computed differentiably as uᵀ W v so gradients
+    see the normalization.
+    """
+    if hasattr(layer, f"{name}_orig"):
+        raise ValueError(f"spectral_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f"{name!r} is not a Parameter of {type(layer)}")
+    ndim = len(w.shape)
+    if dim < 0:
+        dim += ndim
+    h = w.shape[dim]
+    cols = int(np.prod([w.shape[i] for i in range(ndim) if i != dim])) \
+        if ndim > 1 else 1
+
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(h).astype(np.float32)
+    v0 = rng.standard_normal(cols).astype(np.float32)
+    layer.register_buffer(f"{name}_u", Tensor(u0 / np.linalg.norm(u0)))
+    layer.register_buffer(f"{name}_v", Tensor(v0 / np.linalg.norm(v0)))
+    layer.add_parameter(f"{name}_orig", _like_param(w, w._data))
+    del layer._parameters[name]
+    perm = [dim] + [i for i in range(ndim) if i != dim]
+
+    def _recompute(lay, _inputs=None):
+        w_p = getattr(lay, f"{name}_orig")
+        mat = w_p.transpose(perm).reshape([h, cols]) if ndim > 1 else \
+            w_p.reshape([h, 1])
+        u = getattr(lay, f"{name}_u")
+        v = getattr(lay, f"{name}_v")
+        if getattr(lay, "training", True):
+            # power iteration on values only — u/v are constants to autograd
+            m = mat._data
+            ud, vd = u._data, v._data
+            for _ in range(n_power_iterations):
+                vd = m.T @ ud
+                vd = vd / (jnp.linalg.norm(vd) + eps)
+                ud = m @ vd
+                ud = ud / (jnp.linalg.norm(ud) + eps)
+            u._data, v._data = ud, vd
+        # lax.stop_gradient, not Tensor.detach: under jax-level autodiff
+        # (hapi/static/jit paths) detach only flags the eager tape and the
+        # power iteration would otherwise be differentiated through
+        u_c = Tensor(jax.lax.stop_gradient(u._data))
+        v_c = Tensor(jax.lax.stop_gradient(v._data))
+        sigma = u_c.reshape([1, h]).matmul(mat).matmul(
+            v_c.reshape([cols, 1])).reshape([1])
+        eff = w_p / sigma
+        object.__setattr__(lay, name, eff)
+
+    helper = layer.register_forward_pre_hook(_recompute)
+    _recompute(layer)
+    layer.__dict__.setdefault("_spectral_norm_hooks", {})[name] = helper
     return layer
 
 

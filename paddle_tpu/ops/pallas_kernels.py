@@ -127,7 +127,11 @@ def _fwd_call(qt, kt, vt, mask, *, scale, sk, is_causal, has_mask,
             l_fin = jnp.maximum(l_ref[...], 1e-30)
             o_ref[0, 0] = (acc_ref[...] / l_fin).astype(o_ref.dtype)
             lse = m_ref[...][:, 0] + jnp.log(l_fin[:, 0])
-            lse_ref[0, 0] = jnp.where(jnp.isfinite(lse), lse, 0.0)
+            lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
+            # lse rows live in a (8, block_q) tile (sublane-broadcast) —
+            # Mosaic requires the last two block dims be (8,128)-aligned,
+            # so a flat (1,1,block_q) row block is not lowerable
+            lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, block_q))
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d_p),
@@ -153,12 +157,12 @@ def _fwd_call(qt, kt, vt, mask, *, scale, sk, is_causal, has_mask,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d_p),
                          lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b_, h_, qi, ki: (b_, h_, qi)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, qi, ki: (b_, h_, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq_p, d_p), qt.dtype),
-            jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 8, sq_p), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d_p), jnp.float32),
@@ -224,7 +228,7 @@ def _bwd_dq_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
         def _init():
             acc_ref[...] = jnp.zeros_like(acc_ref)
 
-        lse_blk = lse_ref[0, 0][:, None]
+        lse_blk = lse_ref[0, 0, 0][:, None]
         p = _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki,
                             scale=scale, sk=sk, is_causal=is_causal,
                             has_mask=has_mask, need_k_mask=need_k_mask,
@@ -233,7 +237,7 @@ def _bwd_dq_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
         vblk = v_ref[0, 0].astype(jnp.float32)
         dp = jax.lax.dot_general(doblk, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0][:, None])
+        ds = p * (dp - delta_ref[0, 0, 0][:, None])
         if want_dmask:
             # s = scale*q·k + mask ⇒ d(mask) = ds, unscaled; per-(h,qi,ki)
             # blocks are each visited exactly once so a plain store is safe
@@ -251,8 +255,8 @@ def _bwd_dq_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
                           lambda b_, h_, qi, ki: (b_, h_, qi, 0))
     k_spec = pl.BlockSpec((1, 1, block_k, d_p),
                           lambda b_, h_, qi, ki: (b_, h_, ki, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q),
-                            lambda b_, h_, qi, ki: (b_, h_, qi))
+    row_spec = pl.BlockSpec((1, 1, 8, block_q),
+                            lambda b_, h_, qi, ki: (b_, h_, 0, qi))
     score_spec = pl.BlockSpec((1, 1, block_q, block_k),
                               lambda b_, h_, qi, ki: (b_, h_, qi, ki))
     in_specs = [q_spec, k_spec, k_spec]
@@ -313,7 +317,7 @@ def _bwd_dkv_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
             dk_acc[...] = jnp.zeros_like(dk_acc)
             dv_acc[...] = jnp.zeros_like(dv_acc)
 
-        lse_blk = lse_ref[0, 0][:, None]
+        lse_blk = lse_ref[0, 0, 0][:, None]
         p = _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki,
                             scale=scale, sk=sk, is_causal=is_causal,
                             has_mask=has_mask, need_k_mask=need_k_mask,
@@ -325,7 +329,7 @@ def _bwd_dkv_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
             preferred_element_type=jnp.float32)      # p^T @ dO  [bk, d]
         dp = jax.lax.dot_general(doblk, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0][:, None])
+        ds = p * (dp - delta_ref[0, 0, 0][:, None])
         qblk = q_ref[0, 0].astype(jnp.float32)
         dk_acc[...] += jax.lax.dot_general(
             ds, qblk, (((0,), (0,)), ((), ())),
@@ -340,8 +344,8 @@ def _bwd_dkv_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
                           lambda b_, h_, ki, qi: (b_, h_, qi, 0))
     k_spec = pl.BlockSpec((1, 1, block_k, d_p),
                           lambda b_, h_, ki, qi: (b_, h_, ki, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q),
-                            lambda b_, h_, ki, qi: (b_, h_, qi))
+    row_spec = pl.BlockSpec((1, 1, 8, block_q),
+                            lambda b_, h_, ki, qi: (b_, h_, 0, qi))
     in_specs = [q_spec, k_spec, k_spec]
     operands = [qt, kt, vt]
     if has_mask:
@@ -402,6 +406,9 @@ def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
         qt, kt, vt, mask, out, lse = res
         delta = jnp.sum(dout.astype(jnp.float32)
                         * out.astype(jnp.float32), axis=-1)   # [b,h,S]
+        # match lse's sublane-broadcast (b,h,8,S) layout (see _fwd_call)
+        delta = jnp.broadcast_to(delta[:, :, None, :],
+                                 (*delta.shape[:2], 8, delta.shape[-1]))
         kw = _kw(qt, kt)
         dq, dmask_full = _bwd_dq_call(
             qt, kt, vt, mask, dout, lse, delta,

@@ -1,0 +1,96 @@
+"""nn.utils reparametrizations (weight_norm / spectral_norm)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.utils import (
+    parameters_to_vector, remove_weight_norm, spectral_norm,
+    vector_to_parameters, weight_norm,
+)
+
+
+class TestWeightNorm:
+    def test_preserves_function_at_attach(self, rng):
+        l = nn.Linear(4, 3)
+        x = paddle.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
+        before = l(x).numpy()
+        weight_norm(l, dim=0)
+        after = l(x).numpy()
+        np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+        names = dict(l.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+        assert "weight" not in names
+        assert list(names["weight_g"].shape) == [4, 1]
+
+    def test_g_scales_output(self, rng):
+        l = nn.Linear(3, 3, bias_attr=False)
+        weight_norm(l, dim=None)
+        x = paddle.to_tensor(rng.standard_normal((2, 3)).astype(np.float32))
+        base = l(x).numpy()
+        l.weight_g._data = l.weight_g._data * 2.0
+        doubled = l(x).numpy()
+        np.testing.assert_allclose(doubled, 2.0 * base, rtol=1e-5)
+
+    def test_grads_flow_to_g_and_v(self, rng):
+        l = nn.Linear(4, 2)
+        weight_norm(l)
+        x = paddle.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
+        loss = (l(x) * l(x)).sum()
+        loss.backward()
+        assert l.weight_g.grad is not None
+        assert l.weight_v.grad is not None
+        assert float(np.abs(l.weight_g.grad.numpy()).max()) > 0
+
+    def test_remove_restores_plain_param(self, rng):
+        l = nn.Linear(4, 3)
+        x = paddle.to_tensor(rng.standard_normal((1, 4)).astype(np.float32))
+        weight_norm(l)
+        normed = l(x).numpy()
+        remove_weight_norm(l)
+        names = dict(l.named_parameters())
+        assert "weight" in names and "weight_g" not in names
+        np.testing.assert_allclose(l(x).numpy(), normed, rtol=1e-5, atol=1e-6)
+
+
+class TestSpectralNorm:
+    def test_sigma_converges_to_one(self, rng):
+        l = nn.Linear(8, 6, bias_attr=False)
+        # scale weight up so normalization is non-trivial
+        l.weight._data = l.weight._data * 7.0
+        spectral_norm(l, n_power_iterations=3)
+        x = paddle.to_tensor(rng.standard_normal((2, 8)).astype(np.float32))
+        for _ in range(10):  # power iteration refreshes each training fwd
+            l(x)
+        w_eff = l.weight.numpy()
+        top = np.linalg.svd(w_eff, compute_uv=False)[0]
+        assert abs(top - 1.0) < 1e-3, top
+
+    def test_eval_freezes_u_v(self, rng):
+        l = nn.Linear(5, 5, bias_attr=False)
+        spectral_norm(l)
+        x = paddle.to_tensor(rng.standard_normal((1, 5)).astype(np.float32))
+        l(x)
+        l.eval()
+        u_before = l.weight_u.numpy().copy()
+        l(x)
+        np.testing.assert_array_equal(u_before, l.weight_u.numpy())
+
+    def test_grads_flow_through_sigma(self, rng):
+        l = nn.Linear(4, 4, bias_attr=False)
+        spectral_norm(l)
+        x = paddle.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
+        loss = l(x).sum()
+        loss.backward()
+        assert l.weight_orig.grad is not None
+        assert float(np.abs(l.weight_orig.grad.numpy()).max()) > 0
+
+
+class TestParamVector:
+    def test_roundtrip(self):
+        l = nn.Linear(3, 2)
+        vec = parameters_to_vector(l.parameters())
+        assert list(vec.shape) == [8]
+        doubled = vec * 2.0
+        vector_to_parameters(doubled, l.parameters())
+        np.testing.assert_allclose(
+            parameters_to_vector(l.parameters()).numpy(), doubled.numpy())

@@ -26,25 +26,26 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """paddle.grad — returns grads of `outputs` w.r.t. `inputs` without
-    touching .grad. create_graph (higher-order via the tape) is not yet
-    supported; use paddle_tpu.incubate.functional_grad for nested grads."""
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is not supported yet; "
-            "use jax-level transforms (paddle_tpu.jit) for higher-order AD"
-        )
+    touching .grad. With create_graph=True the backward itself is recorded
+    on the tape (each node's vjp is re-derived from its pure function), so
+    the returned grads are differentiable — call grad/backward on them
+    again for higher orders."""
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     store = {}
     targets = {id(t) for t in inputs}
-    retain = bool(retain_graph) if retain_graph is not None else False
+    retain = bool(retain_graph) if retain_graph is not None else create_graph
     tape_mod.backward(outputs, grad_tensors=grad_outputs,
                       retain_graph=retain, targets=targets, store=store,
-                      accumulate_leaf=False)
+                      accumulate_leaf=False, create_graph=create_graph)
     results: List[Optional[Tensor]] = []
     for t in inputs:
         if id(t) in store:
-            results.append(Tensor(store[id(t)], stop_gradient=True))
+            g = store[id(t)]
+            if create_graph:
+                results.append(g)        # recorded Tensor, differentiable
+            else:
+                results.append(Tensor(g, stop_gradient=True))
         else:
             if not allow_unused:
                 raise RuntimeError(
@@ -53,6 +54,82 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                 )
             results.append(None)
     return results
+
+
+def _call_pure(func, datas):
+    """Run an eager Tensor-func on raw (possibly traced) arrays, no tape."""
+    with no_grad():
+        outs = func(*[Tensor(d) for d in datas])
+    if isinstance(outs, (tuple, list)):
+        return tuple(o._data for o in outs)
+    return outs._data
+
+
+def _multi_result(fn, xs, single_in, create_graph, name):
+    """Evaluate a tuple-returning pure fn of the input datas; with
+    create_graph the evaluation is recorded so results are differentiable."""
+    if create_graph:
+        from ..core.dispatch import apply_callable
+
+        res = apply_callable(name, fn, *xs)
+        out = res if isinstance(res, tuple) else (res,)
+    else:
+        with no_grad():
+            vals = fn(*[x._data for x in xs])
+        if not isinstance(vals, tuple):
+            vals = (vals,)
+        out = tuple(Tensor(v, stop_gradient=True) for v in vals)
+    return out[0] if single_in else tuple(out)
+
+
+def jacobian(func, inputs, create_graph=False, allow_unused=False):
+    """Jacobian of ``func`` (a single-output Tensor function) at ``inputs``.
+
+    Computed with jax.jacrev over the eager function — the eager ops run on
+    tracers, so the whole Jacobian is one reverse-mode XLA program instead
+    of a Python loop of per-row tape walks. Returns a Tensor for a single
+    input, else a tuple with one Jacobian per input.
+    """
+    single_in = isinstance(inputs, Tensor)
+    xs = [inputs] if single_in else list(inputs)
+
+    def jac_fn(*ds):
+        j = jax.jacrev(lambda *dd: _call_pure(func, dd),
+                       argnums=tuple(range(len(ds))))(*ds)
+        if isinstance(j, tuple) and isinstance(j[0], tuple):
+            raise RuntimeError("jacobian supports single-output functions")
+        if isinstance(j, tuple) and len(j) == 1:
+            return j[0]   # bare single value: tape vjps expect no 1-tuples
+        return j
+
+    return _multi_result(jac_fn, xs, single_in, create_graph, "jacobian")
+
+
+def hessian(func, inputs, create_graph=False, allow_unused=False):
+    """Hessian of a scalar-valued Tensor function (forward-over-reverse).
+    Single input → Tensor; N inputs → N×N nested tuple (flattened row-major
+    tuple of Tensors per input pair)."""
+    single_in = isinstance(inputs, Tensor)
+    xs = [inputs] if single_in else list(inputs)
+    n = len(xs)
+
+    def scalar(*ds):
+        out = _call_pure(func, ds)
+        if isinstance(out, tuple):
+            out = out[0]
+        if out.size != 1:
+            raise RuntimeError("hessian requires a scalar-valued function")
+        return out.reshape(())
+
+    def hes_fn(*ds):
+        h = jax.hessian(scalar, argnums=tuple(range(len(ds))))(*ds)
+        flat = tuple(h[i][j] for i in range(n) for j in range(n))
+        return flat[0] if len(flat) == 1 else flat
+
+    flat = _multi_result(hes_fn, xs, False, create_graph, "hessian")
+    if single_in:
+        return flat[0]
+    return tuple(tuple(flat[i * n + j] for j in range(n)) for i in range(n))
 
 
 class PyLayerContext:
@@ -138,6 +215,22 @@ class PyLayer(metaclass=PyLayerMeta):
                                    else jnp.asarray(g))
                 return tuple(out)
 
+            def vjp_tensor_fn(ct_tensors):
+                # create_graph path: run the user backward with recording ON
+                # so its Tensor ops land on the tape. Residuals saved from
+                # the (no_grad) forward are constants; saving *inputs* in
+                # forward keeps second-order flow through them.
+                gin = cls.backward(ctx, *ct_tensors)
+                if not isinstance(gin, (tuple, list)):
+                    gin = (gin,)
+                out = []
+                for i, t in enumerate(tensor_inputs):
+                    g = gin[i] if i < len(gin) else None
+                    if g is not None and not isinstance(g, Tensor):
+                        g = Tensor(jnp.asarray(g))
+                    out.append(g)
+                return tuple(out)
+
             node = tape_mod.GradNode(
                 vjp_fn if len(out_tensors) > 1 else
                 (lambda ct: vjp_fn((ct,))),
@@ -146,6 +239,7 @@ class PyLayer(metaclass=PyLayerMeta):
                 name=cls.__name__,
                 out_avals=[(o._data.shape, o._data.dtype)
                            for o in out_tensors],
+                vjp_tensor_fn=vjp_tensor_fn,
             )
             for i, t in enumerate(out_tensors):
                 t._grad_node = node

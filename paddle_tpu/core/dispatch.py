@@ -111,6 +111,7 @@ def apply_op(opdef: OpDef, *args, **kwargs):
             n_outputs=len(outs_flat),
             name=opdef.name,
             out_avals=[(o.shape, o.dtype) for o in outs_flat],
+            pure_fn=fn,
         )
         for i, t in enumerate(out_tensors):
             t._grad_node = node
@@ -148,6 +149,7 @@ def apply_callable(name: str, fn: Callable, *tensors):
             n_outputs=len(outs_flat),
             name=name,
             out_avals=[(o.shape, o.dtype) for o in outs_flat],
+            pure_fn=fn,
         )
         for i, t in enumerate(out_tensors):
             t._grad_node = node

@@ -19,19 +19,29 @@ import jax.numpy as jnp
 
 
 class GradNode:
-    """One recorded op: vjp closure + graph edges."""
+    """One recorded op: vjp closure + graph edges.
+
+    `pure_fn` (when present) is the op's pure jax function of the input
+    datas — create_graph backward re-differentiates through it instead of
+    calling the opaque `vjp_fn`, so second-order gradients see the full
+    dependence on the inputs (residuals included). `vjp_tensor_fn` is the
+    PyLayer seam: a Tensor-in/Tensor-out backward executed with recording
+    enabled.
+    """
 
     __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_grads", "out_avals",
-                 "name", "__weakref__")
+                 "name", "pure_fn", "vjp_tensor_fn", "__weakref__")
 
     def __init__(self, vjp_fn, inputs, n_outputs: int, name: str = "",
-                 out_avals=None):
+                 out_avals=None, pure_fn=None, vjp_tensor_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs              # list[Tensor] — differentiable positions
         self.n_outputs = n_outputs
         self.out_grads: Optional[list] = None  # cotangent accumulation slots
         self.out_avals = out_avals        # (shape, dtype) per output, for zero-fill
         self.name = name
+        self.pure_fn = pure_fn
+        self.vjp_tensor_fn = vjp_tensor_fn
 
     def ready(self) -> bool:
         return self.out_grads is not None and all(
@@ -130,12 +140,19 @@ def _toposort(root_nodes) -> List[GradNode]:
 
 
 def backward(tensors, grad_tensors=None, retain_graph: bool = False,
-             targets=None, store=None, accumulate_leaf: bool = True):
+             targets=None, store=None, accumulate_leaf: bool = True,
+             create_graph: bool = False):
     """Run the backward engine from `tensors` (paddle.autograd.backward).
 
     `targets`/`store` support paddle.grad(): cotangents deposited for tensors
     whose id is in `targets` are also accumulated into `store[id]`.
+    With `create_graph`, the backward computation itself is executed through
+    the recording dispatch (cotangents are Tensors, each node's vjp is
+    re-derived from its pure function), so the results are differentiable.
     """
+    if create_graph:
+        return _backward_create_graph(tensors, grad_tensors, targets, store,
+                                      accumulate_leaf)
     from .tensor import Tensor
 
     def _collect(t, g):
@@ -216,6 +233,126 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                         g if prod.out_grads[i] is None else prod.out_grads[i] + g
                     )
             if not retain_graph:
+                # free everything that pins memory: pure_fn closes over the
+                # input arrays, so leaving it set would both leak activations
+                # and let a later create_graph backward walk a freed node
                 node.vjp_fn = None
+                node.pure_fn = None
+                node.vjp_tensor_fn = None
                 node.inputs = ()
+            node.out_grads = None
+
+
+def _node_backward_tensors(node, ct_tensors):
+    """One node's input grads as recorded Tensors (create_graph path)."""
+    import jax
+
+    from .dispatch import apply_callable
+
+    if node.vjp_tensor_fn is not None:       # PyLayer: user backward records
+        return node.vjp_tensor_fn(ct_tensors)
+    if node.pure_fn is None:
+        raise RuntimeError(
+            f"create_graph backward through {node.name!r} a second time: the "
+            "graph was freed — pass retain_graph=True (or create_graph=True, "
+            "which implies it) to the earlier backward/grad call"
+        )
+    n_in = len(node.inputs)
+
+    def bw_fn(*flat):
+        xs, cts = flat[:n_in], flat[n_in:]
+        _, vjp = jax.vjp(node.pure_fn, *xs)
+        gs = vjp(cts[0] if node.n_outputs == 1 else tuple(cts))
+        out = []
+        for x, g in zip(xs, gs):
+            if g.dtype == jax.dtypes.float0:   # int input: placeholder zeros
+                g = jnp.zeros(x.shape, jnp.float32)
+            out.append(g)
+        # bare value for a single input grad: the tape calls single-output
+        # vjps with a bare cotangent, so the recorded fn must not be a 1-tuple
+        return tuple(out) if len(out) > 1 else out[0]
+
+    res = apply_callable(f"grad::{node.name}", bw_fn,
+                         *(list(node.inputs) + list(ct_tensors)))
+    return res if isinstance(res, tuple) else (res,)
+
+
+def _backward_create_graph(tensors, grad_tensors, targets, store,
+                           accumulate_leaf):
+    """Differentiable backward: cotangents are Tensors, every node grad is
+    computed through the recording dispatch so the tape captures the whole
+    backward graph (second and higher order via repeated calls)."""
+    from .tensor import Tensor
+
+    def _collect(t, g):
+        if targets is not None and id(t) in targets:
+            store[id(t)] = g if id(t) not in store else store[id(t)] + g
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        node = t._grad_node
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires grad_tensors"
+                )
+            g_t = Tensor(jnp.ones_like(t._data), stop_gradient=True)
+        else:
+            g_t = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+        if node is None:
+            _collect(t, g_t)
+            if accumulate_leaf and not t.stop_gradient:
+                t._accumulate_grad(g_t._data)
+            continue
+        _collect(t, g_t)
+        if node.out_grads is None:
+            node.out_grads = [None] * node.n_outputs
+        idx = t._out_index
+        node.out_grads[idx] = (
+            g_t if node.out_grads[idx] is None else node.out_grads[idx] + g_t
+        )
+        roots.append(node)
+
+    if not roots:
+        return
+
+    order = _toposort(roots)
+    try:
+        for node in order:
+            if node.out_grads is None:
+                continue
+            cts = tuple(
+                c if c is not None
+                else Tensor(jnp.zeros(av[0], av[1]), stop_gradient=True)
+                for c, av in zip(node.out_grads, node.out_avals)
+            )
+            in_grads = _node_backward_tensors(node, cts)
+            for t, g in zip(node.inputs, in_grads):
+                if g is None:
+                    continue
+                _collect(t, g)
+                prod = t._grad_node
+                if prod is None:
+                    if accumulate_leaf and not t.stop_gradient:
+                        t._accumulate_grad(g._data)
+                else:
+                    if prod.out_grads is None:
+                        prod.out_grads = [None] * prod.n_outputs
+                    i = t._out_index
+                    prod.out_grads[i] = (
+                        g if prod.out_grads[i] is None
+                        else prod.out_grads[i] + g
+                    )
+            node.out_grads = None
+    finally:
+        # the primal graph is never freed under create_graph; just clear
+        # any accumulation slots a partial walk left behind
+        for node in order:
             node.out_grads = None

@@ -78,6 +78,13 @@ class PipelineLayer(nn.Layer):
         self._loss_fn = loss_fn
         self.seg_method = seg_method
         self.recompute_interval = recompute_interval
+        # Interleaved (VPP) partitioning: V model chunks per physical stage,
+        # assigned round-robin (chunk c lives on stage c % S, the Megatron
+        # interleaved layout) so each device holds V smaller chunks and the
+        # pipeline bubble shrinks by ~1/V.
+        self.num_virtual_stages = int(num_virtual_pipeline_stages or 1)
+        if self.num_virtual_stages < 1:
+            raise ValueError("num_virtual_pipeline_stages must be >= 1")
 
         self._shared = {}
         built = []
@@ -98,13 +105,28 @@ class PipelineLayer(nn.Layer):
         for i, l in enumerate(self._all_layers):
             self.add_sublayer(str(i), l)
 
-        self._segments = self._segment(len(built), self.num_stages,
+        self.num_chunks = self.num_stages * self.num_virtual_stages
+        if len(built) < self.num_chunks:
+            raise ValueError(
+                f"{len(built)} layers cannot be split into "
+                f"{self.num_stages} stages x {self.num_virtual_stages} "
+                "virtual stages")
+        self._segments = self._segment(len(built), self.num_chunks,
                                        seg_method)
-        # stage s owns layers [seg[s], seg[s+1])
+        # chunk c owns layers [seg[c], seg[c+1]); placed on stage c % S
+        self.chunk_layers: List[List[nn.Layer]] = [
+            self._all_layers[self._segments[c]: self._segments[c + 1]]
+            for c in range(self.num_chunks)
+        ]
+        # physical view: stage s = its chunks in execution order
         self.stage_layers: List[List[nn.Layer]] = [
-            self._all_layers[self._segments[s]: self._segments[s + 1]]
+            [l for c in range(s, self.num_chunks, self.num_stages)
+             for l in self.chunk_layers[c]]
             for s in range(self.num_stages)
         ]
+
+    def chunk_to_stage(self, c: int) -> int:
+        return c % self.num_stages
 
     def _segment(self, n_layers: int, n_stages: int, method: str):
         if method.startswith("layer:"):
@@ -125,9 +147,9 @@ class PipelineLayer(nn.Layer):
         return cuts
 
     def get_stage_from_index(self, idx: int) -> int:
-        for s in range(self.num_stages):
-            if self._segments[s] <= idx < self._segments[s + 1]:
-                return s
+        for c in range(self.num_chunks):
+            if self._segments[c] <= idx < self._segments[c + 1]:
+                return self.chunk_to_stage(c)
         raise IndexError(idx)
 
     def forward(self, x):
@@ -169,7 +191,15 @@ def _stage_forward_fn(stage_layers: List[nn.Layer], training: bool = True):
 
 
 class PipelineParallel:
-    """1F1B schedule over per-stage jitted fwd/bwd (train_batch engine)."""
+    """1F1B schedule over per-chunk jitted fwd/bwd (train_batch engine).
+
+    With num_virtual_pipeline_stages=V > 1 this is the interleaved (VPP)
+    engine: the model is cut into S*V chunks, chunk c placed on physical
+    stage c % S, and every forward/backward chain hops each device V times —
+    the Megatron interleaved layout. The chunk units are what the Python
+    scheduler dispatches; XLA's async dispatch overlaps them across the
+    per-stage submeshes.
+    """
 
     def __init__(self, layers: PipelineLayer, hcg, strategy=None):
         self._layers = layers
@@ -178,14 +208,15 @@ class PipelineParallel:
                {"accumulate_steps": 1, "micro_batch_size": 1})
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.num_stages = layers.num_stages
+        self.num_chunks = layers.num_chunks
         self.total_loss = None
 
         self._stage_meshes = self._build_stage_meshes()
-        self._stage_state = []       # (params, buffers) pytrees per stage
-        self._stage_param_sh = []    # per-stage param sharding dicts
-        self._jit_cache = {}         # (stage, training) -> (fwd, bwd)
+        self._chunk_state = []       # (params, buffers) pytrees per chunk
+        self._chunk_param_sh = []    # per-chunk param sharding dicts
+        self._jit_cache = {}         # (chunk, training) -> (fwd, bwd)
         self._opt_states = None
-        self._build_stages()
+        self._build_chunks()
 
     # ------------------------------------------------------------ placement
     def _build_stage_meshes(self):
@@ -204,8 +235,11 @@ class PipelineParallel:
             meshes.append(jax.sharding.Mesh(sub, sub_axes))
         return meshes
 
-    def _stage_sharding(self, s):
-        mesh = self._stage_meshes[s]
+    def _chunk_mesh(self, c):
+        return self._stage_meshes[self._layers.chunk_to_stage(c)]
+
+    def _chunk_sharding(self, c):
+        mesh = self._chunk_mesh(c)
         if mesh is None:
             return None, None
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -228,20 +262,20 @@ class PipelineParallel:
                    else None for a in spec]
         return NamedSharding(mesh, P(*cleaned))
 
-    def _build_stages(self):
-        for s in range(self.num_stages):
-            layers_s = self._layers.stage_layers[s]
+    def _build_chunks(self):
+        for c in range(self.num_chunks):
+            layers_c = self._layers.chunk_layers[c]
             params, buffers = {}, {}
-            for i, layer in enumerate(layers_s):
+            for i, layer in enumerate(layers_c):
                 p_i, b_i = extract_state(layer)
                 params.update({f"{i}/{k}": v for k, v in p_i.items()})
                 buffers.update({f"{i}/{k}": v for k, v in b_i.items()})
-            data_sh, repl = self._stage_sharding(s)
+            data_sh, repl = self._chunk_sharding(c)
             param_sh = None
             if repl is not None:
-                mesh = self._stage_meshes[s]
+                mesh = self._chunk_mesh(c)
                 param_sh = {}
-                for i, layer in enumerate(layers_s):
+                for i, layer in enumerate(layers_c):
                     for k, p in dict(layer.named_parameters()).items():
                         param_sh[f"{i}/{k}"] = self._param_sharding(p, mesh)
                 params = {k: jax.device_put(v, param_sh[k])
@@ -249,27 +283,27 @@ class PipelineParallel:
                 buffers = {k: jax.device_put(v, repl)
                            for k, v in buffers.items()}
                 # write placed arrays back into the live layers
-                for i, layer in enumerate(layers_s):
+                for i, layer in enumerate(layers_c):
                     named = dict(layer.named_parameters())
                     for k, p in named.items():
                         p._data = params[f"{i}/{k}"]
-            self._stage_state.append((params, buffers))
-            self._stage_param_sh.append(param_sh)
+            self._chunk_state.append((params, buffers))
+            self._chunk_param_sh.append(param_sh)
 
-    def _get_jits(self, s: int, training: bool):
-        """Per-(stage, mode) jitted fwd/bwd — lazily built and cached, so
+    def _get_jits(self, c: int, training: bool):
+        """Per-(chunk, mode) jitted fwd/bwd — lazily built and cached, so
         train and eval never share a trace (dropout/BN mode is baked in)."""
-        cache_key = (s, training)
+        cache_key = (c, training)
         hit = self._jit_cache.get(cache_key)
         if hit is not None:
             return hit
 
-        layers_s = self._layers.stage_layers[s]
-        fwd_pure = _stage_forward_fn(layers_s, training=training)
-        is_last = s == self.num_stages - 1
+        layers_c = self._layers.chunk_layers[c]
+        fwd_pure = _stage_forward_fn(layers_c, training=training)
+        is_last = c == self.num_chunks - 1
         loss_fn = self._layers._loss_fn
-        data_sh, repl = self._stage_sharding(s)
-        param_sh = self._stage_param_sh[s]
+        data_sh, repl = self._chunk_sharding(c)
+        param_sh = self._chunk_param_sh[c]
 
         # in_shardings pin each stage's jit to its submesh; the incoming
         # activation (possibly on the previous stage's devices) is then
@@ -321,12 +355,13 @@ class PipelineParallel:
         self._jit_cache[cache_key] = pair
         return pair
 
-    def _to_stage(self, s: int, x):
-        """Move an activation/cotangent onto stage s's submesh (the explicit
-        send/recv of the schedule — an ICI device-to-device copy). jit's
-        in_shardings alone can't do this: shardings with identical specs on
-        different submeshes compare as equivalent and skip the transfer."""
-        data_sh, _ = self._stage_sharding(s)
+    def _to_chunk(self, c: int, x):
+        """Move an activation/cotangent onto chunk c's stage submesh (the
+        explicit send/recv of the schedule — an ICI device-to-device copy).
+        jit's in_shardings alone can't do this: shardings with identical
+        specs on different submeshes compare as equivalent and skip the
+        transfer."""
+        data_sh, _ = self._chunk_sharding(c)
         if data_sh is None:
             return x
         return jax.device_put(x, data_sh)
@@ -335,63 +370,68 @@ class PipelineParallel:
     def forward_backward_pipeline(self, micro_inputs, micro_labels):
         """1F1B: warmup forwards, steady 1F1B, cooldown backwards.
 
-        Returns (mean_loss, per-stage grad pytrees)."""
-        S = self.num_stages
+        Chains run at chunk granularity; with V virtual stages each chain
+        visits every physical stage V times in round-robin order (the
+        interleaved schedule's traversal). Returns (mean_loss, per-chunk
+        grad pytrees)."""
+        C = self.num_chunks
         M = len(micro_inputs)
-        # stage s sees activation inputs acts[s][m]
-        acts = [[None] * M for _ in range(S)]
-        grads = [None] * S           # accumulated param grads per stage
+        # chunk c sees activation inputs acts[c][m]
+        acts = [[None] * M for _ in range(C)]
+        grads = [None] * C           # accumulated param grads per chunk
         losses = []
-        # one RNG key per (stage, micro-batch): forward and its backward
+        # one RNG key per (chunk, micro-batch): forward and its backward
         # recompute consume the same key, so dropout masks agree
         from ....core.rng import default_generator
 
         keys = [[default_generator().next_key() for _ in range(M)]
-                for _ in range(S)]
+                for _ in range(C)]
 
-        def run_fwd_chain(m, upto):
-            """Forward micro-batch m through stages [0, upto]."""
+        def run_fwd_chain(m):
+            """Forward micro-batch m through all chunks."""
             x = micro_inputs[m]
-            for s in range(upto + 1):
-                x = self._to_stage(s, x)
-                acts[s][m] = x
-                if s == S - 1:
+            for c in range(C):
+                x = self._to_chunk(c, x)
+                acts[c][m] = x
+                if c == C - 1:
                     break
-                fwd, _ = self._get_jits(s, training=True)
-                x = fwd(*self._stage_state[s], x, keys[s][m])
+                fwd, _ = self._get_jits(c, training=True)
+                x = fwd(*self._chunk_state[c], x, keys[c][m])
             return x
 
-        def accum(s, dparams):
-            if grads[s] is None:
-                grads[s] = dparams
+        def accum(c, dparams):
+            if grads[c] is None:
+                grads[c] = dparams
             else:
-                grads[s] = jax.tree_util.tree_map(jnp.add, grads[s], dparams)
+                grads[c] = jax.tree_util.tree_map(jnp.add, grads[c], dparams)
 
         def run_bwd_chain(m):
-            """Backward micro-batch m from last stage to first."""
-            s = S - 1
-            _, bwd = self._get_jits(s, training=True)
+            """Backward micro-batch m from last chunk to first."""
+            c = C - 1
+            _, bwd = self._get_jits(c, training=True)
             loss, dparams, gx = bwd(
-                *self._stage_state[s], acts[s][m],
-                self._to_stage(s, micro_labels[m]), keys[s][m])
+                *self._chunk_state[c], acts[c][m],
+                self._to_chunk(c, micro_labels[m]), keys[c][m])
             losses.append(loss)
-            accum(s, dparams)
-            for s in range(S - 2, -1, -1):
-                _, bwd = self._get_jits(s, training=True)
-                dparams, gx = bwd(*self._stage_state[s],
-                                  acts[s][m],
-                                  self._to_stage(s, gx),
-                                  keys[s][m])
-                accum(s, dparams)
-                acts[s][m] = None
-            acts[S - 1][m] = None
+            accum(c, dparams)
+            for c in range(C - 2, -1, -1):
+                _, bwd = self._get_jits(c, training=True)
+                dparams, gx = bwd(*self._chunk_state[c],
+                                  acts[c][m],
+                                  self._to_chunk(c, gx),
+                                  keys[c][m])
+                accum(c, dparams)
+                acts[c][m] = None
+            acts[C - 1][m] = None
 
         # 1F1B: the python loop enqueues work; async dispatch overlaps it.
-        warmup = min(S - 1, M)
+        # Warmup depth is the physical-stage count — in-flight activations
+        # per device stay at the 1F1B footprint (V chunk inputs per chain).
+        warmup = min(self.num_stages - 1, M)
         for m in range(warmup):
-            run_fwd_chain(m, S - 1)
+            run_fwd_chain(m)
         for m in range(warmup, M):
-            run_fwd_chain(m, S - 1)
+            run_fwd_chain(m)
             run_bwd_chain(m - warmup)
         for m in range(max(0, M - warmup), M):
             run_bwd_chain(m)
@@ -421,18 +461,18 @@ class PipelineParallel:
         inner = getattr(optimizer, "_inner_opt", optimizer)
         if self._opt_states is None:
             self._opt_states = [inner.functional_state(p)
-                                for p, _ in self._stage_state]
+                                for p, _ in self._chunk_state]
         inner._step_count += 1
         lr = jnp.asarray(inner.get_lr(), dtype=jnp.float32)
         t = jnp.asarray(inner._step_count, dtype=jnp.int32)
-        for s in range(self.num_stages):
-            params, buffers = self._stage_state[s]
-            scaled = jax.tree_util.tree_map(lambda g: g / M, grads[s])
+        for c in range(self.num_chunks):
+            params, buffers = self._chunk_state[c]
+            scaled = jax.tree_util.tree_map(lambda g: g / M, grads[c])
             new_params, new_state = inner.functional_step(
-                params, scaled, self._opt_states[s], lr, t)
-            self._opt_states[s] = new_state
-            self._stage_state[s] = (new_params, buffers)
-            for i, layer in enumerate(self._layers.stage_layers[s]):
+                params, scaled, self._opt_states[c], lr, t)
+            self._opt_states[c] = new_state
+            self._chunk_state[c] = (new_params, buffers)
+            for i, layer in enumerate(self._layers.chunk_layers[c]):
                 named = dict(layer.named_parameters())
                 for k, p in named.items():
                     p._data = new_params[f"{i}/{k}"]
@@ -447,23 +487,23 @@ class PipelineParallel:
             np.asarray(inputs))
         from ....core.rng import default_generator
 
-        for s in range(self.num_stages - 1):
-            fwd, _ = self._get_jits(s, training=False)
-            x = fwd(*self._stage_state[s], self._to_stage(s, x),
+        for c in range(self.num_chunks - 1):
+            fwd, _ = self._get_jits(c, training=False)
+            x = fwd(*self._chunk_state[c], self._to_chunk(c, x),
                     default_generator().next_key())
-        x = self._to_stage(self.num_stages - 1, x)
+        x = self._to_chunk(self.num_chunks - 1, x)
         if compute_loss and self._layers._loss_fn is not None:
             y = labels._data if isinstance(labels, Tensor) else jnp.asarray(
                 np.asarray(labels))
-            fwd, _ = self._get_jits(self.num_stages - 1, training=False)
-            loss = fwd(*self._stage_state[-1], x,
-                       self._to_stage(self.num_stages - 1, y),
+            fwd, _ = self._get_jits(self.num_chunks - 1, training=False)
+            loss = fwd(*self._chunk_state[-1], x,
+                       self._to_chunk(self.num_chunks - 1, y),
                        default_generator().next_key())
             return Tensor(loss)
-        # run last stage layers without loss
-        fwd = _stage_forward_fn(self._layers.stage_layers[-1],
+        # run last chunk's layers without loss
+        fwd = _stage_forward_fn(self._layers.chunk_layers[-1],
                                 training=False)
-        return Tensor(fwd(*self._stage_state[-1], x,
+        return Tensor(fwd(*self._chunk_state[-1], x,
                           default_generator().next_key()))
 
     def parameters(self):
@@ -478,14 +518,14 @@ class PipelineParallel:
         return out
 
     def _resync_state(self):
-        """Re-extract stage state after external param mutation."""
-        self._stage_state = []
+        """Re-extract chunk state after external param mutation."""
+        self._chunk_state = []
         self._opt_states = None
-        for s in range(self.num_stages):
-            layers_s = self._layers.stage_layers[s]
+        for c in range(self.num_chunks):
+            layers_c = self._layers.chunk_layers[c]
             params, buffers = {}, {}
-            for i, layer in enumerate(layers_s):
+            for i, layer in enumerate(layers_c):
                 p_i, b_i = extract_state(layer)
                 params.update({f"{i}/{k}": v for k, v in p_i.items()})
                 buffers.update({f"{i}/{k}": v for k, v in b_i.items()})
-            self._stage_state.append((params, buffers))
+            self._chunk_state.append((params, buffers))

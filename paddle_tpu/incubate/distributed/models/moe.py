@@ -169,3 +169,84 @@ class MoELayer(nn.Layer):
         if squeeze:
             out = out.squeeze(0)
         return out
+
+    def expert_parallel_forward(self, x: Tensor, mesh, ep_axis: str = "ep"):
+        """All-to-all expert-parallel forward over a mesh axis (SURVEY §2.3
+        EP/MoE row; the global_scatter/global_gather analog).
+
+        Tokens are sharded over `ep_axis`; the GShard dispatch einsum runs
+        per shard, expert queues are exchanged with `lax.all_to_all`, each
+        rank runs its E/W local experts (param pytrees stacked over the
+        expert dim and sharded on `ep_axis`), and a second all_to_all returns
+        expert outputs for the local combine. Requires homogeneous experts
+        and num_experts % ep_size == 0. With enough capacity (no drops) the
+        result equals the dense einsum path bit-for-bit up to reduction
+        order.
+        """
+        from ....core import tape as tape_mod
+        from ....core.dispatch import apply_callable
+        from ....jit.functional import bind_state, extract_state
+        from jax.sharding import PartitionSpec as P
+
+        W = mesh.shape[ep_axis]
+        E = self.num_experts
+        if E % W != 0:
+            raise ValueError(f"num_experts {E} not divisible by "
+                             f"{ep_axis} size {W}")
+
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x.unsqueeze(0)
+        b, s, d = x.shape
+        flat = x.reshape([b * s, d])
+        if (b * s) % W != 0:
+            raise ValueError(f"{b * s} tokens not divisible by ep size {W}")
+
+        # per-expert param pytrees; stacked over the expert dim INSIDE the
+        # pure fn (jnp.stack is differentiable → grads reach each expert)
+        pkeys = sorted(extract_state(self.experts[0])[0])
+        L = len(pkeys)
+        expert_params = []               # Tensor params, expert-major order
+        for e in self.experts:
+            named = dict(e.named_parameters())
+            expert_params.extend(named[k] for k in pkeys)
+        gate_w = self.gate.gate_weight
+
+        def pure(xd, gw, *flat_params):
+            stacked_leaves = [
+                jnp.stack([flat_params[e * L + i] for e in range(E)])
+                for i in range(L)
+            ]
+            def local_fn(x_loc, gw_loc, *leaves_loc):
+                def apply_one(leaves_e, xin):
+                    bound = dict(zip(pkeys, leaves_e))
+                    with bind_state(self.experts[0], bound, {}):
+                        with tape_mod.no_grad():
+                            y = self.experts[0](Tensor(xin))
+                    return y._data if isinstance(y, Tensor) else y
+
+                def expert_run(expert_in):            # [E, C, d] local queues
+                    ein = jax.lax.all_to_all(
+                        expert_in, ep_axis, split_axis=0, concat_axis=1,
+                        tiled=True)                   # [E/W, W*C, d]
+                    y = jax.vmap(apply_one)(tuple(leaves_loc), ein)
+                    return jax.lax.all_to_all(
+                        y, ep_axis, split_axis=1, concat_axis=0,
+                        tiled=True)                   # [E, C, d']
+
+                y, aux = self._routed_forward(x_loc, gw_loc, expert_run)
+                return y, jax.lax.pmean(aux, ep_axis)
+
+            return jax.shard_map(
+                local_fn, mesh=mesh,
+                in_specs=(P(ep_axis), P()) + tuple(P(ep_axis)
+                                                   for _ in stacked_leaves),
+                out_specs=(P(ep_axis), P()),
+            )(xd, gw, *stacked_leaves)
+
+        y, aux = apply_callable("moe_ep", pure, flat, gate_w, *expert_params)
+        self.aux_loss = aux
+        out = y.reshape([b, s, -1])
+        if squeeze:
+            out = out.squeeze(0)
+        return out

@@ -22,7 +22,39 @@ from ..core.tensor import Tensor
 from .functional import call_functional, extract_state
 
 __all__ = ["to_static", "save", "load", "TranslatedLayer", "InputSpec",
-           "not_to_static", "ignore_module"]
+           "not_to_static", "ignore_module", "GraphBreakError"]
+
+
+class GraphBreakError(RuntimeError):
+    """Raised when to_static capture hits data-dependent Python control flow.
+
+    Everything under jit is traced once (XLA semantics): a Python `if`/`while`
+    on a traced Tensor value has no single compile-time answer, and silently
+    specializing on the tracing-time value would bake one branch into the
+    compiled program. The fix is to express the branch as compiled control
+    flow: paddle.static.nn.cond / while_loop / switch_case (lowered to
+    lax.cond / lax.while_loop / lax.switch), or move the branch out of the
+    compiled function.
+    """
+
+
+_TRACE_LEAK_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.ConcretizationTypeError,
+)
+
+
+def _graph_break(fn_name: str, err) -> GraphBreakError:
+    return GraphBreakError(
+        f"to_static could not capture {fn_name!r}: Python control flow (or a "
+        "host conversion like bool()/int()/.numpy()) depends on a traced "
+        "Tensor value, which has no compile-time answer under XLA tracing. "
+        "Rewrite the branch with paddle.static.nn.cond / while_loop / "
+        "switch_case, or keep it outside the @to_static region. "
+        f"Underlying trace error: {type(err).__name__}: {err}"
+    )
 
 
 class InputSpec:
@@ -121,7 +153,10 @@ class StaticFunction:
         else:
             params, buffers = {}, {}
         compiled = self._compiled_for(args)
-        outs, new_buffers = compiled(params, buffers, *datas)
+        try:
+            outs, new_buffers = compiled(params, buffers, *datas)
+        except _TRACE_LEAK_ERRORS as e:
+            raise _graph_break(self.__name__, e) from e
         # write back mutated buffers (BN running stats under training)
         if new_buffers:
             named = {n: b for n, b in self._layer.named_buffers()

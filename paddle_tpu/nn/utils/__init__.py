@@ -26,6 +26,26 @@ def _like_param(src: Parameter, data) -> Parameter:
     return p
 
 
+def _set_effective(lay, name: str, eff: Tensor):
+    """Install the recomputed weight; remember the last concrete value so a
+    traced call (jit/to_static — eff's data is a tracer there) can be undone
+    by the paired post-hook instead of leaking an escaped tracer into the
+    layer's attribute."""
+    if not isinstance(eff._data, jax.core.Tracer):
+        lay.__dict__[f"_{name}_reparam_concrete"] = eff
+    object.__setattr__(lay, name, eff)
+
+
+def _make_restore_hook(name: str):
+    def _restore(lay, _inputs, _outputs):
+        cur = lay.__dict__.get(name)
+        saved = lay.__dict__.get(f"_{name}_reparam_concrete")
+        if (cur is not None and saved is not None
+                and isinstance(cur._data, jax.core.Tracer)):
+            object.__setattr__(lay, name, saved)
+    return _restore
+
+
 def _norm_axes(ndim: int, dim):
     if dim is None:
         return tuple(range(ndim))
@@ -62,13 +82,14 @@ def weight_norm(layer, name: str = "weight", dim: int = 0):
         g = getattr(lay, f"{name}_g")
         v = getattr(lay, f"{name}_v")
         eff = v * (g / _row_norm(v, dim))
-        object.__setattr__(lay, name, eff)
+        _set_effective(lay, name, eff)
 
     helper = layer.register_forward_pre_hook(_recompute)
+    post = layer.register_forward_post_hook(_make_restore_hook(name))
     _recompute(layer)
     # stash for remove_weight_norm
     layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = \
-        (helper, dim)
+        (helper, post, dim)
     return layer
 
 
@@ -77,8 +98,10 @@ def remove_weight_norm(layer, name: str = "weight"):
     hooks = layer.__dict__.get("_weight_norm_hooks", {})
     if name not in hooks:
         raise ValueError(f"weight_norm was not applied to {name!r}")
-    helper, dim = hooks.pop(name)
+    helper, post, dim = hooks.pop(name)
     helper.remove()
+    post.remove()
+    layer.__dict__.pop(f"_{name}_reparam_concrete", None)
     g = getattr(layer, f"{name}_g")
     v = getattr(layer, f"{name}_v")
     eff = v * (g / _row_norm(v, dim))
@@ -144,11 +167,13 @@ def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
         sigma = u_c.reshape([1, h]).matmul(mat).matmul(
             v_c.reshape([cols, 1])).reshape([1])
         eff = w_p / sigma
-        object.__setattr__(lay, name, eff)
+        _set_effective(lay, name, eff)
 
     helper = layer.register_forward_pre_hook(_recompute)
+    post = layer.register_forward_post_hook(_make_restore_hook(name))
     _recompute(layer)
-    layer.__dict__.setdefault("_spectral_norm_hooks", {})[name] = helper
+    layer.__dict__.setdefault("_spectral_norm_hooks", {})[name] = \
+        (helper, post)
     return layer
 
 

@@ -12,6 +12,7 @@ from .program import (  # noqa: F401
 )
 from .executor import Executor, Scope, global_scope  # noqa: F401
 from .io import load_inference_model, save_inference_model  # noqa: F401
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
 
 __all__ = [
     "Program", "Variable", "data", "program_guard", "default_main_program",
@@ -71,7 +72,13 @@ def device_guard(device=None):
 
 
 class _StaticNN:
-    """paddle.static.nn — thin functional layers over the op registry."""
+    """paddle.static.nn — thin functional layers over the op registry, plus
+    the data-dependent control-flow lowerings (control_flow.py)."""
+
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    switch_case = staticmethod(switch_case)
+    case = staticmethod(case)
 
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):

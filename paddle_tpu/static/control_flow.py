@@ -1,0 +1,160 @@
+"""Data-dependent control flow for dy2static (ref: python/paddle/static/nn/
+control_flow.py, upstream layout, unverified — mount empty).
+
+TPU-first design: a traced branch cannot be a Python `if` — everything under
+jit is traced once (XLA semantics). So `cond`/`while_loop`/`switch_case` have
+two executions:
+
+- **dygraph** (concrete values): plain Python control flow on the tape —
+  exactly one branch runs, loops unroll, gradients flow through the eager
+  autograd.
+- **traced** (inputs are jax tracers, i.e. inside to_static/jit/pjit): lower
+  to `lax.cond` / `lax.while_loop` / `lax.switch`, the compiler-friendly
+  control flow XLA compiles natively. Branch callables close over outer
+  tracers, so no operand plumbing is required of the user.
+
+`while_loop` in traced mode is forward-only (jax cannot reverse-differentiate
+`lax.while_loop`); training loops that need gradients through a traced loop
+should use a bounded `lax.scan`-style construct or keep the loop in dygraph.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tape as tape_mod
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap_tree(out):
+    return jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_tree(out):
+    return jax.tree_util.tree_map(
+        lambda d: Tensor(d) if isinstance(d, (jax.Array, jnp.ndarray)) else d,
+        out)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Run `true_fn()` if `pred` else `false_fn()` (paddle.static.nn.cond).
+
+    Dygraph: executes exactly one branch eagerly. Traced: lowers to
+    `lax.cond`; both branches are traced (XLA requirement) and must return
+    the same structure/shapes/dtypes.
+    """
+    pd = _data(pred)
+    if not _is_tracer(pd):
+        return true_fn() if bool(pd) else false_fn()
+
+    def lower(fn):
+        def branch(_):
+            with tape_mod.no_grad():
+                return _unwrap_tree(fn())
+        return branch
+
+    scalar = jnp.reshape(pd, ()).astype(bool)
+    out = jax.lax.cond(scalar, lower(true_fn), lower(false_fn), 0)
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, name=None):
+    """paddle.static.nn.while_loop over a list of loop variables.
+
+    Dygraph: a Python while loop (unrolled, differentiable on the tape).
+    Traced: `lax.while_loop` — body output must match loop_vars'
+    shapes/dtypes; forward-only under autodiff.
+    """
+    is_seq = isinstance(loop_vars, (list, tuple))
+    vals = list(loop_vars) if is_seq else [loop_vars]
+    datas = [_data(v) for v in vals]
+
+    if not any(_is_tracer(d) for d in datas):
+        while bool(_data(cond_fn(*vals))):
+            out = body_fn(*vals)
+            vals = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vals if is_seq else vals[0]
+
+    def c(state):
+        with tape_mod.no_grad():
+            r = cond_fn(*[Tensor(d) for d in state])
+        return jnp.reshape(_data(r), ()).astype(bool)
+
+    def b(state):
+        with tape_mod.no_grad():
+            out = body_fn(*[Tensor(d) for d in state])
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(_data(o) for o in out)
+
+    res = jax.lax.while_loop(c, b, tuple(datas))
+    wrapped = [Tensor(d) for d in res]
+    return wrapped if is_seq else wrapped[0]
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case → `lax.switch` when traced."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+    idx_d = _data(branch_index)
+
+    if not _is_tracer(idx_d):
+        i = int(idx_d)
+        return fns[keys.index(i)]() if i in keys else default()
+
+    # map sparse keys onto a dense lax.switch table; any index outside the
+    # key set (including negatives) must hit the default slot, matching the
+    # dygraph branch above
+    table = {k: j for j, k in enumerate(keys)}
+    lookup = jnp.full(max(keys) + 1, len(fns), jnp.int32)
+    for k, j in table.items():
+        lookup = lookup.at[k].set(j)
+    idx0 = jnp.reshape(idx_d, ()).astype(jnp.int32)
+    in_range = (idx0 >= 0) & (idx0 <= max(keys))
+    dense_idx = jnp.where(in_range,
+                          lookup[jnp.clip(idx0, 0, max(keys))],
+                          len(fns))
+
+    def lower(fn):
+        def branch(_):
+            with tape_mod.no_grad():
+                return _unwrap_tree(fn())
+        return branch
+
+    out = jax.lax.switch(dense_idx, [lower(f) for f in fns] +
+                         [lower(default)], 0)
+    return _wrap_tree(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case: first true predicate wins (nested cond)."""
+    if not pred_fn_pairs:
+        raise ValueError("case() needs at least one (pred, fn) pair")
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+        pred_fn_pairs = pred_fn_pairs[:-1]
+
+    def build(i):
+        if i == len(pred_fn_pairs):
+            return default
+        pred, fn = pred_fn_pairs[i]
+        return lambda: cond(pred, fn, build(i + 1))
+
+    return build(0)()

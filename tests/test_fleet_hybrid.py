@@ -194,6 +194,60 @@ def test_pipeline_vs_single_process_sgd():
                                    atol=1e-5)
 
 
+def test_interleaved_vpp_matches_single_process():
+    """Interleaved schedule (num_virtual_pipeline_stages=2): S=2 stages x
+    V=2 chunks, chunk c on stage c%S, numerics == eager full model."""
+    def build():
+        paddle.seed(13)
+        return [nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 12), nn.ReLU(),
+                nn.Linear(12, 3)]
+
+    layers_a, layers_b = build(), build()
+    loss_fn = nn.CrossEntropyLoss()
+    pipe = PipelineLayer([LayerDesc(l) for l in layers_a], num_stages=2,
+                         loss_fn=loss_fn, num_virtual_pipeline_stages=2)
+    assert pipe.num_chunks == 4
+    # round-robin chunk placement (Megatron interleaved layout)
+    assert [pipe.chunk_to_stage(c) for c in range(4)] == [0, 1, 0, 1]
+    # physical stage 0 holds chunks 0 and 2
+    assert pipe.stage_layers[0] == pipe.chunk_layers[0] + pipe.chunk_layers[2]
+
+    topo = CommunicateTopology(["pp", "dp", "sharding", "sep", "mp"],
+                               [2, 1, 1, 1, 1])
+    hcg = HybridCommunicateGroup(topo, global_rank=0)
+    st = DistributedStrategy()
+    st.pipeline_configs = {"accumulate_steps": 2}
+    engine = PipelineParallel(pipe, hcg, st)
+    opt_a = paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=pipe.parameters())
+
+    seq = nn.Sequential(*layers_b)
+    opt_b = paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=seq.parameters())
+
+    rng = np.random.RandomState(6)
+    x = rng.rand(4, 6).astype("float32")
+    y = rng.randint(0, 3, (4, 1))
+
+    for _ in range(2):
+        engine.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt_a)
+        out = seq(paddle.to_tensor(x))
+        loss = loss_fn(out, paddle.to_tensor(y))
+        loss.backward()
+        opt_b.step()
+        opt_b.clear_grad()
+
+    for pa, pb in zip(pipe.parameters(), seq.parameters()):
+        np.testing.assert_allclose(pa.numpy(), pb.numpy(), rtol=2e-4,
+                                   atol=1e-5)
+
+
+def test_vpp_too_few_layers_raises():
+    with pytest.raises(ValueError, match="virtual"):
+        PipelineLayer([LayerDesc(nn.Linear, 4, 4)] * 3, num_stages=2,
+                      num_virtual_pipeline_stages=2)
+
+
 # ------------------------------------------------------------ GroupSharded
 def test_group_sharded_stage3_matches_replica():
     def build():
@@ -309,6 +363,45 @@ def test_moe_layer_routes_and_learns():
     # with generous capacity every token is routed: combine weights ~ 1
     out2 = moe(x)
     np.testing.assert_allclose(out.numpy(), out2.numpy())  # deterministic
+
+
+def test_moe_expert_parallel_alltoall_matches_dense():
+    """EP dispatch over the 8-device ep axis (lax.all_to_all inside
+    shard_map) == the dense einsum path, forward AND grads (no drops)."""
+    from paddle_tpu.incubate.distributed.models.moe import (
+        GShardGate, MoELayer,
+    )
+
+    paddle.seed(17)
+    d, E = 16, 8
+    experts = [nn.Linear(d, d) for _ in range(E)]
+    # capacity_factor 8 → no token ever dropped, so both paths agree exactly
+    gate = GShardGate(d, num_expert=E, topk=2, capacity=(8.0, 16.0))
+    moe = MoELayer(d_model=d, experts=experts, gate=gate)
+    x_np = np.random.RandomState(1).rand(2, 16, d).astype("float32")
+
+    x1 = paddle.to_tensor(x_np)
+    x1.stop_gradient = False
+    dense = moe(x1)
+    dense.sum().backward()
+    g_dense = {n: p.grad.numpy().copy()
+               for n, p in moe.named_parameters() if p.grad is not None}
+    for p in moe.parameters():
+        p.clear_gradient()
+
+    mesh = Mesh(np.array(jax.devices()), ("ep",))
+    x2 = paddle.to_tensor(x_np)
+    x2.stop_gradient = False
+    ep = moe.expert_parallel_forward(x2, mesh, ep_axis="ep")
+    np.testing.assert_allclose(ep.numpy(), dense.numpy(), rtol=2e-5,
+                               atol=2e-6)
+    ep.sum().backward()
+    g_ep = {n: p.grad.numpy().copy()
+            for n, p in moe.named_parameters() if p.grad is not None}
+    assert set(g_ep) == set(g_dense)
+    for n in g_dense:
+        np.testing.assert_allclose(g_ep[n], g_dense[n], rtol=2e-4,
+                                   atol=2e-5, err_msg=n)
 
 
 # ----------------------------------------------------------------- recompute

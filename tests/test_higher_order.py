@@ -1,0 +1,134 @@
+"""Higher-order autograd: create_graph, jacobian, hessian (SURVEY §2.2
+autograd row; VERDICT r2 item 6)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, grad, hessian, jacobian
+
+
+def _t(a, stop_gradient=False):
+    t = paddle.to_tensor(np.asarray(a, np.float32))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+class TestCreateGraph:
+    def test_second_derivative_cubic(self):
+        x = _t([2.0, 3.0])
+        y = (x * x * x).sum()              # y = Σ x³
+        (g1,) = grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g1.numpy(), 3 * np.array([4.0, 9.0]),
+                                   rtol=1e-6)
+        assert not g1.stop_gradient
+        (g2,) = grad(g1.sum(), x)          # d²y/dx² = 6x
+        np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, 3.0]),
+                                   rtol=1e-6)
+
+    def test_third_derivative(self):
+        x = _t([1.5])
+        y = (x ** 4).sum()
+        (g1,) = grad(y, x, create_graph=True)
+        (g2,) = grad(g1.sum(), x, create_graph=True)
+        (g3,) = grad(g2.sum(), x)
+        np.testing.assert_allclose(g3.numpy(), [24 * 1.5], rtol=1e-5)
+
+    def test_gradient_penalty_backward(self):
+        # WGAN-GP shape: penalty = (|dy/dx| - 1)^2 trained by backward()
+        x = _t([[0.5, -0.3]])
+        w = _t([[1.0], [2.0]])
+        y = x.matmul(w).sum()
+        (gx,) = grad(y, x, create_graph=True)
+        penalty = ((gx * gx).sum() - 1.0) ** 2
+        penalty.backward()
+        # d penalty / dw: gx = w^T, so penalty = (Σw² - 1)²,
+        # dp/dw = 2(Σw²-1)·2w = 4(5-1)w = 16w
+        np.testing.assert_allclose(w.grad.numpy(), 16 * w.numpy(), rtol=1e-5)
+
+    def test_mixed_inputs_chain(self):
+        x = _t([2.0])
+        z = _t([3.0])
+        y = (x * x * z).sum()
+        (gx,) = grad(y, x, create_graph=True)   # 2xz
+        (gxz,) = grad(gx.sum(), z)              # d(2xz)/dz = 2x
+        np.testing.assert_allclose(gxz.numpy(), [4.0], rtol=1e-6)
+
+    def test_first_order_still_frees_graph(self):
+        x = _t([1.0])
+        y = (x * x).sum()
+        (g,) = grad(y, x)
+        assert g.stop_gradient
+
+    def test_create_graph_after_free_raises_clear_error(self):
+        import pytest
+
+        x = _t([2.0])
+        y = (x * x).sum()
+        y.backward()                      # frees the graph
+        y2 = y + 0.0
+        with pytest.raises(RuntimeError, match="graph was freed"):
+            grad(y2, [x], create_graph=True)
+
+
+class TestPyLayerDoubleBackward:
+    def test_square_pylayer(self):
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)     # save the INPUT: 2nd order flows
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor
+                return dy * 2.0 * x
+
+        x = _t([3.0])
+        y = Square.apply(x).sum()
+        (g1,) = grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g1.numpy(), [6.0], rtol=1e-6)
+        (g2,) = grad(g1.sum(), x)
+        np.testing.assert_allclose(g2.numpy(), [2.0], rtol=1e-6)
+
+
+class TestJacobianHessian:
+    def test_jacobian_single_input(self):
+        x = _t([1.0, 2.0, 3.0])
+        jac = jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(jac.numpy(),
+                                   np.diag([2.0, 4.0, 6.0]), rtol=1e-6)
+
+    def test_jacobian_multi_input(self):
+        a = _t([1.0, 2.0])
+        b = _t([3.0, 4.0])
+        jacs = jacobian(lambda u, v: u * v, [a, b])
+        np.testing.assert_allclose(jacs[0].numpy(), np.diag([3.0, 4.0]),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(jacs[1].numpy(), np.diag([1.0, 2.0]),
+                                   rtol=1e-6)
+
+    def test_jacobian_create_graph_differentiable(self):
+        x = _t([2.0])
+        jac = jacobian(lambda t: t ** 3, x, create_graph=True)
+        assert not jac.stop_gradient
+        (g,) = grad(jac.sum(), x)           # d(3x²)/dx = 6x
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-5)
+
+    def test_hessian_quadratic_form(self, rng):
+        A = rng.standard_normal((3, 3)).astype(np.float32)
+        At = paddle.to_tensor(A)
+        x = _t(rng.standard_normal(3).astype(np.float32))
+
+        def f(t):
+            v = t.reshape([3, 1])
+            return v.transpose([1, 0]).matmul(At).matmul(v).sum()
+
+        h = hessian(f, x)
+        np.testing.assert_allclose(h.numpy(), A + A.T, rtol=1e-4, atol=1e-5)
+
+    def test_hessian_multi_input(self):
+        a = _t([1.0])
+        b = _t([2.0])
+        h = hessian(lambda u, v: (u * u * v).sum(), [a, b])
+        np.testing.assert_allclose(h[0][0].numpy(), [[2 * 2.0]], rtol=1e-6)
+        np.testing.assert_allclose(h[0][1].numpy(), [[2 * 1.0]], rtol=1e-6)
+        np.testing.assert_allclose(h[1][1].numpy(), [[0.0]], atol=1e-7)

@@ -85,6 +85,28 @@ class TestSpectralNorm:
         assert float(np.abs(l.weight_orig.grad.numpy()).max()) > 0
 
 
+class TestReparamUnderJit:
+    def test_weight_readable_after_traced_call(self, rng):
+        """A to_static call must not leave an escaped tracer in l.weight."""
+        l = nn.Linear(4, 3)
+        weight_norm(l)
+        sf = paddle.jit.to_static(lambda t: l(t))
+        x = paddle.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
+        traced = sf(x).numpy()
+        w = l.weight.numpy()              # must not raise UnexpectedTracer
+        assert np.all(np.isfinite(w))
+        np.testing.assert_allclose(traced, l(x).numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_spectral_weight_readable_after_traced_call(self, rng):
+        l = nn.Linear(4, 4, bias_attr=False)
+        spectral_norm(l)
+        sf = paddle.jit.to_static(lambda t: l(t))
+        x = paddle.to_tensor(rng.standard_normal((1, 4)).astype(np.float32))
+        sf(x)
+        assert np.all(np.isfinite(l.weight.numpy()))
+
+
 class TestParamVector:
     def test_roundtrip(self):
         l = nn.Linear(3, 2)

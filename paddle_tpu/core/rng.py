@@ -24,13 +24,24 @@ class Generator:
 
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        # LAZY key creation: jax.random.key() is a computation that would
+        # initialize the XLA backend at `import paddle_tpu` time — which
+        # breaks jax.distributed.initialize (must run before backend init)
+        # in real multi-process jobs
+        self._key = None
         # trace-mode stack: (base_key, counter_list)
         self._trace_stack = []
 
+    def _ensure_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
     def manual_seed(self, seed: int):
+        # stays lazy like __init__: paddle.seed() before fleet.init() must
+        # not initialize the XLA backend (breaks jax.distributed.initialize)
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None
         return self
 
     def initial_seed(self) -> int:
@@ -41,11 +52,11 @@ class Generator:
             base, counter = self._trace_stack[-1]
             counter[0] += 1
             return jax.random.fold_in(base, counter[0])
-        self._key, sub = jax.random.split(self._key)
+        self._key, sub = jax.random.split(self._ensure_key())
         return sub
 
     def get_state(self):
-        return jax.random.key_data(self._key)
+        return jax.random.key_data(self._ensure_key())
 
     def set_state(self, state):
         self._key = jax.random.wrap_key_data(np.asarray(state, dtype=np.uint32))

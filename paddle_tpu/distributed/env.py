@@ -24,7 +24,12 @@ def init_parallel_env():
         return
     endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
     n_nodes = len(endpoints.split(",")) if endpoints else 1
-    if n_nodes > 1 and not jax.process_count() > 1:
+    if n_nodes > 1 and not jax.distributed.is_initialized():
+        # must run before any backend init — jax.distributed.is_initialized
+        # only inspects client state, unlike jax.process_count() which would
+        # itself initialize the backends. Genuine failures (bad coordinator,
+        # busy port, seeded-too-early backend) must propagate: swallowing
+        # them would silently run every rank as a world-size-1 job.
         coordinator = endpoints.split(",")[0]
         rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
         jax.distributed.initialize(

@@ -108,6 +108,16 @@ class HybridParallelOptimizer:
         return self._inner_opt.clear_grad(*a, **k)
 
     def minimize(self, *a, **k):
+        from ....static.program import default_main_program, in_static_mode
+
+        if in_static_mode():
+            # the static meta-optimizer seam: record the hybrid context on
+            # the Program so the Executor compiles the fleet path (GSPMD TP
+            # shardings + pipeline segmentation — static/fleet_pass.py)
+            program = default_main_program()
+            mesh = getattr(self._hcg, "mesh", None) if self._hcg else None
+            program._dist_context = {"mesh": mesh,
+                                     "strategy": self._strategy}
         return self._inner_opt.minimize(*a, **k)
 
     def state_dict(self):

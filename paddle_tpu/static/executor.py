@@ -51,7 +51,8 @@ def global_scope() -> Scope:
 def _replay(program: Program, env: Dict[str, jax.Array]):
     """Execute the op list over `env` (name -> array), mutating env."""
     for op in program.global_block().ops:
-        fn = get_op(op.type).fn
+        fn = op.fn if getattr(op, "fn", None) is not None else \
+            get_op(op.type).fn
 
         def build(template):
             out = []
@@ -114,14 +115,30 @@ class Executor:
         param_names = sorted(program.refs.keys())
         param_arrays = {n: program.refs[n]._data for n in param_names}
 
+        # fleet static path: a minimize-carrying Program with a hybrid dist
+        # context (pp_degree>1) runs through the pipeline engine
+        dist_ctx = getattr(program, "_dist_context", None)
+        if (program._minimize_hooks and dist_ctx
+                and dist_ctx.get("mesh") is not None):
+            strategy = dist_ctx.get("strategy")
+            hc = strategy.hybrid_configs if strategy is not None else {}
+            if int(hc.get("pp_degree", 1)) > 1:
+                return self._run_hybrid(program, feed_arrays, fetch_names,
+                                        return_numpy, dist_ctx)
+
         sig = (id(program),
                tuple(sorted((k, v.shape, str(v.dtype))
                             for k, v in feed_arrays.items())),
-               tuple(fetch_names))
+               tuple(fetch_names),
+               # train-ness and mesh identity: minimize()/fleet context may
+               # attach AFTER a forward-only run cached an eval callable
+               bool(program._minimize_hooks),
+               id(dist_ctx["mesh"]) if dist_ctx else None)
         compiled = self._cache.get(sig)
         if compiled is None:
-            compiled = self._compile(program, fetch_names,
-                                     bool(program._minimize_hooks))
+            compiled = self._compile(
+                program, fetch_names, bool(program._minimize_hooks),
+                mesh=dist_ctx.get("mesh") if dist_ctx else None)
             self._cache[sig] = compiled
 
         if program._minimize_hooks:
@@ -191,8 +208,44 @@ class Executor:
                      not in excl_names]
         return names
 
+    def _run_hybrid(self, program, feed_arrays, fetch_names, return_numpy,
+                    dist_ctx):
+        """Static TP+PP train step via the fleet meta-optimizer engine."""
+        from .fleet_pass import StaticHybridEngine
+
+        if not hasattr(self, "_hybrid_engines"):
+            self._hybrid_engines = {}
+
+        opt, loss_var, _ = program._minimize_hooks[0]
+        if fetch_names and fetch_names != [loss_var.name]:
+            raise NotImplementedError(
+                "the static hybrid (pp) path currently fetches only the "
+                f"loss {loss_var.name!r}, got {fetch_names}")
+        engine = self._hybrid_engines.get(id(program))
+        if engine is None:
+            engine = StaticHybridEngine(
+                program, dist_ctx["mesh"], dist_ctx.get("strategy"),
+                getattr(opt, "_inner_opt", opt), loss_var.name,
+                self._trainable_names(program))
+            self._hybrid_engines[id(program)] = engine
+        loss = engine.train_step(feed_arrays)
+        outs = [loss] if fetch_names else []
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
     def _compile(self, program: Program, fetch_names: List[str],
-                 train: bool):
+                 train: bool, mesh=None):
+        # GSPMD shardings for the fleet TP/DP static path (pp collapsed):
+        # params from dist_spec marks, feeds batch-sharded, one jit over the
+        # whole mesh — XLA inserts the Megatron collectives
+        param_in_sh = feed_in_sh = None
+        if mesh is not None:
+            from .fleet_pass import data_sharding, program_param_shardings
+
+            param_in_sh = program_param_shardings(program, mesh)
+            feed_in_sh = data_sharding(mesh)
+
         if not train:
             def fwd(feed_arrays, param_arrays):
                 env = dict(param_arrays)
@@ -200,6 +253,9 @@ class Executor:
                 _replay(program, env)
                 return [env[n] for n in fetch_names]
 
+            if mesh is not None:
+                # prefix pytree: one sharding broadcast over the feed dict
+                return jax.jit(fwd, in_shardings=(feed_in_sh, param_in_sh))
             return jax.jit(fwd)
 
         opt, loss_var, _ = program._minimize_hooks[0]
@@ -227,4 +283,14 @@ class Executor:
             new_params.update(new_trainable)
             return ([env[n] for n in fetch_names], new_params, new_state)
 
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            opt_sh = {n: param_in_sh[n]
+                      for n in self._trainable_names(program)}
+            return jax.jit(step,
+                           in_shardings=(feed_in_sh, param_in_sh, opt_sh,
+                                         repl, repl),
+                           donate_argnums=(1, 2))
         return jax.jit(step, donate_argnums=(1, 2))

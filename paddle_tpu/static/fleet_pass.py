@@ -1,0 +1,370 @@
+"""Static-graph fleet meta-optimizer passes (SURVEY §2.3 "static
+meta-optimizers", §3.2; ref: fleet/meta_optimizers/{pipeline,tensor
+parallel} + paddle/fluid/framework/program rewriting passes, upstream
+layout, unverified — mount empty).
+
+Paddle's static meta-optimizers rewrite the ProgramDesc: insert collective
+ops for TP, split the program into per-stage sections for PP, wire
+send/recv. The TPU-native formulation keeps the Program SSA op list intact
+and instead
+  * derives GSPMD shardings for every persistable from its Parameter
+    `dist_spec` mark (ColumnParallel/RowParallel/VocabParallel layers mark
+    their weights at build time, static or dygraph alike) — XLA inserts the
+    Megatron collectives;
+  * partitions the op LIST into pipeline stage segments with explicit
+    activation cut sets (the send/recv seam), each segment compiled onto its
+    pp submesh — `StaticHybridEngine` then runs the same 1F1B schedule the
+    dygraph engine uses, driving per-stage jitted fwd/bwd replays of the
+    segments.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["StageSegment", "split_for_pipeline", "program_param_shardings",
+           "StaticHybridEngine"]
+
+
+class StageSegment:
+    """One pipeline stage's slice of the op list + its dataflow interface."""
+
+    def __init__(self, ops, param_names, feed_names, in_cuts, out_cuts):
+        self.ops = ops                    # OpDescs, program order
+        self.param_names = param_names    # persistables this segment reads
+        self.feed_names = feed_names      # data vars this segment reads
+        self.in_cuts = in_cuts            # activations received (names)
+        self.out_cuts = out_cuts          # activations sent (names)
+
+    def __repr__(self):
+        return (f"StageSegment({len(self.ops)} ops, in={self.in_cuts}, "
+                f"out={self.out_cuts})")
+
+
+def split_for_pipeline(program, num_stages: int) -> List[StageSegment]:
+    """Uniform op-count split of the Program into stage segments.
+
+    The cut sets are exact dataflow: a non-persistable var produced in an
+    earlier segment and consumed in a later one is carried through every
+    intermediate cut (pass-through), so any cut position is valid — block
+    boundaries just give the smallest cuts.
+    """
+    ops = list(program.global_block().ops)
+    if len(ops) < num_stages:
+        raise ValueError(
+            f"{len(ops)} ops cannot be split into {num_stages} stages")
+    persistable = set(program.refs)
+    data_names = {v.name for v in program._data_vars}
+    bounds = [round(i * len(ops) / num_stages) for i in range(num_stages + 1)]
+
+    seg_of_producer: Dict[str, int] = {}
+    for s in range(num_stages):
+        for op in ops[bounds[s]:bounds[s + 1]]:
+            for o in op.output_names:
+                seg_of_producer[o] = s
+
+    def consumed_in(s: int):
+        names = set()
+        for op in ops[bounds[s]:bounds[s + 1]]:
+            names.update(op.input_names)
+        return names
+
+    # alive[s]: activations crossing the boundary INTO segment s
+    alive: List[set] = [set() for _ in range(num_stages + 1)]
+    for s in range(num_stages - 1, 0, -1):
+        need = set(alive[s + 1]) if s + 1 <= num_stages else set()
+        need |= consumed_in(s)
+        need -= persistable
+        need -= data_names
+        alive[s] = {n for n in need
+                    if n in seg_of_producer and seg_of_producer[n] < s}
+
+    segments = []
+    for s in range(num_stages):
+        seg_ops = ops[bounds[s]:bounds[s + 1]]
+        consumed = consumed_in(s)
+        params = sorted(consumed & persistable)
+        feeds = sorted(consumed & data_names)
+        in_cuts = sorted(alive[s]) if s > 0 else []
+        out_cuts = sorted(alive[s + 1]) if s + 1 < num_stages else []
+        segments.append(StageSegment(seg_ops, params, feeds, in_cuts,
+                                     out_cuts))
+    return segments
+
+
+def program_param_shardings(program, mesh, names: Optional[Sequence] = None):
+    """NamedSharding per persistable from its Parameter.dist_spec mark
+    (replicated when unmarked) — mp_shardings over the Program's ref table."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out = {}
+    for n in (names if names is not None else sorted(program.refs)):
+        p = program.refs[n]
+        spec = getattr(p, "dist_spec", None)
+        if spec is None:
+            out[n] = NamedSharding(mesh, P())
+        else:
+            cleaned = [a if (a in mesh.axis_names and mesh.shape[a] > 1)
+                       else None for a in spec]
+            out[n] = NamedSharding(mesh, P(*cleaned))
+    return out
+
+
+def data_sharding(mesh):
+    """Batch-dim sharding over the data axes of `mesh`."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_axes = tuple(a for a in mesh.axis_names
+                       if a in ("dp", "sharding") and mesh.shape[a] > 1)
+    return NamedSharding(mesh, P(batch_axes if batch_axes else None))
+
+
+def _replay_ops(ops, env):
+    from ..ops.registry import get_op
+
+    for op in ops:
+        fn = op.fn if getattr(op, "fn", None) is not None else \
+            get_op(op.type).fn
+
+        def build(template):
+            out = []
+            for kind, payload in template:
+                if kind == "var":
+                    out.append(env[op.input_names[payload]])
+                elif kind == "list":
+                    out.append([env[op.input_names[p]] if k == "var" else p
+                                for k, p in payload])
+                else:
+                    out.append(payload)
+            return out
+
+        result = fn(*build(op.arg_template), **op.attrs)
+        outs = (list(result) if isinstance(result, (tuple, list))
+                else [result])
+        for name, val in zip(op.output_names, outs):
+            env[name] = val
+    return env
+
+
+class StaticHybridEngine:
+    """Executes a minimize-carrying Program as pipeline stages over the pp
+    axis of a mesh, with TP (mp axis) via GSPMD param shardings and DP via
+    batch sharding — config #4's static TP+PP path.
+
+    Per stage: forward jit replays the segment; backward jit re-derives the
+    segment vjp (recompute, matching the dygraph engine's memory model).
+    The 1F1B loop and micro-batching mirror PipelineParallel.
+    """
+
+    def __init__(self, program, mesh, strategy, opt, loss_name: str,
+                 trainable_names: Sequence[str]):
+        self.program = program
+        self.mesh = mesh
+        self.opt = opt
+        self.loss_name = loss_name
+        self.trainable = list(trainable_names)
+        hc = strategy.hybrid_configs if strategy is not None else {}
+        self.num_stages = int(hc.get("pp_degree", 1))
+        pcfg = getattr(strategy, "pipeline_configs", None) or {}
+        self.accumulate_steps = int(pcfg.get("accumulate_steps", 1))
+        self.segments = split_for_pipeline(program, self.num_stages)
+        # the loss must live in the last segment (uniform split of a
+        # forward+loss program always ends with the loss ops)
+        last_outs = {o for op in self.segments[-1].ops
+                     for o in op.output_names}
+        if loss_name not in last_outs:
+            raise ValueError(
+                f"loss {loss_name!r} is not produced by the last pipeline "
+                "segment; adjust pp_degree or the program split")
+        self._stage_meshes = self._build_stage_meshes()
+        self._stage_param_sh = [self._param_shardings(s)
+                                for s in range(self.num_stages)]
+        # a persistable read by several stages (tied embeddings) is OWNED by
+        # the first reader; grads from other stages are copied to the owner's
+        # submesh before accumulation
+        self._owner_sh = {}
+        for s, seg in enumerate(self.segments):
+            for n in seg.param_names:
+                self._owner_sh.setdefault(n, self._stage_param_sh[s][n])
+        self._jits: Dict = {}
+        self._opt_state = None
+        self._place_params()
+
+    # ------------------------------------------------------------ placement
+    def _build_stage_meshes(self):
+        axes = list(self.mesh.axis_names)
+        if "pp" not in axes or self.mesh.shape["pp"] != self.num_stages:
+            raise ValueError(
+                f"mesh {self.mesh.shape} lacks a pp axis of degree "
+                f"{self.num_stages}")
+        pp_idx = axes.index("pp")
+        sub_axes = tuple(a for a in axes if a != "pp")
+        return [
+            jax.sharding.Mesh(np.take(self.mesh.devices, s, axis=pp_idx),
+                              sub_axes)
+            for s in range(self.num_stages)
+        ]
+
+    def _param_shardings(self, s: int):
+        return program_param_shardings(
+            self.program, self._stage_meshes[s],
+            self.segments[s].param_names)
+
+    def _place_params(self):
+        for n, sh in self._owner_sh.items():
+            ref = self.program.refs[n]
+            ref._data = jax.device_put(ref._data, sh)
+
+    # ------------------------------------------------------------- compile
+    def _get_jits(self, s: int):
+        hit = self._jits.get(s)
+        if hit is not None:
+            return hit
+        seg = self.segments[s]
+        is_last = s == self.num_stages - 1
+        mesh_s = self._stage_meshes[s]
+        param_sh = self._stage_param_sh[s]
+        data_sh = data_sharding(mesh_s)
+
+        def fwd(params, feeds, cuts):
+            env = dict(params)
+            env.update(feeds)
+            env.update(cuts)
+            _replay_ops(seg.ops, env)
+            if is_last:
+                return jnp.sum(env[self.loss_name]).astype(jnp.float32)
+            return {n: env[n] for n in seg.out_cuts}
+
+        def _seg_fn(frozen, feeds):
+            def f(tr, ct):
+                env = dict(frozen)
+                env.update(tr)
+                env.update(feeds)
+                env.update(ct)
+                _replay_ops(seg.ops, env)
+                if is_last:
+                    return jnp.sum(env[self.loss_name]).astype(jnp.float32)
+                return {n: env[n] for n in seg.out_cuts}
+            return f
+
+        def _split_params(params):
+            trainable = {n: params[n] for n in seg.param_names
+                         if n in self.trainable}
+            frozen = {n: params[n] for n in seg.param_names
+                      if n not in self.trainable}
+            return trainable, frozen
+
+        if is_last:
+            def bwd(params, feeds, cuts):
+                trainable, frozen = _split_params(params)
+                loss, vjp = jax.vjp(_seg_fn(frozen, feeds), trainable, cuts)
+                dtr, dcuts = vjp(jnp.ones((), jnp.float32))
+                return loss, dtr, dcuts
+        else:
+            def bwd(params, feeds, cuts, gy):
+                trainable, frozen = _split_params(params)
+                _, vjp = jax.vjp(_seg_fn(frozen, feeds), trainable, cuts)
+                dtr, dcuts = vjp(gy)
+                return dtr, dcuts
+
+        in_sh_f = (param_sh,
+                   {n: data_sh for n in seg.feed_names},
+                   {n: data_sh for n in seg.in_cuts})
+        bwd_in = (in_sh_f if is_last
+                  else in_sh_f + ({n: data_sh for n in seg.out_cuts},))
+        pair = (jax.jit(fwd, in_shardings=in_sh_f),
+                jax.jit(bwd, in_shardings=bwd_in))
+        self._jits[s] = pair
+        return pair
+
+    def _to_stage(self, s: int, tree):
+        sh = data_sharding(self._stage_meshes[s])
+        return {k: jax.device_put(v, sh) for k, v in tree.items()}
+
+    # -------------------------------------------------------------- driving
+    def train_step(self, feed_arrays: Dict) -> jax.Array:
+        M = self.accumulate_steps
+        micro_feeds = [dict() for _ in range(M)]
+        for k, v in feed_arrays.items():
+            if v.shape[0] % M != 0:
+                raise ValueError(
+                    f"feed {k!r} batch {v.shape[0]} not divisible by "
+                    f"accumulate_steps {M}")
+            for m, piece in enumerate(jnp.split(v, M)):
+                micro_feeds[m][k] = piece
+
+        S = self.num_stages
+        refs = self.program.refs
+        # per-stage placement: a no-op copy for owned params, a real ICI
+        # transfer for params shared across stages (tied embeddings)
+        stage_params = [
+            {n: jax.device_put(refs[n]._data, self._stage_param_sh[s][n])
+             for n in seg.param_names}
+            for s, seg in enumerate(self.segments)
+        ]
+        acts = [[None] * M for _ in range(S)]
+        feeds_of = [[None] * M for _ in range(S)]
+        grads: Dict[str, jax.Array] = {}
+        losses = []
+
+        def run_fwd_chain(m):
+            cuts = {}
+            for s in range(S):
+                seg = self.segments[s]
+                feeds = {n: micro_feeds[m][n] for n in seg.feed_names}
+                feeds = self._to_stage(s, feeds)
+                cuts = self._to_stage(s, cuts)
+                acts[s][m] = cuts
+                feeds_of[s][m] = feeds
+                if s == S - 1:
+                    break
+                fwd, _ = self._get_jits(s)
+                cuts = fwd(stage_params[s], feeds, cuts)
+
+        def accum(dtr):
+            for n, g in dtr.items():
+                g = jax.device_put(g, self._owner_sh[n])
+                grads[n] = g if n not in grads else grads[n] + g
+
+        def run_bwd_chain(m):
+            s = S - 1
+            _, bwd = self._get_jits(s)
+            loss, dtr, dcuts = bwd(stage_params[s], feeds_of[s][m],
+                                   acts[s][m])
+            losses.append(loss)
+            accum(dtr)
+            for s in range(S - 2, -1, -1):
+                _, bwd = self._get_jits(s)
+                dtr, dcuts = bwd(stage_params[s], feeds_of[s][m],
+                                 acts[s][m], self._to_stage(s, dcuts))
+                accum(dtr)
+                acts[s][m] = None
+            acts[S - 1][m] = None
+
+        warmup = min(S - 1, M)
+        for m in range(warmup):
+            run_fwd_chain(m)
+        for m in range(warmup, M):
+            run_fwd_chain(m)
+            run_bwd_chain(m - warmup)
+        for m in range(max(0, M - warmup), M):
+            run_bwd_chain(m)
+
+        # one global update: shared params got their grads summed across
+        # stages, every micro-batch contributed 1/M
+        self.opt._step_count += 1
+        lr = jnp.asarray(self.opt.get_lr(), dtype=jnp.float32)
+        t = jnp.asarray(self.opt._step_count, dtype=jnp.int32)
+        train_params = {n: refs[n]._data for n in self.trainable
+                        if n in grads}
+        scaled = {n: grads[n] / M for n in train_params}
+        if self._opt_state is None:
+            self._opt_state = self.opt.functional_state(train_params)
+        new_params, self._opt_state = self.opt.functional_step(
+            train_params, scaled, self._opt_state, lr, t)
+        for n, v in new_params.items():
+            refs[n]._data = v
+        return sum(losses) / M

@@ -174,6 +174,14 @@ def serialize_program(program: Program = None) -> bytes:
     """Pickle the op-list IR (no weights) — ProgramDesc bytes analog."""
     program = program or default_main_program()
     block = program.global_block()
+    closures = [op.type for op in block.ops
+                if getattr(op, "fn", None) is not None]
+    if closures:
+        raise ValueError(
+            f"program contains closure-captured ops {sorted(set(closures))} "
+            "(e.g. Variable slicing) whose functions cannot be serialized; "
+            "express them through registered ops (slice/gather) to save "
+            "this program")
     return pickle.dumps({
         "ops": [(op.type, op.input_names, op.output_names, op.attrs,
                  op.arg_template) for op in block.ops],

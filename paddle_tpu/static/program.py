@@ -71,6 +71,24 @@ class Variable:
         return (f"Variable(name={self.name!r}, shape={self.shape}, "
                 f"dtype={self.dtype})")
 
+    def __getitem__(self, item):
+        """Python-value indexing (ints/slices/ellipsis), captured as an op —
+        mirrors Tensor.__getitem__ so model code slices the same way in
+        both modes. Tensor-valued indices are not supported in static capture."""
+        from ..core.dispatch import apply_callable
+
+        if isinstance(item, (Variable, Tensor)) or (
+                isinstance(item, tuple) and any(
+                    isinstance(e, (Variable, Tensor)) for e in item)):
+            raise TypeError(
+                "static-mode slicing supports Python indices only; use "
+                "gather/index_select ops for tensor-valued indices")
+
+        def fn(x):
+            return x[item]
+
+        return apply_callable("getitem", fn, self)
+
     # ---- op sugar: route every registered op through the dispatcher -----
     def __getattr__(self, item):
         if item.startswith("_"):
@@ -115,7 +133,7 @@ class OpDesc:
 
     def __init__(self, type: str, input_names: Sequence[str],
                  output_names: Sequence[str], attrs: Dict,
-                 arg_template: List):
+                 arg_template: List, fn=None):
         self.type = type
         self.input_names = list(input_names)
         self.output_names = list(output_names)
@@ -123,6 +141,10 @@ class OpDesc:
         # positional skeleton: entries are ("var", idx_into_input_names) or
         # ("const", python_value)
         self.arg_template = arg_template
+        # ad-hoc closure ops (getitem/slicing and other apply_callable
+        # captures) are not in the registry; the concrete fn rides on the
+        # OpDesc. Such Programs replay fine but are not serializable.
+        self.fn = fn
 
     def __repr__(self):
         return (f"{{{', '.join(self.output_names)}}} = {self.type}"
@@ -331,7 +353,8 @@ def _static_handler(opdef: OpDef, args, kwargs):
         out_vars.append(block.create_var(shape=shape, dtype=o2.dtype))
 
     block.append_op(OpDesc(opdef.name, input_names,
-                           [v.name for v in out_vars], kwargs, template))
+                           [v.name for v in out_vars], kwargs, template,
+                           fn=None if opdef.name in OPS else opdef.fn))
     return tuple(out_vars) if multi else out_vars[0]
 
 

@@ -463,11 +463,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     from ...ops import pallas_kernels
 
     use_dropout = dropout_p > 0.0 and training
-    if not use_dropout and \
-            pallas_kernels.flash_attention_available(query, key, value,
-                                                     attn_mask):
-        return pallas_kernels.flash_attention(query, key, value, attn_mask,
-                                              is_causal=is_causal)
+    if pallas_kernels.flash_attention_available(query, key, value,
+                                                attn_mask):
+        # dropout runs inside the kernel (on-chip PRNG), so the flash path
+        # serves training too — the flagship configs default to attention
+        # dropout 0.1 and must not silently fall back to materialized softmax
+        return pallas_kernels.flash_attention(
+            query, key, value, attn_mask, is_causal=is_causal,
+            dropout_p=dropout_p if use_dropout else 0.0,
+            rng_key=next_key() if use_dropout else None)
     rng_key = next_key() if use_dropout else None
     return apply_op(_op("scaled_dot_product_attention"), query, key, value,
                     attn_mask, rng_key, dropout_p=dropout_p,

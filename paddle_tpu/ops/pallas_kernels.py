@@ -29,6 +29,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _BLOCK_Q = 512
 _BLOCK_K = 512
@@ -64,11 +65,33 @@ def _pick_block(s: int, cap: int) -> int:
     return min(cap, _round_up(s, 128))
 
 
+def _keep_mask(pltpu, seed_ref, b_, h_, qi, ki, shape, dropout_p,
+               interpret):
+    """Per-(batch, head, q-block, k-block) dropout keep mask. Seeding with
+    the same 5-tuple in forward and both backward kernels reproduces the
+    identical mask — the recompute-based backward never materializes it.
+    Real TPU uses the on-chip PRNG; interpret mode (no Mosaic prng lowering
+    on CPU) emulates with threefry fold-ins — each path is internally
+    consistent fwd/bwd, which is the contract that matters."""
+    if interpret:
+        key = jax.random.key(seed_ref[0].astype(jnp.uint32))
+        for t in (b_, h_, qi, ki):
+            key = jax.random.fold_in(key, t)
+        bits = jax.random.bits(key, shape, jnp.uint32)
+    else:
+        pltpu.prng_seed(seed_ref[0], b_, h_, qi, ki)
+        # prng_random_bits returns SIGNED int32 (jax 0.9 abstract eval) —
+        # compare in uint32 or half the bits sit below any uint threshold
+        bits = pltpu.prng_random_bits(shape).astype(jnp.uint32)
+    thresh = np.uint32(min(int(dropout_p * (2.0 ** 32)), 2 ** 32 - 1))
+    return bits >= thresh
+
+
 # ---------------------------------------------------------------- forward
 
-def _fwd_call(qt, kt, vt, mask, *, scale, sk, is_causal, has_mask,
+def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
               mask_b_is_one, mask_h_is_one, mask_q_is_one, block_q, block_k,
-              interpret):
+              dropout_p, interpret):
     """qt/kt/vt: padded (b, h, S, D). Returns (out_padded, logsumexp)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -77,13 +100,15 @@ def _fwd_call(qt, kt, vt, mask, *, scale, sk, is_causal, has_mask,
     sk_p = kt.shape[2]
     n_q, n_k = sq_p // block_q, sk_p // block_k
     need_k_mask = sk_p != sk
+    has_dropout = dropout_p > 0.0
 
     def kernel(*refs):
-        if has_mask:
-            q_ref, k_ref, v_ref, m_in_ref, o_ref, lse_ref, \
-                acc_ref, m_ref, l_ref = refs
-        else:
-            q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        refs = list(refs)
+        q_ref, k_ref, v_ref = refs[:3]
+        refs = refs[3:]
+        m_in_ref = refs.pop(0) if has_mask else None
+        seed_ref = refs.pop(0) if has_dropout else None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
         qi = pl.program_id(2)
         ki = pl.program_id(3)
 
@@ -93,11 +118,11 @@ def _fwd_call(qt, kt, vt, mask, *, scale, sk, is_causal, has_mask,
             m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
             l_ref[...] = jnp.zeros_like(l_ref)
 
-        qblk = q_ref[0, 0].astype(jnp.float32) * scale
-        kblk = k_ref[0, 0].astype(jnp.float32)
+        # qk matmul stays in the INPUT dtype (bf16 rides the MXU natively;
+        # an f32 upcast here triples the MXU passes) with f32 accumulation
         s = jax.lax.dot_general(
-            qblk, kblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
         if has_mask:
             s = s + m_in_ref[0, 0].astype(jnp.float32)
         cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -117,9 +142,19 @@ def _fwd_call(qt, kt, vt, mask, *, scale, sk, is_causal, has_mask,
                           jnp.exp(m_prev - m_safe), 0.0)
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_ref[...] = m_cur
-        vblk = v_ref[0, 0].astype(jnp.float32)
+        vblk = v_ref[0, 0]
+        # attention dropout (upscale_in_train): drop unnormalized weights in
+        # the value accumulation; the softmax denominator l uses UNdropped p
+        p_acc = p
+        if has_dropout:
+            keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
+                              pl.program_id(1), qi, ki,
+                              (block_q, block_k), dropout_p, interpret)
+            p_acc = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+        # p cast to V's dtype: bf16 inputs keep the PV matmul on the MXU's
+        # native path (f32 accumulation via preferred_element_type)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+            p_acc.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
         @pl.when(ki == n_k - 1)
@@ -149,6 +184,9 @@ def _fwd_call(qt, kt, vt, mask, *, scale, sk, is_causal, has_mask,
                                     0 if mask_h_is_one else h_,
                                     0 if mask_q_is_one else qi, ki)))
         operands.append(mask)
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(seed)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -179,10 +217,9 @@ def _fwd_call(qt, kt, vt, mask, *, scale, sk, is_causal, has_mask,
 def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
                     is_causal, has_mask, need_k_mask, block_q, block_k):
     """Shared backward recompute: p = exp(s - lse), masked like forward."""
-    qblk = q_ref[0, 0].astype(jnp.float32) * scale
-    kblk = k_ref[0, 0].astype(jnp.float32)
-    s = jax.lax.dot_general(qblk, kblk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+    s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
     if has_mask:
         s = s + m_in_ref[0, 0].astype(jnp.float32)
     cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -197,9 +234,10 @@ def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
     return p
 
 
-def _bwd_dq_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
-                 has_mask, mask_b_is_one, mask_h_is_one, mask_q_is_one,
-                 block_q, block_k, want_dmask, interpret):
+def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
+                 is_causal, has_mask, mask_b_is_one, mask_h_is_one,
+                 mask_q_is_one, block_q, block_k, dropout_p, want_dmask,
+                 interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -207,16 +245,16 @@ def _bwd_dq_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
     sk_p = kt.shape[2]
     n_q, n_k = sq_p // block_q, sk_p // block_k
     need_k_mask = sk_p != sk
+    has_dropout = dropout_p > 0.0
 
     def kernel(*refs):
-        if has_mask:
-            q_ref, k_ref, v_ref, m_in_ref, do_ref, lse_ref, delta_ref = \
-                refs[:7]
-            outs = refs[7:]
-        else:
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
-            outs = refs[6:]
-            m_in_ref = None
+        refs = list(refs)
+        q_ref, k_ref, v_ref = refs[:3]
+        refs = refs[3:]
+        m_in_ref = refs.pop(0) if has_mask else None
+        seed_ref = refs.pop(0) if has_dropout else None
+        do_ref, lse_ref, delta_ref = refs[:3]
+        outs = refs[3:]
         if want_dmask:
             dq_ref, dmask_ref, acc_ref = outs
         else:
@@ -233,18 +271,23 @@ def _bwd_dq_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
                             scale=scale, sk=sk, is_causal=is_causal,
                             has_mask=has_mask, need_k_mask=need_k_mask,
                             block_q=block_q, block_k=block_k)
-        doblk = do_ref[0, 0].astype(jnp.float32)
-        vblk = v_ref[0, 0].astype(jnp.float32)
-        dp = jax.lax.dot_general(doblk, vblk, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[0, 0], v_ref[0, 0],
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if has_dropout:
+            # dP = M/(1-r) ∘ dP_dropped — same mask as forward (same seeds)
+            keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
+                              pl.program_id(1), qi, ki,
+                              (block_q, block_k), dropout_p, interpret)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta_ref[0, 0, 0][:, None])
         if want_dmask:
             # s = scale*q·k + mask ⇒ d(mask) = ds, unscaled; per-(h,qi,ki)
             # blocks are each visited exactly once so a plain store is safe
             dmask_ref[0, 0] = ds
-        kblk = k_ref[0, 0].astype(jnp.float32)
+        kblk = k_ref[0, 0]
         acc_ref[...] += jax.lax.dot_general(
-            ds, kblk, (((1,), (0,)), ((), ())),
+            ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
         @pl.when(ki == n_k - 1)
@@ -268,6 +311,9 @@ def _bwd_dq_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
                                     0 if mask_h_is_one else h_,
                                     0 if mask_q_is_one else qi, ki)))
         operands.append(mask)
+    if has_dropout:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(seed)
     in_specs += [q_spec, row_spec, row_spec]
     operands += [dot, lse, delta]
 
@@ -290,9 +336,9 @@ def _bwd_dq_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
     return result if want_dmask else (result, None)
 
 
-def _bwd_dkv_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
-                  has_mask, mask_b_is_one, mask_h_is_one, mask_q_is_one,
-                  block_q, block_k, interpret):
+def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
+                  is_causal, has_mask, mask_b_is_one, mask_h_is_one,
+                  mask_q_is_one, block_q, block_k, dropout_p, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -300,15 +346,15 @@ def _bwd_dkv_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
     sk_p = kt.shape[2]
     n_q, n_k = sq_p // block_q, sk_p // block_k
     need_k_mask = sk_p != sk
+    has_dropout = dropout_p > 0.0
 
     def kernel(*refs):
-        if has_mask:
-            (q_ref, k_ref, v_ref, m_in_ref, do_ref, lse_ref, delta_ref,
-             dk_ref, dv_ref, dk_acc, dv_acc) = refs
-        else:
-            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-             dk_ref, dv_ref, dk_acc, dv_acc) = refs
-            m_in_ref = None
+        refs = list(refs)
+        q_ref, k_ref, v_ref = refs[:3]
+        refs = refs[3:]
+        m_in_ref = refs.pop(0) if has_mask else None
+        seed_ref = refs.pop(0) if has_dropout else None
+        do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
         ki = pl.program_id(2)
         qi = pl.program_id(3)   # q innermost: it is the accumulated dim here
 
@@ -322,17 +368,28 @@ def _bwd_dkv_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
                             scale=scale, sk=sk, is_causal=is_causal,
                             has_mask=has_mask, need_k_mask=need_k_mask,
                             block_q=block_q, block_k=block_k)
-        doblk = do_ref[0, 0].astype(jnp.float32)
-        vblk = v_ref[0, 0].astype(jnp.float32)
+        doblk = do_ref[0, 0]
+        if has_dropout:
+            # seed args in (b, h, qi, ki) order — identical to fwd/dq even
+            # though this kernel's grid iterates (ki, qi)
+            keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
+                              pl.program_id(1), qi, ki,
+                              (block_q, block_k), dropout_p, interpret)
+            p_d = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+        else:
+            p_d = p
         dv_acc[...] += jax.lax.dot_general(
-            p, doblk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)      # p^T @ dO  [bk, d]
-        dp = jax.lax.dot_general(doblk, vblk, (((1,), (1,)), ((), ())),
+            p_d.astype(doblk.dtype), doblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # P_dropped^T @ dO
+        dp = jax.lax.dot_general(doblk, v_ref[0, 0],
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if has_dropout:
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta_ref[0, 0, 0][:, None])
-        qblk = q_ref[0, 0].astype(jnp.float32)
+        qblk = q_ref[0, 0]
         dk_acc[...] += jax.lax.dot_general(
-            ds, qblk, (((0,), (0,)), ((), ())),
+            ds.astype(qblk.dtype), qblk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # ds^T @ Q
 
         @pl.when(qi == n_q - 1)
@@ -355,6 +412,9 @@ def _bwd_dkv_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
                                     0 if mask_h_is_one else h_,
                                     0 if mask_q_is_one else qi, ki)))
         operands.append(mask)
+    if has_dropout:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(seed)
     in_specs += [q_spec, row_spec, row_spec]
     operands += [dot, lse, delta]
 
@@ -377,12 +437,15 @@ def _bwd_dkv_call(qt, kt, vt, mask, dot, lse, delta, *, scale, sk, is_causal,
 @functools.lru_cache(maxsize=None)
 def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
                mask_h_is_one: bool, mask_q_is_one: bool, sk: int,
-               real_d: int, mask_needs_grad: bool, interpret: bool):
+               real_d: int, mask_needs_grad: bool, dropout_p: float,
+               interpret: bool):
     """custom_vjp'd padded-layout flash attention, specialized per config.
     `real_d` is the unpadded head dim — it sets the softmax scale. When
     `mask_needs_grad`, the dq kernel additionally emits d(mask)=ds blocks
     (O(s^2) fp32 — only materialized for trainable masks, e.g. learned
-    position biases); otherwise the mask cotangent is zeros."""
+    position biases); otherwise the mask cotangent is zeros. With
+    `dropout_p` > 0 a scalar `seed` rides along (SMEM) and the on-chip PRNG
+    regenerates the identical keep mask in forward and backward."""
     scale = 1.0 / math.sqrt(real_d)
 
     def _kw(qt, kt):
@@ -391,19 +454,20 @@ def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
                     mask_h_is_one=mask_h_is_one, mask_q_is_one=mask_q_is_one,
                     block_q=min(_BLOCK_Q, qt.shape[2]),
                     block_k=min(_BLOCK_K, kt.shape[2]),
+                    dropout_p=dropout_p,
                     interpret=interpret)
 
     @jax.custom_vjp
-    def f(qt, kt, vt, mask):
-        out, _ = _fwd_call(qt, kt, vt, mask, **_kw(qt, kt))
+    def f(qt, kt, vt, mask, seed):
+        out, _ = _fwd_call(qt, kt, vt, mask, seed, **_kw(qt, kt))
         return out
 
-    def fwd(qt, kt, vt, mask):
-        out, lse = _fwd_call(qt, kt, vt, mask, **_kw(qt, kt))
-        return out, (qt, kt, vt, mask, out, lse)
+    def fwd(qt, kt, vt, mask, seed):
+        out, lse = _fwd_call(qt, kt, vt, mask, seed, **_kw(qt, kt))
+        return out, (qt, kt, vt, mask, seed, out, lse)
 
     def bwd(res, dout):
-        qt, kt, vt, mask, out, lse = res
+        qt, kt, vt, mask, seed, out, lse = res
         delta = jnp.sum(dout.astype(jnp.float32)
                         * out.astype(jnp.float32), axis=-1)   # [b,h,S]
         # match lse's sublane-broadcast (b,h,8,S) layout (see _fwd_call)
@@ -411,9 +475,10 @@ def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
                                  (*delta.shape[:2], 8, delta.shape[-1]))
         kw = _kw(qt, kt)
         dq, dmask_full = _bwd_dq_call(
-            qt, kt, vt, mask, dout, lse, delta,
+            qt, kt, vt, mask, seed, dout, lse, delta,
             want_dmask=has_mask and mask_needs_grad, **kw)
-        dk, dv = _bwd_dkv_call(qt, kt, vt, mask, dout, lse, delta, **kw)
+        dk, dv = _bwd_dkv_call(qt, kt, vt, mask, seed, dout, lse, delta,
+                               **kw)
         if dmask_full is not None:
             # collapse broadcast dims back to the primal mask's shape;
             # padded rows/cols carry ds=0 (dO=0 / p=0), matching jnp.pad's vjp
@@ -426,7 +491,9 @@ def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
                 dmask = dmask.sum(axis=2, keepdims=True)
         else:
             dmask = jnp.zeros_like(mask)
-        return dq, dk, dv, dmask.astype(mask.dtype)
+        # integer seed: cotangent type is float0 per the custom_vjp contract
+        dseed = np.zeros(np.shape(seed), dtype=jax.dtypes.float0)
+        return dq, dk, dv, dmask.astype(mask.dtype), dseed
 
     f.defvjp(fwd, bwd)
     return f
@@ -435,10 +502,10 @@ def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
 @functools.partial(
     jax.jit,
     static_argnames=("is_causal", "has_mask", "mask_needs_grad",
-                     "interpret"))
-def _flash_attention_data(q, k, v, mask=None, is_causal=False,
+                     "dropout_p", "interpret"))
+def _flash_attention_data(q, k, v, mask=None, seed=None, is_causal=False,
                           has_mask=False, mask_needs_grad=False,
-                          interpret=False):
+                          dropout_p=0.0, interpret=False):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq, _BLOCK_Q)
@@ -470,21 +537,40 @@ def _flash_attention_data(q, k, v, mask=None, is_causal=False,
                               (0, sk_p - sk)))
     else:
         mask = jnp.zeros((1, 1, 1, 1), jnp.float32)  # unused placeholder
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)            # unused placeholder
 
     f = _flash_vjp(is_causal, has_mask, mask_b_is_one, mask_h_is_one,
-                   mask_q_is_one, sk, d, mask_needs_grad, interpret)
-    out = f(qt, kt, vt, mask)
+                   mask_q_is_one, sk, d, mask_needs_grad, float(dropout_p),
+                   interpret)
+    out = f(qt, kt, vt, mask, seed.astype(jnp.int32).reshape((1,)))
     return jnp.einsum("bhsd->bshd", out[:, :, :sq, :d])
 
 
 def flash_attention(q, k, v, attn_mask=None, is_causal=False,
-                    interpret=False):
-    """Tensor-level wrapper used by nn.functional (differentiable)."""
+                    dropout_p=0.0, rng_key=None, interpret=False):
+    """Tensor-level wrapper used by nn.functional (differentiable).
+
+    With `dropout_p` > 0 a scalar seed is derived from `rng_key` (or the
+    framework's default generator) — attention-probs dropout then runs
+    INSIDE the kernel (upscale_in_train), so training reaches the flash
+    path instead of falling back to the materialized-softmax reference."""
     from ..core.dispatch import apply_callable
+
+    seed = None
+    if dropout_p > 0.0:
+        if rng_key is None:
+            from ..core.rng import default_generator
+
+            rng_key = default_generator().next_key()
+        seed = jax.random.randint(rng_key, (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
 
     if attn_mask is None:
         def fn(qd, kd, vd):
-            return _flash_attention_data(qd, kd, vd, is_causal=is_causal,
+            return _flash_attention_data(qd, kd, vd, seed=seed,
+                                         is_causal=is_causal,
+                                         dropout_p=dropout_p,
                                          interpret=interpret)
 
         return apply_callable("flash_attention", fn, q, k, v)
@@ -493,9 +579,11 @@ def flash_attention(q, k, v, attn_mask=None, is_causal=False,
                   and not attn_mask.stop_gradient)
 
     def fn(qd, kd, vd, md):
-        return _flash_attention_data(qd, kd, vd, md, is_causal=is_causal,
+        return _flash_attention_data(qd, kd, vd, md, seed=seed,
+                                     is_causal=is_causal,
                                      has_mask=True,
                                      mask_needs_grad=needs_grad,
+                                     dropout_p=dropout_p,
                                      interpret=interpret)
 
     return apply_callable("flash_attention", fn, q, k, v, attn_mask)

@@ -46,6 +46,7 @@ _SUBPACKAGES = [
     "nn", "optimizer", "io", "metric", "vision", "amp", "static", "jit",
     "distributed", "device", "profiler", "incubate", "sparse", "framework",
     "hapi", "text", "audio", "distribution", "quantization", "utils",
+    "inference",
 ]
 import importlib as _importlib
 

@@ -27,3 +27,6 @@ from .auto_parallel import (  # noqa: F401
     reshard, shard_layer, shard_tensor,
 )
 from . import sharding  # noqa: F401
+from . import rpc  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from . import elastic  # noqa: F401

@@ -39,6 +39,9 @@ def _parse():
                    default=int(os.environ.get("PADDLE_ELASTIC_RETRIES", 0)),
                    help="restart budget per rank before aborting the job")
     p.add_argument("--log_dir", default=os.environ.get("PADDLE_LOG_DIR"))
+    p.add_argument("--elastic_dir",
+                   default=os.environ.get("PADDLE_ELASTIC_DIR"),
+                   help="heartbeat dir enabling membership/health events")
     p.add_argument("--devices", "--gpus", "--tpus", dest="devices",
                    default=None, help="visible device ids, comma separated")
     p.add_argument("script")
@@ -62,6 +65,8 @@ def _rank_env(args, local_rank: int) -> dict:
         "PADDLE_LOCAL_RANK": str(local_rank),
         "PADDLE_NNODES": str(args.nnodes),
     })
+    if args.elastic_dir:
+        env["PADDLE_ELASTIC_DIR"] = args.elastic_dir
     if args.devices:
         env["FLAGS_selected_tpus"] = args.devices
     return env
@@ -105,11 +110,26 @@ def main(argv=None):
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
 
+    # membership/health events (elastic manager) alongside the watch loop
+    manager = None
+    if args.elastic_dir:
+        from ..elastic import ElasticManager
+
+        manager = ElasticManager(
+            args.elastic_dir,
+            # heartbeats carry GLOBAL ranks; health is about the world size
+            np_expected=args.nnodes * args.nproc_per_node)
+        for kind in ("join", "dead", "leave", "scale_up", "scale_down"):
+            manager.on(kind, lambda ev: print(
+                f"[fleetrun][elastic] {ev}", file=sys.stderr))
+
     # watch loop: paddle's collective controller semantics
     exit_code = 0
     try:
         while procs:
             time.sleep(0.5)
+            if manager is not None:
+                manager.scan()
             for lr, p in list(procs.items()):
                 code = p.poll()
                 if code is None:

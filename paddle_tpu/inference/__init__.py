@@ -1,0 +1,247 @@
+"""paddle.inference — Config / Predictor API over the StableHLO export path
+(ref: paddle/fluid/inference/api/analysis_predictor.* +
+python/paddle/inference/wrapper.py, upstream layout, unverified — mount
+empty).
+
+Paddle's AnalysisPredictor runs IR analysis passes then executes on a
+runtime; here the whole analyze+optimize+schedule pipeline IS XLA: the
+artifact saved by `static.save_inference_model` / `jit.save` is a serialized
+`jax.export` module (compiled ahead-of-time per input signature), and the
+Predictor is a thin handle layer (named input/output tensors, copy_from_cpu/
+copy_to_cpu) over its execution. Config toggles that steer upstream's
+IR passes (ir_optim, memory_optim, mkldnn, ...) are accepted and recorded
+for API parity — XLA already performs the corresponding optimizations.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+def get_version() -> str:
+    from .. import __version__
+
+    return __version__
+
+
+class Config:
+    """Predictor configuration (AnalysisConfig analog)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # paddle accepts Config(model_dir) or Config(prog, params); both
+        # resolve here to the .tpu_model artifact directory
+        self._model_path = prog_file
+        self._params_file = params_file
+        self._use_device = "tpu" if _default_is_accel() else "cpu"
+        self._memory_pool_init_mb = 100
+        self._flags: Dict[str, object] = {
+            "ir_optim": True, "memory_optim": False, "mkldnn": False,
+            "glog_info": False, "precision": PrecisionType.Float32,
+        }
+
+    # ----------------------------------------------------------- model path
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self._model_path = prog_file
+        self._params_file = params_file
+
+    def model_dir(self) -> Optional[str]:
+        return self._model_path
+
+    def prog_file(self) -> Optional[str]:
+        return self._model_path
+
+    def params_file(self) -> Optional[str]:
+        return self._params_file
+
+    # -------------------------------------------------------------- devices
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0, precision=None):
+        # accelerator selection is owned by the jax backend; record intent
+        self._use_device = "accelerator"
+        self._memory_pool_init_mb = memory_pool_init_size_mb
+
+    def disable_gpu(self):
+        self._use_device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._use_device != "cpu"
+
+    def enable_xpu(self, *a, **k):
+        self._use_device = "accelerator"
+
+    def enable_custom_device(self, device_type: str, device_id: int = 0):
+        self._use_device = device_type
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._flags["cpu_threads"] = int(n)
+
+    # ------------------------------------------------- optimization toggles
+    def switch_ir_optim(self, enabled: bool = True):
+        self._flags["ir_optim"] = bool(enabled)
+
+    def ir_optim(self) -> bool:
+        return bool(self._flags["ir_optim"])
+
+    def enable_memory_optim(self, enabled: bool = True):
+        self._flags["memory_optim"] = bool(enabled)
+
+    def enable_mkldnn(self):
+        self._flags["mkldnn"] = True
+
+    def disable_glog_info(self):
+        self._flags["glog_info"] = False
+
+    def switch_use_feed_fetch_ops(self, enabled: bool):
+        pass  # feed/fetch are function args under XLA
+
+    def switch_specify_input_names(self, enabled: bool = True):
+        pass
+
+    def summary(self) -> str:
+        lines = [f"model: {self._model_path}",
+                 f"device: {self._use_device}"]
+        lines += [f"{k}: {v}" for k, v in sorted(self._flags.items())]
+        return "\n".join(lines)
+
+
+class Tensor:
+    """Named input/output handle (paddle.inference.Tensor analog)."""
+
+    def __init__(self, name: str, shape=None, dtype=None):
+        self.name = name
+        self._shape = list(shape or [])
+        self._dtype = np.dtype(dtype) if dtype is not None else None
+        self._value: Optional[np.ndarray] = None
+
+    def reshape(self, shape):
+        self._shape = list(shape)
+
+    def copy_from_cpu(self, data: np.ndarray):
+        data = np.asarray(data)
+        if self._dtype is not None and data.dtype != self._dtype:
+            data = data.astype(self._dtype)
+        self._value = data
+        self._shape = list(data.shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"tensor {self.name!r} has no value; did "
+                               "Predictor.run() succeed?")
+        return np.asarray(self._value)
+
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    def type(self):
+        return self._dtype
+
+
+class Predictor:
+    """Executes the exported module with named handles (AnalysisPredictor
+    analog; XLA is the analysis + runtime)."""
+
+    def __init__(self, config: Config):
+        from ..static.io import LoadedInferenceModel
+
+        path = config.model_dir()
+        if path is None:
+            raise ValueError("Config has no model path; use set_model()")
+        out_dir = path if os.path.isdir(path) else str(path) + ".tpu_model"
+        if not os.path.isdir(out_dir):
+            raise FileNotFoundError(
+                f"no inference artifact at {path!r} (expected a directory "
+                "or a save_inference_model/jit.save prefix)")
+        self._config = config
+        self._model = LoadedInferenceModel(out_dir)
+        self._inputs = {
+            d["name"]: Tensor(d["name"], d.get("shape"), d.get("dtype"))
+            for d in self._model.meta["feed"]
+        }
+        self._outputs = {
+            d["name"]: Tensor(d["name"], d.get("shape"), d.get("dtype"))
+            for d in self._model.meta["fetch"]
+        }
+
+    def get_input_names(self) -> List[str]:
+        return list(self._model.feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._model.fetch_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either positional `inputs` (new paddle API) or values
+        previously copy_from_cpu'd into the input handles."""
+        if inputs is not None:
+            for name, arr in zip(self._model.feed_names, inputs):
+                self._inputs[name].copy_from_cpu(np.asarray(arr))
+        feed = {}
+        for name, handle in self._inputs.items():
+            if handle._value is None:
+                raise RuntimeError(
+                    f"input {name!r} not set; call copy_from_cpu first")
+            feed[name] = handle._value
+        outs = self._model.run(feed)
+        results = []
+        for name, val in zip(self._model.fetch_names, outs):
+            arr = np.asarray(val)
+            self._outputs[name]._value = arr
+            self._outputs[name]._shape = list(arr.shape)
+            results.append(arr)
+        return results
+
+    def clone(self) -> "Predictor":
+        """Share the loaded module; fresh handles (paddle clone contract —
+        one predictor per thread/stream)."""
+        clone = object.__new__(Predictor)
+        clone._config = self._config
+        clone._model = self._model
+        clone._inputs = {
+            n: Tensor(n, t._shape, t._dtype)
+            for n, t in self._inputs.items()
+        }
+        clone._outputs = {
+            n: Tensor(n, t._shape, t._dtype)
+            for n, t in self._outputs.items()
+        }
+        return clone
+
+    def try_shrink_memory(self):
+        pass  # XLA owns buffers; nothing to shrink host-side
+
+
+def _default_is_accel() -> bool:
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except RuntimeError:
+        return False
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

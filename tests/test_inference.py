@@ -1,0 +1,83 @@
+"""paddle.inference Config/Predictor API over the StableHLO export
+(SURVEY §1 row 12 + §2.1 inference engine row)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference, static
+
+
+@pytest.fixture
+def saved_model(tmp_path):
+    static.enable_static()
+    main, startup = static.Program(), static.Program()
+    try:
+        with static.program_guard(main, startup):
+            x = static.data("x", shape=[None, 4], dtype="float32")
+            lin = nn.Linear(4, 2)
+            pred = lin(x)
+    finally:
+        static.disable_static()
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(1).randn(5, 4).astype("float32")
+    expect, = exe.run(main, feed={"x": xv}, fetch_list=[pred])
+    prefix = str(tmp_path / "infer")
+    static.save_inference_model(prefix, [x], [pred], exe, program=main)
+    return prefix, xv, expect
+
+
+class TestPredictor:
+    def test_handle_roundtrip(self, saved_model):
+        prefix, xv, expect = saved_model
+        config = inference.Config(prefix)
+        predictor = inference.create_predictor(config)
+        assert predictor.get_input_names() == ["x"]
+        assert len(predictor.get_output_names()) == 1
+
+        h = predictor.get_input_handle("x")
+        h.copy_from_cpu(xv)
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+    def test_positional_run_and_dynamic_batch(self, saved_model):
+        prefix, xv, expect = saved_model
+        predictor = inference.create_predictor(inference.Config(prefix))
+        out, = predictor.run([xv])
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+        # symbolic batch dim: smaller batch on the same compiled artifact
+        out2, = predictor.run([xv[:2]])
+        np.testing.assert_allclose(out2, expect[:2], rtol=1e-5, atol=1e-6)
+
+    def test_clone_shares_module_not_handles(self, saved_model):
+        prefix, xv, _ = saved_model
+        p1 = inference.create_predictor(inference.Config(prefix))
+        p2 = p1.clone()
+        assert p1._model is p2._model
+        p1.get_input_handle("x").copy_from_cpu(xv)
+        with pytest.raises(RuntimeError, match="not set"):
+            p2.run()
+
+    def test_config_surface(self, saved_model):
+        prefix, _, _ = saved_model
+        c = inference.Config(prefix)
+        c.disable_gpu()
+        assert not c.use_gpu()
+        c.enable_use_gpu(256)
+        assert c.use_gpu()
+        c.switch_ir_optim(False)
+        assert not c.ir_optim()
+        c.enable_memory_optim()
+        c.set_cpu_math_library_num_threads(4)
+        assert "model" in c.summary()
+
+    def test_missing_artifact_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            inference.create_predictor(
+                inference.Config(str(tmp_path / "nope")))
+
+    def test_get_version(self):
+        assert inference.get_version() == paddle.__version__

@@ -54,9 +54,12 @@ def _amp_handler(opdef, datas):
         return datas
     name = opdef.name
     amp_dtype = _STATE["dtype"]
-    if name in _STATE["black"]:
+    # name lists first, then the OpDef's own amp_list declaration (the
+    # ops.yaml `amp:` field) — one policy, two declaration sites
+    if name in _STATE["black"] or opdef.amp_list == "black":
         target = jnp.float32
-    elif _STATE["level"] == "O2" or name in _STATE["white"]:
+    elif (_STATE["level"] == "O2" or name in _STATE["white"]
+          or opdef.amp_list == "white"):
         target = amp_dtype
     else:
         return datas

@@ -577,6 +577,15 @@ for _m, _op in _METHOD_TABLE.items():
     if not hasattr(Tensor, _m):
         setattr(Tensor, _m, _make_method(_op))
 
+# ops.yaml-generated methods attach through the same mechanism (the ops
+# package — including yaml_ops — is fully registered before this module's
+# body runs; see paddle_tpu/__init__ import order)
+from ..ops.yaml_ops import METHOD_SPECS as _YAML_METHODS  # noqa: E402
+
+for _m, _op in _YAML_METHODS.items():
+    if not hasattr(Tensor, _m):
+        setattr(Tensor, _m, _make_method(_op))
+
 
 def _topk_method(self, k, axis=-1, largest=True, sorted=True):
     idx = apply_op(get_op("topk_indices"), self, k=k, axis=axis,

@@ -6,3 +6,4 @@ from . import comparison  # noqa: F401
 from . import manipulation  # noqa: F401
 from . import linalg  # noqa: F401
 from . import nn_ops  # noqa: F401
+from . import yaml_ops  # noqa: F401  (ops.yaml codegen — SURVEY §2.4)

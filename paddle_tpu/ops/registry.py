@@ -21,10 +21,12 @@ import jax
 
 
 class OpDef:
-    __slots__ = ("name", "fn", "multi_output", "inplace_view", "amp_list")
+    __slots__ = ("name", "fn", "multi_output", "inplace_view", "amp_list",
+                 "eager_only")
 
     def __init__(self, name: str, fn: Callable, multi_output: bool = False,
-                 inplace_view: bool = False, amp_list: Optional[str] = None):
+                 inplace_view: bool = False, amp_list: Optional[str] = None,
+                 eager_only: bool = False):
         self.name = name
         self.fn = fn
         # whether fn returns a tuple of arrays rather than a single array
@@ -33,6 +35,10 @@ class OpDef:
         self.inplace_view = inplace_view
         # 'white' (run in low precision), 'black' (keep fp32), None (follow inputs)
         self.amp_list = amp_list
+        # data-dependent output shape: usable eagerly, rejected by the
+        # static capture (which would otherwise fail later with an opaque
+        # tracer shape error)
+        self.eager_only = eager_only
 
     def infer_meta(self, *args, **kwargs):
         """InferMeta analog: abstract shape/dtype evaluation."""
@@ -46,12 +52,13 @@ OPS: Dict[str, OpDef] = {}
 
 
 def register_op(name: str, multi_output: bool = False, inplace_view: bool = False,
-                amp_list: Optional[str] = None):
+                amp_list: Optional[str] = None, eager_only: bool = False):
     """Decorator registering a pure jax function as a framework op."""
 
     def deco(fn: Callable):
         opdef = OpDef(name, fn, multi_output=multi_output,
-                      inplace_view=inplace_view, amp_list=amp_list)
+                      inplace_view=inplace_view, amp_list=amp_list,
+                      eager_only=eager_only)
         if name in OPS:
             raise ValueError(f"op {name!r} registered twice")
         OPS[name] = opdef

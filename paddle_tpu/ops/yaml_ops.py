@@ -1,0 +1,76 @@
+"""YAML-driven op codegen — the PHI API-generator analog (ref:
+paddle/phi/api/yaml/ops.yaml + paddle/phi/api/generator/*, upstream layout,
+unverified — mount empty).
+
+Upstream generates C++ API, kernels-dispatch and autograd nodes from
+ops.yaml at build time. Here the same single-source-of-truth idea runs at
+import time: `ops.yaml` declares each op's name, python signature, jnp
+implementation (expression or body), AMP list and Tensor-method binding;
+this module compiles the functions, registers them (autograd comes free —
+the dispatcher wraps every registered op in jax.vjp), and exposes the
+generated names for the paddle.tensor namespace to export.
+
+Schema per entry:
+    op: exp2                  # registry + namespace name
+    args: "x"                 # python signature (defaults allowed)
+    impl: "jnp.exp2(x)"       # expression, or a block with `return`
+    amp: white|black          # optional AMP list
+    multi_output: true        # optional: returns a tuple
+    method: exp2|null         # Tensor method name (defaults to op; null=no)
+    eager_only: true          # data-dependent output shape; not jittable
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register_op
+
+_YAML_PATH = os.path.join(os.path.dirname(__file__), "ops.yaml")
+
+#: names generated from ops.yaml (for the paddle.tensor namespace)
+GENERATED: List[str] = []
+#: tensor-method name -> op name, for core.tensor attachment
+METHOD_SPECS: Dict[str, str] = {}
+
+
+def _compile_fn(name: str, args: str, impl: str):
+    impl = impl.strip()
+    if "\n" in impl or impl.startswith("return"):
+        body = "\n".join("    " + line for line in impl.splitlines())
+    else:
+        body = f"    return {impl}"
+    src = f"def {name}({args}):\n{body}\n"
+    ns = {"jnp": jnp, "jax": jax, "lax": lax, "np": np,
+          "functools": functools}
+    exec(compile(src, f"<ops.yaml:{name}>", "exec"), ns)
+    fn = ns[name]
+    fn.__doc__ = f"Generated from ops.yaml (impl: jnp). Signature: ({args})"
+    return fn
+
+
+def load():
+    import yaml
+
+    with open(_YAML_PATH) as f:
+        specs = yaml.safe_load(f)
+    for spec in specs:
+        name = spec["op"]
+        fn = _compile_fn(name, spec.get("args", "x"), spec["impl"])
+        register_op(name,
+                    multi_output=bool(spec.get("multi_output", False)),
+                    amp_list=spec.get("amp"),
+                    eager_only=bool(spec.get("eager_only", False)))(fn)
+        GENERATED.append(name)
+        method = spec.get("method", name)
+        if method:
+            METHOD_SPECS[method] = name
+
+
+load()

@@ -273,6 +273,11 @@ def _concrete_shape(shape, dyn=2):
 
 def _static_handler(opdef: OpDef, args, kwargs):
     """Called by core.dispatch for every op issued in static mode."""
+    if getattr(opdef, "eager_only", False):
+        raise NotImplementedError(
+            f"op {opdef.name!r} has a data-dependent output shape and "
+            "cannot be captured into a static Program; compute it eagerly "
+            "outside the static region")
     program = default_main_program()
     block = program.current_block()
 

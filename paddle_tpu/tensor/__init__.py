@@ -64,6 +64,15 @@ for _name in _FN_EXPORTS:
     if _name not in _g:
         _g[_name] = _make_fn(_name)
 
+# ops.yaml-generated namespace functions (Tensor methods attach in
+# core.tensor, next to the hand-written method table)
+from ..ops.yaml_ops import GENERATED as _YAML_GENERATED  # noqa: E402
+
+for _name in _YAML_GENERATED:
+    if _name not in _g:
+        _g[_name] = _make_fn(_name)
+del _YAML_GENERATED
+
 
 def pow(x, y):
     if isinstance(y, (int, float)):

@@ -1,0 +1,232 @@
+"""ops.yaml codegen layer: generated ops vs NumPy references, autograd,
+static capture, Tensor-method binding (SURVEY §2.4 YAML single source)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.tensor as T
+from paddle_tpu import static
+from paddle_tpu.ops.registry import OPS
+from paddle_tpu.ops.yaml_ops import GENERATED, METHOD_SPECS
+
+
+def _t(a, sg=True):
+    t = paddle.to_tensor(np.asarray(a))
+    t.stop_gradient = sg
+    return t
+
+
+class TestGeneratedSurface:
+    def test_all_yaml_ops_registered_and_exported(self):
+        assert len(GENERATED) >= 50
+        for name in GENERATED:
+            assert name in OPS
+            assert callable(getattr(T, name))
+
+    def test_method_binding(self):
+        t = _t(np.float32([1.0, 2.0]))
+        for meth in ("exp2", "sgn", "signbit", "diff"):
+            assert meth in METHOD_SPECS
+            assert hasattr(t, meth)
+        np.testing.assert_allclose(t.exp2().numpy(), [2.0, 4.0])
+
+
+class TestNumerics:
+    def test_elementwise_family(self):
+        x = np.float32([0.5, 1.0, 2.0])
+        y = np.float32([1.5, 2.0, 0.5])
+        np.testing.assert_allclose(T.exp2(_t(x)).numpy(), np.exp2(x),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(T.logaddexp2(_t(x), _t(y)).numpy(),
+                                   np.logaddexp2(x, y), rtol=1e-6)
+        np.testing.assert_allclose(T.nextafter(_t(x), _t(y)).numpy(),
+                                   np.nextafter(x, y))
+        np.testing.assert_allclose(
+            T.xlogy(_t(x), _t(y)).numpy(), x * np.log(y), rtol=1e-6)
+
+    def test_int_family(self):
+        a = np.int32([12, 18, 7])
+        b = np.int32([8, 12, 21])
+        np.testing.assert_array_equal(T.gcd(_t(a), _t(b)).numpy(),
+                                      np.gcd(a, b))
+        np.testing.assert_array_equal(T.lcm(_t(a), _t(b)).numpy(),
+                                      np.lcm(a, b))
+
+    def test_inf_sign_family(self):
+        x = np.float32([-np.inf, -1.0, 0.0, np.inf])
+        np.testing.assert_array_equal(T.isneginf(_t(x)).numpy(),
+                                      np.isneginf(x))
+        np.testing.assert_array_equal(T.isposinf(_t(x)).numpy(),
+                                      np.isposinf(x))
+        np.testing.assert_array_equal(T.signbit(_t(x)).numpy(),
+                                      np.signbit(x))
+
+    def test_frexp_multi_output(self):
+        x = np.float32([0.5, 4.0, 12.0])
+        m, e = T.frexp(_t(x))
+        rm, re = np.frexp(x)
+        np.testing.assert_allclose(m.numpy(), rm)
+        np.testing.assert_array_equal(e.numpy(), re)
+
+    def test_quantile_and_nanquantile(self):
+        x = np.float32([[1, 2, 3, 4], [5, 6, 7, 8]])
+        np.testing.assert_allclose(
+            T.quantile(_t(x), 0.25, axis=1).numpy(),
+            np.quantile(x, 0.25, axis=1), rtol=1e-6)
+        xn = x.copy()
+        xn[0, 0] = np.nan
+        np.testing.assert_allclose(
+            T.nanquantile(_t(xn), 0.5, axis=1).numpy(),
+            np.nanquantile(xn, 0.5, axis=1), rtol=1e-6)
+
+    def test_kthvalue_and_mode(self):
+        x = np.float32([[3, 1, 2], [9, 9, 1]])
+        v, i = T.kthvalue(_t(x), 2, axis=1)
+        np.testing.assert_allclose(v.numpy(), [2.0, 9.0])
+        mv, _ = T.mode(_t(x), axis=1)
+        np.testing.assert_allclose(mv.numpy(), [1.0, 9.0])
+        # keepdim: BOTH outputs carry the kept axis (paddle contract)
+        vk, ik = T.kthvalue(_t(x), 2, axis=1, keepdim=True)
+        assert vk.shape == [2, 1] and ik.shape == [2, 1]
+        mk, mik = T.mode(_t(x), axis=1, keepdim=True)
+        assert mk.shape == [2, 1] and mik.shape == [2, 1]
+
+    def test_cdist_pdist_chebyshev_and_hamming(self):
+        x = np.float32([[0.0, 0.0], [0.5, 3.0]])
+        inf_d = T.cdist(_t(x), _t(x), p=float("inf")).numpy()
+        np.testing.assert_allclose(inf_d, [[0.0, 3.0], [3.0, 0.0]])
+        zero_d = T.cdist(_t(x), _t(x), p=0).numpy()
+        np.testing.assert_allclose(zero_d, [[0.0, 2.0], [2.0, 0.0]])
+        np.testing.assert_allclose(
+            T.pdist(_t(x), p=float("inf")).numpy(), [3.0])
+
+    def test_trapezoid_family(self):
+        y = np.float32([1, 2, 3, 4])
+        np.testing.assert_allclose(T.trapezoid(_t(y)).numpy(),
+                                   np.trapezoid(y), rtol=1e-6)
+        ct = T.cumulative_trapezoid(_t(y)).numpy()
+        np.testing.assert_allclose(ct, [1.5, 4.0, 7.5], rtol=1e-6)
+
+    def test_stack_split_family(self):
+        a = np.float32([[1, 2], [3, 4]])
+        np.testing.assert_array_equal(
+            T.hstack([_t(a), _t(a)]).numpy(), np.hstack([a, a]))
+        np.testing.assert_array_equal(
+            T.vstack([_t(a), _t(a)]).numpy(), np.vstack([a, a]))
+        np.testing.assert_array_equal(
+            T.column_stack([_t(a[:, 0]), _t(a[:, 1])]).numpy(), a)
+        parts = T.tensor_split(_t(np.arange(7)), 3)
+        np.testing.assert_array_equal(parts[0].numpy(), [0, 1, 2])
+        np.testing.assert_array_equal(parts[2].numpy(), [5, 6])
+
+    def test_index_ops(self):
+        x = np.zeros((3, 4), np.float32)
+        idx = np.int32([0, 2])
+        out = T.index_fill(_t(x), _t(idx), 0, 5.0).numpy()
+        assert out[0].sum() == 20 and out[1].sum() == 0
+        add = T.index_add(_t(x), _t(idx), 0,
+                          _t(np.ones((2, 4), np.float32))).numpy()
+        np.testing.assert_array_equal(add[idx], np.ones((2, 4)))
+
+    def test_linalg_family(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(3, 3).astype("float32")
+        sym = a @ a.T + 3 * np.eye(3, dtype="float32")
+        np.testing.assert_allclose(T.eigvalsh(_t(sym)).numpy(),
+                                   np.linalg.eigvalsh(sym), rtol=1e-4)
+        b = rng.randn(3, 2).astype("float32")
+        np.testing.assert_allclose(
+            T.addmm(_t(np.ones((3, 2), np.float32)), _t(a), _t(b),
+                    beta=2.0, alpha=0.5).numpy(),
+            2.0 + 0.5 * (a @ b), rtol=1e-5)
+        np.testing.assert_allclose(
+            T.multi_dot([_t(a), _t(a), _t(b)]).numpy(),
+            np.linalg.multi_dot([a, a, b]), rtol=2e-4, atol=1e-5)
+        x = rng.randn(4, 3).astype("float32")
+        d = T.cdist(_t(x), _t(x)).numpy()
+        ref = np.sqrt(((x[:, None] - x[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(d, ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(T.pdist(_t(x)).numpy(),
+                                   ref[np.triu_indices(4, 1)], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_stat_family(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(3, 10).astype("float32")
+        np.testing.assert_allclose(T.corrcoef(_t(x)).numpy(),
+                                   np.corrcoef(x), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(T.cov(_t(x)).numpy(), np.cov(x),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_misc(self):
+        np.testing.assert_array_equal(
+            T.vander(_t(np.float32([1, 2, 3]))).numpy(),
+            np.vander(np.float32([1, 2, 3])))
+        x = np.arange(24, dtype=np.float32).reshape(2, 12)
+        np.testing.assert_array_equal(
+            T.unflatten(_t(x), 1, [3, 4]).numpy(), x.reshape(2, 3, 4))
+        np.testing.assert_array_equal(
+            T.bucketize(_t(np.float32([0.5, 2.5])),
+                        _t(np.float32([1, 2, 3]))).numpy(), [0, 2])
+        np.testing.assert_allclose(
+            T.renorm(_t(np.float32([[3, 4], [0.3, 0.4]])), 2.0, 0,
+                     1.0).numpy(),
+            [[0.6, 0.8], [0.3, 0.4]], rtol=1e-5)
+
+
+class TestAutogradAndStatic:
+    def test_autograd_through_generated_op(self):
+        x = _t(np.float32([1.0, 2.0]), sg=False)
+        y = T.exp2(x).sum()
+        y.backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), np.exp2([1.0, 2.0]) * np.log(2), rtol=1e-5)
+
+    def test_static_capture_of_generated_op(self):
+        static.enable_static()
+        main = static.Program()
+        try:
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 3], "float32")
+                out = T.exp2(x)
+        finally:
+            static.disable_static()
+        exe = static.Executor()
+        xv = np.float32([[0.0, 1.0, 3.0]])
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(got, np.exp2(xv), rtol=1e-6)
+
+    def test_amp_list_declaration_has_runtime_effect(self):
+        """The ops.yaml amp: field must actually steer autocast — a black
+        op keeps fp32 inputs fp32 even under O2."""
+        from paddle_tpu import amp
+
+        assert OPS["exp2"].amp_list == "black"
+        assert OPS["eigvalsh"].amp_list == "black"
+        sym = np.eye(3, dtype="float32") * 4.0
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            out = T.eigvalsh(_t(sym))
+        assert str(out.dtype) in ("float32", "paddle.float32"), out.dtype
+        np.testing.assert_allclose(out.numpy(), [4.0, 4.0, 4.0], rtol=1e-5)
+
+    def test_eager_only_rejected_by_static_capture(self):
+        from paddle_tpu.ops.registry import OPS as _OPS, register_op
+
+        @register_op("_test_eager_only", eager_only=True)
+        def _test_eager_only(x):
+            return x
+        try:
+            static.enable_static()
+            main = static.Program()
+            try:
+                with static.program_guard(main, static.Program()):
+                    x = static.data("x", [2], "float32")
+                    from paddle_tpu.core.dispatch import apply_op
+
+                    with pytest.raises(NotImplementedError,
+                                       match="data-dependent"):
+                        apply_op(_OPS["_test_eager_only"], x)
+            finally:
+                static.disable_static()
+        finally:
+            del _OPS["_test_eager_only"]

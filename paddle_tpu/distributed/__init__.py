@@ -22,6 +22,7 @@ from .spawn import spawn  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from .auto_parallel_engine import Engine, complete_param_shardings  # noqa: F401,E501
 from .auto_parallel import (  # noqa: F401
     Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
     reshard, shard_layer, shard_tensor,
@@ -30,3 +31,4 @@ from . import sharding  # noqa: F401
 from . import rpc  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import elastic  # noqa: F401
+from .fleet_executor import FleetExecutor, TaskNode  # noqa: F401

@@ -1,0 +1,106 @@
+"""auto_parallel Engine: completion → partition → fit/evaluate/predict on
+the 8-device mesh (SURVEY §2.3 auto_parallel row; VERDICT r2 missing #7)."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import Engine, complete_param_shardings
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear,
+)
+from paddle_tpu.io import TensorDataset
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "mp"))
+
+
+def _tp_mlp(seed=31):
+    paddle.seed(seed)
+    return nn.Sequential(
+        ColumnParallelLinear(8, 32, gather_output=False),
+        nn.ReLU(),
+        RowParallelLinear(32, 4, input_is_parallel=True),
+    )
+
+
+def _data(n=32):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 8).astype("float32")
+    y = rng.randint(0, 4, (n, 1)).astype("int64")
+    return x, y
+
+
+class TestCompletion:
+    def test_marked_params_get_mesh_axes(self):
+        mesh = _mesh()
+        net = _tp_mlp()
+        params, data_sh, repl = complete_param_shardings(net, mesh)
+        col_w = params["0.weight"]
+        assert "mp" in str(col_w.spec), col_w.spec
+        # bias of the row-parallel layer is replicated (post-reduction add)
+        assert all(a is None for a in params["2.bias"].spec)
+        assert "dp" in str(data_sh.spec)
+
+
+class TestEngineFit:
+    def test_fit_converges_and_shards_params(self):
+        mesh = _mesh()
+        net = _tp_mlp()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        engine = Engine(net, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                        metrics=paddle.metric.Accuracy(), mesh=mesh)
+        x, y = _data(64)
+        ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+        hist = engine.fit(ds, epochs=5, batch_size=32)
+        assert hist["loss"][-1] < hist["loss"][0]
+        # the partitioner actually sharded the TP weight over mp
+        w = dict(net.named_parameters())["0.weight"]
+        assert "mp" in str(w._data.sharding.spec)
+
+        out = engine.evaluate(ds, batch_size=32)
+        assert "loss" in out and "acc" in out
+        preds = engine.predict(ds, batch_size=32)
+        assert preds[0].shape == (32, 4)
+
+    def test_matches_eager_sgd(self):
+        """2 Engine steps over the mesh == 2 eager single-device steps —
+        the partitioned program computes the same math."""
+        mesh = _mesh()
+        net_a = _tp_mlp(seed=77)
+        net_b = _tp_mlp(seed=77)
+        for pa, pb in zip(net_a.parameters(), net_b.parameters()):
+            np.testing.assert_array_equal(pa.numpy(), pb.numpy())
+
+        x, y = _data(16)
+        loss_fn = nn.CrossEntropyLoss()
+        opt_a = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_a.parameters())
+        engine = Engine(net_a, loss=loss_fn, optimizer=opt_a, mesh=mesh)
+        ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+        engine.fit(ds, epochs=2, batch_size=16)   # 1 step per epoch
+
+        opt_b = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_b.parameters())
+        for _ in range(2):
+            loss = loss_fn(net_b(paddle.to_tensor(x)), paddle.to_tensor(y))
+            loss.backward()
+            opt_b.step()
+            opt_b.clear_grad()
+
+        for pa, pb in zip(net_a.parameters(), net_b.parameters()):
+            np.testing.assert_allclose(pa.numpy(), pb.numpy(), rtol=2e-4,
+                                       atol=1e-5)
+
+    def test_needs_mesh(self):
+        net = _tp_mlp()
+        engine = Engine(net, loss=nn.CrossEntropyLoss(),
+                        optimizer=paddle.optimizer.SGD(
+                            learning_rate=0.1,
+                            parameters=net.parameters()))
+        with pytest.raises(ValueError, match="mesh"):
+            engine.prepare()

@@ -411,3 +411,18 @@ class TestProfiler:
 
         with profiler.RecordEvent("orphan"):
             pass  # must not raise or leak into any profiler
+
+
+def test_tape_overhead_benchmark_smoke():
+    """benchmarks/tape_overhead.py runs and yields sane numbers."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                        "tape_overhead.py")
+    spec = importlib.util.spec_from_file_location("tape_overhead", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.measure(n_ops=5)
+    assert out["per_op_us"]["dispatch_tape"] > 0
+    assert out["train_step_ms"]["jitted_functional"] > 0

@@ -65,6 +65,14 @@ def set_flags(flags: Dict[str, Any]):
         entry = _FLAGS[name]
         entry["value"] = _coerce(value, entry["type"])
         entry["env_read"] = True
+        if name == "FLAGS_check_nan_inf":
+            # the eager dispatcher checks op outputs itself; jitted/pjit
+            # steps (where the dispatcher never sees values) get the same
+            # guard through XLA's nan debugging — paddle's
+            # check_numerics-under-graph analog
+            import jax
+
+            jax.config.update("jax_debug_nans", bool(entry["value"]))
 
 
 def list_flags():

@@ -426,3 +426,28 @@ def test_tape_overhead_benchmark_smoke():
     out = mod.measure(n_ops=5)
     assert out["per_op_us"]["dispatch_tape"] > 0
     assert out["train_step_ms"]["jitted_functional"] > 0
+
+
+def test_check_nan_inf_flag_guards_jitted_paths():
+    """FLAGS_check_nan_inf must catch NaNs in BOTH regimes: eager dispatch
+    (op-output check) and jitted steps (jax_debug_nans wiring)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        assert jax.config.jax_debug_nans
+        # eager: the dispatcher raises on a nan output
+        bad = paddle.to_tensor(np.float32([1.0, -1.0]))
+        with pytest.raises(FloatingPointError):
+            bad.log()  # log(-1) = nan
+        # jitted: XLA debug_nans raises out of the compiled computation
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda v: jnp.log(v))(jnp.float32([-1.0])).block_until_ready()
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        assert not jax.config.jax_debug_nans

@@ -117,7 +117,14 @@ def set_device(device) -> Place:
     elif name in ("tpu", "gpu", "cuda", "xpu", "npu", "custom", "axon"):
         place = TPUPlace(idx)
     else:
-        raise ValueError(f"unknown device {device!r}")
+        from ..device.plugin import is_custom_device_registered
+
+        if is_custom_device_registered(name):
+            # a registered PJRT plugin is an accelerator place; backend
+            # selection itself is owned by jax (JAX_PLATFORMS)
+            place = TPUPlace(idx)
+        else:
+            raise ValueError(f"unknown device {device!r}")
     _CURRENT_PLACE[0] = place
     return place
 

@@ -18,6 +18,10 @@ from ..core.place import (  # noqa: F401
     CPUPlace, CUDAPlace, Place, TPUPlace, device_count, get_device,
     is_compiled_with_tpu, set_device,
 )
+from . import plugin  # noqa: F401
+from .plugin import (  # noqa: F401
+    is_custom_device_registered, list_custom_devices, register_custom_device,
+)
 
 __all__ = [
     "set_device", "get_device", "get_all_device_type",
@@ -28,6 +32,8 @@ __all__ = [
     "device_count", "synchronize", "Stream", "Event",
     "current_stream", "set_stream", "stream_guard", "cuda",
     "Place", "CPUPlace", "CUDAPlace", "TPUPlace",
+    "register_custom_device", "list_custom_devices",
+    "is_custom_device_registered",
 ]
 
 
@@ -36,7 +42,8 @@ def get_all_device_type():
 
 
 def get_all_custom_device_type():
-    return [p for p in get_all_device_type() if p not in ("cpu", "gpu")]
+    builtin = [p for p in get_all_device_type() if p not in ("cpu", "gpu")]
+    return sorted(set(builtin) | set(list_custom_devices()))
 
 
 def get_available_device():
@@ -61,7 +68,7 @@ def is_compiled_with_xpu() -> bool:
 
 
 def is_compiled_with_custom_device(device_type: str = "tpu") -> bool:
-    return device_type == "tpu"
+    return device_type == "tpu" or is_custom_device_registered(device_type)
 
 
 def synchronize(device=None) -> None:

@@ -1,0 +1,60 @@
+"""Custom-device plugin seam (ref: paddle/phi/backends/custom/custom_device.cc
++ python/paddle/device/__init__.py CustomPlace plumbing, upstream layout,
+unverified — mount empty).
+
+Paddle's CustomDevice loads a vendor runtime .so implementing its C device
+API. The TPU-native equivalent of "bring your own accelerator runtime" is a
+PJRT plugin: a vendor ships a PJRT C-API library, and the framework
+registers it with the jax runtime — every layer above (ops, jit, meshes,
+collectives) works unchanged because XLA talks PJRT, not device specifics.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["register_custom_device", "list_custom_devices",
+           "is_custom_device_registered"]
+
+_REGISTERED: Dict[str, str] = {}
+
+
+def register_custom_device(device_type: str,
+                           library_path: Optional[str] = None,
+                           priority: int = 400,
+                           options: Optional[Dict] = None) -> None:
+    """Register a PJRT plugin as a paddle custom device.
+
+    `library_path` points at the vendor's PJRT C-API shared library (the
+    CustomDevice runtime .so analog). Must run before any jax computation
+    initializes the backends; select it with
+    ``paddle.device.set_device(device_type)`` /
+    ``JAX_PLATFORMS=<device_type>``.
+    """
+    if not device_type or not device_type.isidentifier():
+        raise ValueError(f"invalid custom device name {device_type!r}")
+    if device_type in _REGISTERED:
+        raise ValueError(
+            f"custom device {device_type!r} is already registered "
+            f"(library: {_REGISTERED[device_type]})")
+    if library_path is None:
+        raise ValueError(
+            "register_custom_device requires library_path to the vendor's "
+            "PJRT C-API shared library")
+    if not os.path.exists(library_path):
+        raise FileNotFoundError(
+            f"PJRT plugin library not found: {library_path}")
+    from jax._src import xla_bridge as xb
+
+    xb.register_plugin(device_type, library_path=library_path,
+                       priority=priority, options=options)
+    _REGISTERED[device_type] = library_path
+
+
+def list_custom_devices() -> List[str]:
+    """Names of custom devices registered through this seam."""
+    return sorted(_REGISTERED)
+
+
+def is_custom_device_registered(device_type: str) -> bool:
+    return device_type in _REGISTERED

@@ -3,3 +3,4 @@
 Ref: python/paddle/incubate/ (upstream layout, unverified — mount empty).
 """
 from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

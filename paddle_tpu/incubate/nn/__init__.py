@@ -1,0 +1,140 @@
+"""paddle.incubate.nn — fused transformer layers (ref:
+python/paddle/incubate/nn/layer/fused_transformer.py, upstream layout,
+unverified — mount empty).
+
+Upstream fuses attention/FFN into single CUDA kernels
+(fused_attention/fused_feedforward ops). On TPU the fusion budget belongs
+to XLA + the Pallas flash kernel: these layers keep the upstream module
+contract (pre/post-LN placement, residual + dropout epilogues, fused QKV
+weight layout) and route the attention core through
+`F.scaled_dot_product_attention` — the Pallas flash path on TPU — while
+XLA fuses the surrounding elementwise epilogues into the matmuls.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import nn
+from ...nn import functional as F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """Pre/post-LN attention block with fused QKV and flash-backed core."""
+
+    def __init__(self, embed_dim: int, num_heads: int, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if (kdim or embed_dim) != embed_dim or (vdim or embed_dim) != embed_dim:
+            raise ValueError("fused attention requires kdim == vdim == "
+                             "embed_dim (upstream contract)")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        if self.head_dim * num_heads != embed_dim:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        # fused [h, 3h] QKV: one MXU matmul instead of three
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierUniform())
+        self.qkv_bias = self.create_parameter([3 * embed_dim], attr=bias_attr,
+                                              is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierUniform())
+        self.linear_bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                                 is_bias=True)
+        self.pre_ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, query, attn_mask=None, cache=None):
+        residual = query
+        x = self.pre_ln(query) if self.normalize_before else query
+        b, s, h = x.shape
+        qkv = x.matmul(self.qkv_weight)
+        if self.qkv_bias is not None:
+            qkv = qkv + self.qkv_bias
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        qkv = qkv.transpose([2, 0, 1, 3, 4])     # 3,b,s,nh,hd (sdpa layout)
+        ctx = F.scaled_dot_product_attention(
+            qkv[0], qkv[1], qkv[2], attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0)
+        out = ctx.reshape([b, s, h]).matmul(self.linear_weight)
+        if self.linear_bias is not None:
+            out = out + self.linear_bias
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    """Pre/post-LN FFN block (linear → act → dropout → linear → residual)."""
+
+    def __init__(self, d_model: int, dim_feedforward: int, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 linear1_weight_attr, linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 linear2_weight_attr, linear2_bias_attr)
+        # ln1 attrs configure the (single) norm of this block; ln2 attrs
+        # only apply when normalize_before=False in upstream's fused op —
+        # same LN either way here, so prefer whichever was given
+        self.norm = nn.LayerNorm(
+            d_model, epsilon=epsilon,
+            weight_attr=(ln1_scale_attr if ln1_scale_attr is not None
+                         else ln2_scale_attr),
+            bias_attr=(ln1_bias_attr if ln1_bias_attr is not None
+                       else ln2_bias_attr))
+        self.dropout = nn.Dropout(dropout_rate)
+        self.act_dropout = nn.Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.norm(src) if self.normalize_before else src
+        x = self.act_dropout(getattr(F, self.activation)(self.linear1(x)))
+        x = residual + self.dropout(self.linear2(x))
+        if not self.normalize_before:
+            x = self.norm(x)
+        return x
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """fused attention + fused FFN, the fused_transformer encoder layer."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout_rate=0.1, activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before, weight_attr=weight_attr,
+            bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

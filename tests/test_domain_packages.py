@@ -451,3 +451,39 @@ def test_check_nan_inf_flag_guards_jitted_paths():
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False})
         assert not jax.config.jax_debug_nans
+
+
+def test_custom_device_plugin_seam(tmp_path):
+    """PJRT-plugin registration seam: validation + bookkeeping (a real
+    vendor .so cannot be loaded hermetically; the registration path into
+    jax's plugin registry is exercised up to the library check)."""
+    import pytest
+
+    from paddle_tpu.device.plugin import (
+        is_custom_device_registered, list_custom_devices,
+        register_custom_device,
+    )
+
+    with pytest.raises(ValueError, match="invalid"):
+        register_custom_device("my-npu!", library_path="x.so")
+    with pytest.raises(ValueError, match="library_path"):
+        register_custom_device("mynpu")
+    with pytest.raises(FileNotFoundError):
+        register_custom_device("mynpu", library_path=str(tmp_path / "no.so"))
+    assert not is_custom_device_registered("mynpu")
+    assert list_custom_devices() == []
+
+
+def test_registered_custom_device_visible_to_device_api(monkeypatch):
+    """A registered plugin must be selectable + discoverable by the rest of
+    the device API (set_device / is_compiled_with_custom_device /
+    get_all_custom_device_type)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.device import plugin
+
+    monkeypatch.setitem(plugin._REGISTERED, "mynpu", "/fake/libpjrt.so")
+    assert paddle.device.is_compiled_with_custom_device("mynpu")
+    assert "mynpu" in paddle.device.get_all_custom_device_type()
+    place = paddle.device.set_device("mynpu")
+    assert place is not None
+    paddle.device.set_device("cpu")

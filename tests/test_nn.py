@@ -242,3 +242,85 @@ class TestInitializers:
 
         w = I.KaimingNormal()((1000, 100), "float32")
         assert abs(float(w.std()) - (2.0 / 1000) ** 0.5) < 5e-3
+
+
+class TestIncubateFusedLayers:
+    def test_fused_mha_matches_manual(self, rng):
+        """Eval-mode fused attention == hand-computed attention with the
+        same fused weights."""
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+        paddle.seed(5)
+        m = FusedMultiHeadAttention(embed_dim=16, num_heads=4,
+                                    dropout_rate=0.0, attn_dropout_rate=0.0)
+        m.eval()
+        x = paddle.to_tensor(rng.standard_normal((2, 6, 16))
+                             .astype(np.float32))
+        out = m(x).numpy()
+
+        xn = x.numpy()
+        qkv = xn @ m.qkv_weight.numpy() + m.qkv_bias.numpy()
+        q, k, v = np.split(qkv.reshape(2, 6, 3, 4, 4), 3, axis=2)
+        ref = np.empty((2, 6, 4, 4), np.float32)
+        for b in range(2):
+            for h in range(4):
+                qs, ks, vs = (t[b, :, 0, h] for t in (q, k, v))
+                sc = qs @ ks.T / 2.0
+                p = np.exp(sc - sc.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                ref[b, :, h] = p @ vs
+        ref = ref.reshape(2, 6, 16) @ m.linear_weight.numpy() \
+            + m.linear_bias.numpy()
+        ref = xn + ref                       # residual (post-LN layout)
+        mean = ref.mean(-1, keepdims=True)
+        var = ref.var(-1, keepdims=True)
+        ref = (ref - mean) / np.sqrt(var + 1e-5) * m.ln.weight.numpy() \
+            + m.ln.bias.numpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_fused_encoder_layer_trains(self, rng):
+        from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+        paddle.seed(6)
+        layer = FusedTransformerEncoderLayer(d_model=16, nhead=4,
+                                             dim_feedforward=32,
+                                             dropout_rate=0.0)
+        x = paddle.to_tensor(rng.standard_normal((2, 5, 16))
+                             .astype(np.float32))
+        out = layer(x)
+        assert out.shape == [2, 5, 16]
+        loss = (out * out).sum()
+        loss.backward()
+        g = layer.fused_attn.qkv_weight.grad
+        assert g is not None and float(np.abs(g.numpy()).max()) > 0
+
+    def test_fused_pre_ln_variant(self, rng):
+        from paddle_tpu.incubate.nn import FusedFeedForward
+
+        ffn = FusedFeedForward(8, 16, dropout_rate=0.0,
+                               normalize_before=True)
+        ffn.eval()
+        x = paddle.to_tensor(rng.standard_normal((1, 3, 8))
+                             .astype(np.float32))
+        out = ffn(x).numpy()
+        xn = x.numpy()
+        mean = xn.mean(-1, keepdims=True)
+        var = xn.var(-1, keepdims=True)
+        ln = (xn - mean) / np.sqrt(var + 1e-5) * ffn.norm.weight.numpy() \
+            + ffn.norm.bias.numpy()
+        h = np.maximum(ln @ ffn.linear1.weight.numpy()
+                       + ffn.linear1.bias.numpy(), 0)
+        ref = xn + h @ ffn.linear2.weight.numpy() + ffn.linear2.bias.numpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_fused_mha_no_bias(self, rng):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+        m = FusedMultiHeadAttention(embed_dim=8, num_heads=2,
+                                    dropout_rate=0.0, attn_dropout_rate=0.0,
+                                    bias_attr=False)
+        m.eval()
+        x = paddle.to_tensor(rng.standard_normal((1, 4, 8))
+                             .astype(np.float32))
+        out = m(x)
+        assert out.shape == [1, 4, 8]

@@ -9,3 +9,6 @@ from .gpt import (  # noqa: F401
     GPTConfig, GPTEmbeddingPipe, GPTForCausalLM, GPTHeadPipe, GPTModel,
     GPTPretrainingCriterion, gpt_pipe_layers,
 )
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel,
+)

@@ -1,0 +1,147 @@
+"""LLaMA-family decoder (ref: the PaddleNLP llama modeling family —
+upstream lives in the PaddleNLP ecosystem; layout unverified — mount empty).
+
+RMSNorm + rotary embeddings + SwiGLU + grouped-query attention, written
+with framework layers so the whole stack (ops.yaml RoPE op, rms_norm op,
+sdpa→Pallas flash on TPU, fleet TP marks) is exercised. TPU notes: GQA
+expands KV heads by repeat before sdpa so the flash kernel sees the
+standard (b, s, heads, hd) layout; all matmuls are [*, h]x[h, *] MXU
+shapes; fp32 trig inside RoPE keeps bf16 activations stable.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32          # < heads → grouped-query attn
+    intermediate_size: int = 11008
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+
+    @classmethod
+    def llama7b(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, num_key_value_heads=2,
+                   intermediate_size=128, max_position_embeddings=64)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_key_value_heads
+        if self.num_heads % self.num_kv_heads != 0:
+            raise ValueError("num_attention_heads must be a multiple of "
+                             "num_key_value_heads")
+        if cfg.hidden_size % cfg.num_attention_heads != 0:
+            raise ValueError("hidden_size must be divisible by "
+                             "num_attention_heads")
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        if self.head_dim % 2 != 0:
+            raise ValueError(f"RoPE needs an even head_dim, got "
+                             f"{self.head_dim}")
+        self.rope_theta = cfg.rope_theta
+        h, kv = cfg.hidden_size, self.num_kv_heads * self.head_dim
+        self.q_proj = nn.Linear(h, h, bias_attr=False)
+        self.k_proj = nn.Linear(h, kv, bias_attr=False)
+        self.v_proj = nn.Linear(h, kv, bias_attr=False)
+        self.o_proj = nn.Linear(h, h, bias_attr=False)
+
+    def forward(self, x):
+        from ..tensor import rotary_position_embedding
+
+        b, s, h = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k = rotary_position_embedding(q, k, theta=self.rope_theta)
+        rep = self.num_heads // self.num_kv_heads
+        if rep > 1:   # GQA: expand KV to full heads for the flash kernel
+            k = k.repeat_interleave(rep, axis=2)
+            v = v.repeat_interleave(rep, axis=2)
+        ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.o_proj(ctx.reshape([b, s, h]))
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                   bias_attr=False)
+        self.up_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                 bias_attr=False)
+        self.down_proj = nn.Linear(cfg.intermediate_size, cfg.hidden_size,
+                                   bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                          epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   epsilon=cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: Optional[LlamaConfig] = None):
+        super().__init__()
+        self.config = cfg or LlamaConfig()
+        cfg = self.config
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        from .ernie import _init_transformer_weights
+
+        _init_transformer_weights(self, 0.02)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: Optional[LlamaConfig] = None):
+        super().__init__()
+        self.llama = LlamaModel(cfg)
+        cfg = self.llama.config
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids):
+        return self.lm_head(self.llama(input_ids))
+
+    def loss(self, logits, labels):
+        vocab = logits.shape[-1]
+        return F.cross_entropy(
+            logits[:, :-1].reshape([-1, vocab]),
+            labels[:, 1:].reshape([-1]))

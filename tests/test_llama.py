@@ -1,0 +1,103 @@
+"""LLaMA family: RMSNorm + RoPE + SwiGLU + GQA (models/llama.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.tensor as T
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, LlamaModel
+
+
+class TestRoPE:
+    def test_rotation_preserves_norm_and_relative_phase(self, rng):
+        q = paddle.to_tensor(rng.standard_normal((1, 8, 2, 16))
+                             .astype(np.float32))
+        qr, kr = T.rotary_position_embedding(q, q)
+        np.testing.assert_allclose((qr.numpy() ** 2).sum(-1),
+                                   (q.numpy() ** 2).sum(-1), rtol=1e-5)
+        # relative property: <R(p)x, R(p+k)y> depends only on k — compare
+        # dot of rotated pairs at two absolute offsets
+        x = rng.standard_normal((1, 1, 1, 16)).astype(np.float32)
+        y = rng.standard_normal((1, 1, 1, 16)).astype(np.float32)
+        big = np.concatenate([x, y, x, y] * 2, axis=1).astype(np.float32)
+        r, _ = T.rotary_position_embedding(paddle.to_tensor(big),
+                                           paddle.to_tensor(big))
+        r = r.numpy()[0, :, 0]
+        d02 = float(r[0] @ r[1])   # offset 1 at positions (0,1)
+        d24 = float(r[2] @ r[3])   # offset 1 at positions (2,3)
+        np.testing.assert_allclose(d02, d24, rtol=1e-4)
+
+    def test_position_offset_continuation(self, rng):
+        x = paddle.to_tensor(rng.standard_normal((1, 8, 1, 8))
+                             .astype(np.float32))
+        full, _ = T.rotary_position_embedding(x, x)
+        tail, _ = T.rotary_position_embedding(x[:, 4:], x[:, 4:],
+                                              position_offset=4)
+        np.testing.assert_allclose(tail.numpy(), full.numpy()[:, 4:],
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestLlama:
+    def test_causality(self):
+        model = LlamaModel(LlamaConfig.tiny())
+        model.eval()
+        ids = np.arange(12, dtype=np.int64).reshape(1, 12) % 512
+        base = model(paddle.to_tensor(ids)).numpy()
+        ids2 = ids.copy()
+        ids2[0, 5] = 400
+        pert = model(paddle.to_tensor(ids2)).numpy()
+        delta = np.abs(pert - base).reshape(12, -1).max(axis=1)
+        assert np.all(delta[:5] == 0.0)
+        assert np.all(delta[5:] > 0.0)
+
+    def test_gqa_matches_mha_when_kv_repeated(self, rng):
+        """GQA with kv groups == plain MHA when K/V projections are
+        tiled copies across the groups."""
+        cfg_g = LlamaConfig(vocab_size=128, hidden_size=32,
+                            num_hidden_layers=1, num_attention_heads=4,
+                            num_key_value_heads=2, intermediate_size=64,
+                            max_position_embeddings=32)
+        cfg_m = LlamaConfig(**{**dataclass_asdict(cfg_g),
+                               "num_key_value_heads": 4})
+        paddle.seed(9)
+        g = LlamaModel(cfg_g)
+        paddle.seed(9)
+        m = LlamaModel(cfg_m)
+        # copy shared weights; build MHA's k/v by repeating GQA's per group
+        gs, ms = dict(g.named_parameters()), dict(m.named_parameters())
+        for name, p in ms.items():
+            if ".k_proj." in name or ".v_proj." in name:
+                src = gs[name].numpy()          # [h, 2*hd]
+                hd = cfg_g.hidden_size // 4
+                blocks = [src[:, i * hd:(i + 1) * hd] for i in range(2)]
+                tiled = np.concatenate([blocks[0], blocks[0],
+                                        blocks[1], blocks[1]], axis=1)
+                p._data = paddle.to_tensor(tiled)._data
+            else:
+                p._data = gs[name]._data
+        g.eval(), m.eval()
+        ids = paddle.to_tensor(np.arange(8, dtype=np.int64)
+                               .reshape(1, 8) % 128)
+        np.testing.assert_allclose(g(ids).numpy(), m(ids).numpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_causal_lm_trains(self, rng):
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        ids = paddle.to_tensor(
+            rng.integers(0, 512, (2, 16)).astype(np.int64))
+        losses = []
+        for _ in range(5):
+            logits = model(ids)
+            loss = model.loss(logits, ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+def dataclass_asdict(cfg):
+    import dataclasses
+
+    return dataclasses.asdict(cfg)

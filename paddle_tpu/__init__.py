@@ -46,7 +46,7 @@ _SUBPACKAGES = [
     "nn", "optimizer", "io", "metric", "vision", "amp", "static", "jit",
     "distributed", "device", "profiler", "incubate", "sparse", "framework",
     "hapi", "text", "audio", "distribution", "quantization", "utils",
-    "inference",
+    "inference", "linalg", "fft",
 ]
 import importlib as _importlib
 

@@ -1,0 +1,30 @@
+"""paddle.fft namespace (ref: python/paddle/fft.py, upstream layout,
+unverified — mount empty). Transform ops live in ops.yaml (registry ops →
+eager/static/jit all work); the frequency-grid helpers are creation-style
+functions over jnp.fft.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .tensor import (  # noqa: F401
+    fft, fft2, fftn, fftshift, hfft, ifft, ifft2, ifftn, ifftshift, ihfft,
+    irfft, irfft2, irfftn, rfft, rfft2, rfftn,
+)
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+    "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftshift",
+    "ifftshift", "fftfreq", "rfftfreq",
+]
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return Tensor(out.astype(dtype) if dtype else out)

@@ -1,0 +1,36 @@
+"""paddle.linalg namespace (ref: python/paddle/linalg.py — upstream re-
+exports tensor.linalg; layout unverified — mount empty). Every function is
+a registry op (hand-written jnp or ops.yaml codegen), so eager tape /
+static capture / jit all work through the same dispatch; names absent from
+the paddle.tensor namespace resolve straight off the registry.
+"""
+from __future__ import annotations
+
+from .tensor import _make_fn
+
+__all__ = [
+    "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "eig",
+    "eigh", "eigvals", "eigvalsh", "householder_product", "inner", "inv",
+    "inverse", "lstsq", "lu", "lu_unpack", "matrix_exp", "matrix_power",
+    "matrix_rank", "multi_dot", "norm", "outer", "pinv", "qr", "slogdet",
+    "solve", "svd", "tensordot", "triangular_solve", "vecdot",
+    "vector_norm", "matrix_norm",
+]
+
+_OP_NAMES = {name: name for name in __all__
+             if name not in ("inv", "vector_norm", "matrix_norm")}
+_OP_NAMES["inv"] = "inverse"
+
+
+_g = globals()
+for _name, _opname in _OP_NAMES.items():
+    _g[_name] = _make_fn(_opname)
+del _g, _name, _opname
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)  # noqa: F821
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)  # noqa: F821

@@ -1,0 +1,144 @@
+"""paddle.linalg / paddle.fft namespaces (SURVEY §2.2 Tensor-API row)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, linalg
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestLinalg:
+    def test_namespace_surface(self):
+        for name in ("cholesky", "svd", "qr", "eigh", "solve", "pinv",
+                     "matrix_exp", "lu", "lu_unpack", "det", "inv"):
+            assert callable(getattr(linalg, name))
+
+    def test_cholesky_solve(self, rng):
+        a = rng.standard_normal((4, 4)).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        b = rng.standard_normal((4, 2)).astype(np.float32)
+        chol = linalg.cholesky(_t(spd))
+        x = linalg.cholesky_solve(_t(b), chol).numpy()
+        np.testing.assert_allclose(spd @ x, b, rtol=1e-3, atol=1e-4)
+
+    def test_eig_reconstructs(self, rng):
+        a = rng.standard_normal((3, 3)).astype(np.float32)
+        w, v = linalg.eig(_t(a))
+        wn, vn = w.numpy(), v.numpy()
+        np.testing.assert_allclose(a.astype(np.complex64) @ vn, vn * wn,
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.sort_complex(
+            linalg.eigvals(_t(a)).numpy()), np.sort_complex(wn),
+            rtol=1e-3, atol=1e-4)
+
+    def test_matrix_exp(self):
+        a = np.zeros((2, 2), np.float32)
+        np.testing.assert_allclose(linalg.matrix_exp(_t(a)).numpy(),
+                                   np.eye(2), rtol=1e-6)
+        d = np.diag([1.0, 2.0]).astype(np.float32)
+        np.testing.assert_allclose(linalg.matrix_exp(_t(d)).numpy(),
+                                   np.diag(np.exp([1.0, 2.0])), rtol=1e-5)
+
+    def test_lu_unpack_roundtrip(self, rng):
+        a = rng.standard_normal((4, 4)).astype(np.float32) \
+            + 4 * np.eye(4, dtype=np.float32)
+        lu_packed, piv = linalg.lu(_t(a))
+        p, l, u = linalg.lu_unpack(lu_packed, piv)
+        np.testing.assert_allclose(
+            p.numpy() @ l.numpy() @ u.numpy(), a, rtol=1e-3, atol=1e-4)
+
+    def test_householder_product_orthonormal(self, rng):
+        a = rng.standard_normal((4, 3)).astype(np.float32)
+        # LAPACK geqrf storage (packed reflectors + tau) via scipy raw mode
+        import scipy.linalg as sl
+
+        (h, tau), _ = sl.qr(a, mode="raw"), None
+        h, tau = np.asarray(h[0]), np.asarray(h[1])
+        q = linalg.householder_product(
+            _t(h.astype(np.float32)), _t(tau.astype(np.float32))).numpy()
+        np.testing.assert_allclose(q.T @ q, np.eye(3), rtol=1e-3, atol=1e-4)
+        r = np.triu(h)[:3]
+        np.testing.assert_allclose(q @ r, a, rtol=1e-3, atol=1e-4)
+
+    def test_vector_matrix_norm(self, rng):
+        v = rng.standard_normal(5).astype(np.float32)
+        np.testing.assert_allclose(linalg.vector_norm(_t(v)).numpy(),
+                                   np.linalg.norm(v), rtol=1e-5)
+        m = rng.standard_normal((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(linalg.matrix_norm(_t(m)).numpy(),
+                                   np.linalg.norm(m), rtol=1e-5)
+
+
+class TestFFT:
+    def test_roundtrip_and_reference(self, rng):
+        x = rng.standard_normal(16).astype(np.float32)
+        f = fft.fft(_t(x))
+        np.testing.assert_allclose(f.numpy(), np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-4)
+        back = fft.ifft(f).numpy()
+        np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-5)
+
+    def test_rfft_family(self, rng):
+        x = rng.standard_normal((2, 16)).astype(np.float32)
+        r = fft.rfft(_t(x))
+        np.testing.assert_allclose(r.numpy(), np.fft.rfft(x, axis=-1),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(fft.irfft(r, n=16).numpy(), x,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_2d_and_shift(self, rng):
+        x = rng.standard_normal((4, 4)).astype(np.float32)
+        np.testing.assert_allclose(fft.fft2(_t(x)).numpy(), np.fft.fft2(x),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(fft.fftshift(_t(x)).numpy(),
+                                   np.fft.fftshift(x))
+
+    def test_freq_grids(self):
+        np.testing.assert_allclose(fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5))
+        np.testing.assert_allclose(fft.rfftfreq(8).numpy(),
+                                   np.fft.rfftfreq(8))
+
+    def test_grad_through_rfft(self, rng):
+        x = paddle.to_tensor(rng.standard_normal(8).astype(np.float32))
+        x.stop_gradient = False
+        mag = (fft.rfft(x).abs() ** 2).sum()
+        mag.backward()
+        # Parseval-ish: gradient exists and is finite
+        assert np.all(np.isfinite(x.grad.numpy()))
+        assert float(np.abs(x.grad.numpy()).max()) > 0
+
+
+class TestReviewRegressions:
+    def test_householder_product_complex_unitary(self, rng):
+        import scipy.linalg as sl
+
+        a = (rng.standard_normal((4, 3))
+             + 1j * rng.standard_normal((4, 3))).astype(np.complex64)
+        h, tau = sl.qr(a, mode="raw")[0]
+        q = linalg.householder_product(
+            _t(np.asarray(h).astype(np.complex64)),
+            _t(np.asarray(tau).astype(np.complex64))).numpy()
+        np.testing.assert_allclose(q.conj().T @ q, np.eye(3), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_lu_unpack_rectangular(self, rng):
+        a = rng.standard_normal((4, 3)).astype(np.float32)
+        lu_p, piv = linalg.lu(_t(a))
+        p, l, u = linalg.lu_unpack(lu_p, piv)
+        assert l.shape == [4, 3] and u.shape == [3, 3]
+        np.testing.assert_allclose(p.numpy() @ l.numpy() @ u.numpy(), a,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_lu_unpack_flags(self, rng):
+        a = rng.standard_normal((3, 3)).astype(np.float32) \
+            + 3 * np.eye(3, dtype=np.float32)
+        lu_p, piv = linalg.lu(_t(a))
+        p, l, u = linalg.lu_unpack(lu_p, piv, unpack_ludata=False)
+        assert l.shape == [0, 0] and u.shape == [0, 0]
+        assert p.shape == [3, 3]
+        p2, l2, u2 = linalg.lu_unpack(lu_p, piv, unpack_pivots=False)
+        assert p2.shape == [0, 0] and l2.shape == [3, 3]

@@ -79,7 +79,12 @@ def _keep_mask(pltpu, seed_ref, b_, h_, qi, ki, shape, dropout_p,
             key = jax.random.fold_in(key, t)
         bits = jax.random.bits(key, shape, jnp.uint32)
     else:
-        pltpu.prng_seed(seed_ref[0], b_, h_, qi, ki)
+        # Mosaic's prng_set_seed_32 takes at most 2 seed words; fold the
+        # 4 block coordinates into one i32 with odd-constant mixing
+        # (wrapping int32 arithmetic decorrelates neighboring blocks)
+        mixed = (b_ * jnp.int32(-1640531527)) ^ (h_ * jnp.int32(97) +
+                 qi * jnp.int32(1000003)) ^ (ki * jnp.int32(13176917))
+        pltpu.prng_seed(seed_ref[0], mixed)
         # prng_random_bits returns SIGNED int32 (jax 0.9 abstract eval) —
         # compare in uint32 or half the bits sit below any uint threshold
         bits = pltpu.prng_random_bits(shape).astype(jnp.uint32)

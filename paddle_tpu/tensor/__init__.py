@@ -17,7 +17,15 @@ from .random import (  # noqa: F401
 )
 
 
+_FN_CACHE: dict = {}
+
+
 def _make_fn(opname):
+    # memoized: every namespace re-exporting an op shares ONE function
+    # object (paddle.norm is paddle.linalg.norm), so patching/identity
+    # checks see a single patchable object per op
+    if opname in _FN_CACHE:
+        return _FN_CACHE[opname]
     op = get_op(opname)
 
     def fn(*args, **kwargs):
@@ -26,6 +34,7 @@ def _make_fn(opname):
     fn.__name__ = opname
     fn.__qualname__ = opname
     fn.__doc__ = (op.fn.__doc__ or "") + f"\n\n(framework op {opname!r})"
+    _FN_CACHE[opname] = fn
     return fn
 
 

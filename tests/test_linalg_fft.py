@@ -55,8 +55,8 @@ class TestLinalg:
         # LAPACK geqrf storage (packed reflectors + tau) via scipy raw mode
         import scipy.linalg as sl
 
-        (h, tau), _ = sl.qr(a, mode="raw"), None
-        h, tau = np.asarray(h[0]), np.asarray(h[1])
+        h, tau = sl.qr(a, mode="raw")[0]
+        h, tau = np.asarray(h), np.asarray(tau)
         q = linalg.householder_product(
             _t(h.astype(np.float32)), _t(tau.astype(np.float32))).numpy()
         np.testing.assert_allclose(q.T @ q, np.eye(3), rtol=1e-3, atol=1e-4)
@@ -113,6 +113,17 @@ class TestFFT:
 
 
 class TestReviewRegressions:
+    def test_householder_product_batched_raises(self, rng):
+        x = _t(rng.standard_normal((2, 4, 3)).astype(np.float32))
+        tau = _t(rng.standard_normal((2, 3)).astype(np.float32))
+        with pytest.raises(NotImplementedError):
+            linalg.householder_product(x, tau)
+
+    def test_linalg_shares_tensor_namespace_objects(self):
+        import paddle_tpu as paddle
+        assert paddle.linalg.norm is paddle.tensor.norm
+        assert paddle.linalg.cholesky is paddle.tensor.cholesky
+
     def test_householder_product_complex_unitary(self, rng):
         import scipy.linalg as sl
 

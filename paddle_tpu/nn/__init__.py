@@ -3,7 +3,8 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer.layers import Layer, ParamAttr  # noqa: F401
 from .layer.common import (  # noqa: F401
-    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+    AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
+    Dropout2D, Dropout3D,
     Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, PixelShuffle,
     Unflatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     ZeroPad2D,

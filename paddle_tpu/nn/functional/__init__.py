@@ -346,7 +346,13 @@ def upsample(x, size=None, scale_factor=None, mode="nearest",
 
 
 def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
-    return apply_op(_op("pixel_shuffle"), x, upscale_factor=upscale_factor)
+    return apply_op(_op("pixel_shuffle"), x, upscale_factor=upscale_factor,
+                    data_format=data_format)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return apply_op(_op("channel_shuffle"), x, groups=groups,
+                    data_format=data_format)
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):

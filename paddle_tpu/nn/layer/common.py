@@ -207,6 +207,16 @@ class PixelShuffle(Layer):
         return F.pixel_shuffle(x, self.upscale_factor)
 
 
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
 class Bilinear(Layer):
     def __init__(self, in1_features, in2_features, out_features,
                  weight_attr=None, bias_attr=None, name=None):

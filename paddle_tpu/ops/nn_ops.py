@@ -799,11 +799,31 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 @register_op("pixel_shuffle")
 def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
     r = upscale_factor
+    if data_format == "NHWC":
+        n, h, w, c = x.shape
+        oc = c // (r * r)
+        out = x.reshape(n, h, w, r, r, oc)
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h * r, w * r, oc)
     n, c, h, w = x.shape
     oc = c // (r * r)
     out = x.reshape(n, oc, r, r, h, w)
     out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
     return out.reshape(n, oc, h * r, w * r)
+
+
+@register_op("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW"):
+    """Interleave channels across `groups` (ShuffleNet block glue; ref:
+    paddle.nn.functional.channel_shuffle, upstream phi kernel — mount
+    empty). Pure reshape/transpose: XLA lowers it to a free relayout."""
+    if data_format == "NHWC":
+        n, h, w, c = x.shape
+        out = x.reshape(n, h, w, groups, c // groups)
+        return jnp.swapaxes(out, 3, 4).reshape(n, h, w, c)
+    n, c, h, w = x.shape
+    out = x.reshape(n, groups, c // groups, h, w)
+    return jnp.swapaxes(out, 1, 2).reshape(n, c, h, w)
 
 
 @register_op("unfold")

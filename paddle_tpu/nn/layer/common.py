@@ -202,9 +202,10 @@ class PixelShuffle(Layer):
     def __init__(self, upscale_factor, data_format="NCHW", name=None):
         super().__init__()
         self.upscale_factor = upscale_factor
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.pixel_shuffle(x, self.upscale_factor)
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
 
 
 class ChannelShuffle(Layer):

@@ -800,10 +800,11 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
     r = upscale_factor
     if data_format == "NHWC":
+        # channel dim factors as (oc, r, r), matching the NCHW semantics
         n, h, w, c = x.shape
         oc = c // (r * r)
-        out = x.reshape(n, h, w, r, r, oc)
-        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        out = x.reshape(n, h, w, oc, r, r)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
         return out.reshape(n, h * r, w * r, oc)
     n, c, h, w = x.shape
     oc = c // (r * r)

@@ -47,8 +47,10 @@ _FN_EXPORTS = [
     "tanh", "asinh", "acosh", "atanh", "sigmoid", "erf", "erfinv", "floor",
     "ceil", "trunc", "frac", "sign", "reciprocal", "square", "clip", "lerp",
     "logit", "nan_to_num", "conj", "angle", "real", "imag", "digamma",
-    "lgamma", "i0", "sinc", "deg2rad", "rad2deg", "heaviside", "hypot",
-    "copysign", "logaddexp", "stanh", "multiply_scalar", "pow_scalar",
+    "lgamma", "gammaln", "polygamma", "i0", "sinc", "deg2rad", "rad2deg",
+    "heaviside", "hypot",
+    "copysign", "ldexp", "logaddexp", "stanh", "multiply_scalar",
+    "pow_scalar",
     "sum", "mean", "max", "min", "amax", "amin", "prod", "all", "any",
     "argmax", "argmin", "logsumexp", "std", "var", "median", "nanmean",
     "nansum", "count_nonzero", "cumsum", "cumprod", "logcumsumexp", "cummax",
@@ -56,6 +58,7 @@ _FN_EXPORTS = [
     "greater_equal", "equal_all", "isclose", "allclose", "isnan", "isinf",
     "isfinite", "logical_and", "logical_or", "logical_xor", "logical_not",
     "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
     "reshape", "transpose", "flatten", "squeeze", "unsqueeze", "concat",
     "stack", "split", "unbind", "expand", "broadcast_to", "expand_as",
     "tile", "cast", "gather", "gather_nd", "index_select", "index_sample",

@@ -8,6 +8,7 @@ pre-allocated feature buffer like CUDA implementations use).
 from __future__ import annotations
 
 from ... import nn
+from ...tensor import concat
 from ._utils import check_pretrained
 
 __all__ = [
@@ -57,13 +58,12 @@ class _DenseBlock(nn.Layer):
         ])
 
     def forward(self, x):
-        import paddle_tpu as paddle
         features = [x]
         for layer in self.layers:
-            new = layer(paddle.concat(features, axis=1)
+            new = layer(concat(features, axis=1)
                         if len(features) > 1 else features[0])
             features.append(new)
-        return paddle.concat(features, axis=1)
+        return concat(features, axis=1)
 
 
 class _Transition(nn.Layer):
@@ -119,7 +119,6 @@ class DenseNet(nn.Layer):
             self.classifier = nn.Linear(num_features, num_classes)
 
     def forward(self, x):
-        import paddle_tpu as paddle
         x = self.pool0(self.relu0(self.norm0(self.conv0(x))))
         for i, block in enumerate(self.blocks):
             x = block(x)
@@ -129,7 +128,7 @@ class DenseNet(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            x = paddle.flatten(x, 1)
+            x = x.flatten(1)
             x = self.classifier(x)
         return x
 

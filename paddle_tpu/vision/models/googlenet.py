@@ -5,6 +5,7 @@ caller weighs them (paddle convention)."""
 from __future__ import annotations
 
 from ... import nn
+from ...tensor import concat
 from ._utils import ConvBNReLU, check_pretrained
 
 __all__ = ["GoogLeNet", "googlenet"]
@@ -22,8 +23,7 @@ class _Inception(nn.Layer):
                                      ConvBNReLU(in_ch, proj, 1))
 
     def forward(self, x):
-        import paddle_tpu as paddle
-        return paddle.concat([self.branch1(x), self.branch2(x),
+        return concat([self.branch1(x), self.branch2(x),
                               self.branch3(x), self.branch4(x)], axis=1)
 
 
@@ -38,9 +38,8 @@ class _AuxHead(nn.Layer):
         self.fc2 = nn.Linear(1024, num_classes)
 
     def forward(self, x):
-        import paddle_tpu as paddle
         x = self.conv(self.pool(x))
-        x = paddle.flatten(x, 1)
+        x = x.flatten(1)
         x = self.dropout(self.relu(self.fc1(x)))
         return self.fc2(x)
 
@@ -77,7 +76,6 @@ class GoogLeNet(nn.Layer):
             self.aux2 = _AuxHead(528, num_classes)
 
     def forward(self, x):
-        import paddle_tpu as paddle
         x = self.stem(x)
         x = self.pool3(self.inc3b(self.inc3a(x)))
         x = self.inc4a(x)
@@ -89,7 +87,7 @@ class GoogLeNet(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            x = paddle.flatten(x, 1)
+            x = x.flatten(1)
             x = self.fc(self.dropout(x))
             return x, aux1, aux2
         return x

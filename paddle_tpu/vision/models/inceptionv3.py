@@ -4,6 +4,7 @@ inference; paddle's InceptionV3 omits aux entirely)."""
 from __future__ import annotations
 
 from ... import nn
+from ...tensor import concat
 from ._utils import ConvBNReLU, check_pretrained
 
 __all__ = ["InceptionV3", "inception_v3"]
@@ -11,7 +12,7 @@ __all__ = ["InceptionV3", "inception_v3"]
 
 def _cat(tensors):
     import paddle_tpu as paddle
-    return paddle.concat(tensors, axis=1)
+    return concat(tensors, axis=1)
 
 
 class _InceptionA(nn.Layer):
@@ -150,13 +151,12 @@ class InceptionV3(nn.Layer):
             self.fc = nn.Linear(2048, num_classes)
 
     def forward(self, x):
-        import paddle_tpu as paddle
         x = self.blocks(self.stem(x))
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
             x = self.dropout(x)
-            x = paddle.flatten(x, 1)
+            x = x.flatten(1)
             x = self.fc(x)
         return x
 

@@ -5,6 +5,7 @@ which XLA folds into adjacent convs — no explicit gather on TPU."""
 from __future__ import annotations
 
 from ... import nn
+from ...tensor import concat
 from ._utils import check_pretrained
 from ...nn import functional as F
 
@@ -47,10 +48,9 @@ class _InvertedResidual(nn.Layer):
         )
 
     def forward(self, x):
-        import paddle_tpu as paddle
         half = x.shape[1] // 2
         x1, x2 = x[:, :half], x[:, half:]
-        out = paddle.concat([x1, self.branch_main(x2)], axis=1)
+        out = concat([x1, self.branch_main(x2)], axis=1)
         return F.channel_shuffle(out, 2)
 
 
@@ -79,8 +79,7 @@ class _InvertedResidualDS(nn.Layer):
         )
 
     def forward(self, x):
-        import paddle_tpu as paddle
-        out = paddle.concat([self.branch_proj(x), self.branch_main(x)],
+        out = concat([self.branch_proj(x), self.branch_main(x)],
                             axis=1)
         return F.channel_shuffle(out, 2)
 
@@ -122,7 +121,6 @@ class ShuffleNetV2(nn.Layer):
             self.fc = nn.Linear(out_ch[-1], num_classes)
 
     def forward(self, x):
-        import paddle_tpu as paddle
         x = self.max_pool(self.conv1(x))
         for stage in self.stages:
             x = stage(x)
@@ -130,7 +128,7 @@ class ShuffleNetV2(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            x = paddle.flatten(x, 1)
+            x = x.flatten(1)
             x = self.fc(x)
         return x
 

@@ -3,6 +3,7 @@ layout, unverified — mount empty): versions 1.0 and 1.1."""
 from __future__ import annotations
 
 from ... import nn
+from ...tensor import concat
 from ._utils import check_pretrained
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
@@ -19,9 +20,8 @@ class _Fire(nn.Layer):
         self.relu = nn.ReLU()
 
     def forward(self, x):
-        import paddle_tpu as paddle
         x = self.relu(self.squeeze(x))
-        return paddle.concat(
+        return concat(
             [self.relu(self.expand1x1(x)), self.relu(self.expand3x3(x))],
             axis=1)
 
@@ -60,14 +60,13 @@ class SqueezeNet(nn.Layer):
             self.avgpool = nn.AdaptiveAvgPool2D(1)
 
     def forward(self, x):
-        import paddle_tpu as paddle
         x = self.features(x)
         if self.num_classes > 0:
             x = self.classifier_relu(
                 self.final_conv(self.classifier_dropout(x)))
         if self.with_pool:
             x = self.avgpool(x)
-            x = paddle.flatten(x, 1)
+            x = x.flatten(1)
         return x
 
 

@@ -33,7 +33,7 @@ class TestChannelShuffle:
 
     def test_pixel_shuffle_nhwc(self, rng):
         # regression: F.pixel_shuffle dropped data_format (review finding)
-        x = rng.standard_normal((1, 2, 2, 4)).astype(np.float32)
+        x = rng.standard_normal((1, 2, 2, 8)).astype(np.float32)
         out = F.pixel_shuffle(paddle.to_tensor(x), 2, "NHWC").numpy()
         nchw = F.pixel_shuffle(
             paddle.to_tensor(x.transpose(0, 3, 1, 2)), 2).numpy()

@@ -230,3 +230,119 @@ class TestAutogradAndStatic:
                 static.disable_static()
         finally:
             del _OPS["_test_eager_only"]
+
+
+class TestRound3BreadthOps:
+    """Round-3 API-breadth additions (cummin/isin/nanmedian/scatter family/
+    combinations/unique_consecutive/histogramdd/special fns)."""
+
+    def test_cummin_matches_numpy(self, rng):
+        x = rng.standard_normal(17).astype(np.float32)
+        v, i = paddle.cummin(_t(x))
+        np.testing.assert_allclose(v.numpy(), np.minimum.accumulate(x),
+                                   rtol=1e-6)
+        # indices point at the first occurrence of each running min
+        np.testing.assert_array_equal(x[i.numpy()], np.minimum.accumulate(x))
+
+    def test_cummin_ties_keep_first_index(self):
+        v, i = paddle.cummin(_t(np.float32([2.0, 1.0, 1.0, 1.0])))
+        np.testing.assert_array_equal(i.numpy(), [0, 1, 1, 1])
+
+    def test_cummin_axis(self, rng):
+        x = rng.standard_normal((3, 5)).astype(np.float32)
+        v, i = paddle.cummin(_t(x), axis=1)
+        np.testing.assert_allclose(v.numpy(),
+                                   np.minimum.accumulate(x, axis=1),
+                                   rtol=1e-6)
+
+    def test_isin_and_invert(self):
+        x = _t(np.array([1, 2, 3, 4]))
+        np.testing.assert_array_equal(
+            paddle.isin(x, _t(np.array([2, 4]))).numpy(),
+            [False, True, False, True])
+        np.testing.assert_array_equal(
+            paddle.isin(x, _t(np.array([2, 4])), invert=True).numpy(),
+            [True, False, True, False])
+
+    def test_ldexp(self):
+        out = paddle.ldexp(_t(np.float32([1.0, 2.0])),
+                           _t(np.array([2, 3], np.int32)))
+        np.testing.assert_allclose(out.numpy(), [4.0, 16.0])
+
+    def test_nanmedian(self):
+        x = _t(np.float32([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]]))
+        np.testing.assert_allclose(paddle.nanmedian(x).numpy(), 3.5)
+        np.testing.assert_allclose(paddle.nanmedian(x, axis=1).numpy(),
+                                   [2.0, 4.5])
+
+    def test_bitwise_shifts(self):
+        x = _t(np.array([1, 4]))
+        np.testing.assert_array_equal(
+            paddle.bitwise_left_shift(x, _t(np.array([2, 1]))).numpy(),
+            [4, 8])
+        np.testing.assert_array_equal(
+            paddle.bitwise_right_shift(x, _t(np.array([0, 2]))).numpy(),
+            [1, 1])
+
+    def test_slice_scatter(self):
+        out = paddle.slice_scatter(
+            _t(np.zeros((2, 6), np.float32)),
+            _t(np.ones((2, 2), np.float32)), [1], [1], [5], [2])
+        ref = np.zeros((2, 6), np.float32)
+        ref[:, 1:5:2] = 1.0
+        np.testing.assert_array_equal(out.numpy(), ref)
+
+    def test_diagonal_scatter_offsets(self):
+        base = np.zeros((4, 4), np.float32)
+        for off in (-1, 0, 2):
+            k = 4 - abs(off)
+            out = paddle.diagonal_scatter(
+                _t(base), _t(np.arange(1, k + 1, dtype=np.float32)),
+                offset=off)
+            ref = base.copy()
+            rows = np.arange(k) + (-off if off < 0 else 0)
+            cols = np.arange(k) + (off if off > 0 else 0)
+            ref[rows, cols] = np.arange(1, k + 1)
+            np.testing.assert_array_equal(out.numpy(), ref)
+
+    def test_combinations(self):
+        out = paddle.combinations(_t(np.array([1, 2, 3])), 2)
+        assert out.numpy().tolist() == [[1, 2], [1, 3], [2, 3]]
+        outr = paddle.combinations(_t(np.array([1, 2])), 2,
+                                   with_replacement=True)
+        assert outr.numpy().tolist() == [[1, 1], [1, 2], [2, 2]]
+
+    def test_unique_consecutive(self):
+        u, inv, cnt = paddle.unique_consecutive(
+            _t(np.array([1, 1, 2, 2, 2, 3, 1])), return_inverse=True,
+            return_counts=True)
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3, 1])
+        np.testing.assert_array_equal(inv.numpy(), [0, 0, 1, 1, 1, 2, 3])
+        np.testing.assert_array_equal(cnt.numpy(), [2, 3, 1, 1])
+
+    def test_histogramdd_matches_numpy(self, rng):
+        x = rng.random((30, 2)).astype(np.float32)
+        out = paddle.histogramdd(_t(x), bins=4)
+        ref_h, ref_e = np.histogramdd(x, bins=4)
+        np.testing.assert_allclose(out[0].numpy(), ref_h)
+        for got, want in zip(out[1:], ref_e):
+            np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+    def test_special_functions(self):
+        import scipy.special as sp
+        x = np.float32([0.5, 1.5, 2.5])
+        np.testing.assert_allclose(paddle.gammaln(_t(x)).numpy(),
+                                   sp.gammaln(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.polygamma(_t(x), 1).numpy(),
+                                   sp.polygamma(1, x), rtol=1e-4)
+        np.testing.assert_allclose(paddle.i0e(_t(x)).numpy(), sp.i0e(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.i1(_t(x)).numpy(), sp.i1(x),
+                                   rtol=1e-5)
+
+    def test_cummin_grad_flows(self):
+        x = _t(np.float32([3.0, 1.0, 2.0]), sg=False)
+        v, _ = paddle.cummin(x)
+        v.sum().backward()
+        # d(sum of running min)/dx: x0 contributes once, x1 twice, x2 never
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 2.0, 0.0])

@@ -85,6 +85,17 @@ for _name in _YAML_GENERATED:
         _g[_name] = _make_fn(_name)
 del _YAML_GENERATED
 
+_histogramdd_op = _make_fn("histogramdd")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """paddle contract: (hist, [edges_0, ..., edges_{D-1}]) — the generated
+    op returns a flat tuple whose arity varies with D, so re-pack here."""
+    out = _histogramdd_op(x, bins=bins, ranges=ranges, density=density,
+                          weights=weights)
+    return out[0], list(out[1:])
+
 
 def pow(x, y):
     if isinstance(y, (int, float)):

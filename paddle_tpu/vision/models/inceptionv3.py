@@ -11,7 +11,6 @@ __all__ = ["InceptionV3", "inception_v3"]
 
 
 def _cat(tensors):
-    import paddle_tpu as paddle
     return concat(tensors, axis=1)
 
 

@@ -1,0 +1,189 @@
+"""Detection ops round 3 (deform_conv2d / yolo_box / prior_box / box_coder /
+matrix_nms) — behavioral tests per SURVEY §4 op-test strategy: closed-form
+NumPy references where available, identity reductions elsewhere."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestDeformConv2D:
+    def test_zero_offset_equals_conv(self, rng):
+        x = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)
+        w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+        off = np.zeros((2, 18, 8, 8), np.float32)
+        out = V.deform_conv2d(_t(x), _t(off), _t(w), padding=1)
+        ref = F.conv2d(_t(x), _t(w), padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self, rng):
+        # a (+0, +1) offset on a 1x1 kernel samples the pixel to the right
+        x = rng.standard_normal((1, 1, 5, 5)).astype(np.float32)
+        w = np.ones((1, 1, 1, 1), np.float32)
+        off = np.zeros((1, 2, 5, 5), np.float32)
+        off[:, 1] = 1.0  # x-offset
+        out = V.deform_conv2d(_t(x), _t(off), _t(w)).numpy()
+        ref = np.zeros_like(x)
+        ref[..., :, :-1] = x[..., :, 1:]  # right neighbor; 0 at the border
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_mask_halves_output(self, rng):
+        x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 3, 3)).astype(np.float32)
+        off = np.zeros((1, 18, 6, 6), np.float32)
+        mask = np.full((1, 9, 6, 6), 0.5, np.float32)
+        full = V.deform_conv2d(_t(x), _t(off), _t(w), padding=1)
+        halved = V.deform_conv2d(_t(x), _t(off), _t(w), padding=1,
+                                 mask=_t(mask))
+        np.testing.assert_allclose(halved.numpy(), full.numpy() * 0.5,
+                                   atol=1e-5)
+
+    def test_groups_and_stride(self, rng):
+        x = rng.standard_normal((1, 4, 8, 8)).astype(np.float32)
+        w = rng.standard_normal((4, 2, 3, 3)).astype(np.float32)
+        off = np.zeros((1, 18, 4, 4), np.float32)
+        out = V.deform_conv2d(_t(x), _t(off), _t(w), stride=2, padding=1,
+                              groups=2)
+        ref = F.conv2d(_t(x), _t(w), stride=2, padding=1, groups=2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_layer_wrapper(self, rng):
+        layer = V.DeformConv2D(3, 5, 3, padding=1)
+        x = _t(rng.standard_normal((1, 3, 6, 6)).astype(np.float32))
+        off = _t(np.zeros((1, 18, 6, 6), np.float32))
+        out = layer(x, off)
+        assert tuple(out.shape) == (1, 5, 6, 6)
+
+
+class TestYoloBox:
+    def test_shapes_and_ranges(self, rng):
+        feat = rng.standard_normal((2, 27, 4, 4)).astype(np.float32)
+        boxes, scores = V.yolo_box(_t(feat), _t(np.array([[64, 64],
+                                                          [32, 48]])),
+                                   [10, 13, 16, 30, 33, 23], 4, 0.005, 16)
+        assert tuple(boxes.shape) == (2, 48, 4)
+        assert tuple(scores.shape) == (2, 48, 4)
+        b = boxes.numpy()
+        assert np.isfinite(b).all()
+        # clip_bbox keeps coordinates inside the image
+        assert (b[0][:, [0, 1]] >= 0).all()
+        assert (b[0][:, 2] <= 63.0 + 1e-5).all()
+
+    def test_conf_thresh_zeroes_low_boxes(self, rng):
+        feat = np.full((1, 12, 2, 2), -10.0, np.float32)  # sigmoid ~ 0
+        boxes, scores = V.yolo_box(_t(feat), _t(np.array([[32, 32]])),
+                                   [10, 13, 16, 30], 1, 0.5, 16)
+        assert np.all(boxes.numpy() == 0)
+        assert np.all(scores.numpy() == 0)
+
+
+class TestPriorBox:
+    def test_centers_and_sizes(self):
+        feat = _t(np.zeros((1, 8, 2, 2), np.float32))
+        img = _t(np.zeros((1, 3, 16, 16), np.float32))
+        boxes, var = V.prior_box(feat, img, min_sizes=[4.0])
+        assert tuple(boxes.shape) == (2, 2, 1, 4)
+        b = boxes.numpy()[0, 0, 0]  # first cell: center (4, 4) px, 4x4 box
+        np.testing.assert_allclose(b, [2 / 16, 2 / 16, 6 / 16, 6 / 16],
+                                   atol=1e-6)
+        np.testing.assert_allclose(var.numpy()[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+    def test_flip_adds_reciprocal_ratio(self):
+        feat = _t(np.zeros((1, 8, 1, 1), np.float32))
+        img = _t(np.zeros((1, 3, 16, 16), np.float32))
+        no_flip, _ = V.prior_box(feat, img, min_sizes=[4.0],
+                                 aspect_ratios=[2.0])
+        flip, _ = V.prior_box(feat, img, min_sizes=[4.0],
+                              aspect_ratios=[2.0], flip=True)
+        assert no_flip.shape[2] + 1 == flip.shape[2]
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        priors = np.array([[0., 0., 10., 10.], [5., 5., 20., 20.]],
+                          np.float32)
+        gts = np.array([[1., 1., 8., 8.], [2., 4., 12., 14.]], np.float32)
+        enc = V.box_coder(_t(priors), None, _t(gts), "encode_center_size")
+        dec = V.box_coder(_t(priors), None,
+                          _t(enc.numpy().transpose(1, 0, 2)),
+                          "decode_center_size", axis=0)
+        for m in range(2):
+            np.testing.assert_allclose(dec.numpy()[:, m, :],
+                                       np.tile(gts[m], (2, 1)), atol=1e-4)
+
+    def test_variance_scales_encoding(self):
+        priors = np.array([[0., 0., 10., 10.]], np.float32)
+        gts = np.array([[1., 1., 8., 8.]], np.float32)
+        plain = V.box_coder(_t(priors), None, _t(gts), "encode_center_size")
+        scaled = V.box_coder(_t(priors), _t(np.float32([0.5, 0.5, 0.5, 0.5])),
+                             _t(gts), "encode_center_size")
+        np.testing.assert_allclose(scaled.numpy(), plain.numpy() * 2.0,
+                                   rtol=1e-5)
+
+
+class TestMatrixNms:
+    def test_suppresses_overlap_keeps_distant(self):
+        bxs = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                         [50, 50, 60, 60]]], np.float32)
+        scs = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+        out, nums = V.matrix_nms(_t(bxs), _t(scs), 0.1, 0.3, 3, 3,
+                                 background_label=-1)
+        assert nums.numpy().tolist() == [2]
+        np.testing.assert_allclose(out.numpy()[:, 1], [0.9, 0.7])
+
+    def test_gaussian_decay_softer_than_linear(self):
+        bxs = np.array([[[0, 0, 10, 10], [2, 2, 12, 12]]], np.float32)
+        scs = np.array([[[0.9, 0.8]]], np.float32)
+        lin, _ = V.matrix_nms(_t(bxs), _t(scs), 0.1, 0.0, 2, 2,
+                              background_label=-1)
+        gau, _ = V.matrix_nms(_t(bxs), _t(scs), 0.1, 0.0, 2, 2,
+                              use_gaussian=True, gaussian_sigma=2.0,
+                              background_label=-1)
+        assert gau.numpy()[1, 1] >= lin.numpy()[1, 1]
+
+    def test_single_class_all_background_returns_empty(self):
+        bxs = np.array([[[0, 0, 10, 10]]], np.float32)
+        scs = np.array([[[0.9]]], np.float32)
+        out, nums = V.matrix_nms(_t(bxs), _t(scs), 0.1, 0.3, 1, 1,
+                                 background_label=0)
+        assert nums.numpy().tolist() == [0]
+        assert out.numpy().shape == (0, 6)
+
+    def test_deform_layer_params_tracked_by_parent(self):
+        import paddle_tpu.nn as nn
+
+        class Det(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.dcn = V.DeformConv2D(3, 5, 3, padding=1)
+
+            def forward(self, x, off):
+                return self.dcn(x, off)
+
+        m = Det()
+        names = [n for n, _ in m.named_parameters()]
+        assert any("dcn" in n for n in names), names
+        assert len(list(m.parameters())) >= 2  # weight + bias
+
+    def test_yolo_box_iou_aware_layout(self, rng):
+        # leading block of an ioup channels, then an*(5+cls) channels
+        feat = rng.standard_normal((1, 2 + 2 * 6, 2, 2)).astype(np.float32)
+        boxes, scores = V.yolo_box(_t(feat), _t(np.array([[32, 32]])),
+                                   [10, 13, 16, 30], 1, 0.005, 16,
+                                   iou_aware=True)
+        assert tuple(boxes.shape) == (1, 8, 4)
+        assert np.isfinite(scores.numpy()).all()
+
+    def test_classes_do_not_suppress_each_other(self):
+        bxs = np.array([[[0, 0, 10, 10], [0, 0, 10, 10]]], np.float32)
+        scs = np.array([[[0.9, 0.0], [0.0, 0.8]]], np.float32)
+        out, nums = V.matrix_nms(_t(bxs), _t(scs), 0.1, 0.5, 4, 4,
+                                 background_label=-1)
+        assert nums.numpy().tolist() == [2]  # same box, different classes

@@ -13,10 +13,10 @@ __version__ = "0.1.0"
 from . import ops  # registers the op library  # noqa: F401
 from .core import (  # noqa: F401
     CPUPlace, CUDAPlace, Parameter, Place, TPUPlace, Tensor, bfloat16, bool_,
-    complex64, complex128, device_count, enable_grad, float16, float32,
-    float64, get_default_dtype, get_device, get_flags, int8, int16, int32,
-    int64, is_compiled_with_tpu, no_grad, seed, set_default_dtype, set_device,
-    set_flags, set_grad_enabled, uint8,
+    complex64, complex128, device_count, enable_grad, finfo, float16, float32,
+    float64, get_default_dtype, get_device, get_flags, iinfo, int8, int16,
+    int32, int64, is_compiled_with_tpu, no_grad, seed, set_default_dtype,
+    set_device, set_flags, set_grad_enabled, uint8,
 )
 from .core.rng import get_rng_state, set_rng_state  # noqa: F401
 from . import autograd  # noqa: F401
@@ -46,7 +46,7 @@ _SUBPACKAGES = [
     "nn", "optimizer", "io", "metric", "vision", "amp", "static", "jit",
     "distributed", "device", "profiler", "incubate", "sparse", "framework",
     "hapi", "text", "audio", "distribution", "quantization", "utils",
-    "inference", "linalg", "fft",
+    "inference", "linalg", "fft", "signal", "hub", "onnx",
 ]
 import importlib as _importlib
 
@@ -60,6 +60,8 @@ if "framework" in globals() and hasattr(framework, "save"):  # noqa: F821
 if "hapi" in globals() and hasattr(hapi, "Model"):  # noqa: F821
     Model = hapi.Model  # noqa: F821
     summary = hapi.summary  # noqa: F821
+    flops = hapi.flops  # noqa: F821
+autocast = amp.auto_cast  # noqa: F821  (paddle 3.x top-level alias)
 if "static" in globals() and hasattr(static, "enable_static"):  # noqa: F821
     enable_static = static.enable_static  # noqa: F821
     disable_static = static.disable_static  # noqa: F821

@@ -1,8 +1,8 @@
 from . import dtype as dtype_mod  # noqa: F401
 from .dtype import (  # noqa: F401
-    bfloat16, bool_, complex64, complex128, convert_dtype, float16, float32,
-    float64, get_default_dtype, int8, int16, int32, int64, set_default_dtype,
-    uint8,
+    bfloat16, bool_, complex64, complex128, convert_dtype, finfo, float16,
+    float32, float64, get_default_dtype, iinfo, int8, int16, int32, int64,
+    set_default_dtype, uint8,
 )
 from .enforce import EnforceNotMet, enforce  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401

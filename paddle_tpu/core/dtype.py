@@ -89,3 +89,47 @@ def is_integer(dtype) -> bool:
 def is_complex(dtype) -> bool:
     d = convert_dtype(dtype)
     return np.issubdtype(d, np.complexfloating)
+
+
+class iinfo:
+    """paddle.iinfo: integer dtype limits (numpy-backed)."""
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        info = np.iinfo(d)
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = info.bits
+        self.dtype = dtype_name(d)
+
+    def __repr__(self):
+        return (f"paddle.iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class finfo:
+    """paddle.finfo: float dtype limits. bfloat16 is not a numpy dtype —
+    its limits are filled in from the IEEE bfloat16 spec."""
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        if d == bfloat16:
+            self.min = -3.3895313892515355e38
+            self.max = 3.3895313892515355e38
+            self.eps = 0.0078125
+            self.tiny = self.smallest_normal = 1.1754943508222875e-38
+            self.resolution = 0.01
+            self.bits = 16
+        else:
+            info = np.finfo(d)
+            self.min = float(info.min)
+            self.max = float(info.max)
+            self.eps = float(info.eps)
+            self.tiny = self.smallest_normal = float(info.tiny)
+            self.resolution = float(info.resolution)
+            self.bits = info.bits
+        self.dtype = dtype_name(d)
+
+    def __repr__(self):
+        return (f"paddle.finfo(min={self.min}, max={self.max}, "
+                f"eps={self.eps}, bits={self.bits}, dtype={self.dtype})")

@@ -4,4 +4,4 @@ Ref: python/paddle/hapi/ (upstream layout, unverified — mount empty).
 """
 from . import callbacks  # noqa: F401
 from .model import Model  # noqa: F401
-from .summary import summary  # noqa: F401
+from .summary import flops, summary  # noqa: F401

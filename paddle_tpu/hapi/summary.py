@@ -1,5 +1,6 @@
-"""paddle.summary (ref: python/paddle/hapi/model_summary.py, upstream layout,
-unverified — mount empty). Uses jax.eval_shape — no FLOPs are spent."""
+"""paddle.summary + paddle.flops (ref: python/paddle/hapi/model_summary.py,
+dynamic_flops.py — upstream layout, unverified — mount empty). Both trace
+the net with jax.eval_shape — no FLOPs are spent measuring FLOPs."""
 from __future__ import annotations
 
 import jax
@@ -9,13 +10,46 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..jit.functional import call_functional, extract_state
 
-__all__ = ["summary"]
+__all__ = ["summary", "flops"]
+
+
+def _trace_with_hooks(net, make_hook, input_size=None, dtypes=None,
+                      input=None):
+    """Register `make_hook(name)` on every leaf sublayer, run the net once
+    abstractly (jax.eval_shape — hooks fire during tracing with exact
+    shapes), then remove the hooks."""
+    hooks = []
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+    try:
+        if input is not None:
+            args = [input] if isinstance(input, Tensor) else list(input)
+            datas = [a._data for a in args]
+        else:
+            if input_size is None:
+                raise ValueError("need input_size or input")
+            sizes = [input_size] if isinstance(input_size, tuple) else \
+                list(input_size)
+            dts = dtypes or ["float32"] * len(sizes)
+            if isinstance(dts, str):
+                dts = [dts] * len(sizes)
+            datas = [jnp.zeros([1 if s is None or s == -1 else s
+                                for s in size], dtype=dt)
+                     for size, dt in zip(sizes, dts)]
+        params, buffers = extract_state(net)
+        jax.eval_shape(
+            lambda p, b, *d: call_functional(net, p, b, d,
+                                             training=False)[0],
+            params, buffers, *datas)
+    finally:
+        for h in hooks:
+            h.remove()
 
 
 def summary(net, input_size=None, dtypes=None, input=None):
     """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
     rows = []
-    hooks = []
 
     def make_hook(name):
         def hook(layer, inputs, outputs):
@@ -28,34 +62,7 @@ def summary(net, input_size=None, dtypes=None, input=None):
             rows.append((name, type(layer).__name__, shapes, n_params))
         return hook
 
-    for name, sub in net.named_sublayers():
-        if not sub._sub_layers:  # leaves only
-            hooks.append(sub.register_forward_post_hook(make_hook(name)))
-
-    try:
-        if input is not None:
-            args = [input] if isinstance(input, Tensor) else list(input)
-            datas = [a._data for a in args]
-        else:
-            if input_size is None:
-                raise ValueError("summary needs input_size or input")
-            sizes = [input_size] if isinstance(input_size, tuple) else \
-                list(input_size)
-            dts = dtypes or ["float32"] * len(sizes)
-            if isinstance(dts, str):
-                dts = [dts] * len(sizes)
-            datas = [jnp.zeros([1 if s is None or s == -1 else s
-                                for s in size], dtype=dt)
-                     for size, dt in zip(sizes, dts)]
-        params, buffers = extract_state(net)
-        # run abstractly — hooks fire during tracing, shapes are exact
-        jax.eval_shape(
-            lambda p, b, *d: call_functional(net, p, b, d,
-                                             training=False)[0],
-            params, buffers, *datas)
-    finally:
-        for h in hooks:
-            h.remove()
+    _trace_with_hooks(net, make_hook, input_size, dtypes, input)
 
     total = sum(int(np.prod(p.shape)) for p in net.parameters())
     trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
@@ -75,3 +82,83 @@ def summary(net, input_size=None, dtypes=None, input=None):
     print(f"Non-trainable params: {total - trainable:,}")
     print(line)
     return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False, dtypes=None):
+    """Per-layer FLOP estimate (ref: python/paddle/hapi/dynamic_flops.py,
+    upstream layout, unverified — mount empty).
+
+    Accounting follows the upstream conventions: a multiply-add counts as
+    2 ops for matmul-like layers, normalizations/activations count one op
+    per element. `custom_ops` maps a layer class to
+    fn(layer, input_shape, output_shape) -> flops and overrides the table.
+    """
+    from .. import nn
+
+    custom_ops = custom_ops or {}
+    rows = []
+
+    def _count(layer, in_shape, out_shape):
+        for cls, fn in custom_ops.items():
+            if isinstance(layer, cls):
+                return int(fn(layer, in_shape, out_shape))
+        out_el = int(np.prod(out_shape))
+        in_el = int(np.prod(in_shape))
+        if isinstance(layer, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            w = layer.weight
+            kernel_ops = int(np.prod(w.shape[1:]))  # Cin/g * prod(k)
+            bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+            return out_el * (2 * kernel_ops - 1 + bias_ops)
+        if isinstance(layer, nn.Conv2DTranspose):
+            # transpose conv: each INPUT element is scattered through the
+            # whole (Cout/g, kh, kw) kernel block — weight is (Cin, Cout/g,
+            # kh, kw), so MACs = in_el * prod(w.shape[1:])
+            w = layer.weight
+            bias_ops = int(np.prod(out_shape[-2:])) if \
+                getattr(layer, "bias", None) is not None else 0
+            return in_el * 2 * int(np.prod(w.shape[1:])) + bias_ops
+        if isinstance(layer, nn.Linear):
+            in_f = layer.weight.shape[0]
+            bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+            return out_el * (2 * in_f - 1 + bias_ops)
+        if isinstance(layer, (nn.BatchNorm, nn.BatchNorm1D, nn.BatchNorm2D,
+                              nn.BatchNorm3D, nn.LayerNorm, nn.GroupNorm)):
+            return 2 * out_el
+        if isinstance(layer, (nn.AvgPool2D, nn.MaxPool2D, nn.AvgPool1D,
+                              nn.MaxPool1D, nn.AdaptiveAvgPool2D)):
+            return out_el
+        if isinstance(layer, (nn.ReLU, nn.ReLU6, nn.GELU, nn.Sigmoid,
+                              nn.Tanh, nn.Hardswish, nn.Hardsigmoid,
+                              nn.Swish, nn.SiLU, nn.LeakyReLU, nn.Softmax)):
+            return out_el
+        return 0
+
+    def make_hook(name):
+        def hook(layer, inputs, outputs):
+            ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            outs = outputs if isinstance(outputs, (list, tuple)) \
+                else [outputs]
+            in_shape = list(ins[0].shape) if ins and \
+                isinstance(ins[0], Tensor) else []
+            out_shape = list(outs[0].shape) if outs and \
+                isinstance(outs[0], Tensor) else []
+            n_params = sum(
+                int(np.prod(p.shape)) for p in layer._parameters.values()
+                if p is not None)
+            rows.append((name, type(layer).__name__, out_shape, n_params,
+                         _count(layer, in_shape, out_shape)))
+        return hook
+
+    _trace_with_hooks(net, make_hook, input_size, dtypes, inputs)
+
+    total = sum(r[4] for r in rows)
+    if print_detail:
+        width = max((len(r[0]) for r in rows), default=10) + 2
+        print(f"{'Layer':<{width}}{'Type':<18}{'Output':<20}"
+              f"{'Params':>12}{'FLOPs':>16}")
+        for name, tname, oshape, n_params, fl in rows:
+            print(f"{name:<{width}}{tname:<18}{str(oshape):<20}"
+                  f"{n_params:>12}{fl:>16}")
+        print(f"Total FLOPs: {total}")
+    return total

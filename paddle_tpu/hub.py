@@ -1,0 +1,66 @@
+"""paddle.hub — load models from a local hubconf (ref: python/paddle/hub.py,
+upstream layout, unverified — mount empty).
+
+This environment has no network egress, so only the `source='local'` path is
+functional; github/gitee sources raise with a clear message instead of
+hanging on a download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_entry_module(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} found in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise RuntimeError(
+            f"paddle.hub source {source!r} needs network access, which this "
+            "environment does not have; clone the repo and use "
+            "source='local' with its directory path")
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """Entrypoint names exported by repo_dir/hubconf.py."""
+    _check_source(source)
+    mod = _load_entry_module(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False):
+    """Docstring of one hubconf entrypoint."""
+    _check_source(source)
+    mod = _load_entry_module(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Instantiate a hubconf entrypoint: load('path/to/repo', 'resnet18')."""
+    _check_source(source)
+    mod = _load_entry_module(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
+    return getattr(mod, model)(**kwargs)

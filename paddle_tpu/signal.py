@@ -1,0 +1,152 @@
+"""paddle.signal — STFT family (ref: python/paddle/signal.py, upstream
+layout, unverified — mount empty): frame, overlap_add, stft, istft.
+
+TPU note: framing is a gather over a [frames, frame_length] index grid and
+the transforms are jnp.fft (XLA-native), so everything here jits; istft's
+overlap-add uses segment-style scatter-add (`.at[].add`), which XLA lowers
+to an efficient scatter on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _get_window(window, win_length, dtype):
+    if window is None:
+        return jnp.ones((win_length,), dtype)
+    w = _unwrap(window)
+    if w.shape[-1] != win_length:
+        raise ValueError(
+            f"window length {w.shape[-1]} != win_length {win_length}")
+    return w.astype(dtype)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along `axis` (librosa-style)."""
+    xd = _unwrap(x)
+    if axis not in (-1, xd.ndim - 1, 0):
+        raise ValueError("frame supports axis=0 or axis=-1")
+    seq_last = axis in (-1, xd.ndim - 1)
+    T = xd.shape[-1] if seq_last else xd.shape[0]
+    if frame_length > T:
+        raise ValueError(f"frame_length {frame_length} > signal length {T}")
+    n_frames = 1 + (T - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    if seq_last:
+        out = xd[..., idx]                       # [..., frames, frame_len]
+        out = jnp.swapaxes(out, -1, -2)          # [..., frame_len, frames]
+    else:
+        out = xd[idx]                            # [frames, frame_len, ...]
+        out = jnp.moveaxis(out, (0, 1), (1, 0))  # [frame_len, frames, ...]
+    return Tensor(out)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of `frame`: add overlapping frames back into a signal.
+
+    x: [..., frame_length, n_frames] (axis=-1) or
+       [frame_length, n_frames, ...] (axis=0).
+    """
+    xd = _unwrap(x)
+    if axis not in (-1, xd.ndim - 1, 0):
+        raise ValueError("overlap_add supports axis=0 or axis=-1")
+    seq_last = axis in (-1, xd.ndim - 1)
+    if not seq_last:
+        xd = jnp.moveaxis(xd, (0, 1), (-2, -1))
+    frame_length, n_frames = xd.shape[-2], xd.shape[-1]
+    T = hop_length * (n_frames - 1) + frame_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])     # [frames, frame_len]
+    out = jnp.zeros(xd.shape[:-2] + (T,), xd.dtype)
+    contrib = jnp.swapaxes(xd, -1, -2)              # [..., frames, flen]
+    out = out.at[..., idx].add(contrib)
+    if not seq_last:
+        out = jnp.moveaxis(out, -1, 0)
+    return Tensor(out)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform. x: (B, T) or (T,) real or complex;
+    returns complex (B, F, n_frames) with F = n_fft//2+1 if onesided."""
+    xd = _unwrap(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    is_complex = jnp.iscomplexobj(xd)
+    if is_complex and onesided:
+        raise ValueError("onesided is not supported for complex inputs")
+    real_dtype = jnp.float32 if xd.dtype != jnp.float64 else jnp.float64
+    w = _get_window(window, win_length, real_dtype)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = [(0, 0)] * (xd.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        xd = jnp.pad(xd, pad, mode=pad_mode)
+    T = xd.shape[-1]
+    if T < n_fft:
+        raise ValueError(
+            f"stft input length {T} (after centering) < n_fft {n_fft}")
+    n_frames = 1 + (T - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = xd[..., idx] * w                        # [..., frames, n_fft]
+    if onesided:
+        spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+    else:
+        spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, real_dtype))
+    return Tensor(jnp.moveaxis(spec, -1, -2))        # [..., F, frames]
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope (NOLA) normalization."""
+    xd = _unwrap(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    real_dtype = jnp.float32
+    w = _get_window(window, win_length, real_dtype)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    spec = jnp.moveaxis(xd, -2, -1)                  # [..., frames, F]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, real_dtype))
+    if onesided:
+        if return_complex:
+            raise ValueError(
+                "return_complex=True requires onesided=False (a onesided "
+                "spectrum reconstructs a real signal)")
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, n=n_fft, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    wf = frames * w                                  # synthesis window
+    n_frames = wf.shape[-2]
+    T = hop_length * (n_frames - 1) + n_fft
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    sig = jnp.zeros(wf.shape[:-2] + (T,), wf.dtype)
+    sig = sig.at[..., idx].add(wf)
+    env = jnp.zeros((T,), real_dtype).at[idx.reshape(-1)].add(
+        jnp.broadcast_to(w * w, (n_frames, n_fft)).reshape(-1))
+    sig = sig / jnp.maximum(env, 1e-11)
+    if center:
+        sig = sig[..., n_fft // 2:T - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return Tensor(sig)

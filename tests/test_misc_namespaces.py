@@ -1,0 +1,245 @@
+"""Round-3 namespace additions: paddle.signal, paddle.hub, paddle.onnx,
+iinfo/finfo, paddle.flops, paddle.autocast alias, incubate.optimizer
+(LookAhead / ModelAverage)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import signal
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip_identity_hop(self, rng):
+        x = rng.standard_normal(32).astype(np.float32)
+        f = signal.frame(_t(x), frame_length=8, hop_length=8)
+        assert tuple(f.shape) == (8, 4)
+        back = signal.overlap_add(f, hop_length=8)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+    def test_frame_batched_last_axis(self, rng):
+        x = rng.standard_normal((3, 32)).astype(np.float32)
+        f = signal.frame(_t(x), 16, 4)
+        assert tuple(f.shape) == (3, 16, 5)
+
+    def test_overlap_add_overlapping_sums(self):
+        frames = np.ones((4, 3), np.float32)  # frame_length 4, 3 frames
+        out = signal.overlap_add(_t(frames), hop_length=2)
+        # length = 2*2+4 = 8; middle samples overlap twice
+        np.testing.assert_allclose(out.numpy(),
+                                   [1, 1, 2, 2, 2, 2, 1, 1])
+
+    def test_stft_matches_scipy(self, rng):
+        import scipy.signal as ss
+        x = rng.standard_normal(512).astype(np.float32)
+        win = np.hanning(128).astype(np.float32)
+        got = signal.stft(_t(x), n_fft=128, hop_length=32,
+                          window=_t(win), center=False).numpy()
+        _, _, ref = ss.stft(x, window=win, nperseg=128, noverlap=96,
+                            boundary=None, padded=False)
+        # scipy normalizes by win.sum(); undo for raw comparison
+        ref = ref * win.sum()
+        np.testing.assert_allclose(got, ref, atol=1e-3)
+
+    def test_stft_istft_roundtrip(self, rng):
+        x = rng.standard_normal((2, 400)).astype(np.float32)
+        win = _t(np.hanning(100).astype(np.float32))
+        spec = signal.stft(_t(x), n_fft=100, hop_length=25, window=win)
+        back = signal.istft(spec, n_fft=100, hop_length=25, window=win,
+                            length=400)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
+
+    def test_onesided_complex_input_raises(self):
+        x = _t(np.ones(64, np.complex64))
+        with pytest.raises(ValueError):
+            signal.stft(x, n_fft=16)
+
+    def test_stft_too_short_raises(self):
+        with pytest.raises(ValueError, match="n_fft"):
+            signal.stft(_t(np.ones(50, np.float32)), n_fft=64, center=False)
+
+    def test_istft_onesided_return_complex_raises(self):
+        spec = signal.stft(_t(np.ones(256, np.float32)), n_fft=64)
+        with pytest.raises(ValueError, match="return_complex"):
+            signal.istft(spec, n_fft=64, return_complex=True)
+
+
+class TestHub:
+    def test_local_hubconf(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(scale=1):\n"
+            "    '''a tiny model entrypoint'''\n"
+            "    return {'scale': scale}\n")
+        names = paddle.hub.list(str(tmp_path))
+        assert "tiny_model" in names
+        assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model")
+        out = paddle.hub.load(str(tmp_path), "tiny_model", scale=3)
+        assert out == {"scale": 3}
+
+    def test_remote_source_raises(self):
+        with pytest.raises(RuntimeError, match="network"):
+            paddle.hub.list("user/repo", source="github")
+
+    def test_missing_entry_raises(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text("x = 1\n")
+        with pytest.raises(ValueError):
+            paddle.hub.load(str(tmp_path), "nope")
+
+
+class TestOnnx:
+    def test_export_gated(self):
+        with pytest.raises(RuntimeError, match="paddle2onnx"):
+            paddle.onnx.export(None, "model.onnx")
+
+
+class TestDtypeInfo:
+    def test_iinfo(self):
+        i = paddle.iinfo("int16")
+        assert (i.min, i.max, i.bits) == (-32768, 32767, 16)
+
+    def test_finfo_float32(self):
+        f = paddle.finfo(paddle.float32)
+        np.testing.assert_allclose(f.eps, np.finfo(np.float32).eps)
+        assert f.bits == 32
+
+    def test_finfo_bfloat16(self):
+        f = paddle.finfo("bfloat16")
+        assert f.bits == 16
+        assert f.eps == 0.0078125
+        assert f.max > 3e38
+
+
+class TestFlops:
+    def test_linear_flops_exact(self):
+        import paddle_tpu.nn as nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        n = paddle.flops(M(), input_size=(1, 8))
+        assert n == 4 * (2 * 8 - 1 + 1)  # out*(2*in-1+bias)
+
+    def test_conv_transpose_counted(self):
+        import paddle_tpu.nn as nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.up = nn.Conv2DTranspose(4, 2, 3, padding=1)
+
+            def forward(self, x):
+                return self.up(x)
+
+        n = paddle.flops(M(), input_size=(1, 4, 8, 8))
+        assert n > 0  # regression: transpose convs used to count 0
+
+    def test_autocast_alias(self):
+        assert paddle.autocast is paddle.amp.auto_cast
+
+
+class TestIncubateOptimizers:
+    def _setup(self):
+        import paddle_tpu.nn as nn
+        net = nn.Linear(4, 2)
+        x = _t(np.random.RandomState(0).standard_normal((8, 4))
+               .astype(np.float32))
+        y = _t(np.random.RandomState(1).standard_normal((8, 2))
+               .astype(np.float32))
+
+        def loss_fn():
+            import paddle_tpu.nn.functional as F
+            return F.mse_loss(net(x), y)
+        return net, loss_fn
+
+    def test_lookahead_converges_and_syncs(self):
+        from paddle_tpu.incubate import LookAhead
+        net, loss_fn = self._setup()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=3)
+        losses = []
+        for _ in range(9):
+            loss = loss_fn()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        # after a sync step the slow copies equal the live weights
+        w = net.weight._data
+        slow = opt._slow[id(net.weight)]
+        np.testing.assert_allclose(np.asarray(w), np.asarray(slow))
+
+    def test_lookahead_validates_args(self):
+        net, _ = self._setup()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters())
+        from paddle_tpu.incubate import LookAhead
+        with pytest.raises(ValueError):
+            LookAhead(inner, alpha=1.5)
+        with pytest.raises(ValueError):
+            LookAhead(inner, k=0)
+
+    def test_model_average_double_apply_raises(self):
+        from paddle_tpu.incubate import ModelAverage
+        net, loss_fn = self._setup()
+        avg = ModelAverage(0.5, parameters=net.parameters(),
+                           min_average_window=100)
+        avg.step()
+        avg.apply()
+        with pytest.raises(RuntimeError, match="restore"):
+            avg.apply()
+        avg.restore()
+
+    def test_lookahead_state_roundtrip(self):
+        from paddle_tpu.incubate import LookAhead
+        net, loss_fn = self._setup()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters())
+        opt = LookAhead(inner, k=2)
+        for _ in range(2):
+            loss = loss_fn()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        sd = opt.state_dict()
+        assert sd["step"] == 2 and sd["slow"]
+        inner2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                      parameters=net.parameters())
+        opt2 = LookAhead(inner2, k=2)
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 2
+        assert len(opt2._slow) == len(sd["slow"])
+
+    def test_model_average_apply_restore(self):
+        from paddle_tpu.incubate import ModelAverage
+        net, loss_fn = self._setup()
+        inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                     parameters=net.parameters())
+        # window large enough that no restart happens within the 4 steps
+        avg = ModelAverage(0.5, parameters=net.parameters(),
+                           min_average_window=100, max_average_window=100)
+        seen = []
+        for _ in range(4):
+            loss = loss_fn()
+            loss.backward()
+            inner.step()
+            inner.clear_grad()
+            avg.step()
+            seen.append(np.asarray(net.weight._data).copy())
+        live = np.asarray(net.weight._data).copy()
+        avg.apply()
+        applied = np.asarray(net.weight._data)
+        np.testing.assert_allclose(applied, np.mean(seen, axis=0),
+                                   rtol=1e-5)
+        assert not np.allclose(applied, live)
+        avg.restore()
+        np.testing.assert_allclose(np.asarray(net.weight._data), live)

@@ -1,0 +1,176 @@
+"""TPU-native autoregressive generation with a static-shape KV cache.
+
+Ref surface: PaddleNLP `model.generate` (greedy/sampling; ecosystem atop
+the reference fork — mount empty, layout unverified). TPU-first design:
+
+- the KV cache is a pair of fixed-size arrays per layer, updated in place
+  with `lax.dynamic_update_slice` (XLA keeps the buffer donated/aliased
+  across steps — no reallocation, no dynamic shapes);
+- prefill is ONE jitted call over the whole padded prompt; decode is ONE
+  jitted single-token step reused for every position (two compilations
+  total, both MXU-shaped);
+- sampling (greedy / temperature / top-k / top-p) runs inside the jitted
+  step with threefry keys, so the logits never leave the device.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.functional import call_functional, extract_state
+from ..nn import functional as F
+
+__all__ = ["generate", "attend_with_cache", "init_caches"]
+
+
+def attend_with_cache(q, k, v, cache, start_pos, rep):
+    """Write this block's K/V into the cache at `start_pos`, then attend q
+    over the full (masked) cache.
+
+    q: Tensor (b, s, heads, hd); k/v: Tensor (b, s, kv_heads, hd);
+    cache: (k_cache, v_cache) raw jnp arrays (b, max_len, kv_heads, hd).
+    Returns (ctx Tensor (b, s, heads, hd), new_cache).
+    """
+    kc, vc = cache
+    kd = k._data.astype(kc.dtype)
+    vd = v._data.astype(vc.dtype)
+    start = jnp.asarray(start_pos, jnp.int32)
+    kc = jax.lax.dynamic_update_slice(kc, kd, (0, start, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, vd, (0, start, 0, 0))
+    max_len = kc.shape[1]
+    s = q.shape[1]
+    kf, vf = kc, vc
+    if rep > 1:  # GQA: expand kv heads to match q heads
+        kf = jnp.repeat(kf, rep, axis=2)
+        vf = jnp.repeat(vf, rep, axis=2)
+    # position j visible to query i iff j <= start_pos + i
+    pos_q = start + jnp.arange(s, dtype=jnp.int32)
+    allowed = jnp.arange(max_len, dtype=jnp.int32)[None, :] <= pos_q[:, None]
+    mask = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)[None, None]
+    ctx = F.scaled_dot_product_attention(
+        q, Tensor(kf), Tensor(vf), attn_mask=Tensor(mask), is_causal=False)
+    return ctx, (kc, vc)
+
+
+def init_caches(model, batch, max_len, dtype=jnp.float32):
+    """Zeroed (k, v) cache pair per decoder layer, sized from the config."""
+    cfg = _config_of(model)
+    kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    shape = (batch, max_len, kv_heads, head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.num_hidden_layers)]
+
+
+def _config_of(model):
+    for attr in ("llama", "gpt"):
+        if hasattr(model, attr):
+            return getattr(model, attr).config
+    if hasattr(model, "config"):
+        return model.config
+    raise ValueError("model exposes no config for cache sizing")
+
+
+def _sample(logits, key, temperature, top_k, top_p):
+    """Sample the next token from (b, vocab) logits inside jit."""
+    if temperature == 0.0:  # greedy
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    vocab = logits.shape[-1]
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, min(top_k, vocab))[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p (first element always in)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
+             top_k=0, top_p=1.0, eos_token_id: Optional[int] = None,
+             seed: Optional[int] = None, cache_dtype=jnp.float32):
+    """Autoregressive generation. input_ids: Tensor/array (b, prompt_len).
+    Returns a Tensor (b, prompt_len + max_new_tokens) of token ids; rows
+    that hit `eos_token_id` are padded with eos afterwards."""
+    was_training = model.training
+    model.eval()
+    try:
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, prompt_len = ids.shape
+        total = prompt_len + max_new_tokens
+        params, buffers = extract_state(model)
+        caches = init_caches(model, b, total, cache_dtype)
+        if seed is None:
+            # fresh entropy per call: unseeded sampling must differ between
+            # calls (PaddleNLP generate semantics)
+            seed = int(np.random.randint(0, 2 ** 31 - 1))
+        key = jax.random.key(seed)
+
+        # jitted steps are memoized on the model: jax's jit cache is keyed
+        # by function identity, so fresh closures per call would recompile
+        # every generate() invocation
+        cache_key = (b, prompt_len, total, float(temperature), int(top_k),
+                     float(top_p), jnp.dtype(cache_dtype).name)
+        jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
+        if cache_key not in jit_cache:
+            def prefill(params, buffers, ids, caches):
+                (logits, new_caches), _ = call_functional(
+                    model, params, buffers, (Tensor(ids),),
+                    kwargs={"caches": caches, "start_pos": 0},
+                    training=False)
+                return logits[:, -1], new_caches
+
+            def decode(params, buffers, token, caches, pos, key):
+                (logits, new_caches), _ = call_functional(
+                    model, params, buffers, (Tensor(token[:, None]),),
+                    kwargs={"caches": caches, "start_pos": pos},
+                    training=False)
+                nxt = _sample(logits[:, 0], key, temperature, top_k, top_p)
+                return nxt, new_caches
+
+            jit_cache[cache_key] = (jax.jit(prefill),
+                                    jax.jit(decode, donate_argnums=(3,)))
+        prefill_j, decode_j = jit_cache[cache_key]
+
+        last_logits, caches = prefill_j(params, buffers, ids, caches)
+        key, sub = jax.random.split(key)
+        token = _sample(last_logits, sub, temperature, top_k, top_p)
+
+        out = [ids, token[:, None]]
+        finished = np.zeros((b,), bool)
+        if eos_token_id is not None:
+            finished |= np.asarray(token) == eos_token_id
+        for step in range(1, max_new_tokens):
+            key, sub = jax.random.split(key)
+            token, caches = decode_j(params, buffers, token, caches,
+                                     jnp.int32(prompt_len + step - 1), sub)
+            if eos_token_id is not None:
+                # already-finished rows keep emitting eos
+                token = jnp.where(jnp.asarray(finished), eos_token_id,
+                                  token)
+                finished |= np.asarray(token) == eos_token_id
+            out.append(token[:, None])
+            if eos_token_id is not None and finished.all():
+                # pad the remaining positions with eos and stop early
+                remaining = max_new_tokens - 1 - step
+                if remaining:
+                    out.append(jnp.full((b, remaining), eos_token_id,
+                                        ids.dtype))
+                break
+        return Tensor(jnp.concatenate(
+            [o.astype(ids.dtype) for o in out], axis=1))
+    finally:
+        if was_training:
+            model.train()

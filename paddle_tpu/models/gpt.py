@@ -58,12 +58,16 @@ class GPTAttention(nn.Layer):
             self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.dropout_p = cfg.attention_probs_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, cache=None, start_pos=0):
         b, s, h = x.shape
         # scaled_dot_product_attention's layout contract is (b, s, heads, hd)
         qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         qkv = qkv.transpose([2, 0, 1, 3, 4])  # 3,b,s,nh,hd
         q, k, v = qkv[0], qkv[1], qkv[2]
+        if cache is not None:  # KV-cache decode (inference only)
+            from .generation import attend_with_cache
+            ctx, new_cache = attend_with_cache(q, k, v, cache, start_pos, 1)
+            return self.out(ctx.reshape([b, s, h])), new_cache
         ctx = F.scaled_dot_product_attention(
             q, k, v, is_causal=True,
             dropout_p=self.dropout_p if self.training else 0.0)
@@ -93,10 +97,16 @@ class GPTBlock(nn.Layer):
             self.ffn_out = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln1(x)))
+    def forward(self, x, cache=None, start_pos=0):
+        if cache is None:
+            x = x + self.dropout(self.attn(self.ln1(x)))
+            x = x + self.dropout(
+                self.ffn_out(F.gelu(self.ffn_in(self.ln2(x)))))
+            return x
+        attn, new_cache = self.attn(self.ln1(x), cache, start_pos)
+        x = x + self.dropout(attn)
         x = x + self.dropout(self.ffn_out(F.gelu(self.ffn_in(self.ln2(x)))))
-        return x
+        return x, new_cache
 
 
 class GPTModel(nn.Layer):
@@ -126,16 +136,32 @@ class GPTModel(nn.Layer):
 
         _init_transformer_weights(self, 0.02)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                start_pos=0):
+        from ..core.tensor import Tensor
         from ..tensor.creation import arange
+        import jax.numpy as jnp
 
         b, s = input_ids.shape
         if position_ids is None:
-            position_ids = arange(s, dtype="int64").unsqueeze(0)
+            if caches is None:
+                position_ids = arange(s, dtype="int64").unsqueeze(0)
+            else:  # decode offset may be traced: static arange + add
+                position_ids = Tensor(
+                    (jnp.arange(s, dtype=jnp.int32) + start_pos)[None])
         x = self.dropout(self.wte(input_ids) + self.wpe(position_ids))
-        for blk in self.blocks:
-            x = blk(x)
-        return self.ln_f(x)
+        if caches is None:
+            for blk in self.blocks:
+                x = blk(x)
+            return self.ln_f(x)
+        if len(caches) != len(self.blocks):
+            raise ValueError(f"got {len(caches)} caches for "
+                             f"{len(self.blocks)} blocks")
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, nc = blk(x, cache, start_pos)
+            new_caches.append(nc)
+        return self.ln_f(x), new_caches
 
 
 class GPTEmbeddingPipe(nn.Layer):
@@ -208,10 +234,18 @@ class GPTForCausalLM(nn.Layer):
         super().__init__()
         self.gpt = GPTModel(cfg)
 
-    def forward(self, input_ids, position_ids=None):
-        h = self.gpt(input_ids, position_ids)
-        # tied LM head: one [h, vocab] matmul
-        return h.matmul(self.gpt.wte.weight, transpose_y=True)
+    def forward(self, input_ids, position_ids=None, caches=None,
+                start_pos=0):
+        if caches is None:
+            h = self.gpt(input_ids, position_ids)
+            # tied LM head: one [h, vocab] matmul
+            return h.matmul(self.gpt.wte.weight, transpose_y=True)
+        h, new_caches = self.gpt(input_ids, position_ids, caches, start_pos)
+        return h.matmul(self.gpt.wte.weight, transpose_y=True), new_caches
+
+    def generate(self, input_ids, **kwargs):
+        from .generation import generate
+        return generate(self, input_ids, **kwargs)
 
     def loss(self, logits, labels):
         vocab = logits.shape[-1]

@@ -62,20 +62,29 @@ class LlamaAttention(nn.Layer):
         self.v_proj = nn.Linear(h, kv, bias_attr=False)
         self.o_proj = nn.Linear(h, h, bias_attr=False)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, start_pos=0):
+        """cache: optional (k_cache, v_cache) raw jnp arrays of shape
+        (b, max_len, kv_heads, head_dim) — the KV-cache decode path
+        (inference only; returns (out, new_cache)). Without cache, the
+        ordinary causal training path."""
         from ..tensor import rotary_position_embedding
 
         b, s, h = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
-        q, k = rotary_position_embedding(q, k, theta=self.rope_theta)
+        q, k = rotary_position_embedding(q, k, theta=self.rope_theta,
+                                         position_offset=start_pos)
         rep = self.num_heads // self.num_kv_heads
-        if rep > 1:   # GQA: expand KV to full heads for the flash kernel
-            k = k.repeat_interleave(rep, axis=2)
-            v = v.repeat_interleave(rep, axis=2)
-        ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-        return self.o_proj(ctx.reshape([b, s, h]))
+        if cache is None:
+            if rep > 1:  # GQA: expand KV to full heads for the flash kernel
+                k = k.repeat_interleave(rep, axis=2)
+                v = v.repeat_interleave(rep, axis=2)
+            ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            return self.o_proj(ctx.reshape([b, s, h]))
+        from .generation import attend_with_cache
+        ctx, new_cache = attend_with_cache(q, k, v, cache, start_pos, rep)
+        return self.o_proj(ctx.reshape([b, s, h])), new_cache
 
 
 class LlamaMLP(nn.Layer):
@@ -104,9 +113,14 @@ class LlamaDecoderLayer(nn.Layer):
                                                    epsilon=cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
-        return x + self.mlp(self.post_attention_layernorm(x))
+    def forward(self, x, cache=None, start_pos=0):
+        if cache is None:
+            x = x + self.self_attn(self.input_layernorm(x))
+            return x + self.mlp(self.post_attention_layernorm(x))
+        attn, new_cache = self.self_attn(self.input_layernorm(x), cache,
+                                         start_pos)
+        x = x + attn
+        return x + self.mlp(self.post_attention_layernorm(x)), new_cache
 
 
 class LlamaModel(nn.Layer):
@@ -122,11 +136,20 @@ class LlamaModel(nn.Layer):
 
         _init_transformer_weights(self, 0.02)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, start_pos=0):
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x)
-        return self.norm(x)
+        if caches is None:
+            for layer in self.layers:
+                x = layer(x)
+            return self.norm(x)
+        if len(caches) != len(self.layers):
+            raise ValueError(f"got {len(caches)} caches for "
+                             f"{len(self.layers)} decoder layers")
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            x, nc = layer(x, cache, start_pos)
+            new_caches.append(nc)
+        return self.norm(x), new_caches
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -137,8 +160,15 @@ class LlamaForCausalLM(nn.Layer):
         self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                  bias_attr=False)
 
-    def forward(self, input_ids):
-        return self.lm_head(self.llama(input_ids))
+    def forward(self, input_ids, caches=None, start_pos=0):
+        if caches is None:
+            return self.lm_head(self.llama(input_ids))
+        h, new_caches = self.llama(input_ids, caches, start_pos)
+        return self.lm_head(h), new_caches
+
+    def generate(self, input_ids, **kwargs):
+        from .generation import generate
+        return generate(self, input_ids, **kwargs)
 
     def loss(self, logits, labels):
         vocab = logits.shape[-1]

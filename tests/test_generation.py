@@ -1,0 +1,120 @@
+"""KV-cache generation (models/generation.py): cache parity vs full
+recompute, greedy/sampling/eos behavior, GPT + LLaMA (GQA) coverage."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.functional import call_functional, extract_state
+from paddle_tpu.models import (
+    GPTConfig, GPTForCausalLM, LlamaConfig, LlamaForCausalLM,
+)
+from paddle_tpu.models.generation import init_caches
+
+
+def _llama():
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m, LlamaConfig.tiny()
+
+
+def _gpt():
+    m = GPTForCausalLM(GPTConfig.tiny())
+    m.eval()
+    return m, GPTConfig.tiny()
+
+
+@pytest.mark.parametrize("mk", [_llama, _gpt], ids=["llama", "gpt"])
+class TestCacheParity:
+    def test_prefill_matches_full_forward(self, mk):
+        m, cfg = mk()
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8))
+        full = m(paddle.to_tensor(ids)).numpy()
+        params, buffers = extract_state(m)
+        caches = init_caches(m, 2, 16)
+        (cached, _), _ = call_functional(
+            m, params, buffers, (Tensor(jnp.asarray(ids)),),
+            kwargs={"caches": caches, "start_pos": 0}, training=False)
+        np.testing.assert_allclose(np.asarray(cached), full, atol=2e-4)
+
+    def test_greedy_generate_matches_full_recompute(self, mk):
+        m, cfg = mk()
+        ids = np.random.RandomState(1).randint(0, cfg.vocab_size, (2, 6))
+        out = m.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                         temperature=0.0).numpy()
+        cur = ids.copy()
+        for _ in range(5):
+            lg = m(paddle.to_tensor(cur)).numpy()
+            cur = np.concatenate([cur, lg[:, -1].argmax(-1)[:, None]],
+                                 axis=1)
+        np.testing.assert_array_equal(out, cur)
+
+
+class TestSampling:
+    def test_seeded_sampling_reproducible(self):
+        m, cfg = _llama()
+        ids = np.random.RandomState(2).randint(0, cfg.vocab_size, (1, 4))
+        a = m.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                       temperature=0.8, seed=7).numpy()
+        b = m.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                       temperature=0.8, seed=7).numpy()
+        c = m.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                       temperature=0.8, seed=8).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)  # different seed diverges (w.h.p.)
+
+    def test_unseeded_sampling_differs_across_calls(self):
+        m, cfg = _llama()
+        ids = np.random.RandomState(6).randint(0, cfg.vocab_size, (1, 4))
+        outs = {tuple(m.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                                 temperature=1.5).numpy()[0])
+                for _ in range(4)}
+        assert len(outs) > 1  # fresh entropy per unseeded call (w.h.p.)
+
+    def test_jitted_steps_memoized_across_calls(self):
+        m, cfg = _llama()
+        ids = np.random.RandomState(7).randint(0, cfg.vocab_size, (1, 4))
+        m.generate(paddle.to_tensor(ids), max_new_tokens=3, temperature=0.0)
+        m.generate(paddle.to_tensor(ids), max_new_tokens=3, temperature=0.0)
+        assert len(m._generate_jit_cache) == 1  # same shapes -> one entry
+
+    def test_mismatched_cache_count_raises(self):
+        m, cfg = _llama()
+        from paddle_tpu.models.generation import init_caches
+        caches = init_caches(m, 1, 8)[:-1]  # one short
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int64))
+        with pytest.raises(ValueError, match="caches"):
+            m(ids, caches=caches, start_pos=0)
+
+    def test_top_k_one_is_greedy(self):
+        m, cfg = _llama()
+        ids = np.random.RandomState(3).randint(0, cfg.vocab_size, (1, 4))
+        greedy = m.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                            temperature=0.0).numpy()
+        topk1 = m.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                           temperature=0.5, top_k=1, seed=0).numpy()
+        np.testing.assert_array_equal(greedy, topk1)
+
+    def test_output_shape_and_prompt_preserved(self):
+        m, cfg = _gpt()
+        ids = np.random.RandomState(4).randint(0, cfg.vocab_size, (3, 5))
+        out = m.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                         temperature=0.0).numpy()
+        assert out.shape == (3, 9)
+        np.testing.assert_array_equal(out[:, :5], ids)
+        assert (out >= 0).all() and (out < cfg.vocab_size).all()
+
+    def test_eos_padding(self):
+        m, cfg = _llama()
+        ids = np.random.RandomState(5).randint(0, cfg.vocab_size, (1, 4))
+        # force eos on the very first sampled token by making every token eos
+        out_free = m.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                              temperature=0.0).numpy()
+        eos = int(out_free[0, 4])  # greedy first new token
+        out = m.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                         temperature=0.0, eos_token_id=eos).numpy()
+        assert out.shape == (1, 10)
+        # after the first eos, everything is eos
+        assert (out[0, 4:] == eos).all()

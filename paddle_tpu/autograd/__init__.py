@@ -132,6 +132,59 @@ def hessian(func, inputs, create_graph=False, allow_unused=False):
     return tuple(tuple(flat[i * n + j] for j in range(n)) for i in range(n))
 
 
+def jvp(func, xs, v=None):
+    """Forward-mode: (outputs, J @ v). Ref: paddle.incubate.autograd.jvp
+    (upstream layout, unverified — mount empty). v defaults to ones."""
+    single_in = isinstance(xs, Tensor)
+    xs_t = (xs,) if single_in else tuple(xs)
+    datas = tuple(x._data for x in xs_t)
+    if v is None:
+        tangents = tuple(jnp.ones_like(d) for d in datas)
+    else:
+        v_t = (v,) if isinstance(v, Tensor) else tuple(v)
+        tangents = tuple(t._data for t in v_t)
+
+    def pure(*ds):
+        return _call_pure(func, ds)
+
+    with no_grad():
+        outs, tans = jax.jvp(pure, datas, tangents)
+    wrap = lambda t: Tensor(t, stop_gradient=True)  # noqa: E731
+    if isinstance(outs, tuple):
+        return tuple(map(wrap, outs)), tuple(map(wrap, tans))
+    return wrap(outs), wrap(tans)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: (outputs, vᵀ @ J). Ref: paddle.incubate.autograd.vjp
+    (upstream layout, unverified — mount empty). v defaults to ones."""
+    single_in = isinstance(xs, Tensor)
+    xs_t = (xs,) if single_in else tuple(xs)
+    datas = tuple(x._data for x in xs_t)
+
+    def pure(*ds):
+        return _call_pure(func, ds)
+
+    with no_grad():
+        outs, pullback = jax.vjp(pure, *datas)
+        if v is None:
+            if isinstance(outs, tuple):
+                cots = tuple(jnp.ones_like(o) for o in outs)
+            else:
+                cots = jnp.ones_like(outs)
+        else:
+            if isinstance(v, Tensor):
+                cots = v._data
+            else:
+                cots = tuple(t._data for t in v)
+        grads = pullback(cots)
+    wrap = lambda t: Tensor(t, stop_gradient=True)  # noqa: E731
+    outs_w = tuple(map(wrap, outs)) if isinstance(outs, tuple) \
+        else wrap(outs)
+    grads_w = wrap(grads[0]) if single_in else tuple(map(wrap, grads))
+    return outs_w, grads_w
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved = ()

@@ -1,0 +1,80 @@
+"""paddle.incubate.autograd — functional AD surface (ref:
+python/paddle/incubate/autograd/ — upstream layout, unverified — mount
+empty). On this framework forward/reverse transforms are jax-native, so
+the incubate API is a thin parity shim over paddle.autograd; the upstream
+prim/composite machinery (operator decomposition for higher-order AD) is
+unnecessary — jax.jvp/jax.vjp compose to any order already."""
+from __future__ import annotations
+
+from ..autograd import hessian as _hessian
+from ..autograd import jacobian as _jacobian
+from ..autograd import jvp, vjp  # noqa: F401
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled"]
+
+
+def _check_single(xs, mat, kind):
+    if isinstance(mat, tuple):
+        raise NotImplementedError(
+            f"{kind} object view supports a single input tensor; for a "
+            f"list of inputs call paddle.autograd.{kind.lower()} directly "
+            "(it returns the per-input blocks)")
+    return mat
+
+
+class Jacobian:
+    """Lazy J[i][j]-style view (upstream returns an indexable object)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "is_batched=True is not implemented; vmap the function "
+                "yourself or compute per-sample jacobians")
+        self._mat = _check_single(xs, _jacobian(func, xs), "Jacobian")
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+    def numpy(self):
+        return self._mat.numpy()
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "is_batched=True is not implemented; vmap the function "
+                "yourself or compute per-sample hessians")
+        self._mat = _check_single(xs, _hessian(func, xs), "Hessian")
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+    def numpy(self):
+        return self._mat.numpy()
+
+
+_prim = {"enabled": False}
+
+
+def enable_prim():
+    """Upstream switches autodiff to primitive-op decomposition; here the
+    flag is accepted for compatibility (jax transforms already compose)."""
+    _prim["enabled"] = True
+
+
+def disable_prim():
+    _prim["enabled"] = False
+
+
+def prim_enabled():
+    return _prim["enabled"]

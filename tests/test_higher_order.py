@@ -132,3 +132,58 @@ class TestJacobianHessian:
         np.testing.assert_allclose(h[0][0].numpy(), [[2 * 2.0]], rtol=1e-6)
         np.testing.assert_allclose(h[0][1].numpy(), [[2 * 1.0]], rtol=1e-6)
         np.testing.assert_allclose(h[1][1].numpy(), [[0.0]], atol=1e-7)
+
+
+class TestFunctionalJvpVjp:
+    """paddle.autograd.jvp/vjp + incubate.autograd shim (round 3)."""
+
+    def test_jvp_values(self):
+        from paddle_tpu.autograd import jvp
+        f = lambda x: x * x + 2.0 * x
+        x = _t(np.float32([1.0, 2.0]))
+        out, tan = jvp(f, x, _t(np.float32([1.0, 1.0])))
+        np.testing.assert_allclose(out.numpy(), [3.0, 8.0])
+        np.testing.assert_allclose(tan.numpy(), [4.0, 6.0])  # 2x + 2
+
+    def test_jvp_default_tangent_ones(self):
+        from paddle_tpu.autograd import jvp
+        x = _t(np.float32([2.0]))
+        _, tan = jvp(lambda a: a * a, x)
+        np.testing.assert_allclose(tan.numpy(), [4.0])
+
+    def test_vjp_multi_input(self):
+        from paddle_tpu.autograd import vjp
+        f = lambda a, b: a * b
+        a, b = _t(np.float32([2.0])), _t(np.float32([5.0]))
+        out, (ga, gb) = vjp(f, (a, b))
+        np.testing.assert_allclose(out.numpy(), [10.0])
+        np.testing.assert_allclose(ga.numpy(), [5.0])
+        np.testing.assert_allclose(gb.numpy(), [2.0])
+
+    def test_incubate_shim(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.autograd import Hessian, Jacobian, jvp, vjp
+        assert jvp is paddle.autograd.jvp
+        J = Jacobian(lambda a: a * a, _t(np.float32([1.0, 3.0])))
+        np.testing.assert_allclose(J.numpy(), [[2.0, 0.0], [0.0, 6.0]])
+        H = Hessian(lambda a: (a * a).sum(), _t(np.float32([1.0, 2.0])))
+        np.testing.assert_allclose(H.numpy(), 2 * np.eye(2))
+
+    def test_object_views_reject_multi_input_and_batched(self):
+        import pytest as _pytest
+        from paddle_tpu.incubate.autograd import Hessian, Jacobian
+        x, y = _t(np.float32([1.0])), _t(np.float32([2.0]))
+        with _pytest.raises(NotImplementedError):
+            Jacobian(lambda a, b: a * b, [x, y])
+        with _pytest.raises(NotImplementedError):
+            Jacobian(lambda a: a, x, is_batched=True)
+        with _pytest.raises(NotImplementedError):
+            Hessian(lambda a: (a * a).sum(), x, is_batched=True)
+
+    def test_prim_flag_roundtrip(self):
+        from paddle_tpu.incubate import autograd as ia
+        assert not ia.prim_enabled()
+        ia.enable_prim()
+        assert ia.prim_enabled()
+        ia.disable_prim()
+        assert not ia.prim_enabled()

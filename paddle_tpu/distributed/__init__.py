@@ -12,8 +12,9 @@ from .group import (  # noqa: F401
 from .communication import (  # noqa: F401
     P2POp, ReduceOp, all_gather, all_gather_object, all_reduce,
     alltoall, alltoall_single, barrier, batch_isend_irecv, broadcast,
-    get_rank, get_world_size, irecv, isend, recv, reduce, reduce_scatter,
-    scatter, send, stream, wait,
+    broadcast_object_list, get_backend, get_rank, get_world_size, irecv,
+    isend, recv, reduce, reduce_scatter, scatter, scatter_object_list,
+    send, stream, wait,
 )
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
 from . import fleet  # noqa: F401

@@ -25,10 +25,11 @@ from .group import Group, get_default_group, new_group  # noqa: F401
 
 __all__ = [
     "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "reduce",
-    "reduce_scatter", "broadcast", "scatter", "alltoall", "alltoall_single",
+    "reduce_scatter", "broadcast", "broadcast_object_list", "scatter",
+    "scatter_object_list", "alltoall", "alltoall_single",
     "send", "recv", "isend", "irecv", "barrier", "batch_isend_irecv",
-    "P2POp", "wait", "get_rank", "get_world_size", "is_initialized",
-    "stream",
+    "P2POp", "wait", "get_backend", "get_rank", "get_world_size",
+    "is_initialized", "stream",
 ]
 
 
@@ -154,6 +155,43 @@ def all_gather_object(object_list: List, obj, group: Optional[Group] = None):
                            "outside jitted code")
     object_list.extend([obj] * 1)
     return object_list
+
+
+def broadcast_object_list(object_list: List, src: int = 0,
+                          group: Optional[Group] = None):
+    """Host-side object broadcast. Single-controller: every process in a
+    jax.distributed job holds the same Python program state, so the src
+    rank's list is already what this rank holds — the call validates scope
+    and returns the list unchanged (the reference pickles over NCCL)."""
+    g = _resolve(group)
+    if _axis_in_scope(g.axis_name):
+        raise RuntimeError("broadcast_object_list is host-side only; call "
+                           "it outside jitted code")
+    return object_list
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src: int = 0, group: Optional[Group] = None):
+    """Host-side object scatter: this rank receives its slot of the src
+    rank's list."""
+    g = _resolve(group)
+    if _axis_in_scope(g.axis_name):
+        raise RuntimeError("scatter_object_list is host-side only; call it "
+                           "outside jitted code")
+    rank = get_rank(group)
+    if in_object_list is not None:
+        if len(in_object_list) < get_world_size(group):
+            raise ValueError("in_object_list must have one entry per rank")
+        val = in_object_list[rank]  # read BEFORE clear: lists may alias
+        out_object_list.clear()
+        out_object_list.append(val)
+    return out_object_list
+
+
+def get_backend(group: Optional[Group] = None) -> str:
+    """The communication backend name — XLA collectives on this framework
+    (the reference returns 'NCCL'/'GLOO')."""
+    return "XLA"
 
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM,

@@ -14,24 +14,26 @@ __all__ = ["jvp", "vjp", "Jacobian", "Hessian", "enable_prim",
            "disable_prim", "prim_enabled"]
 
 
-def _check_single(xs, mat, kind):
-    if isinstance(mat, tuple):
+def _require_single_input(xs, kind):
+    from ..core.tensor import Tensor
+    if not isinstance(xs, Tensor):
         raise NotImplementedError(
             f"{kind} object view supports a single input tensor; for a "
             f"list of inputs call paddle.autograd.{kind.lower()} directly "
             "(it returns the per-input blocks)")
-    return mat
 
 
 class Jacobian:
-    """Lazy J[i][j]-style view (upstream returns an indexable object)."""
+    """Indexable J[i][j] view. Evaluated eagerly on construction (one
+    jacrev XLA program), unlike upstream's evaluate-on-index laziness."""
 
     def __init__(self, func, xs, is_batched=False):
         if is_batched:
             raise NotImplementedError(
                 "is_batched=True is not implemented; vmap the function "
                 "yourself or compute per-sample jacobians")
-        self._mat = _check_single(xs, _jacobian(func, xs), "Jacobian")
+        _require_single_input(xs, "Jacobian")
+        self._mat = _jacobian(func, xs)
 
     def __getitem__(self, idx):
         return self._mat[idx]
@@ -45,12 +47,15 @@ class Jacobian:
 
 
 class Hessian:
+    """Indexable H[i][j] view, evaluated eagerly on construction."""
+
     def __init__(self, func, xs, is_batched=False):
         if is_batched:
             raise NotImplementedError(
                 "is_batched=True is not implemented; vmap the function "
                 "yourself or compute per-sample hessians")
-        self._mat = _check_single(xs, _hessian(func, xs), "Hessian")
+        _require_single_input(xs, "Hessian")
+        self._mat = _hessian(func, xs)
 
     def __getitem__(self, idx):
         return self._mat[idx]

@@ -189,3 +189,17 @@ def vector_to_parameters(vec, parameters):
         p._data = vec._data[offset:offset + n].reshape(p._data.shape).astype(
             p._data.dtype)
         offset += n
+
+
+# grad-clip utils live in nn/clip.py (float32-accumulated norms); re-export
+from ..clip import clip_grad_norm_  # noqa: F401,E402
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place elementwise gradient clip to [-clip_value, clip_value]."""
+    params = [parameters] if not isinstance(parameters, (list, tuple)) \
+        else list(parameters)
+    cv = float(clip_value)
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -cv, cv)

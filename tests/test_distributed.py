@@ -342,3 +342,27 @@ def test_fleetrun_abort_on_failure(tmp_path):
         cwd="/root/repo")
     assert out.returncode == 3
     assert "aborting job" in out.stderr
+
+
+class TestObjectCollectivesAndBackend:
+    """Host-side object collectives + get_backend (round 3)."""
+
+    def test_object_collectives_single_process(self):
+        import paddle_tpu.distributed as D
+        objs = []
+        D.all_gather_object(objs, {"a": 1})
+        assert objs == [{"a": 1}]
+        lst = [{"x": 1}]
+        assert D.broadcast_object_list(lst) is lst
+        out = []
+        D.scatter_object_list(out, [42])
+        assert out == [42]
+
+    def test_scatter_object_list_validates_length(self):
+        import paddle_tpu.distributed as D
+        with pytest.raises(ValueError):
+            D.scatter_object_list([], [])
+
+    def test_get_backend(self):
+        import paddle_tpu.distributed as D
+        assert D.get_backend() == "XLA"

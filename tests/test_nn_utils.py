@@ -116,3 +116,55 @@ class TestParamVector:
         vector_to_parameters(doubled, l.parameters())
         np.testing.assert_allclose(
             parameters_to_vector(l.parameters()).numpy(), doubled.numpy())
+
+
+class TestGradClipUtils:
+    """clip_grad_norm_ / clip_grad_value_ (round 3)."""
+
+    def _net_with_grads(self):
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(3, 2)
+        x = paddle.to_tensor(np.ones((4, 3), np.float32) * 10)
+        (lin(x) ** 2).sum().backward()
+        return lin
+
+    def test_clip_grad_norm_scales_to_max(self):
+        from paddle_tpu.nn.utils import clip_grad_norm_
+        lin = self._net_with_grads()
+        g0 = np.concatenate([p.grad.numpy().ravel()
+                             for p in lin.parameters()])
+        total = clip_grad_norm_(list(lin.parameters()), max_norm=1.0)
+        np.testing.assert_allclose(float(total.numpy()),
+                                   np.linalg.norm(g0), rtol=1e-4)
+        g1 = np.concatenate([p.grad.numpy().ravel()
+                             for p in lin.parameters()])
+        np.testing.assert_allclose(np.linalg.norm(g1), 1.0, rtol=1e-4)
+
+    def test_small_grads_not_scaled_up(self):
+        from paddle_tpu.nn.utils import clip_grad_norm_
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(2, 1)
+        x = paddle.to_tensor(np.full((1, 2), 1e-4, np.float32))
+        lin(x).sum().backward()
+        g0 = np.concatenate([p.grad.numpy().ravel()
+                             for p in lin.parameters()])
+        clip_grad_norm_(list(lin.parameters()), max_norm=100.0)
+        g1 = np.concatenate([p.grad.numpy().ravel()
+                             for p in lin.parameters()])
+        np.testing.assert_allclose(g0, g1)  # under the cap: untouched
+
+    def test_inf_norm(self):
+        from paddle_tpu.nn.utils import clip_grad_norm_
+        lin = self._net_with_grads()
+        g0 = max(float(np.abs(p.grad.numpy()).max())
+                 for p in lin.parameters())
+        total = clip_grad_norm_(list(lin.parameters()), max_norm=1.0,
+                                norm_type=float("inf"))
+        np.testing.assert_allclose(float(total.numpy()), g0, rtol=1e-5)
+
+    def test_clip_grad_value(self):
+        from paddle_tpu.nn.utils import clip_grad_value_
+        lin = self._net_with_grads()
+        clip_grad_value_(list(lin.parameters()), 0.05)
+        for p in lin.parameters():
+            assert np.abs(p.grad.numpy()).max() <= 0.05 + 1e-8

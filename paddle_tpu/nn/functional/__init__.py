@@ -214,11 +214,32 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         n_axes = 1
     else:
         n_axes = len(list(normalized_shape))
+    from ...ops import pallas_kernels
+    if (n_axes == 1 and weight is not None
+            and pallas_kernels.fused_norm_available(x)):
+        # fused Pallas path (one VMEM pass fwd, one for dx) — SURVEY §7
+        from ...core.dispatch import apply_callable
+
+        if bias is None:  # apply_callable unwraps every arg: branch on None
+            def fn(xd, wd):
+                return pallas_kernels.layer_norm_fused(xd, wd, None, epsilon)
+            return apply_callable("layer_norm_fused", fn, x, weight)
+
+        def fn(xd, wd, bd):
+            return pallas_kernels.layer_norm_fused(xd, wd, bd, epsilon)
+        return apply_callable("layer_norm_fused", fn, x, weight, bias)
     return apply_op(_op("layer_norm"), x, weight, bias, epsilon=epsilon,
                     begin_norm_axis=x.ndim - n_axes)
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    from ...ops import pallas_kernels
+    if weight is not None and pallas_kernels.fused_norm_available(x):
+        from ...core.dispatch import apply_callable
+
+        def fn(xd, wd):
+            return pallas_kernels.rms_norm_fused(xd, wd, epsilon)
+        return apply_callable("rms_norm_fused", fn, x, weight)
     return apply_op(_op("rms_norm"), x, weight, epsilon=epsilon)
 
 

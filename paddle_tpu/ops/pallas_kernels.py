@@ -592,3 +592,176 @@ def flash_attention(q, k, v, attn_mask=None, is_causal=False,
                                      interpret=interpret)
 
     return apply_callable("flash_attention", fn, q, k, v, attn_mask)
+
+
+# ==================================================================== norms
+#
+# Fused RMSNorm / LayerNorm (SURVEY §7's "fused LN" in the designed Pallas
+# fusion set alongside flash attention). One HBM pass for the forward
+# (reduction + normalize + affine fused in VMEM), one for dx; dw/db are a
+# plain XLA reduction over rows (a matmul-shaped sum XLA handles well).
+# f32 compute inside the kernel regardless of input dtype (bf16-safe).
+
+_NORM_BLOCK_ROWS = 256
+_NORM_MAX_HIDDEN = 16384
+
+
+def fused_norm_available(x) -> bool:
+    """Fused path: TPU, float dtype, last dim 128-aligned (no pad-mask
+    logic in-kernel; every transformer hidden size qualifies)."""
+    xd = x._data if hasattr(x, "_data") else x
+    if not _on_tpu():
+        return False
+    if xd.ndim < 2 or xd.shape[-1] % 128 != 0:
+        return False
+    if xd.shape[-1] > _NORM_MAX_HIDDEN:
+        return False
+    return jnp.issubdtype(xd.dtype, jnp.floating)
+
+
+def _norm_fwd_call(x2, w, b, *, eps, subtract_mean, block_r, interpret):
+    """x2: (rows_p, h). Returns (y, mu, rstd) with mu/rstd (rows_p, 128)
+    sublane-broadcast (Mosaic block rule: last two dims (8,128)-tiled)."""
+    from jax.experimental import pallas as pl
+
+    rows_p, h = x2.shape
+    n_r = rows_p // block_r
+    has_b = b is not None
+
+    def kernel(*refs):
+        x_ref, w_ref = refs[0], refs[1]
+        b_ref = refs[2] if has_b else None
+        y_ref, mu_ref, rstd_ref = refs[-3:]
+        xb = x_ref[...].astype(jnp.float32)
+        if subtract_mean:
+            mu = jnp.mean(xb, axis=1, keepdims=True)
+            xc = xb - mu
+        else:
+            mu = jnp.zeros((block_r, 1), jnp.float32)
+            xc = xb
+        rstd = jax.lax.rsqrt(jnp.mean(xc * xc, axis=1, keepdims=True) + eps)
+        y = xc * rstd * w_ref[...].astype(jnp.float32)
+        if has_b:
+            y = y + b_ref[...].astype(jnp.float32)
+        y_ref[...] = y.astype(y_ref.dtype)
+        mu_ref[...] = jnp.broadcast_to(mu, (block_r, 128))
+        rstd_ref[...] = jnp.broadcast_to(rstd, (block_r, 128))
+
+    in_specs = [
+        pl.BlockSpec((block_r, h), lambda r: (r, 0)),
+        pl.BlockSpec((1, h), lambda r: (0, 0)),
+    ]
+    operands = [x2, w.reshape(1, h)]
+    if has_b:
+        in_specs.append(pl.BlockSpec((1, h), lambda r: (0, 0)))
+        operands.append(b.reshape(1, h))
+    return pl.pallas_call(
+        kernel,
+        grid=(n_r,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((block_r, h), lambda r: (r, 0)),
+                   pl.BlockSpec((block_r, 128), lambda r: (r, 0)),
+                   pl.BlockSpec((block_r, 128), lambda r: (r, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows_p, h), x2.dtype),
+                   jax.ShapeDtypeStruct((rows_p, 128), jnp.float32),
+                   jax.ShapeDtypeStruct((rows_p, 128), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+
+
+def _norm_bwd_call(x2, w, dy2, mu, rstd, *, subtract_mean, block_r,
+                   interpret):
+    """dx in one fused pass; (rows_p, h) blocks."""
+    from jax.experimental import pallas as pl
+
+    rows_p, h = x2.shape
+    n_r = rows_p // block_r
+
+    def kernel(x_ref, w_ref, dy_ref, mu_ref, rstd_ref, dx_ref):
+        xb = x_ref[...].astype(jnp.float32)
+        dy = dy_ref[...].astype(jnp.float32)
+        wv = w_ref[...].astype(jnp.float32)
+        mu = mu_ref[..., :1]
+        rstd = rstd_ref[..., :1]
+        xc = (xb - mu) if subtract_mean else xb
+        xhat = xc * rstd
+        dyw = dy * wv
+        c1 = jnp.mean(dyw * xhat, axis=1, keepdims=True)
+        dx = dyw - xhat * c1
+        if subtract_mean:
+            dx = dx - jnp.mean(dyw, axis=1, keepdims=True)
+        dx_ref[...] = (dx * rstd).astype(dx_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_r,),
+        in_specs=[pl.BlockSpec((block_r, h), lambda r: (r, 0)),
+                  pl.BlockSpec((1, h), lambda r: (0, 0)),
+                  pl.BlockSpec((block_r, h), lambda r: (r, 0)),
+                  pl.BlockSpec((block_r, 128), lambda r: (r, 0)),
+                  pl.BlockSpec((block_r, 128), lambda r: (r, 0))],
+        out_specs=pl.BlockSpec((block_r, h), lambda r: (r, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows_p, h), x2.dtype),
+        interpret=interpret,
+    )(x2, w.reshape(1, h), dy2, mu, rstd)
+
+
+def _fused_norm_data(x, weight, bias=None, eps=1e-6, subtract_mean=False,
+                     interpret=False):
+    """Differentiable fused norm over the last axis. subtract_mean=False →
+    RMSNorm, True → LayerNorm."""
+    shape = x.shape
+    h = shape[-1]
+    rows = int(np.prod(shape[:-1]))
+    # VMEM budget: the kernel holds ~4 f32 (block_r, h) tiles (x, y/dx, dy,
+    # temporaries); cap the row block so 16*block_r*h bytes stays ~4 MB
+    vmem_cap = max(8, (4 * 1024 * 1024 // (16 * h)) // 8 * 8)
+    block_r = min(_NORM_BLOCK_ROWS, vmem_cap, _round_up(rows, 8))
+    rows_p = _round_up(rows, block_r)
+    has_b = bias is not None
+
+    @jax.custom_vjp
+    def run(x, w, b):
+        return _fwd(x, w, b)[0]
+
+    def _fwd(x, w, b):
+        x2 = x.reshape(rows, h)
+        if rows_p != rows:  # padded rows: zeros → rstd=rsqrt(eps), no nan
+            x2 = jnp.pad(x2, ((0, rows_p - rows), (0, 0)))
+        y, mu, rstd = _norm_fwd_call(x2, w, b, eps=eps,
+                                     subtract_mean=subtract_mean,
+                                     block_r=block_r, interpret=interpret)
+        out = y[:rows].reshape(shape)
+        return out, (x2, w, mu, rstd)
+
+    def _bwd(res, dy):
+        x2, w, mu, rstd = res
+        dy2 = dy.reshape(rows, h)
+        if rows_p != rows:
+            dy2 = jnp.pad(dy2, ((0, rows_p - rows), (0, 0)))
+        dx = _norm_bwd_call(x2, w, dy2, mu, rstd,
+                            subtract_mean=subtract_mean, block_r=block_r,
+                            interpret=interpret)
+        # dw/db: row reductions — XLA's territory (fuses into one pass)
+        xc = x2.astype(jnp.float32)
+        if subtract_mean:
+            xc = xc - mu[:, :1]
+        xhat = xc * rstd[:, :1]
+        dyf = dy2.astype(jnp.float32)
+        dw = jnp.sum(dyf * xhat, axis=0).astype(w.dtype)
+        db = jnp.sum(dyf, axis=0).astype(w.dtype) if has_b else None
+        return (dx[:rows].reshape(shape), dw, db)
+
+    run.defvjp(lambda x, w, b: _fwd(x, w, b), _bwd)
+    b_arg = bias if has_b else None
+    return run(x, weight, b_arg)
+
+
+def rms_norm_fused(x, weight, eps=1e-6, interpret=False):
+    return _fused_norm_data(x, weight, None, eps, subtract_mean=False,
+                            interpret=interpret)
+
+
+def layer_norm_fused(x, weight, bias=None, eps=1e-5, interpret=False):
+    return _fused_norm_data(x, weight, bias, eps, subtract_mean=True,
+                            interpret=interpret)

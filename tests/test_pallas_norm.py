@@ -1,0 +1,125 @@
+"""Fused Pallas RMSNorm/LayerNorm kernels (SURVEY §7 fused-LN set):
+interpret-mode parity on CPU + real-TPU compile gates (flash-kernel test
+pattern: the hermetic suite runs interpret=True; the TPU box compiles the
+real Mosaic kernel)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import pallas_kernels as pk
+
+
+def _ref_rms(x, w, eps=1e-6):
+    return x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + eps) * w
+
+
+def _ref_ln(x, w, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    out = (x - mu) * jax.lax.rsqrt(var + eps) * w
+    return out + b if b is not None else out
+
+
+class TestFusedNormInterpret:
+    def _data(self, rows=(2, 7), h=256, dtype=jnp.float32, seed=0):
+        r = np.random.RandomState(seed)
+        x = jnp.asarray(r.standard_normal((*rows, h)), dtype)
+        w = jnp.asarray(r.standard_normal(h) * 0.1 + 1.0, dtype)
+        b = jnp.asarray(r.standard_normal(h) * 0.1, dtype)
+        return x, w, b
+
+    def test_rms_forward_parity(self):
+        x, w, _ = self._data()
+        got = pk.rms_norm_fused(x, w, 1e-6, interpret=True)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_ref_rms(x, w)), atol=1e-5)
+
+    def test_ln_forward_parity(self):
+        x, w, b = self._data()
+        got = pk.layer_norm_fused(x, w, b, 1e-5, interpret=True)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_ref_ln(x, w, b)), atol=1e-5)
+
+    def test_ln_no_bias(self):
+        x, w, _ = self._data()
+        got = pk.layer_norm_fused(x, w, None, 1e-5, interpret=True)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_ref_ln(x, w, None)),
+                                   atol=1e-5)
+
+    def test_grads_match_reference(self):
+        x, w, b = self._data()
+
+        def loss_f(x, w, b):
+            return (pk.layer_norm_fused(x, w, b, 1e-5, interpret=True)
+                    * jnp.cos(x)).sum()
+
+        def loss_r(x, w, b):
+            return (_ref_ln(x, w, b) * jnp.cos(x)).sum()
+
+        g1 = jax.grad(loss_f, argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(loss_r, argnums=(0, 1, 2))(x, w, b)
+        for a, c in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       atol=2e-4)
+
+    def test_rms_grads_match_reference(self):
+        x, w, _ = self._data(seed=3)
+
+        def loss_f(x, w):
+            return (pk.rms_norm_fused(x, w, 1e-6, interpret=True) ** 2).sum()
+
+        def loss_r(x, w):
+            return (_ref_rms(x, w) ** 2).sum()
+
+        g1 = jax.grad(loss_f, argnums=(0, 1))(x, w)
+        g2 = jax.grad(loss_r, argnums=(0, 1))(x, w)
+        for a, c in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       atol=2e-4)
+
+    def test_bf16_inputs(self):
+        x, w, _ = self._data(dtype=jnp.bfloat16)
+        got = pk.rms_norm_fused(x, w, 1e-6, interpret=True)
+        assert got.dtype == jnp.bfloat16
+        ref = _ref_rms(x.astype(jnp.float32), w.astype(jnp.float32))
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref), atol=0.1)
+
+    def test_row_padding(self):
+        # 3 rows: padded to block multiple internally; padded rows sliced
+        x, w, _ = self._data(rows=(3,), seed=5)
+        got = pk.rms_norm_fused(x, w, 1e-6, interpret=True)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_ref_rms(x, w)), atol=1e-5)
+
+    def test_availability_gate(self):
+        # 100 is not 128-aligned -> fused path unavailable everywhere
+        assert not pk.fused_norm_available(jnp.zeros((4, 100)))
+        assert not pk.fused_norm_available(jnp.zeros((4,)))
+        assert not pk.fused_norm_available(jnp.zeros((4, 256), jnp.int32))
+
+
+_on_real_tpu = jax.devices()[0].platform not in ("cpu",)
+
+
+@pytest.mark.skipif(not _on_real_tpu, reason="needs a real TPU chip")
+class TestFusedNormRealTPU:
+    def test_rms_compiles_and_matches(self):
+        r = np.random.RandomState(0)
+        x = jnp.asarray(r.standard_normal((64, 1024)), jnp.bfloat16)
+        w = jnp.asarray(np.ones(1024), jnp.bfloat16)
+        got = np.asarray(pk.rms_norm_fused(x, w, 1e-6), np.float32)
+        ref = np.asarray(_ref_rms(x.astype(jnp.float32),
+                                  w.astype(jnp.float32)))
+        np.testing.assert_allclose(got, ref, atol=0.1)
+
+    def test_ln_grad_compiles(self):
+        r = np.random.RandomState(1)
+        x = jnp.asarray(r.standard_normal((32, 512)), jnp.float32)
+        w = jnp.asarray(np.ones(512), jnp.float32)
+        b = jnp.asarray(np.zeros(512), jnp.float32)
+        g = jax.grad(lambda x: pk.layer_norm_fused(x, w, b).sum())(x)
+        assert np.isfinite(np.asarray(g)).all()

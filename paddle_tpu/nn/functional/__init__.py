@@ -208,6 +208,23 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 
 # ------------------------------------------------------------- norm/dropout
+def _amp_black_cast(*tensors):
+    """Mirror the dispatch AMP black-list for fused (apply_callable) paths:
+    the XLA norm ops are amp-black (upcast to fp32 under auto_cast), so the
+    Pallas path must produce the same dtypes."""
+    from ...amp import _STATE as _amp_state
+
+    if not _amp_state["enabled"]:
+        return tensors
+    import jax.numpy as _jnp
+
+    return tuple(
+        t.astype("float32")
+        if t is not None and _jnp.issubdtype(t._data.dtype, _jnp.floating)
+        and t._data.dtype != _jnp.float32 else t
+        for t in tensors)
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
                name=None):
     if isinstance(normalized_shape, int):
@@ -220,6 +237,7 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         # fused Pallas path (one VMEM pass fwd, one for dx) — SURVEY §7
         from ...core.dispatch import apply_callable
 
+        x, weight, bias = _amp_black_cast(x, weight, bias)
         if bias is None:  # apply_callable unwraps every arg: branch on None
             def fn(xd, wd):
                 return pallas_kernels.layer_norm_fused(xd, wd, None, epsilon)
@@ -236,6 +254,8 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     from ...ops import pallas_kernels
     if weight is not None and pallas_kernels.fused_norm_available(x):
         from ...core.dispatch import apply_callable
+
+        x, weight = _amp_black_cast(x, weight)
 
         def fn(xd, wd):
             return pallas_kernels.rms_norm_fused(xd, wd, epsilon)

@@ -24,6 +24,7 @@ from .lr import LRScheduler
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
     "AdamWDL", "RMSProp", "Adadelta", "Lamb", "LRScheduler", "lr",
+    "Rprop", "ASGD", "LBFGS",
 ]
 
 lr = lr_mod
@@ -506,3 +507,72 @@ class Lamb(Optimizer):
 
 
 AdamWDL = AdamW  # incubate alias
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (ref: python/paddle/optimizer/rprop.py, upstream
+    layout, unverified — mount empty): per-element step sizes grown/shrunk
+    by gradient-sign agreement; full-batch method (sign-based, so the
+    gradient magnitude never enters the update)."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _create_accumulators(self, p_data):
+        return {"prev_grad": jnp.zeros_like(p_data, dtype=jnp.float32),
+                "step_size": jnp.full_like(p_data, float(self.get_lr()),
+                                           dtype=jnp.float32)}
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g32 = g.astype(jnp.float32)
+        sign = jnp.sign(g32 * acc["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        step = jnp.clip(acc["step_size"] * factor, self._lr_min,
+                        self._lr_max)
+        # on sign flip the step is retracted (grad treated as 0 this round)
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        new_p = (p.astype(jnp.float32)
+                 - jnp.sign(g_eff) * step).astype(p.dtype)
+        return new_p, {"prev_grad": g_eff, "step_size": step}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (ref: python/paddle/optimizer/asgd.py, upstream layout,
+    unverified — mount empty): SGD steps plus a running average of the
+    iterates; the average is what `paddle.incubate` ModelAverage exposes
+    for eval, here kept as an accumulator slot per the upstream kernel."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._batch_num = batch_num
+
+    def _create_accumulators(self, p_data):
+        return {"d": jnp.zeros_like(p_data, dtype=jnp.float32),
+                "ys": jnp.zeros((self._batch_num,) + tuple(p_data.shape),
+                                jnp.float32)}
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        # upstream ASGD kernel: d += g_new - ys[t % m]; ys[t % m] = g_new;
+        # p -= lr/m * d   (a trailing average over the last m gradients)
+        g32 = g.astype(jnp.float32)
+        idx = (t - 1) % self._batch_num
+        old = acc["ys"][idx]
+        d = acc["d"] + g32 - old
+        ys = acc["ys"].at[idx].set(g32)
+        m = jnp.minimum(t.astype(jnp.float32), float(self._batch_num))
+        new_p = (p.astype(jnp.float32)
+                 - (lr_val * lr_scale) / m * d).astype(p.dtype)
+        return new_p, {"d": d, "ys": ys}
+
+
+from .lbfgs import LBFGS  # noqa: E402,F401

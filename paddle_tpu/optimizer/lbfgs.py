@@ -1,0 +1,205 @@
+"""L-BFGS optimizer (ref: python/paddle/optimizer/lbfgs.py, upstream
+layout, unverified — mount empty).
+
+Closure-based quasi-Newton: `step(closure)` re-evaluates loss+grads as the
+line search probes points. The two-loop recursion and strong-Wolfe search
+run host-side over a flattened parameter vector (L-BFGS is inherently
+sequential; each inner evaluation is still XLA-compiled through the
+ordinary eager path), matching the reference's dygraph implementation
+shape rather than a lax.while_loop — the loop bounds are tiny (history
+~10, line-search evals ~25) and data-dependent.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn: Optional[str] = None,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        if grad_clip is not None:
+            raise NotImplementedError(
+                "LBFGS does not support grad_clip (clipping the gradient "
+                "would break the line-search/curvature conditions)")
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._rho_hist: list = []
+        self._prev_flat_grad = None
+        self._n_evals = 0
+
+    # ------------------------------------------------------- flat helpers
+    def _params(self):
+        return [p for p in self._parameter_list if p.trainable]
+
+    def _gather_flat_grad(self):
+        gs = []
+        for p in self._params():
+            if p.grad is None:
+                gs.append(jnp.zeros(int(np.prod(p.shape)) or 1,
+                                    jnp.float32))
+            else:
+                gs.append(p.grad._data.astype(jnp.float32).reshape(-1))
+        return jnp.concatenate(gs)
+
+    def _gather_flat_params(self):
+        return jnp.concatenate([p._data.astype(jnp.float32).reshape(-1)
+                                for p in self._params()])
+
+    def _set_flat_params(self, flat):
+        offset = 0
+        for p in self._params():
+            n = int(np.prod(p.shape)) or 1
+            p._data = flat[offset:offset + n].reshape(p._data.shape).astype(
+                p._data.dtype)
+            offset += n
+
+    def _eval(self, closure, flat_x):
+        """Loss and flat gradient at x (restores nothing — caller owns).
+        Coupled L2 weight decay is folded into BOTH loss and gradient so
+        the strong-Wolfe conditions see one consistent objective."""
+        self._set_flat_params(flat_x)
+        self.clear_grad()
+        loss = closure()
+        self._n_evals += 1
+        ld = loss._data if isinstance(loss, Tensor) else loss
+        f = float(np.asarray(ld))
+        g = self._gather_flat_grad()
+        coeff = self.regularization.coeff if self.regularization is not None \
+            else 0.0
+        if coeff:
+            f += 0.5 * coeff * float(jnp.dot(flat_x, flat_x))
+            g = g + coeff * flat_x
+        return f, g
+
+    # ------------------------------------------------------- direction
+    def _two_loop(self, flat_grad):
+        q = flat_grad
+        alphas = []
+        for s, y, rho in zip(reversed(self._s_hist),
+                             reversed(self._y_hist),
+                             reversed(self._rho_hist)):
+            a = rho * jnp.dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if self._y_hist:
+            y_last, s_last = self._y_hist[-1], self._s_hist[-1]
+            gamma = jnp.dot(s_last, y_last) / jnp.maximum(
+                jnp.dot(y_last, y_last), 1e-12)
+            q = q * gamma
+        for (s, y, rho), a in zip(zip(self._s_hist, self._y_hist,
+                                      self._rho_hist), reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    # ------------------------------------------------------- line search
+    def _strong_wolfe(self, closure, x0, f0, g0, d, t, c1=1e-4, c2=0.9,
+                      max_ls=25):
+        """Strong-Wolfe line search (bracket + zoom, bisection steps)."""
+        dg0 = float(jnp.dot(g0, d))
+        f_prev, t_prev = f0, 0.0
+        g_new = g0
+        lo = hi = None
+        f_lo = f_hi = None
+        t_cur = t
+        for _ in range(max_ls):
+            f_new, g_new = self._eval(closure, x0 + t_cur * d)
+            dg_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t_cur * dg0 or \
+                    (t_prev > 0 and f_new >= f_prev):
+                lo, hi, f_lo, f_hi = t_prev, t_cur, f_prev, f_new
+                break
+            if abs(dg_new) <= -c2 * dg0:
+                return t_cur, f_new, g_new
+            if dg_new >= 0:
+                lo, hi, f_lo, f_hi = t_cur, t_prev, f_new, f_prev
+                break
+            f_prev, t_prev = f_new, t_cur
+            t_cur *= 2.0
+        else:
+            # bracket loop exhausted: (t_prev, f_prev, g_new) is the last
+            # point actually evaluated (t_cur was doubled past it)
+            return t_prev, f_prev, g_new
+        # zoom by bisection
+        for _ in range(max_ls):
+            t_mid = 0.5 * (lo + hi)
+            f_mid, g_mid = self._eval(closure, x0 + t_mid * d)
+            dg_mid = float(jnp.dot(g_mid, d))
+            if f_mid > f0 + c1 * t_mid * dg0 or f_mid >= f_lo:
+                hi, f_hi = t_mid, f_mid
+            else:
+                if abs(dg_mid) <= -c2 * dg0:
+                    return t_mid, f_mid, g_mid
+                if dg_mid * (hi - lo) >= 0:
+                    hi, f_hi = lo, f_lo
+                lo, f_lo = t_mid, f_mid
+            if abs(hi - lo) < 1e-10:
+                break
+        return t_mid, f_mid, g_mid
+
+    # ------------------------------------------------------------- step
+    def step(self, closure=None):
+        if closure is None:
+            raise RuntimeError("LBFGS.step requires a closure that "
+                               "recomputes loss and gradients")
+        self._n_evals = 0
+        lr = self.get_lr()
+        x = self._gather_flat_params()
+        loss0, flat_grad = self._eval(closure, x)
+        loss = loss0
+        if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+            return Tensor(jnp.asarray(loss))
+
+        for _ in range(self.max_iter):
+            d = self._two_loop(flat_grad)
+            t = min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(flat_grad))),
+                                   1e-12)) * lr if not self._s_hist else lr
+            if self.line_search_fn == "strong_wolfe":
+                t, loss, g_new = self._strong_wolfe(closure, x, loss,
+                                                    flat_grad, d, t)
+                x_new = x + t * d
+            else:
+                x_new = x + t * d
+                loss, g_new = self._eval(closure, x_new)
+            s = x_new - x
+            y = g_new - flat_grad
+            sy = float(jnp.dot(s, y))
+            if sy > 1e-10:
+                if len(self._s_hist) >= self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+                    self._rho_hist.pop(0)
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                self._rho_hist.append(1.0 / sy)
+            x, flat_grad = x_new, g_new
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+                break
+            if float(jnp.max(jnp.abs(s))) <= self.tolerance_change:
+                break
+            if self._n_evals >= self.max_eval:
+                break
+        self._set_flat_params(x)
+        return Tensor(jnp.asarray(loss))

@@ -202,3 +202,83 @@ class TestParamGroups:
         set_grad(p, [1.0])
         opt.step()
         np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+
+
+class TestRound3Optimizers:
+    """LBFGS / Rprop / ASGD (round 3)."""
+
+    def test_lbfgs_solves_quadratic(self):
+        from paddle_tpu.core.tensor import Parameter
+        r = np.random.RandomState(0)
+        A = r.standard_normal((6, 6)).astype(np.float32)
+        A = A @ A.T + 6 * np.eye(6, dtype=np.float32)
+        b = r.standard_normal(6).astype(np.float32)
+        p = Parameter(paddle.to_tensor(np.zeros(6, np.float32))._data)
+        p.stop_gradient = False
+        opt = paddle.optimizer.LBFGS(parameters=[p],
+                                     line_search_fn="strong_wolfe")
+        At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+
+        def closure():
+            opt.clear_grad()
+            loss = 0.5 * (p.matmul(At) * p).sum() - (p * bt).sum()
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        sol = np.linalg.solve(A, b)
+        np.testing.assert_allclose(p.numpy(), sol, atol=1e-3)
+
+    def test_lbfgs_requires_closure(self):
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(paddle.to_tensor(np.zeros(2, np.float32))._data)
+        opt = paddle.optimizer.LBFGS(parameters=[p])
+        with pytest.raises(RuntimeError, match="closure"):
+            opt.step()
+
+    def test_lbfgs_rejects_unknown_line_search(self):
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(paddle.to_tensor(np.zeros(2, np.float32))._data)
+        with pytest.raises(ValueError):
+            paddle.optimizer.LBFGS(parameters=[p], line_search_fn="armijo")
+
+    @pytest.mark.parametrize("mk", [
+        lambda ps: paddle.optimizer.Rprop(learning_rate=0.01,
+                                          parameters=ps),
+        lambda ps: paddle.optimizer.ASGD(learning_rate=0.05, batch_num=4,
+                                         parameters=ps),
+    ], ids=["rprop", "asgd"])
+    def test_converges_on_least_squares(self, mk):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        r = np.random.RandomState(3)
+        lin = nn.Linear(4, 2)
+        opt = mk(lin.parameters())
+        xs = paddle.to_tensor(r.standard_normal((16, 4)).astype(np.float32))
+        ys = paddle.to_tensor(r.standard_normal((16, 2)).astype(np.float32))
+        losses = []
+        for _ in range(30):
+            loss = F.mse_loss(lin(xs), ys)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.5 * losses[0]
+
+    def test_rprop_step_size_bounds(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        lin = nn.Linear(2, 1)
+        opt = paddle.optimizer.Rprop(learning_rate=0.01,
+                                     learning_rate_range=(1e-4, 0.02),
+                                     parameters=lin.parameters())
+        x = paddle.to_tensor(np.ones((4, 2), np.float32))
+        y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+        for _ in range(10):
+            loss = F.mse_loss(lin(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        for slots in opt._accumulators.values():
+            s = np.asarray(slots["step_size"])
+            assert (s >= 1e-4 - 1e-8).all() and (s <= 0.02 + 1e-8).all()

@@ -211,7 +211,10 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def _amp_black_cast(*tensors):
     """Mirror the dispatch AMP black-list for fused (apply_callable) paths:
     the XLA norm ops are amp-black (upcast to fp32 under auto_cast), so the
-    Pallas path must produce the same dtypes."""
+    Pallas path must produce the same dtypes. Note custom_white_list cannot
+    override a DECLARED-black op in the dispatch handler either (`name in
+    black or opdef.amp_list == "black"` — declaration wins), so the
+    unconditional upcast here matches apply_op exactly."""
     from ...amp import _STATE as _amp_state
 
     if not _amp_state["enabled"]:

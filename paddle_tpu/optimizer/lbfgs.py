@@ -36,6 +36,12 @@ class LBFGS(Optimizer):
             raise NotImplementedError(
                 "LBFGS does not support grad_clip (clipping the gradient "
                 "would break the line-search/curvature conditions)")
+        from . import L2Decay
+        if self.regularization is not None and \
+                not isinstance(self.regularization, L2Decay):
+            raise NotImplementedError(
+                "LBFGS supports only L2 weight decay (float or L2Decay); "
+                "other regularizers would change the line-search objective")
         self.max_iter = max_iter
         self.max_eval = max_eval if max_eval is not None \
             else max_iter * 5 // 4
@@ -46,7 +52,6 @@ class LBFGS(Optimizer):
         self._s_hist: list = []
         self._y_hist: list = []
         self._rho_hist: list = []
-        self._prev_flat_grad = None
         self._n_evals = 0
 
     # ------------------------------------------------------- flat helpers
@@ -57,8 +62,7 @@ class LBFGS(Optimizer):
         gs = []
         for p in self._params():
             if p.grad is None:
-                gs.append(jnp.zeros(int(np.prod(p.shape)) or 1,
-                                    jnp.float32))
+                gs.append(jnp.zeros(int(np.prod(p.shape)), jnp.float32))
             else:
                 gs.append(p.grad._data.astype(jnp.float32).reshape(-1))
         return jnp.concatenate(gs)
@@ -70,7 +74,7 @@ class LBFGS(Optimizer):
     def _set_flat_params(self, flat):
         offset = 0
         for p in self._params():
-            n = int(np.prod(p.shape)) or 1
+            n = int(np.prod(p.shape))
             p._data = flat[offset:offset + n].reshape(p._data.shape).astype(
                 p._data.dtype)
             offset += n
@@ -122,19 +126,19 @@ class LBFGS(Optimizer):
         f_prev, t_prev = f0, 0.0
         g_new = g0
         lo = hi = None
-        f_lo = f_hi = None
+        f_lo = None
         t_cur = t
         for _ in range(max_ls):
             f_new, g_new = self._eval(closure, x0 + t_cur * d)
             dg_new = float(jnp.dot(g_new, d))
             if f_new > f0 + c1 * t_cur * dg0 or \
                     (t_prev > 0 and f_new >= f_prev):
-                lo, hi, f_lo, f_hi = t_prev, t_cur, f_prev, f_new
+                lo, hi, f_lo = t_prev, t_cur, f_prev
                 break
             if abs(dg_new) <= -c2 * dg0:
                 return t_cur, f_new, g_new
             if dg_new >= 0:
-                lo, hi, f_lo, f_hi = t_cur, t_prev, f_new, f_prev
+                lo, hi, f_lo = t_cur, t_prev, f_new
                 break
             f_prev, t_prev = f_new, t_cur
             t_cur *= 2.0
@@ -148,16 +152,37 @@ class LBFGS(Optimizer):
             f_mid, g_mid = self._eval(closure, x0 + t_mid * d)
             dg_mid = float(jnp.dot(g_mid, d))
             if f_mid > f0 + c1 * t_mid * dg0 or f_mid >= f_lo:
-                hi, f_hi = t_mid, f_mid
+                hi = t_mid
             else:
                 if abs(dg_mid) <= -c2 * dg0:
                     return t_mid, f_mid, g_mid
                 if dg_mid * (hi - lo) >= 0:
-                    hi, f_hi = lo, f_lo
+                    hi = lo
                 lo, f_lo = t_mid, f_mid
             if abs(hi - lo) < 1e-10:
                 break
         return t_mid, f_mid, g_mid
+
+    # ------------------------------------------------------- checkpoint
+    def state_dict(self):
+        out = super().state_dict()
+        out["@lbfgs_history"] = {
+            "s": [Tensor(a) for a in self._s_hist],
+            "y": [Tensor(a) for a in self._y_hist],
+            "rho": list(self._rho_hist),
+        }
+        return out
+
+    def set_state_dict(self, state_dict):
+        state_dict = dict(state_dict)
+        hist = state_dict.pop("@lbfgs_history", None)
+        super().set_state_dict(state_dict)
+        if hist:
+            unwrap = lambda a: a._data if isinstance(a, Tensor) \
+                else jnp.asarray(np.asarray(a))  # noqa: E731
+            self._s_hist = [unwrap(a) for a in hist["s"]]
+            self._y_hist = [unwrap(a) for a in hist["y"]]
+            self._rho_hist = [float(r) for r in hist["rho"]]
 
     # ------------------------------------------------------------- step
     def step(self, closure=None):

@@ -81,3 +81,29 @@ def test_double_step_without_update_raises():
     scaler.update()
     scaler2, opt2, _ = _one_param_opt()
     scaler2.step(opt2)  # fresh pair fine after update
+
+
+def test_fused_norm_path_matches_dispatch_dtype_under_amp():
+    """The fused Pallas norm branch must produce the same output dtype as
+    the apply_op path under auto_cast — incl. with custom_white_list,
+    which cannot override a declared-black op in either path."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import amp
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).standard_normal((4, 256))
+        .astype(np.float32)).astype("bfloat16")
+    w = paddle.to_tensor(np.ones(256, np.float32)).astype("bfloat16")
+    with amp.auto_cast(level="O1", dtype="bfloat16",
+                       custom_white_list=["rms_norm"]):
+        dispatch_out = F.rms_norm(x, w)  # CPU: apply_op path
+    assert str(dispatch_out.dtype).endswith("float32")
+    # the fused branch applies the same declared-black upcast
+    from paddle_tpu.nn.functional import _amp_black_cast
+    with amp.auto_cast(level="O1", dtype="bfloat16",
+                       custom_white_list=["rms_norm"]):
+        xc, wc = _amp_black_cast(x, w)
+    assert str(xc.dtype).endswith("float32")
+    assert str(wc.dtype).endswith("float32")

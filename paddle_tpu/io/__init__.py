@@ -77,6 +77,32 @@ class ConcatDataset(Dataset):
         raise IndexError(idx)
 
 
+class ComposeDataset(Dataset):
+    """Zip-style composition: sample i is the flattened concatenation of
+    each dataset's sample i (paddle.io.ComposeDataset semantics)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("datasets must be non-empty")
+        lens = {len(d) for d in self.datasets}
+        if len(lens) != 1:
+            raise ValueError("all datasets must have the same length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            if isinstance(item, (list, tuple)):
+                out.extend(item)
+            else:
+                out.append(item)
+        return tuple(out)
+
+
 class ChainDataset(IterableDataset):
     def __init__(self, datasets):
         self.datasets = list(datasets)
@@ -119,6 +145,22 @@ class Sampler:
 class SequenceSampler(Sampler):
     def __iter__(self):
         return iter(range(len(self.data_source)))
+
+
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+
+        order = _np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class RandomSampler(Sampler):

@@ -468,6 +468,72 @@ def kl_div(input, label, reduction="mean", name=None):
     return apply_op(_op("kl_div"), input, label, reduction=reduction)
 
 
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    return apply_op(_op("huber_loss"), input, label, delta=delta,
+                    reduction=reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(_op("soft_margin_loss"), input, label,
+                    reduction=reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    return apply_op(_op("multi_label_soft_margin_loss"), input, label,
+                    weight, reduction=reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    return apply_op(_op("poisson_nll_loss"), input, label,
+                    log_input=log_input, full=full, epsilon=epsilon,
+                    reduction=reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    return apply_op(_op("gaussian_nll_loss"), input, label, variance,
+                    full=full, epsilon=epsilon, reduction=reduction)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return apply_op(_op("pairwise_distance"), x, y, p=p, epsilon=epsilon,
+                    keepdim=keepdim)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    return apply_op(_op("triplet_margin_loss"), input, positive, negative,
+                    margin=margin, p=p, epsilon=epsilon, swap=swap,
+                    reduction=reduction)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(_op("log_loss"), input, label, epsilon=epsilon)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return apply_op(_op("dice_loss"), input, label, epsilon=epsilon)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False,
+                         reduction="mean", group=None, name=None):
+    return apply_op(_op("margin_cross_entropy"), logits, label,
+                    margin1=margin1, margin2=margin2, margin3=margin3,
+                    scale=scale, return_softmax=return_softmax,
+                    reduction=reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    return apply_op(_op("ctc_loss"), log_probs, labels, input_lengths,
+                    label_lengths, blank=blank, reduction=reduction,
+                    norm_by_times=norm_by_times)
+
+
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
                        reduction="sum", name=None):
     return apply_op(_op("sigmoid_focal_loss"), logit, label, normalizer,

@@ -873,3 +873,183 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
     rest = x5[:, :, 2 * fold:]
     out = jnp.concatenate([left, right, rest], axis=2)
     return out.reshape(nt, c, h, w)
+
+
+# ----------------------------------------------------------- round-3 losses
+
+def _reduce_loss(loss, reduction):
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("huber_loss")
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff <= delta, 0.5 * diff * diff,
+                     delta * (diff - 0.5 * delta))
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("soft_margin_loss")
+def soft_margin_loss(input, label, reduction="mean"):
+    # softplus(-y*x): overflow-stable form of log(1 + exp(-y*x))
+    loss = jax.nn.softplus(-label.astype(input.dtype) * input)
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("multi_label_soft_margin_loss")
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    lab = label.astype(input.dtype)
+    loss = -(lab * jax.nn.log_sigmoid(input)
+             + (1.0 - lab) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("poisson_nll_loss")
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for the label! term, applied where y > 1
+        stirling = (label * jnp.log(label + epsilon) - label
+                    + 0.5 * jnp.log(2.0 * math.pi * (label + epsilon)))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("gaussian_nll_loss")
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * math.log(2.0 * math.pi)
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("pairwise_distance")
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y + epsilon
+    return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+
+@register_op("triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return pairwise_distance(a, b, p=p, epsilon=epsilon)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("log_loss")
+def log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) \
+        - (1.0 - label) * jnp.log(1.0 - input + epsilon)
+
+
+@register_op("dice_loss")
+def dice_loss(input, label, epsilon=1e-5):
+    # input: (N, ..., C) probabilities; label: (N, ..., 1) int class ids
+    n_classes = input.shape[-1]
+    lab = jax.nn.one_hot(label.squeeze(-1), n_classes, dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lab, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(lab, axis=reduce_dims)
+    dice = (2.0 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+@register_op("margin_cross_entropy")
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax (cos(m1*θ + m2) - m3), single-rank
+    path (the fleet model-parallel variant shards the class dim)."""
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    target_cos = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    adjusted = jnp.where(onehot > 0, target_cos, cos) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(onehot * logp, axis=-1)
+    loss = _reduce_loss(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+@register_op("ctc_loss")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC forward algorithm in log space via lax.scan over time.
+
+    log_probs: (T, B, C) log-softmaxed activations (paddle's warpctc
+    contract); labels: (B, L) int; returns per-sample negative log
+    likelihood. Static shapes: the alpha lattice is (B, 2L+1) with masked
+    updates — TPU-friendly (one scan, no data-dependent shapes)."""
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    neg_inf = jnp.float32(-1e30)
+
+    # extended label sequence: blank y1 blank y2 ... yL blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    pos = jnp.arange(S)[None, :]
+    valid = pos < (2 * label_lengths[:, None] + 1)
+    # transitions: alpha[s] <- alpha[s] + alpha[s-1] (+ alpha[s-2] when the
+    # current symbol differs from the one two back, i.e. not blank-blank
+    # and not repeated label)
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    lp0 = log_probs[0]
+    alpha0 = jnp.where(pos == 0, lp0[jnp.arange(B)[:, None], ext[:, :1]],
+                       jnp.where(pos == 1,
+                                 lp0[jnp.arange(B)[:, None], ext[:, 1:2]],
+                                 neg_inf))
+    alpha0 = jnp.where(valid, alpha0, neg_inf)
+
+    def step(alpha, lp_t):
+        a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                          constant_values=neg_inf)[:, :S]
+        a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                          constant_values=neg_inf)[:, :S]
+        a = jnp.logaddexp(alpha, a_prev1)
+        a = jnp.where(can_skip, jnp.logaddexp(a, a_prev2), a)
+        emit = lp_t[jnp.arange(B)[:, None], ext]
+        new_alpha = jnp.where(valid, a + emit, neg_inf)
+        return new_alpha, new_alpha
+
+    _, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, B, S)
+
+    # read out at each sample's input length: last blank or last label
+    t_idx = jnp.clip(input_lengths.astype(jnp.int32) - 1, 0, T - 1)
+    alpha_T = alphas[t_idx, jnp.arange(B)]                    # (B, S)
+    end = 2 * label_lengths.astype(jnp.int32)
+    a_last = alpha_T[jnp.arange(B), end]
+    a_prev = alpha_T[jnp.arange(B), jnp.maximum(end - 1, 0)]
+    nll = -jnp.logaddexp(a_last, jnp.where(label_lengths > 0, a_prev,
+                                           neg_inf))
+    if norm_by_times:
+        nll = nll / jnp.maximum(input_lengths.astype(nll.dtype), 1.0)
+    if reduction == "mean":
+        # warpctc/torch contract: per-sample nll over label length, THEN
+        # batch mean
+        return jnp.mean(nll / jnp.maximum(
+            label_lengths.astype(nll.dtype), 1.0))
+    return _reduce_loss(nll, reduction)

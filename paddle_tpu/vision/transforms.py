@@ -180,6 +180,78 @@ def adjust_hue(img, factor):
     return _clip_like(out, _as_hwc(img))
 
 
+def _inverse_map(img, xin, yin, fill):
+    """Nearest-neighbour sample img at float input coords (h, w grids)."""
+    h, w = img.shape[:2]
+    xi = np.round(xin).astype(np.int64)
+    yi = np.round(yin).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full_like(img, fill)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """Affine transform (paddle.vision.transforms.affine contract):
+    rotation + translation + isotropic scale + shear, about the center."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else (
+        center[1], center[0])
+    if isinstance(shear, numbers.Number):
+        shear = (float(shear), 0.0)
+    rad = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward matrix M = T(center) R S Shear T(-center) + translate;
+    # build it then invert for output->input mapping
+    # torchvision/paddle matrix convention: rot - sy (y-shear direction)
+    a = scale * np.cos(rad - sy) / np.cos(sy)
+    b = scale * (-np.cos(rad - sy) * np.tan(sx) / np.cos(sy)
+                 - np.sin(rad))
+    c = scale * np.sin(rad - sy) / np.cos(sy)
+    d = scale * (-np.sin(rad - sy) * np.tan(sx) / np.cos(sy)
+                 + np.cos(rad))
+    M = np.array([[a, b], [c, d]])
+    Minv = np.linalg.inv(M)
+    tx, ty = translate
+    ys, xs = np.mgrid[0:h, 0:w]
+    dx = xs - cx - tx
+    dy = ys - cy - ty
+    xin = Minv[0, 0] * dx + Minv[0, 1] * dy + cx
+    yin = Minv[1, 0] * dx + Minv[1, 1] * dy + cy
+    return _inverse_map(img, xin, yin, fill)
+
+
+def _homography(src, dst):
+    """8-dof homography mapping src points -> dst points (4 pairs)."""
+    A, bv = [], []
+    for (x, y), (u, v) in zip(src, dst):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        bv.append(u)
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        bv.append(v)
+    hcoef = np.linalg.solve(np.asarray(A, np.float64),
+                            np.asarray(bv, np.float64))
+    return np.append(hcoef, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """4-point perspective warp: startpoints (in the input) map to
+    endpoints (in the output)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    # inverse mapping: output coords -> input coords
+    Hinv = _homography(endpoints, startpoints)
+    ys, xs = np.mgrid[0:h, 0:w]
+    denom = Hinv[2, 0] * xs + Hinv[2, 1] * ys + Hinv[2, 2]
+    denom = np.where(np.abs(denom) < 1e-9, 1e-9, denom)
+    xin = (Hinv[0, 0] * xs + Hinv[0, 1] * ys + Hinv[0, 2]) / denom
+    yin = (Hinv[1, 0] * xs + Hinv[1, 1] * ys + Hinv[1, 2]) / denom
+    return _inverse_map(img, xin, yin, fill)
+
+
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
     """Rotate by angle degrees (nearest-neighbour inverse mapping)."""
@@ -193,12 +265,7 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     # inverse rotation: output coord -> input coord
     xin = cos * (xs - cx) + sin * (ys - cy) + cx
     yin = -sin * (xs - cx) + cos * (ys - cy) + cy
-    xi = np.round(xin).astype(np.int64)
-    yi = np.round(yin).astype(np.int64)
-    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
-    out = np.full_like(img, fill)
-    out[valid] = img[yi[valid], xi[valid]]
-    return out
+    return _inverse_map(img, xin, yin, fill)
 
 
 def erase(img, i, j, h, w, v, inplace=False):
@@ -461,6 +528,63 @@ class RandomRotation(BaseTransform):
     def _apply_image(self, img):
         angle = random.uniform(*self.degrees)
         return rotate(img, angle, **self.kwargs)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.kwargs = dict(interpolation=interpolation, fill=fill,
+                           center=center)
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        h, w = _as_hwc(img).shape[:2]
+        tx = ty = 0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale is not None else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            shear = self.shear
+            if isinstance(shear, numbers.Number):
+                shear = (-shear, shear)
+            if len(shear) == 2:
+                sh = (random.uniform(shear[0], shear[1]), 0.0)
+            else:
+                sh = (random.uniform(shear[0], shear[1]),
+                      random.uniform(shear[2], shear[3]))
+        return affine(img, angle=angle, translate=(tx, ty), scale=sc,
+                      shear=sh, **self.kwargs)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.kwargs = dict(interpolation=interpolation, fill=fill)
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        h, w = _as_hwc(img).shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(d * h / 2), int(d * w / 2)
+        def jitter(x, y, dx, dy):
+            return (x + random.randint(0, max(dx, 1) - 1) * (1 if x == 0 else -1),
+                    y + random.randint(0, max(dy, 1) - 1) * (1 if y == 0 else -1))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [jitter(x, y, half_w, half_h) for x, y in start]
+        return perspective(img, start, end, **self.kwargs)
 
 
 class RandomErasing(BaseTransform):

@@ -231,3 +231,25 @@ def test_paddle_save_load_roundtrip(tmp_path):
     back = paddle.load(p)
     np.testing.assert_allclose(back["w"].numpy(), obj["w"].numpy())
     assert back["meta"] == obj["meta"]
+
+
+class TestRound3Transforms:
+    def test_affine_identity_and_translate(self):
+        from paddle_tpu.vision.transforms import affine
+        img = np.arange(5 * 5 * 3, dtype=np.uint8).reshape(5, 5, 3)
+        np.testing.assert_array_equal(affine(img), img)
+        out = affine(img, translate=(1, 0))
+        np.testing.assert_array_equal(out[:, 1:], img[:, :-1])
+
+    def test_perspective_identity(self):
+        from paddle_tpu.vision.transforms import perspective
+        img = np.arange(4 * 4 * 3, dtype=np.uint8).reshape(4, 4, 3)
+        pts = [(0, 0), (3, 0), (3, 3), (0, 3)]
+        np.testing.assert_array_equal(perspective(img, pts, pts), img)
+
+    def test_random_affine_and_perspective_shapes(self):
+        import paddle_tpu.vision.transforms as T
+        img = np.zeros((8, 8, 3), np.uint8)
+        assert T.RandomAffine(15, translate=(0.2, 0.2), scale=(0.8, 1.2),
+                              shear=10)(img).shape == (8, 8, 3)
+        assert T.RandomPerspective(prob=1.0)(img).shape == (8, 8, 3)

@@ -1,5 +1,6 @@
 """io: datasets, samplers, DataLoader, DistributedBatchSampler contract."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.io import (
@@ -138,3 +139,44 @@ class TestDistributedBatchSampler:
         assert e0 == [i for b in s for i in b]  # same epoch → same order
         s.set_epoch(1)
         assert e0 != [i for b in s for i in b]  # different epoch → reshuffle
+
+
+class TestRound3IO:
+    def test_compose_dataset(self):
+        class DS(Dataset):
+            def __init__(self, v):
+                self.v = v
+
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return (self.v * i, self.v)
+
+        from paddle_tpu.io import ComposeDataset
+        cd = ComposeDataset([DS(1), DS(2)])
+        assert len(cd) == 4
+        assert cd[2] == (2, 1, 4, 2)
+
+    def test_compose_dataset_validates(self):
+        class DS(Dataset):
+            def __init__(self, n):
+                self.n = n
+
+            def __len__(self):
+                return self.n
+
+            def __getitem__(self, i):
+                return i
+
+        from paddle_tpu.io import ComposeDataset
+        with pytest.raises(ValueError):
+            ComposeDataset([])
+        with pytest.raises(ValueError):
+            ComposeDataset([DS(3), DS(4)])
+
+    def test_subset_random_sampler(self):
+        from paddle_tpu.io import SubsetRandomSampler
+        s = SubsetRandomSampler([5, 7, 9])
+        assert len(s) == 3
+        assert sorted(s) == [5, 7, 9]

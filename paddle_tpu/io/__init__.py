@@ -154,9 +154,7 @@ class SubsetRandomSampler(Sampler):
         self.indices = list(indices)
 
     def __iter__(self):
-        import numpy as _np
-
-        order = _np.random.permutation(len(self.indices))
+        order = np.random.permutation(len(self.indices))
         return iter([self.indices[i] for i in order])
 
     def __len__(self):

@@ -39,7 +39,7 @@ from .layer.transformer import (  # noqa: F401
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
 from .layer.rnn import (  # noqa: F401
-    GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell,
+    GRU, GRUCell, LSTM, LSTMCell, RNN, BiRNN, SimpleRNN, SimpleRNNCell,
 )
 from .clip import (  # noqa: F401
     ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,

@@ -534,6 +534,13 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
                     norm_by_times=norm_by_times)
 
 
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    return apply_op(_op("rnnt_loss"), input, label, input_lengths,
+                    label_lengths, blank=blank,
+                    fastemit_lambda=fastemit_lambda, reduction=reduction)
+
+
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
                        reduction="sum", name=None):
     return apply_op(_op("sigmoid_focal_loss"), logit, label, normalizer,

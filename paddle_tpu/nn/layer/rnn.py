@@ -103,6 +103,18 @@ class GRUCell(_RNNCellBase):
         return out, out
 
 
+def _map_states(states, fn):
+    if isinstance(states, (tuple, list)):
+        return type(states)(_map_states(s, fn) for s in states)
+    return fn(states)
+
+
+def _map_states2(a, b, fn):
+    if isinstance(a, (tuple, list)):
+        return type(a)(_map_states2(x, y, fn) for x, y in zip(a, b))
+    return fn(a, b)
+
+
 class RNN(Layer):
     """Wraps a cell into a layer scanning over time (paddle.nn.RNN)."""
 
@@ -113,19 +125,36 @@ class RNN(Layer):
         self.time_major = time_major
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import stack
+        from ...tensor.creation import to_tensor
+
         time_axis = 0 if self.time_major else 1
         steps = inputs.shape[time_axis]
+        sl = None
+        if sequence_length is not None:
+            # masked updates: padded steps keep the previous state and emit
+            # zeros, so a reversed scan still starts at each sample's LAST
+            # VALID frame (paddle semantics)
+            sl = to_tensor(sequence_length).astype("int32").unsqueeze(-1)
         outputs = []
         states = initial_states
         idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
         for i in idx:
             x_t = inputs[:, i] if time_axis == 1 else inputs[i]
-            out, states = self.cell(x_t, states)
+            out, new_states = self.cell(x_t, states)
+            if sl is not None:
+                valid = (sl > i).astype(out.dtype)
+                out = out * valid
+                if states is None:
+                    states = _map_states(new_states,
+                                         lambda ns: ns * 0.0)
+                new_states = _map_states2(
+                    new_states, states,
+                    lambda ns, os: ns * valid + os * (1.0 - valid))
+            states = new_states
             outputs.append(out)
         if self.is_reverse:
             outputs = outputs[::-1]
-        from ...tensor import stack
-
         out = stack(outputs, axis=time_axis)
         return out, states
 
@@ -280,3 +309,26 @@ class LSTM(_RNNBase):
 
 class GRU(_RNNBase):
     _MODE = "GRU"
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (paddle.nn.BiRNN): forward and
+    backward passes concatenated on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import concat
+
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

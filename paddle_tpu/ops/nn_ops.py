@@ -978,7 +978,8 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
                          reduction="mean"):
     """ArcFace-family margin softmax (cos(m1*θ + m2) - m3), single-rank
     path (the fleet model-parallel variant shards the class dim)."""
-    cos = jnp.clip(logits, -1.0, 1.0)
+    # clip strictly inside (-1, 1): d/dx arccos explodes at the endpoints
+    cos = jnp.clip(logits, -1.0 + 1e-6, 1.0 - 1e-6)
     theta = jnp.arccos(cos)
     target_cos = jnp.cos(margin1 * theta + margin2) - margin3
     onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
@@ -1052,4 +1053,69 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         # batch mean
         return jnp.mean(nll / jnp.maximum(
             label_lengths.astype(nll.dtype), 1.0))
+    return _reduce_loss(nll, reduction)
+
+
+@register_op("rnnt_loss")
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean"):
+    """RNN-Transducer loss (Graves 2012) — forward-variable DP.
+
+    input: (B, T, U+1, V) joint-network logits (log_softmax applied here,
+    warprnnt contract); label: (B, U) int. The lattice recursion scans t
+    with an inner scan over u (the in-row dependency alpha[t,u-1] ->
+    alpha[t,u] is inherently sequential); everything is static-shape, so
+    the whole loss jits as two nested lax.scans. fastemit_lambda adds the
+    FastEmit regularization ((1+λ) weight on the emit path)."""
+    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=-1)
+    B, T, U1, V = logp.shape
+    U = U1 - 1
+    lab = label.astype(jnp.int32)
+    b_idx = jnp.arange(B)[:, None]
+    u_idx = jnp.arange(U)[None, :]
+    # emit[b, t, u] = logp[b, t, u, label[b, u]]  (u < U)
+    emit = logp[b_idx[:, :, None], jnp.arange(T)[None, :, None],
+                u_idx[:, None, :], lab[:, None, :]]    # (B, T, U)
+    blank_p = logp[..., blank]                         # (B, T, U+1)
+    neg_inf = jnp.float32(-1e30)
+
+    def row_scan(base, emit_row):
+        """row[u] = logaddexp(base[u], row[u-1] + emit_row[u-1]) along u."""
+        def step(prev, be):
+            b_u, e_prev = be
+            cur = jnp.logaddexp(b_u, prev + e_prev)
+            return cur, cur
+        first = base[:, 0]
+        _, rest = jax.lax.scan(
+            step, first,
+            (jnp.swapaxes(base[:, 1:], 0, 1),
+             jnp.swapaxes(emit_row, 0, 1)))
+        return jnp.concatenate([first[:, None],
+                                jnp.swapaxes(rest, 0, 1)], axis=1)
+
+    # t = 0 row: pure emit chain
+    alpha0 = row_scan(
+        jnp.concatenate([jnp.zeros((B, 1), jnp.float32),
+                         jnp.full((B, U), neg_inf)], axis=1),
+        (1.0 + fastemit_lambda) * emit[:, 0])
+
+    def t_step(alpha_prev, inps):
+        blank_prev, emit_t = inps                      # (B, U+1), (B, U)
+        base = alpha_prev + blank_prev                 # advance t via blank
+        alpha_t = row_scan(base, (1.0 + fastemit_lambda) * emit_t)
+        return alpha_t, alpha_t
+
+    _, alphas = jax.lax.scan(
+        t_step, alpha0,
+        (jnp.swapaxes(blank_p[:, :-1], 0, 1),
+         jnp.swapaxes(emit[:, 1:], 0, 1)))
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, B, U+1)
+
+    t_last = jnp.clip(input_lengths.astype(jnp.int32) - 1, 0, T - 1)
+    u_last = jnp.clip(label_lengths.astype(jnp.int32), 0, U)
+    bb = jnp.arange(B)
+    ll = alphas[t_last, bb, u_last] + blank_p[bb, t_last, u_last]
+    nll = -ll
+    if reduction == "mean":
+        return jnp.mean(nll)
     return _reduce_loss(nll, reduction)

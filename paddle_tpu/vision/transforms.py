@@ -25,6 +25,7 @@ __all__ = [
     "normalize", "to_tensor", "resize", "hflip", "vflip", "crop",
     "center_crop", "pad", "to_grayscale", "adjust_brightness",
     "adjust_contrast", "adjust_hue", "rotate", "erase",
+    "affine", "perspective", "RandomAffine", "RandomPerspective",
 ]
 
 

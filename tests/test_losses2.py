@@ -204,3 +204,96 @@ class TestPaddleOnlyLosses:
         assert np.isfinite(float(nn.TripletMarginLoss()(a, p_, n).numpy()))
         assert np.isfinite(float(nn.MultiLabelSoftMarginLoss()(
             x, _t((y.numpy() > 0).astype(np.float32))).numpy()))
+
+
+class TestRNNT:
+    def _ref(self, logits, labels, T_l, U_l, blank=0):
+        B = logits.shape[0]
+        out = []
+        for b in range(B):
+            e = np.exp(logits[b])
+            lp = np.log(e / e.sum(-1, keepdims=True))
+            Tt, Uu = T_l[b], U_l[b]
+            alpha = np.full((Tt, Uu + 1), -np.inf)
+            alpha[0, 0] = 0.0
+            for t in range(Tt):
+                for u in range(Uu + 1):
+                    c = []
+                    if t > 0:
+                        c.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+                    if u > 0:
+                        c.append(alpha[t, u - 1]
+                                 + lp[t, u - 1, labels[b, u - 1]])
+                    if c:
+                        alpha[t, u] = np.logaddexp.reduce(c)
+            out.append(-(alpha[Tt - 1, Uu] + lp[Tt - 1, Uu, blank]))
+        return np.array(out)
+
+    def test_matches_dp_reference(self):
+        r = np.random.RandomState(0)
+        B, T_, U, V = 3, 6, 4, 5
+        logits = r.standard_normal((B, T_, U + 1, V)).astype(np.float32)
+        labels = r.randint(1, V, (B, U)).astype(np.int32)
+        T_l = np.array([6, 5, 4], np.int32)
+        U_l = np.array([4, 3, 2], np.int32)
+        ours = F.rnnt_loss(_t(logits), _t(labels), _t(T_l), _t(U_l),
+                           fastemit_lambda=0.0, reduction="none")
+        np.testing.assert_allclose(ours.numpy(),
+                                   self._ref(logits, labels, T_l, U_l),
+                                   rtol=1e-4)
+
+    def test_gradient_flows_and_jits(self):
+        import jax
+        import jax.numpy as jnp
+        r = np.random.RandomState(1)
+        logits = r.standard_normal((2, 5, 4, 6)).astype(np.float32)
+        labels = r.randint(1, 6, (2, 3)).astype(np.int32)
+
+        def loss(lg):
+            return F.rnnt_loss(paddle.Tensor(lg), _t(labels),
+                               _t(np.array([5, 4], np.int32)),
+                               _t(np.array([3, 2], np.int32)),
+                               fastemit_lambda=0.0)._data
+        g = jax.jit(jax.grad(loss))(jnp.asarray(logits))
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_fastemit_increases_emit_weight(self):
+        r = np.random.RandomState(2)
+        logits = r.standard_normal((1, 4, 3, 4)).astype(np.float32)
+        labels = r.randint(1, 4, (1, 2)).astype(np.int32)
+        args = (_t(logits), _t(labels), _t(np.array([4], np.int32)),
+                _t(np.array([2], np.int32)))
+        base = float(F.rnnt_loss(*args, fastemit_lambda=0.0).numpy())
+        fe = float(F.rnnt_loss(*args, fastemit_lambda=0.1).numpy())
+        assert fe != base
+
+
+class TestBiRNN:
+    def test_concat_of_directions(self):
+        import paddle_tpu.nn as nn
+        r = np.random.RandomState(0)
+        x = _t(r.standard_normal((2, 5, 4)).astype(np.float32))
+        cell_fw = nn.GRUCell(4, 3)
+        cell_bw = nn.GRUCell(4, 3)
+        bi = nn.BiRNN(cell_fw, cell_bw)
+        out, (st_fw, st_bw) = bi(x)
+        assert tuple(out.shape) == (2, 5, 6)
+        fw_only, _ = nn.RNN(cell_fw)(x)
+        np.testing.assert_allclose(out.numpy()[..., :3], fw_only.numpy(),
+                                   rtol=1e-5)
+
+    def test_sequence_length_masks_padding(self):
+        import paddle_tpu.nn as nn
+        r = np.random.RandomState(0)
+        x = r.standard_normal((2, 5, 4)).astype(np.float32)
+        x[0, 3:] = 99.0  # poisoned padding must not leak
+        bi = nn.BiRNN(nn.GRUCell(4, 3), nn.GRUCell(4, 3))
+        out, (st_fw, st_bw) = bi(_t(x), sequence_length=[3, 5])
+        out_ref, (sf, sb) = bi(_t(x[:1, :3]))
+        np.testing.assert_allclose(out.numpy()[0, :3], out_ref.numpy()[0],
+                                   atol=1e-5)
+        assert np.abs(out.numpy()[0, 3:]).max() == 0.0
+        np.testing.assert_allclose(st_fw.numpy()[0], sf.numpy()[0],
+                                   atol=1e-5)
+        np.testing.assert_allclose(st_bw.numpy()[0], sb.numpy()[0],
+                                   atol=1e-5)

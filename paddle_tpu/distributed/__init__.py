@@ -17,6 +17,7 @@ from .communication import (  # noqa: F401
     send, stream, wait,
 )
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
+from .tcp_store import TCPStore  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet import DistributedStrategy  # noqa: F401
 from .spawn import spawn  # noqa: F401

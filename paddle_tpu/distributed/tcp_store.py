@@ -1,0 +1,132 @@
+"""paddle.distributed.TCPStore — ctypes binding over the native C++ store.
+
+Ref: paddle/fluid/distributed/store/tcp_store.* (upstream layout,
+unverified — mount empty). The C++ server/client live in
+core/native/tcp_store.cc, compiled on first use through the same
+g++ pipeline as utils.cpp_extension (no pybind in this image — plain
+C ABI + ctypes, per the build-environment contract).
+
+Master (is_master=True) starts the in-process server AND a client to it;
+workers connect as clients. API mirrors the reference: set/get (get waits
+for the key), wait, add (atomic counter — the rendezvous primitive),
+plus a counter-based barrier helper.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_LIB = None
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                    "core", "native", "tcp_store.cc")
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        from ..utils.cpp_extension import _compile
+
+        so = _compile("paddle_tpu_tcp_store", [_SRC],
+                      extra_cflags=["-std=c++17", "-pthread"])
+        lib = ctypes.CDLL(so)
+        lib.ts_server_start.restype = ctypes.c_void_p
+        lib.ts_server_start.argtypes = [ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int)]
+        lib.ts_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ts_client_connect.restype = ctypes.c_void_p
+        lib.ts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.ts_client_close.argtypes = [ctypes.c_void_p]
+        lib.ts_set.restype = ctypes.c_int
+        lib.ts_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        lib.ts_get.restype = ctypes.c_int
+        lib.ts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                               ctypes.c_int]
+        lib.ts_add.restype = ctypes.c_longlong
+        lib.ts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int, ctypes.c_longlong]
+        _LIB = lib
+    return _LIB
+
+
+class TCPStore:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 30.0):
+        lib = _lib()
+        self._lib = lib
+        self._server = None
+        self.host = host
+        self.timeout_ms = int(timeout * 1000)
+        self.world_size = world_size
+        if is_master:
+            out_port = ctypes.c_int(0)
+            self._server = lib.ts_server_start(port,
+                                               ctypes.byref(out_port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore master failed to bind "
+                                   f"port {port}")
+            port = out_port.value
+        self.port = port
+        self._client = lib.ts_client_connect(host.encode(), port,
+                                             self.timeout_ms)
+        if not self._client:
+            if self._server:
+                lib.ts_server_stop(self._server)
+            raise RuntimeError(
+                f"TCPStore could not connect to {host}:{port}")
+
+    # ----------------------------------------------------------- KV API
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        k = key.encode()
+        if self._lib.ts_set(self._client, k, len(k), data, len(data)) != 0:
+            raise RuntimeError("TCPStore set failed (connection lost)")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        """Blocks until the key exists (reference wait-then-get contract)."""
+        k = key.encode()
+        tmo = self.timeout_ms if timeout is None else int(timeout * 1000)
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.ts_get(self._client, k, len(k), buf, len(buf), tmo)
+        if n == -1:
+            raise TimeoutError(f"TCPStore get({key!r}) timed out")
+        if n < 0:
+            raise RuntimeError(f"TCPStore get({key!r}) failed (code {n})")
+        return buf.raw[:n]
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        for k in ([keys] if isinstance(keys, str) else keys):
+            self.get(k, timeout)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        k = key.encode()
+        out = self._lib.ts_add(self._client, k, len(k), amount)
+        if out == -1:
+            raise RuntimeError("TCPStore add failed (connection lost)")
+        return int(out)
+
+    def barrier(self, name: str = "barrier",
+                timeout: Optional[float] = None) -> None:
+        """Counter barrier over `world_size` participants."""
+        arrived = self.add(f"__barrier/{name}", 1)
+        if arrived >= self.world_size:
+            self.set(f"__barrier/{name}/release", b"1")
+        self.get(f"__barrier/{name}/release", timeout)
+
+    def close(self) -> None:
+        if self._client:
+            self._lib.ts_client_close(self._client)
+            self._client = None
+        if self._server:
+            self._lib.ts_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

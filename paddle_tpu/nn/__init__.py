@@ -29,7 +29,7 @@ from .layer.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
     HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
     MultiLabelSoftMarginLoss, NLLLoss, PairwiseDistance, PoissonNLLLoss,
-    SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+    RNNTLoss, SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
 )
 from .layer.container import (  # noqa: F401
     LayerDict, LayerList, ParameterList, Sequential,

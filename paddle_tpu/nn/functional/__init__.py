@@ -535,7 +535,7 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.001, reduction="mean", name=None):
+              fastemit_lambda=0.0, reduction="mean", name=None):
     return apply_op(_op("rnnt_loss"), input, label, input_lengths,
                     label_lengths, blank=blank,
                     fastemit_lambda=fastemit_lambda, reduction=reduction)

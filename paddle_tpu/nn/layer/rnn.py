@@ -103,6 +103,11 @@ class GRUCell(_RNNCellBase):
         return out, out
 
 
+def _zeros_like_t(t):
+    from ...tensor.creation import zeros
+    return zeros(list(t.shape), dtype=str(t.dtype))
+
+
 def _map_states(states, fn):
     if isinstance(states, (tuple, list)):
         return type(states)(_map_states(s, fn) for s in states)
@@ -143,14 +148,16 @@ class RNN(Layer):
             x_t = inputs[:, i] if time_axis == 1 else inputs[i]
             out, new_states = self.cell(x_t, states)
             if sl is not None:
-                valid = (sl > i).astype(out.dtype)
-                out = out * valid
-                if states is None:
-                    states = _map_states(new_states,
-                                         lambda ns: ns * 0.0)
+                from ...tensor import where
+                valid = sl > i
+                out = where(valid, out, _zeros_like_t(out))
+                if states is None:  # zeros_like, NOT ns*0: ns may be NaN
+                    states = _map_states(new_states, _zeros_like_t)
+                # select (not blend): NaN/Inf produced on padded frames
+                # must not leak through a *0 multiply
                 new_states = _map_states2(
                     new_states, states,
-                    lambda ns, os: ns * valid + os * (1.0 - valid))
+                    lambda ns, os: where(valid, ns, os))
             states = new_states
             outputs.append(out)
         if self.is_reverse:

@@ -1065,8 +1065,17 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     warprnnt contract); label: (B, U) int. The lattice recursion scans t
     with an inner scan over u (the in-row dependency alpha[t,u-1] ->
     alpha[t,u] is inherently sequential); everything is static-shape, so
-    the whole loss jits as two nested lax.scans. fastemit_lambda adds the
-    FastEmit regularization ((1+λ) weight on the emit path)."""
+    the whole loss jits as two nested lax.scans.
+
+    fastemit_lambda: FastEmit scales the EMIT PORTION OF THE GRADIENT
+    (the forward NLL value is unchanged in warprnnt); a forward-side
+    rescale would un-normalize the per-step distribution, so nonzero
+    values are rejected until the gradient-side form is implemented."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "fastemit_lambda != 0 is not implemented (warprnnt applies "
+            "FastEmit to the gradient only; a forward-side rescale would "
+            "change the returned NLL)")
     logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=-1)
     B, T, U1, V = logp.shape
     U = U1 - 1
@@ -1097,12 +1106,12 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     alpha0 = row_scan(
         jnp.concatenate([jnp.zeros((B, 1), jnp.float32),
                          jnp.full((B, U), neg_inf)], axis=1),
-        (1.0 + fastemit_lambda) * emit[:, 0])
+        emit[:, 0])
 
     def t_step(alpha_prev, inps):
         blank_prev, emit_t = inps                      # (B, U+1), (B, U)
         base = alpha_prev + blank_prev                 # advance t via blank
-        alpha_t = row_scan(base, (1.0 + fastemit_lambda) * emit_t)
+        alpha_t = row_scan(base, emit_t)
         return alpha_t, alpha_t
 
     _, alphas = jax.lax.scan(
@@ -1116,6 +1125,4 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     bb = jnp.arange(B)
     ll = alphas[t_last, bb, u_last] + blank_p[bb, t_last, u_last]
     nll = -ll
-    if reduction == "mean":
-        return jnp.mean(nll)
     return _reduce_loss(nll, reduction)

@@ -257,15 +257,27 @@ class TestRNNT:
         g = jax.jit(jax.grad(loss))(jnp.asarray(logits))
         assert np.isfinite(np.asarray(g)).all()
 
-    def test_fastemit_increases_emit_weight(self):
+    def test_fastemit_rejected_loudly(self):
+        # warprnnt applies FastEmit to the gradient only; a forward-side
+        # rescale would change the NLL — nonzero lambda must not silently
+        # compute the wrong objective
         r = np.random.RandomState(2)
         logits = r.standard_normal((1, 4, 3, 4)).astype(np.float32)
         labels = r.randint(1, 4, (1, 2)).astype(np.int32)
         args = (_t(logits), _t(labels), _t(np.array([4], np.int32)),
                 _t(np.array([2], np.int32)))
-        base = float(F.rnnt_loss(*args, fastemit_lambda=0.0).numpy())
-        fe = float(F.rnnt_loss(*args, fastemit_lambda=0.1).numpy())
-        assert fe != base
+        with pytest.raises(NotImplementedError, match="FastEmit"):
+            F.rnnt_loss(*args, fastemit_lambda=0.1)
+
+    def test_layer_wrapper(self):
+        import paddle_tpu.nn as nn
+        r = np.random.RandomState(3)
+        logits = r.standard_normal((1, 4, 3, 4)).astype(np.float32)
+        labels = r.randint(1, 4, (1, 2)).astype(np.int32)
+        out = nn.RNNTLoss()(_t(logits), _t(labels),
+                            _t(np.array([4], np.int32)),
+                            _t(np.array([2], np.int32)))
+        assert np.isfinite(float(out.numpy()))
 
 
 class TestBiRNN:
@@ -286,7 +298,7 @@ class TestBiRNN:
         import paddle_tpu.nn as nn
         r = np.random.RandomState(0)
         x = r.standard_normal((2, 5, 4)).astype(np.float32)
-        x[0, 3:] = 99.0  # poisoned padding must not leak
+        x[0, 3:] = np.nan  # NaN padding must not leak (select, not blend)
         bi = nn.BiRNN(nn.GRUCell(4, 3), nn.GRUCell(4, 3))
         out, (st_fw, st_bw) = bi(_t(x), sequence_length=[3, 5])
         out_ref, (sf, sb) = bi(_t(x[:1, :3]))

@@ -1,0 +1,119 @@
+"""Native C++ TCPStore (core/native/tcp_store.cc via ctypes): in-process
+KV/wait/add semantics + a REAL two-process rendezvous (the reference's
+multi-process-single-host test pattern, SURVEY §4)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed import TCPStore
+
+
+class TestInProcess:
+    def test_set_get_add(self):
+        m = TCPStore(is_master=True, world_size=1)
+        w = TCPStore(port=m.port)
+        try:
+            m.set("k", b"v1")
+            assert w.get("k") == b"v1"
+            assert w.add("c", 3) == 3
+            assert m.add("c", 2) == 5
+            # counters are also visible as keys (8-byte little-endian)
+            assert int.from_bytes(m.get("c"), "little") == 5
+        finally:
+            w.close()
+            m.close()
+
+    def test_get_blocks_until_set(self):
+        m = TCPStore(is_master=True)
+        w = TCPStore(port=m.port)
+        try:
+            got = {}
+
+            def waiter():
+                got["v"] = w.get("late", timeout=5.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.2)
+            m.set("late", b"now")
+            t.join(timeout=5)
+            assert got["v"] == b"now"
+        finally:
+            w.close()
+            m.close()
+
+    def test_timeout(self):
+        m = TCPStore(is_master=True)
+        try:
+            with pytest.raises(TimeoutError):
+                m.get("never", timeout=0.2)
+        finally:
+            m.close()
+
+    def test_barrier_two_clients(self):
+        m = TCPStore(is_master=True, world_size=2)
+        w = TCPStore(port=m.port, world_size=2)
+        try:
+            done = []
+
+            def other():
+                w.barrier("b0", timeout=5.0)
+                done.append("w")
+
+            t = threading.Thread(target=other)
+            t.start()
+            m.barrier("b0", timeout=5.0)
+            t.join(timeout=5)
+            assert done == ["w"]
+        finally:
+            w.close()
+            m.close()
+
+
+_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize pins the TPU
+from paddle_tpu.distributed import TCPStore
+
+port = int(sys.argv[1])
+store = TCPStore(port=port, world_size=2, timeout=15.0)
+store.set("worker/ready", b"1")
+val = store.get("master/payload", timeout=10.0)
+store.set("worker/echo", val + b"-seen")
+store.barrier("fin", timeout=10.0)
+store.close()
+print("WORKER_OK")
+"""
+
+
+class TestTwoProcesses:
+    def test_cross_process_rendezvous(self, tmp_path):
+        master = TCPStore(is_master=True, world_size=2, timeout=15.0)
+        script = tmp_path / "worker.py"
+        script.write_text(_WORKER)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen([sys.executable, str(script),
+                                 str(master.port)],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, env=env,
+                                text=True)
+        try:
+            assert master.get("worker/ready", timeout=30.0) == b"1"
+            master.set("master/payload", b"token42")
+            assert master.get("worker/echo", timeout=10.0) == b"token42-seen"
+            master.barrier("fin", timeout=10.0)
+            out, _ = proc.communicate(timeout=30)
+            assert proc.returncode == 0, out
+            assert "WORKER_OK" in out
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            master.close()

@@ -1,0 +1,87 @@
+// Host profiler tracer — native event sink (the fluid/platform/profiler
+// host_tracer.* analog; upstream layout unverified — mount empty).
+//
+// RecordEvent spans are recorded with C++ steady_clock timestamps into a
+// mutex-protected buffer (per-thread open-span stacks, completed spans in
+// one global vector), drained to Python as packed binary records. Names
+// are interned to i32 ids Python-side so the hot begin/end path moves no
+// strings.
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Span {
+  int32_t name_id;
+  int64_t t0_ns;
+  int64_t t1_ns;
+  int64_t tid;
+};
+
+std::mutex g_mu;
+std::vector<Span> g_done;
+bool g_armed = false;
+
+int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int64_t tid_hash() {
+  return static_cast<int64_t>(
+      std::hash<std::thread::id>{}(std::this_thread::get_id()));
+}
+
+}  // namespace
+
+extern "C" {
+
+long long ht_now_ns() { return now_ns(); }
+
+void ht_set_armed(int armed) {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_armed = armed != 0;
+}
+
+// stateless span recording: the caller holds t0 (from ht_now_ns), so
+// arbitrarily interleaved (non-nested) spans pair correctly — a
+// thread-local stack would mis-pair a.begin(); b.begin(); a.end()
+void ht_record(int name_id, long long t0_ns, long long t1_ns) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (g_armed) g_done.push_back(Span{name_id, t0_ns, t1_ns, tid_hash()});
+}
+
+int ht_count() {
+  std::lock_guard<std::mutex> g(g_mu);
+  return static_cast<int>(g_done.size());
+}
+
+// Drain up to cap records into buf as packed little-endian
+// (i32 name_id, i64 t0_ns, i64 t1_ns, i64 tid) = 28 bytes each.
+// Returns the number of records written; drained records are removed.
+int ht_drain(char* buf, int cap_records) {
+  std::vector<Span> out;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    int n = std::min<int>(cap_records, static_cast<int>(g_done.size()));
+    out.assign(g_done.begin(), g_done.begin() + n);
+    g_done.erase(g_done.begin(), g_done.begin() + n);
+  }
+  char* p = buf;
+  for (const Span& s : out) {
+    std::memcpy(p, &s.name_id, 4);
+    std::memcpy(p + 4, &s.t0_ns, 8);
+    std::memcpy(p + 12, &s.t1_ns, 8);
+    std::memcpy(p + 20, &s.tid, 8);
+    p += 28;
+  }
+  return static_cast<int>(out.size());
+}
+
+}  // extern "C"

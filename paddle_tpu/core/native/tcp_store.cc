@@ -22,6 +22,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -43,6 +44,10 @@ struct Server {
   int port = 0;
   Store store;
   std::thread accept_thread;
+  std::mutex conn_mu;
+  std::condition_variable conn_cv;
+  std::vector<int> conn_fds;
+  int active_handlers = 0;
   bool stopping = false;
 };
 
@@ -68,14 +73,18 @@ bool write_full(int fd, const void* buf, size_t n) {
   return true;
 }
 
+constexpr uint32_t kMaxLen = 16u << 20;  // 16 MB: reject garbage frames
+
 void serve_conn(Server* srv, int fd) {
   for (;;) {
     uint8_t op;
     uint32_t klen, vlen;
     if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+    if (klen > kMaxLen) break;  // stray/hostile connection: drop it
     std::string key(klen, '\0');
     if (klen && !read_full(fd, key.data(), klen)) break;
     if (!read_full(fd, &vlen, 4)) break;
+    if (vlen > kMaxLen) break;
     std::string val(vlen, '\0');
     if (vlen && !read_full(fd, val.data(), vlen)) break;
 
@@ -88,11 +97,18 @@ void serve_conn(Server* srv, int fd) {
       uint32_t zero = 0;
       if (!write_full(fd, &zero, 4)) break;
     } else if (op == 2) {  // GET with wait; val carries timeout_ms as text
-      long timeout_ms = std::stol(val.empty() ? "30000" : val);
+      long timeout_ms = 30000;
+      if (!val.empty()) {
+        errno = 0;
+        char* endp = nullptr;
+        long parsed = std::strtol(val.c_str(), &endp, 10);
+        if (errno == 0 && endp && *endp == '\0') timeout_ms = parsed;
+      }
       std::unique_lock<std::mutex> lk(srv->store.mu);
       bool ok = srv->store.cv.wait_for(
           lk, std::chrono::milliseconds(timeout_ms),
-          [&] { return srv->store.kv.count(key) > 0; });
+          [&] { return srv->stopping || srv->store.kv.count(key) > 0; });
+      if (srv->stopping) ok = false;
       if (!ok) {
         lk.unlock();
         uint32_t miss = 0xFFFFFFFFu;
@@ -158,7 +174,21 @@ void* ts_server_start(int port, int* out_port) {
     for (;;) {
       int fd = ::accept(srv->listen_fd, nullptr, nullptr);
       if (fd < 0) return;  // listen socket closed -> shut down
-      std::thread(serve_conn, srv, fd).detach();
+      {
+        std::lock_guard<std::mutex> g(srv->conn_mu);
+        if (srv->stopping) {
+          ::close(fd);
+          continue;
+        }
+        srv->conn_fds.push_back(fd);
+        ++srv->active_handlers;
+      }
+      std::thread([srv, fd] {
+        serve_conn(srv, fd);
+        std::lock_guard<std::mutex> g(srv->conn_mu);
+        --srv->active_handlers;
+        srv->conn_cv.notify_all();
+      }).detach();
     }
   });
   return srv;
@@ -167,10 +197,21 @@ void* ts_server_start(int port, int* out_port) {
 void ts_server_stop(void* handle) {
   auto* srv = static_cast<Server*>(handle);
   if (!srv) return;
-  srv->stopping = true;
+  {
+    std::lock_guard<std::mutex> g(srv->conn_mu);
+    srv->stopping = true;
+    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  srv->store.cv.notify_all();  // wake any GET waiters so handlers exit
   ::shutdown(srv->listen_fd, SHUT_RDWR);
   ::close(srv->listen_fd);
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  {
+    // wait for every detached handler to leave srv before freeing it
+    std::unique_lock<std::mutex> lk(srv->conn_mu);
+    srv->conn_cv.wait_for(lk, std::chrono::seconds(5),
+                          [&] { return srv->active_handlers == 0; });
+  }
   delete srv;
 }
 
@@ -222,6 +263,12 @@ int ts_set(void* h, const char* key, int klen, const char* val, int vlen) {
 int ts_get(void* h, const char* key, int klen, char* buf, int buflen,
            int timeout_ms) {
   int fd = fd_of(h);
+  // belt-and-braces: enforce the timeout client-side too (a dead master
+  // never replies; SO_RCVTIMEO turns that into a transport error)
+  timeval tv{};
+  tv.tv_sec = (timeout_ms + 2000) / 1000;
+  tv.tv_usec = ((timeout_ms + 2000) % 1000) * 1000;
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   std::string t = std::to_string(timeout_ms);
   if (!request(fd, 2, key, klen, t.data(), static_cast<uint32_t>(t.size())))
     return -2;
@@ -238,15 +285,18 @@ int ts_get(void* h, const char* key, int klen, char* buf, int buflen,
   return static_cast<int>(len);
 }
 
-long long ts_add(void* h, const char* key, int klen, long long delta) {
+// returns 0 on success with *out = new counter value; -1 on transport error
+int ts_add(void* h, const char* key, int klen, long long delta,
+           long long* out) {
   int fd = fd_of(h);
   if (!request(fd, 3, key, klen, reinterpret_cast<char*>(&delta), 8))
     return -1;
   uint32_t len;
-  int64_t out = 0;
-  if (!read_full(fd, &len, 4) || len != 8 || !read_full(fd, &out, 8))
+  int64_t val = 0;
+  if (!read_full(fd, &len, 4) || len != 8 || !read_full(fd, &val, 8))
     return -1;
-  return out;
+  if (out) *out = val;
+  return 0;
 }
 
 void ts_client_close(void* h) { ::close(fd_of(h)); }

@@ -45,9 +45,10 @@ def _lib():
         lib.ts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
                                ctypes.c_int]
-        lib.ts_add.restype = ctypes.c_longlong
+        lib.ts_add.restype = ctypes.c_int
         lib.ts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                               ctypes.c_int, ctypes.c_longlong]
+                               ctypes.c_int, ctypes.c_longlong,
+                               ctypes.POINTER(ctypes.c_longlong)]
         _LIB = lib
     return _LIB
 
@@ -104,18 +105,23 @@ class TCPStore:
 
     def add(self, key: str, amount: int = 1) -> int:
         k = key.encode()
-        out = self._lib.ts_add(self._client, k, len(k), amount)
-        if out == -1:
+        out = ctypes.c_longlong(0)
+        rc = self._lib.ts_add(self._client, k, len(k), amount,
+                              ctypes.byref(out))
+        if rc != 0:
             raise RuntimeError("TCPStore add failed (connection lost)")
-        return int(out)
+        return int(out.value)
 
     def barrier(self, name: str = "barrier",
                 timeout: Optional[float] = None) -> None:
-        """Counter barrier over `world_size` participants."""
+        """Reusable counter barrier over `world_size` participants: each
+        pass is an epoch, so calling barrier() in a loop re-synchronizes
+        every time instead of sailing through on stale state."""
         arrived = self.add(f"__barrier/{name}", 1)
-        if arrived >= self.world_size:
-            self.set(f"__barrier/{name}/release", b"1")
-        self.get(f"__barrier/{name}/release", timeout)
+        epoch = (arrived - 1) // self.world_size
+        if arrived % self.world_size == 0:
+            self.set(f"__barrier/{name}/release/{epoch}", b"1")
+        self.get(f"__barrier/{name}/release/{epoch}", timeout)
 
     def close(self) -> None:
         if self._client:

@@ -103,11 +103,6 @@ class GRUCell(_RNNCellBase):
         return out, out
 
 
-def _zeros_like_t(t):
-    from ...tensor.creation import zeros
-    return zeros(list(t.shape), dtype=str(t.dtype))
-
-
 def _map_states(states, fn):
     if isinstance(states, (tuple, list)):
         return type(states)(_map_states(s, fn) for s in states)
@@ -130,7 +125,7 @@ class RNN(Layer):
         self.time_major = time_major
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
-        from ...tensor import stack
+        from ...tensor import stack, where, zeros_like
         from ...tensor.creation import to_tensor
 
         time_axis = 0 if self.time_major else 1
@@ -148,11 +143,10 @@ class RNN(Layer):
             x_t = inputs[:, i] if time_axis == 1 else inputs[i]
             out, new_states = self.cell(x_t, states)
             if sl is not None:
-                from ...tensor import where
                 valid = sl > i
-                out = where(valid, out, _zeros_like_t(out))
+                out = where(valid, out, zeros_like(out))
                 if states is None:  # zeros_like, NOT ns*0: ns may be NaN
-                    states = _map_states(new_states, _zeros_like_t)
+                    states = _map_states(new_states, zeros_like)
                 # select (not blend): NaN/Inf produced on padded frames
                 # must not leak through a *0 multiply
                 new_states = _map_states2(

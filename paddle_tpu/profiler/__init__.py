@@ -74,21 +74,36 @@ class _HostEvent:
 
 
 class _HostTracer:
-    """Process-wide host event sink (RecordEvent appends here when armed)."""
+    """Process-wide host event sink. Spans are recorded by the NATIVE C++
+    tracer (core/native/host_tracer.cc — the upstream host_tracer analog)
+    when it compiles, with this Python list as the fallback sink and the
+    merge point at drain()."""
 
     def __init__(self):
         self.events: list[_HostEvent] = []
         self.armed = False
         self._lock = threading.Lock()
 
+    def set_armed(self, armed: bool):
+        self.armed = armed
+        from . import native_tracer
+
+        if native_tracer.available():
+            native_tracer.set_armed(armed)
+
     def add(self, ev: _HostEvent):
         with self._lock:
             self.events.append(ev)
 
     def drain(self) -> list:
+        from . import native_tracer
+
         with self._lock:
             out = self.events
             self.events = []
+        for name, start, end, tid in native_tracer.drain():
+            out.append(_HostEvent(name, start, end, tid, "UserDefined"))
+        out.sort(key=lambda e: e.start)
         return out
 
 
@@ -109,6 +124,12 @@ class RecordEvent:
         self._annotation = None
 
     def begin(self):
+        from . import native_tracer
+
+        if _HOST_TRACER.armed and native_tracer.available():
+            self._native_t0 = native_tracer.now_ns()
+        else:
+            self._native_t0 = None
         self._start = time.perf_counter()
         try:
             import jax.profiler as jp
@@ -125,7 +146,13 @@ class RecordEvent:
             self._annotation = None
         if self._start is None:
             return
-        if _HOST_TRACER.armed:
+        if getattr(self, "_native_t0", None) is not None:
+            from . import native_tracer
+
+            native_tracer.record(native_tracer.intern(self.name),
+                                 self._native_t0, native_tracer.now_ns())
+            self._native_t0 = None
+        elif _HOST_TRACER.armed:
             _HOST_TRACER.add(_HostEvent(
                 self.name, self._start, time.perf_counter(),
                 threading.get_ident(), self.event_type))
@@ -295,7 +322,7 @@ class Profiler:
                 self._arm()
 
     def _arm(self):
-        _HOST_TRACER.armed = True
+        _HOST_TRACER.set_armed(True)
         if not self.timer_only:
             try:
                 import jax.profiler as jp
@@ -309,7 +336,7 @@ class Profiler:
                 self._device_tracing = False
 
     def _disarm(self):
-        _HOST_TRACER.armed = False
+        _HOST_TRACER.set_armed(False)
         evs = _HOST_TRACER.drain()
         self._window_events.extend(evs)
         self._all_events.extend(evs)

@@ -1,0 +1,106 @@
+"""ctypes binding over core/native/host_tracer.cc — the C++ host event
+sink behind paddle.profiler.RecordEvent (upstream's host tracer is C++;
+this keeps that component native per SURVEY §7). Falls back cleanly: the
+profiler uses the Python sink when compilation is unavailable."""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                    "core", "native", "host_tracer.cc")
+
+_lib = None
+_load_failed = False
+_names: List[str] = []
+_name_ids = {}
+_lock = threading.Lock()
+#: perf_counter seconds at calibration minus native ns * 1e-9
+_offset: Optional[float] = None
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _load():
+    global _lib, _load_failed, _offset
+    if _lib is not None or _load_failed:
+        return _lib
+    try:
+        from ..utils.cpp_extension import _compile
+
+        so = _compile("paddle_tpu_host_tracer", [_SRC],
+                      extra_cflags=["-std=c++17", "-pthread"])
+        lib = ctypes.CDLL(so)
+        lib.ht_now_ns.restype = ctypes.c_longlong
+        lib.ht_record.argtypes = [ctypes.c_int, ctypes.c_longlong,
+                                  ctypes.c_longlong]
+        lib.ht_drain.restype = ctypes.c_int
+        lib.ht_drain.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ht_set_armed.argtypes = [ctypes.c_int]
+        lib.ht_count.restype = ctypes.c_int
+        # calibrate the steady_clock base against perf_counter so native
+        # spans share a timeline with Python-recorded ones
+        t0 = time.perf_counter()
+        ns = lib.ht_now_ns()
+        _offset = t0 - ns * 1e-9
+        _lib = lib
+    except Exception:
+        _load_failed = True
+    return _lib
+
+
+def intern(name: str) -> int:
+    with _lock:
+        nid = _name_ids.get(name)
+        if nid is None:
+            nid = len(_names)
+            _names.append(name)
+            _name_ids[name] = nid
+    return nid
+
+
+def set_armed(armed: bool) -> None:
+    lib = _load()
+    if lib is not None:
+        lib.ht_set_armed(1 if armed else 0)
+
+
+def now_ns() -> int:
+    return int(_lib.ht_now_ns())  # _load() guaranteed via available()
+
+
+def record(name_id: int, t0_ns: int, t1_ns: int) -> None:
+    """Stateless span recording — (t0, t1) pairing is held by the caller,
+    so interleaved non-nested spans cannot mis-pair."""
+    _lib.ht_record(name_id, t0_ns, t1_ns)
+
+
+def drain() -> List[Tuple[str, float, float, int]]:
+    """Completed native spans as (name, start_s, end_s, tid) on the
+    perf_counter timeline."""
+    lib = _load()
+    if lib is None:
+        return []
+    out = []
+    while True:
+        n = lib.ht_count()
+        if n <= 0:
+            break
+        buf = ctypes.create_string_buffer(28 * min(n, 4096))
+        got = lib.ht_drain(buf, min(n, 4096))
+        for i in range(got):
+            name_id, t0, t1, tid = struct.unpack_from("<iqqq", buf.raw,
+                                                      i * 28)
+            name = _names[name_id] if 0 <= name_id < len(_names) \
+                else f"event_{name_id}"
+            out.append((name, t0 * 1e-9 + _offset, t1 * 1e-9 + _offset,
+                        tid))
+        if got == 0:
+            break
+    return out

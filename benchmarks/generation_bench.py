@@ -1,0 +1,77 @@
+"""KV-cache decode throughput microbench (models/generation.py).
+
+Measures tokens/sec for LLaMA-tiny (CPU smoke) or a larger LLaMA config on
+TPU, separating prefill latency from steady-state decode. Run directly:
+
+    python benchmarks/generation_bench.py [--cpu]
+
+Prints one JSON line (same convention as bench.py)."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    force_cpu = "--cpu" in sys.argv
+    import jax
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          num_hidden_layers=16, num_attention_heads=16,
+                          num_key_value_heads=16, intermediate_size=5504,
+                          max_position_embeddings=2048)
+        batch, prompt, new = 8, 128, 128
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, prompt, new = 2, 16, 32
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                         (batch, prompt)))
+
+    def timed(n_tokens):
+        # warm at the SAME horizon first: generate()'s jit cache keys on
+        # (prompt, total), so a different max_new_tokens would recompile
+        # inside the timed region
+        m.generate(ids, max_new_tokens=n_tokens, temperature=0.0)
+        t0 = time.perf_counter()
+        out = m.generate(ids, max_new_tokens=n_tokens, temperature=0.0)
+        _ = np.asarray(out.numpy())
+        return time.perf_counter() - t0
+
+    short = max(2, new // 8)
+    t_short = timed(short)
+    t_full = timed(new)
+    # two horizons, both including one prefill: the difference isolates
+    # steady-state decode, the remainder is the prefill
+    decode_s_per_tok = max((t_full - t_short) / (new - short), 1e-9)
+    prefill_s = max(t_short - short * decode_s_per_tok, 0.0)
+    print(json.dumps({
+        "metric": "llama_kvcache_decode_tokens_per_sec",
+        "value": round(batch / decode_s_per_tok, 1),
+        "unit": "tokens/s",
+        "detail": {"device": getattr(dev, "device_kind", dev.platform),
+                   "batch": batch, "prompt": prompt, "new_tokens": new,
+                   "decode_ms_per_token": round(decode_s_per_tok * 1000, 2),
+                   "prefill_ms": round(prefill_s * 1000, 2)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

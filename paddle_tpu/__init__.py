@@ -8,7 +8,7 @@ distributed/ maps Fleet/HCG onto jax.sharding meshes with XLA collectives.
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # kept equal to version.full_version
 
 from . import ops  # registers the op library  # noqa: F401
 from .core import (  # noqa: F401
@@ -24,6 +24,7 @@ from .autograd import grad, is_grad_enabled  # noqa: F401
 
 # Functional tensor API (paddle.add, paddle.matmul, ...) re-exported at top
 # level, as paddle does.
+from . import version  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401
     chunk, einsum, masked_select, nonzero, pow, round, slice, strided_slice,
@@ -71,3 +72,29 @@ if "distributed" in globals():
         DataParallel = distributed.parallel.DataParallel  # noqa: F821
     except AttributeError:
         pass
+
+
+def disable_signal_handler():
+    """No-op (upstream unhooks its C++ signal handlers; none installed)."""
+
+
+def get_cuda_rng_state():
+    """API-parity alias: the framework has ONE threefry generator."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+class LazyGuard:
+    """Context under which Layers defer parameter initialization
+    (paddle.LazyGuard). Parameters here are created eagerly by design
+    (jax arrays are cheap until traced), so the guard is a no-op context
+    kept for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

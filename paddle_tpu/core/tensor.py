@@ -385,8 +385,12 @@ class Tensor:
 
     def __repr__(self):
         grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        from ..tensor import PRINT_OPTIONS
+
+        with np.printoptions(**PRINT_OPTIONS):
+            body = repr(np.asarray(self._data))
         return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
-                f"{grad_info},\n       {np.asarray(self._data)!r})")
+                f"{grad_info},\n       {body})")
 
     def __format__(self, spec):
         if self.ndim == 0:

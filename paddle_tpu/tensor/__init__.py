@@ -158,3 +158,88 @@ def unstack(x, axis=0, num=None):
 def split_fn(x, num_or_sections, axis=0):
     return apply_op(get_op("split"), x, num_or_sections=num_or_sections,
                     axis=axis)
+
+
+def rank(x):
+    """Number of dimensions, as a 0-d int Tensor (paddle.rank)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(len(x.shape), jnp.int32))
+
+
+def shape(x):
+    """Runtime shape as a 1-D int Tensor (paddle.shape contract)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(list(x.shape), jnp.int32))
+
+
+def is_floating_point(x):
+    from ..core import dtype as _dt
+    return _dt.is_floating_point(str(x.dtype))
+
+
+def is_complex(x):
+    from ..core import dtype as _dt
+    return _dt.is_complex(str(x.dtype))
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static slice: take a `shape`-sized window at `offsets`
+    (paddle.crop; -1 in shape means 'to the end')."""
+    import builtins
+
+    def _as_list(v, default):
+        if v is None:
+            return default
+        if isinstance(v, Tensor):
+            return [int(i) for i in v.numpy().tolist()]
+        return list(v)
+
+    offs = _as_list(offsets, [0] * len(x.shape))
+    shp = _as_list(shape, [-1] * len(x.shape))
+    # builtins.slice: this module's `slice` is the paddle slice-op wrapper
+    idx = tuple(builtins.slice(o, None if s == -1 else o + s)
+                for o, s in zip(offs, shp))
+    return x[idx]
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    """Scatter `value` at coordinate tensors `indices` (paddle.index_put)."""
+    from ..core.dispatch import apply_callable
+
+    idx_t = tuple(indices)
+
+    def fn(xd, vd, *idx):
+        at = xd.at[tuple(idx)]
+        return at.add(vd) if accumulate else at.set(vd)
+
+    return apply_callable("index_put", fn, x, value, *idx_t)
+
+
+#: Tensor-repr print options (paddle-scoped: the user's own numpy
+#: printing is untouched; Tensor.__repr__ applies these via a context)
+PRINT_OPTIONS: dict = {}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Print options for TENSOR reprs only (upstream scope; the process-
+    global numpy options are not mutated)."""
+    if precision is not None:
+        PRINT_OPTIONS["precision"] = precision
+    if threshold is not None:
+        PRINT_OPTIONS["threshold"] = threshold
+    if edgeitems is not None:
+        PRINT_OPTIONS["edgeitems"] = edgeitems
+    if linewidth is not None:
+        PRINT_OPTIONS["linewidth"] = linewidth
+    if sci_mode is not None:
+        PRINT_OPTIONS["suppress"] = not sci_mode

@@ -1,0 +1,28 @@
+"""paddle.version analog (ref: python/paddle/version.py, generated at
+build time upstream; here static for the TPU-native build)."""
+full_version = "0.3.0"  # == paddle.__version__
+major = "0"
+minor = "3"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+istaged = False
+with_pip = False
+cuda_version = "False"      # upstream reports the CUDA toolkit; TPU build
+cudnn_version = "False"
+xpu_version = "False"
+tensorrt_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("backend: jax/XLA (TPU-native)")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

@@ -289,3 +289,52 @@ class TestDtypePromotion:
         a = paddle.to_tensor([1.0, 2.0], dtype="bfloat16")
         assert a.dtype == paddle.bfloat16
         assert (a + a).dtype == paddle.bfloat16
+
+
+class TestRound3Shims:
+    """version / rank / shape / crop / index_put / broadcast_shape /
+    LazyGuard parity shims."""
+
+    def test_version(self):
+        assert paddle.version.full_version
+        paddle.version.show()
+        assert paddle.version.cuda() is False
+
+    def test_rank_and_shape(self):
+        x = paddle.to_tensor(np.zeros((2, 3, 4), np.float32))
+        assert int(paddle.rank(x).numpy()) == 3
+        assert paddle.shape(x).numpy().tolist() == [2, 3, 4]
+
+    def test_dtype_predicates(self):
+        f = paddle.to_tensor(np.zeros(2, np.float32))
+        c = paddle.to_tensor(np.zeros(2, np.complex64))
+        assert paddle.is_floating_point(f) and not paddle.is_complex(f)
+        assert paddle.is_complex(c) and not paddle.is_floating_point(c)
+
+    def test_broadcast_shape(self):
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+    def test_crop(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4))
+        out = paddle.crop(x, shape=[2, 2], offsets=[1, 1])
+        assert out.numpy().tolist() == [[5, 6], [9, 10]]
+        tail = paddle.crop(x, shape=[-1, 2], offsets=[1, 0])
+        assert tail.numpy().tolist() == [[4, 5], [8, 9]]
+
+    def test_index_put_set_and_accumulate(self):
+        x = paddle.to_tensor(np.zeros(5, np.float32))
+        idx = (paddle.to_tensor(np.array([1, 3, 1])),)
+        v = paddle.to_tensor(np.array([7.0, 8.0, 2.0], np.float32))
+        out = paddle.index_put(x, idx, v)
+        assert out.numpy()[3] == 8.0
+        acc = paddle.index_put(x, idx, v, accumulate=True)
+        assert acc.numpy()[1] == 9.0  # 7 + 2
+
+    def test_misc_shims(self):
+        with paddle.LazyGuard():
+            lin = paddle.nn.Linear(2, 2)
+        assert lin.weight is not None
+        paddle.disable_signal_handler()
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+        paddle.set_printoptions(precision=4)

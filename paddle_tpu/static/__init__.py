@@ -98,5 +98,47 @@ class _StaticNN:
         c = int(input.shape[1])
         return _nn.BatchNorm(c)(input)
 
+    @staticmethod
+    def embedding(input, size, is_sparse=False, padding_idx=None,
+                  param_attr=None, dtype="float32"):
+        from .. import nn as _nn
+
+        layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                              weight_attr=param_attr)
+        out = layer(input)
+        if dtype not in (None, "float32"):
+            out = out.astype(dtype)
+        return out
+
+    @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+               dilation=1, groups=1, param_attr=None, bias_attr=None,
+               act=None, name=None):
+        from .. import nn as _nn
+
+        c_in = int(input.shape[1])
+        layer = _nn.Conv2D(c_in, num_filters, filter_size, stride=stride,
+                           padding=padding, dilation=dilation, groups=groups,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+        out = layer(input)
+        if act:
+            out = getattr(_nn.functional, act)(out)
+        return out
+
+    @staticmethod
+    def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+                   epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+                   name=None):
+        from .. import nn as _nn
+
+        shape = list(input.shape[begin_norm_axis:])
+        layer = _nn.LayerNorm(shape, epsilon=epsilon,
+                              weight_attr=param_attr if scale else False,
+                              bias_attr=bias_attr if shift else False)
+        out = layer(input)
+        if act:
+            out = getattr(_nn.functional, act)(out)
+        return out
+
 
 nn = _StaticNN()

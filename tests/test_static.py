@@ -189,3 +189,35 @@ def test_minimize_parameters_subset_restricts_updates():
     w1_before = np.asarray(l1.weight.numpy()).copy()
     exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
     np.testing.assert_array_equal(w1_before, np.asarray(l1.weight.numpy()))
+
+
+class TestStaticNNSugar:
+    """static.nn layer sugar added round 3 (embedding/conv2d/layer_norm)."""
+
+    def test_embedding_conv_ln_capture(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+
+        static.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                ids = static.data("ids", [4, 8], "int64")
+                emb = static.nn.embedding(ids, size=[100, 16])
+                img = static.data("img", [2, 3, 8, 8], "float32")
+                conv = static.nn.conv2d(img, 4, 3, padding=1, act="relu")
+                ln = static.nn.layer_norm(emb, begin_norm_axis=2)
+            exe = static.Executor()
+            exe.run(startup)
+            r = np.random.RandomState(0)
+            out = exe.run(main, feed={
+                "ids": r.randint(0, 100, (4, 8)).astype(np.int64),
+                "img": r.standard_normal((2, 3, 8, 8)).astype(np.float32),
+            }, fetch_list=[emb, conv, ln])
+            assert out[0].shape == (4, 8, 16)
+            assert out[1].shape == (2, 4, 8, 8)
+            assert (out[1] >= 0).all()  # relu applied
+            assert abs(out[2].mean()) < 0.2  # normalized
+        finally:
+            static.disable_static()

@@ -599,3 +599,43 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return apply_op(_op("scaled_dot_product_attention"), query, key, value,
                     attn_mask, rng_key, dropout_p=dropout_p,
                     is_causal=is_causal)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention parity surface (ref:
+    python/paddle/nn/functional/flash_attention.py, upstream layout,
+    unverified — mount empty). Layout (b, s, heads, head_dim); returns
+    (out, softmax) — softmax is None (the fused kernel never
+    materializes the attention matrix; pass return_softmax=False)."""
+    if return_softmax:
+        raise NotImplementedError(
+            "return_softmax=True requires materializing the attention "
+            "matrix, which the fused TPU kernel never does; use "
+            "scaled_dot_product_attention's reference path for debugging")
+    if dropout > 0.0 and (fixed_seed_offset is not None or rng_name):
+        # honored nowhere downstream: refusing beats silently
+        # irreproducible dropout masks
+        raise NotImplementedError(
+            "fixed_seed_offset/rng_name are not supported; seed the "
+            "framework generator with paddle.seed(...) for reproducible "
+            "dropout")
+    out = scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                       dropout_p=dropout, is_causal=causal,
+                                       training=training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        name=None):
+    """Varlen (packed ragged batch) flash attention. Not implemented: the
+    TPU-native representation for ragged batches is a padded batch plus an
+    additive mask (XLA requires static shapes); pad the sequences and call
+    flash_attention / scaled_dot_product_attention with a mask instead."""
+    raise NotImplementedError(
+        "flash_attn_unpadded is not supported on the TPU-native backend "
+        "(static shapes); pad to a rectangular batch and pass an additive "
+        "attn_mask to scaled_dot_product_attention")

@@ -99,12 +99,12 @@ class _StaticNN:
         return _nn.BatchNorm(c)(input)
 
     @staticmethod
-    def embedding(input, size, is_sparse=False, padding_idx=None,
-                  param_attr=None, dtype="float32"):
+    def embedding(input, size, is_sparse=False, is_distributed=False,
+                  padding_idx=None, param_attr=None, dtype="float32"):
         from .. import nn as _nn
 
         layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
-                              weight_attr=param_attr)
+                              sparse=is_sparse, weight_attr=param_attr)
         out = layer(input)
         if dtype not in (None, "float32"):
             out = out.astype(dtype)
@@ -113,13 +113,15 @@ class _StaticNN:
     @staticmethod
     def conv2d(input, num_filters, filter_size, stride=1, padding=0,
                dilation=1, groups=1, param_attr=None, bias_attr=None,
-               act=None, name=None):
+               use_cudnn=True, act=None, name=None, data_format="NCHW"):
         from .. import nn as _nn
 
-        c_in = int(input.shape[1])
+        c_axis = 1 if data_format == "NCHW" else -1
+        c_in = int(input.shape[c_axis])
         layer = _nn.Conv2D(c_in, num_filters, filter_size, stride=stride,
                            padding=padding, dilation=dilation, groups=groups,
-                           weight_attr=param_attr, bias_attr=bias_attr)
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_format)
         out = layer(input)
         if act:
             out = getattr(_nn.functional, act)(out)

@@ -380,3 +380,34 @@ def test_bf16_inputs_match_reference_loosely():
     g = jax.grad(loss)(qb)
     assert g.dtype == jnp.bfloat16
     assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+
+
+class TestPublicFlashAPI:
+    """paddle.nn.functional.flash_attention parity surface (round 3)."""
+
+    def test_matches_sdpa(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        r = np.random.RandomState(0)
+        q = paddle.to_tensor(
+            r.standard_normal((2, 32, 4, 16)).astype(np.float32))
+        out, softmax = F.flash_attention(q, q, q, causal=True,
+                                         training=False)
+        assert softmax is None
+        ref = F.scaled_dot_product_attention(q, q, q, is_causal=True,
+                                             training=False)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_return_softmax_rejected(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        q = paddle.to_tensor(np.zeros((1, 8, 2, 8), np.float32))
+        with pytest.raises(NotImplementedError):
+            F.flash_attention(q, q, q, return_softmax=True)
+
+    def test_unpadded_rejected_with_guidance(self):
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(NotImplementedError, match="pad"):
+            F.flash_attn_unpadded(None, None, None, None, None, 0, 0)

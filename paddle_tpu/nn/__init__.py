@@ -5,9 +5,9 @@ from .layer.layers import Layer, ParamAttr  # noqa: F401
 from .layer.common import (  # noqa: F401
     AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
     Dropout2D, Dropout3D,
-    Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, PixelShuffle,
-    Unflatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
-    ZeroPad2D,
+    Embedding, Flatten, Fold, Identity, Linear, Pad1D, Pad2D, PixelShuffle,
+    Unflatten, Unfold, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    ZeroPad1D, ZeroPad2D, ZeroPad3D,
 )
 from .layer.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
 from .layer.norm import (  # noqa: F401
@@ -19,17 +19,19 @@ from .layer.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
     LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
     RReLU, SELU, Sigmoid, SiLU, Softmax, Softplus, Softshrink, Softsign,
-    Swish, Tanh, Tanhshrink,
+    Swish, Tanh, Tanhshrink, Softmax2D,
 )
 from .layer.pooling import (  # noqa: F401
-    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D,
-    AvgPool2D, MaxPool1D, MaxPool2D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, AvgPool3D, LPPool1D, LPPool2D,
+    MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool2D,
 )
 from .layer.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
     HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
     MultiLabelSoftMarginLoss, NLLLoss, PairwiseDistance, PoissonNLLLoss,
     RNNTLoss, SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+    CosineEmbeddingLoss, TripletMarginWithDistanceLoss,
 )
 from .layer.container import (  # noqa: F401
     LayerDict, LayerList, ParameterList, Sequential,

@@ -173,6 +173,11 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 # ------------------------------------------------------------------- pooling
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        return apply_op(_op("max_pool2d_with_index"), x,
+                        kernel_size=kernel_size, stride=stride,
+                        padding=padding, ceil_mode=ceil_mode,
+                        data_format=data_format)
     return apply_op(_op("max_pool2d"), x, kernel_size=kernel_size,
                     stride=stride, padding=padding, ceil_mode=ceil_mode,
                     data_format=data_format)
@@ -402,6 +407,92 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     return apply_op(_op("unfold"), x, kernel_sizes=kernel_sizes,
                     strides=strides, paddings=paddings, dilations=dilations)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    return apply_op(_op("fold"), x, output_sizes=output_sizes,
+                    kernel_sizes=kernel_sizes, strides=strides,
+                    paddings=paddings, dilations=dilations)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    return apply_op(_op("max_unpool2d"), x, indices,
+                    kernel_size=kernel_size, stride=stride, padding=padding,
+                    output_size=output_size, data_format=data_format)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return apply_op(_op("grid_sample"), x, grid, mode=mode,
+                    padding_mode=padding_mode, align_corners=align_corners)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    return apply_op(_op("affine_grid"), theta,
+                    out_shape=tuple(int(v) for v in out_shape),
+                    align_corners=align_corners)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    return apply_op(_op("max_pool3d"), x, kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode,
+                    data_format=data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCDHW", name=None):
+    return apply_op(_op("avg_pool3d"), x, kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode,
+                    count_include_pad=not exclusive,
+                    data_format=data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return apply_op(_op("adaptive_avg_pool3d"), x, output_size=output_size,
+                    data_format=data_format)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    return apply_op(_op("lp_pool1d"), x, norm_type=norm_type,
+                    kernel_size=kernel_size, stride=stride, padding=padding,
+                    ceil_mode=ceil_mode, data_format=data_format)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return apply_op(_op("lp_pool2d"), x, norm_type=norm_type,
+                    kernel_size=kernel_size, stride=stride, padding=padding,
+                    ceil_mode=ceil_mode, data_format=data_format)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    return apply_op(_op("cosine_embedding_loss"), input1, input2, label,
+                    margin=margin, reduction=reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet loss with a custom distance callable (defaults to the
+    p=2 pairwise distance, matching triplet_margin_loss)."""
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_swap = distance_function(positive, negative)
+        d_neg = d_neg.minimum(d_swap)
+    loss = (d_pos - d_neg + margin).clip(min=0.0)
+    if reduction == "none":
+        return loss
+    return loss.sum() if reduction == "sum" else loss.mean()
 
 
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):

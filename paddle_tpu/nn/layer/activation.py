@@ -203,3 +203,12 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self.axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of (N, C, H, W) inputs."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects (N, C, H, W) or (C, H, W)")
+        return F.softmax(x, axis=-3)

@@ -253,9 +253,11 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
             (int(pads[2 * i]), int(pads[2 * i + 1])) for i in range(n_spatial)
         ]
         if data_format.startswith("NC"):
-            cfg = cfg + spatial[::-1] if len(pads) == 2 else cfg + spatial
+            # paddle lists pads INNERMOST axis first ((Wl,Wr,Ht,Hb,Df,Db)
+            # for NCDHW): reverse to match the axis order
+            cfg = cfg + spatial[::-1]
         else:
-            cfg = [(0, 0)] + spatial + [(0, 0)]
+            cfg = [(0, 0)] + spatial[::-1] + [(0, 0)]
     if len(pads) == 2 and ndim >= 3 and data_format.startswith("NC"):
         # common paddle shorthand: pad last axis
         cfg = [(0, 0)] * (ndim - 1) + [(int(pads[0]), int(pads[1]))]

@@ -1126,3 +1126,301 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     ll = alphas[t_last, bb, u_last] + blank_p[bb, t_last, u_last]
     nll = -ll
     return _reduce_loss(nll, reduction)
+
+
+# ---------------------------------------------------- round-3c vision ops
+
+def _triple_(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * 3
+
+
+def _check_pool3d_args(ceil_mode, data_format):
+    if ceil_mode:
+        raise NotImplementedError("ceil_mode=True is not implemented for "
+                                  "3d/lp pooling; pad the input instead")
+    if data_format not in ("NCDHW", "NDHWC"):
+        raise ValueError(f"unsupported data_format {data_format!r}")
+
+
+@register_op("max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW"):
+    _check_pool3d_args(ceil_mode, data_format)
+    k = _triple_(kernel_size)
+    s = _triple_(stride) if stride is not None else k
+    p = _triple_(padding)
+    if data_format == "NDHWC":
+        window, strides = (1,) + k + (1,), (1,) + s + (1,)
+        pad = [(0, 0)] + [(pi, pi) for pi in p] + [(0, 0)]
+    else:
+        window, strides = (1, 1) + k, (1, 1) + s
+        pad = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+    return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+
+
+@register_op("avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               count_include_pad=True, data_format="NCDHW"):
+    _check_pool3d_args(ceil_mode, data_format)
+    k = _triple_(kernel_size)
+    s = _triple_(stride) if stride is not None else k
+    p = _triple_(padding)
+    if data_format == "NDHWC":
+        window, strides = (1,) + k + (1,), (1,) + s + (1,)
+        pad = [(0, 0)] + [(pi, pi) for pi in p] + [(0, 0)]
+    else:
+        window, strides = (1, 1) + k, (1, 1) + s
+        pad = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+    if count_include_pad:
+        return summed / float(k[0] * k[1] * k[2])
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
+    return summed / counts
+
+
+@register_op("adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    out = _triple_(output_size)
+    if data_format != "NCDHW":
+        x = jnp.transpose(x, (0, 4, 1, 2, 3))
+    n, c, d, h, w = x.shape
+    od, oh, ow = out
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        res = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow).mean(
+            axis=(3, 5, 7))
+    else:
+        # general adaptive pooling via per-window means (2D-op pattern)
+        def win_mean(di, hi, wi):
+            ds, de = (di * d) // od, -(-((di + 1) * d) // od)
+            hs, he = (hi * h) // oh, -(-((hi + 1) * h) // oh)
+            ws, we = (wi * w) // ow, -(-((wi + 1) * w) // ow)
+            return x[:, :, ds:de, hs:he, ws:we].mean(axis=(2, 3, 4))
+
+        planes = [jnp.stack(
+            [jnp.stack([win_mean(i, j, l) for l in range(ow)], axis=-1)
+             for j in range(oh)], axis=-2) for i in range(od)]
+        res = jnp.stack(planes, axis=-3)
+    if data_format != "NCDHW":
+        res = jnp.transpose(res, (0, 2, 3, 4, 1))
+    return res
+
+
+@register_op("lp_pool1d")
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL"):
+    if ceil_mode:
+        raise NotImplementedError("ceil_mode=True is not implemented for "
+                                  "lp pooling")
+    if data_format != "NCL":
+        raise ValueError("lp_pool1d supports data_format='NCL' only")
+    k = int(kernel_size)
+    s = int(stride) if stride is not None else k
+    p = int(padding)
+    xp = jnp.abs(x.astype(jnp.float32)) ** norm_type
+    summed = lax.reduce_window(xp, 0.0, lax.add, (1, 1, k), (1, 1, s),
+                               [(0, 0), (0, 0), (p, p)])
+    return (summed ** (1.0 / norm_type)).astype(x.dtype)
+
+
+@register_op("lp_pool2d")
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    if ceil_mode:
+        raise NotImplementedError("ceil_mode=True is not implemented for "
+                                  "lp pooling")
+    if data_format != "NCHW":
+        raise ValueError("lp_pool2d supports data_format='NCHW' only")
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    xp = jnp.abs(x.astype(jnp.float32)) ** norm_type
+    summed = lax.reduce_window(
+        xp, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+        [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+    return (summed ** (1.0 / norm_type)).astype(x.dtype)
+
+
+@register_op("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im — inverse of unfold: x (N, C*kh*kw, L) -> (N, C, H, W) with
+    overlapping patches summed (scatter-add via .at[])."""
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    oh, ow = _pair(output_sizes)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    hp, wp = oh + 2 * ph, ow + 2 * pw
+    n_h = (hp - dh * (kh - 1) - 1) // sh + 1
+    n_w = (wp - dw * (kw - 1) - 1) // sw + 1
+    if n_h * n_w != L:
+        raise ValueError(f"fold: L={L} inconsistent with output_sizes "
+                         f"(expected {n_h * n_w} patches)")
+    cols = x.reshape(n, c, kh, kw, n_h, n_w)
+    # absolute row/col index per (kernel tap, patch)
+    rows = (jnp.arange(kh)[:, None] * dh
+            + jnp.arange(n_h)[None, :] * sh)          # (kh, n_h)
+    colsi = (jnp.arange(kw)[:, None] * dw
+             + jnp.arange(n_w)[None, :] * sw)         # (kw, n_w)
+    out = jnp.zeros((n, c, hp, wp), x.dtype)
+    # scatter-add all taps at once: index grids broadcast to cols' layout
+    r = rows[None, None, :, None, :, None]
+    cc = colsi[None, None, None, :, None, :]
+    out = out.at[
+        jnp.arange(n)[:, None, None, None, None, None],
+        jnp.arange(c)[None, :, None, None, None, None],
+        r, cc].add(cols)
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+@register_op("max_unpool2d")
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    """Scatter pooled values back to their argmax positions (indices are
+    flat per (n, c) spatial offsets — the paddle/torch convention)."""
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    n, c, h, w = x.shape
+    if output_size is None:
+        oh = (h - 1) * s[0] - 2 * p[0] + k[0]
+        ow = (w - 1) * s[1] - 2 * p[1] + k[1]
+    else:  # paddle/torch accept a full (N, C, H, W) shape too
+        osz = list(output_size)
+        oh, ow = int(osz[-2]), int(osz[-1])
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.astype(jnp.int32).reshape(n, c, h * w)
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], idx].set(
+        x.reshape(n, c, h * w))
+    return flat.reshape(n, c, oh, ow)
+
+
+@register_op("cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    x1 = input1.astype(jnp.float32)
+    x2 = input2.astype(jnp.float32)
+    cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    lab = label.astype(jnp.float32)
+    loss = jnp.where(lab > 0, 1.0 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta (N, 2, 3) -> sampling grid (N, H, W, 2) in [-1, 1] coords."""
+    n, _, h, w = [int(v) for v in out_shape]
+    if align_corners:
+        xs = jnp.linspace(-1.0, 1.0, w)
+        ys = jnp.linspace(-1.0, 1.0, h)
+    else:
+        xs = (jnp.arange(w) * 2.0 + 1.0) / w - 1.0
+        ys = (jnp.arange(h) * 2.0 + 1.0) / h - 1.0
+    gx, gy = jnp.meshgrid(xs, ys, indexing="xy")     # (h, w)
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (h, w, 3)
+    return jnp.einsum("hwk,njk->nhwj", base,
+                      theta.astype(jnp.float32)).astype(theta.dtype)
+
+
+@register_op("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample x (N, C, H, W) at normalized grid (N, Hg, Wg, 2) coords.
+
+    bilinear/nearest; padding zeros/border/reflection. All-gather based —
+    XLA lowers the 4 corner gathers the same way deform_conv2d's do."""
+    n, c, h, w = x.shape
+    g = grid.astype(jnp.float32)
+
+    def unnorm(v, size):
+        if align_corners:
+            return (v + 1.0) / 2.0 * (size - 1)
+        return ((v + 1.0) * size - 1.0) / 2.0
+
+    gx = unnorm(g[..., 0], w)
+    gy = unnorm(g[..., 1], h)
+
+    def reflect(v, size):
+        if size <= 1:
+            return jnp.zeros_like(v)
+        span = 2.0 * (size - 1) if align_corners else 2.0 * size
+        off = 0.0 if align_corners else 0.5
+        v2 = jnp.mod(v + off, span)
+        v2 = jnp.minimum(v2, span - v2)
+        return v2 - off
+
+    if padding_mode == "reflection":
+        gx = reflect(gx, w)
+        gy = reflect(gy, h)
+
+    def sample(ix, iy):
+        inside = (ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1)
+        cx = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        cy = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        v = x[jnp.arange(n)[:, None, None], :, cy, cx]   # (n, hg, wg, c)
+        if padding_mode == "zeros":
+            v = v * inside[..., None].astype(x.dtype)
+        return v
+
+    if mode == "nearest":
+        out = sample(jnp.round(gx), jnp.round(gy))
+    else:
+        x0, y0 = jnp.floor(gx), jnp.floor(gy)
+        wx, wy = gx - x0, gy - y0
+        v00 = sample(x0, y0)
+        v01 = sample(x0 + 1, y0)
+        v10 = sample(x0, y0 + 1)
+        v11 = sample(x0 + 1, y0 + 1)
+        wx = wx[..., None].astype(x.dtype)
+        wy = wy[..., None].astype(x.dtype)
+        out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+               + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return jnp.moveaxis(out, -1, 1)                       # (n, c, hg, wg)
+
+
+@register_op("max_pool2d_with_index", multi_output=True)
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          ceil_mode=False, data_format="NCHW"):
+    """Max pool returning (values, flat argmax indices over H*W) — the
+    paddle return_mask=True contract, feeding max_unpool2d. Candidates
+    are gathered per kernel tap (kh*kw stacked slices) and argmax'd; the
+    taps are few, so this stays a handful of fused XLA slices."""
+    if ceil_mode:
+        raise NotImplementedError("ceil_mode with return_mask is not "
+                                  "implemented")
+    if data_format != "NCHW":
+        raise ValueError("return_mask supports data_format='NCHW' only")
+    k = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])],
+                 constant_values=-jnp.inf)
+    hp, wp = h + 2 * p[0], w + 2 * p[1]
+    oh = (hp - k[0]) // st[0] + 1
+    ow = (wp - k[1]) // st[1] + 1
+    vals, idxs = [], []
+    # absolute (unpadded) flat index per tap and output cell
+    oy = jnp.arange(oh)[:, None] * st[0] - p[0]
+    ox = jnp.arange(ow)[None, :] * st[1] - p[1]
+    for i in range(k[0]):
+        for j in range(k[1]):
+            sl = jax.lax.slice(
+                xp, (0, 0, i, j),
+                (n, c, i + (oh - 1) * st[0] + 1, j + (ow - 1) * st[1] + 1),
+                (1, 1, st[0], st[1]))
+            vals.append(sl)
+            idxs.append(((oy + i) * w + (ox + j))[None, None])
+    stacked = jnp.stack(vals)                           # (taps, n, c, oh, ow)
+    tap = jnp.argmax(stacked, axis=0)
+    out = jnp.max(stacked, axis=0)
+    flat_idx = jnp.stack([jnp.broadcast_to(ix, (n, c, oh, ow))
+                          for ix in idxs])
+    indices = jnp.take_along_axis(flat_idx, tap[None], axis=0)[0]
+    return out, indices.astype(jnp.int32)

@@ -1,0 +1,223 @@
+"""Round-3c vision/pooling ops vs torch: grid_sample, affine_grid, fold,
+max_unpool2d, 3D pools, LP pools, cosine_embedding_loss + layer classes."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    @pytest.mark.parametrize("ac", [True, False])
+    def test_matches_torch(self, mode, pad, ac, rng):
+        x = rng.standard_normal((2, 3, 5, 7)).astype(np.float32)
+        grid = (rng.random((2, 4, 6, 2)).astype(np.float32) * 2 - 1)
+        ours = F.grid_sample(_t(x), _t(grid), mode=mode, padding_mode=pad,
+                             align_corners=ac)
+        ref = TF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                             padding_mode=pad, align_corners=ac)
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(), atol=2e-5)
+
+    def test_gradient_flows(self, rng):
+        import jax
+        import jax.numpy as jnp
+        x = jnp.asarray(rng.standard_normal((1, 2, 6, 6)), jnp.float32)
+        grid = jnp.asarray(rng.random((1, 3, 3, 2)) * 2 - 1, jnp.float32)
+
+        def loss(x, g):
+            return F.grid_sample(paddle.Tensor(x),
+                                 paddle.Tensor(g))._data.sum()
+        gx, gg = jax.grad(loss, argnums=(0, 1))(x, grid)
+        assert np.isfinite(np.asarray(gx)).all()
+        assert float(jnp.abs(gg).sum()) > 0
+
+
+class TestAffineGrid:
+    @pytest.mark.parametrize("ac", [True, False])
+    def test_matches_torch(self, ac, rng):
+        theta = rng.standard_normal((2, 2, 3)).astype(np.float32)
+        ours = F.affine_grid(_t(theta), (2, 3, 4, 5), align_corners=ac)
+        ref = TF.affine_grid(torch.tensor(theta), (2, 3, 4, 5),
+                             align_corners=ac)
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_stn_identity(self, rng):
+        # identity theta + grid_sample reproduces the input (interior)
+        x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+        theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+        grid = F.affine_grid(_t(theta), (1, 2, 6, 6))
+        out = F.grid_sample(_t(x), grid)
+        np.testing.assert_allclose(out.numpy(), x, atol=1e-5)
+
+
+class TestFoldUnpool:
+    def test_fold_matches_torch(self, rng):
+        x = rng.standard_normal((2, 12, 12)).astype(np.float32)
+        ours = F.fold(_t(x), (4, 5), (2, 2))
+        ref = TF.fold(torch.tensor(x), (4, 5), (2, 2))
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_fold_unfold_roundtrip_identity_stride(self, rng):
+        x = rng.standard_normal((1, 3, 4, 4)).astype(np.float32)
+        cols = F.unfold(_t(x), 2, strides=2)
+        back = F.fold(cols, (4, 4), 2, strides=2)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-5)
+
+    def test_max_unpool2d_matches_torch(self, rng):
+        xp = rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+        pooled, idx = TF.max_pool2d(torch.tensor(xp), 2,
+                                    return_indices=True)
+        ours = F.max_unpool2d(_t(pooled.numpy()), _t(idx.numpy()), 2)
+        ref = TF.max_unpool2d(pooled, idx, 2)
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_layer_classes(self, rng):
+        x = rng.standard_normal((1, 12, 12)).astype(np.float32)
+        assert tuple(nn.Fold((4, 5), (2, 2))(_t(x)).shape) == (1, 3, 4, 5)
+        img = rng.standard_normal((1, 3, 4, 4)).astype(np.float32)
+        assert tuple(nn.Unfold(2, strides=2)(_t(img)).shape) == (1, 12, 4)
+
+
+class TestPools3D:
+    def test_max_avg_adaptive_match_torch(self, rng):
+        x3 = rng.standard_normal((1, 2, 4, 6, 8)).astype(np.float32)
+        t3 = torch.tensor(x3)
+        np.testing.assert_allclose(
+            F.max_pool3d(_t(x3), 2).numpy(), TF.max_pool3d(t3, 2).numpy(),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            F.avg_pool3d(_t(x3), 2).numpy(), TF.avg_pool3d(t3, 2).numpy(),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool3d(_t(x3), 2).numpy(),
+            TF.adaptive_avg_pool3d(t3, 2).numpy(), atol=1e-6)
+
+    def test_layers(self, rng):
+        x3 = _t(rng.standard_normal((1, 2, 4, 6, 8)).astype(np.float32))
+        assert tuple(nn.MaxPool3D(2)(x3).shape) == (1, 2, 2, 3, 4)
+        assert tuple(nn.AvgPool3D(2)(x3).shape) == (1, 2, 2, 3, 4)
+        assert tuple(nn.AdaptiveAvgPool3D(2)(x3).shape) == (1, 2, 2, 2, 2)
+
+    def test_lp_pools_match_torch(self, rng):
+        x = rng.standard_normal((2, 3, 5, 7)).astype(np.float32)
+        np.testing.assert_allclose(
+            F.lp_pool2d(_t(x), 2.0, 2).numpy(),
+            TF.lp_pool2d(torch.tensor(x), 2.0, 2).numpy(), atol=1e-4)
+        x1 = rng.standard_normal((2, 3, 9)).astype(np.float32)
+        np.testing.assert_allclose(
+            F.lp_pool1d(_t(x1), 2.0, 3).numpy(),
+            TF.lp_pool1d(torch.tensor(x1), 2.0, 3).numpy(), atol=1e-4)
+        assert tuple(nn.LPPool2D(2.0, 2)(_t(x)).shape) == (2, 3, 2, 3)
+
+
+class TestNewLossesAndLayers:
+    def test_cosine_embedding_matches_torch(self, rng):
+        a = rng.standard_normal((4, 8)).astype(np.float32)
+        b = rng.standard_normal((4, 8)).astype(np.float32)
+        lab = np.array([1, -1, 1, -1], np.float32)
+        ours = F.cosine_embedding_loss(_t(a), _t(b), _t(lab), margin=0.2)
+        ref = TF.cosine_embedding_loss(torch.tensor(a), torch.tensor(b),
+                                       torch.tensor(lab), margin=0.2)
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   atol=1e-5)
+        layer = nn.CosineEmbeddingLoss(margin=0.2)
+        np.testing.assert_allclose(
+            float(layer(_t(a), _t(b), _t(lab)).numpy()), ref.item(),
+            atol=1e-5)
+
+    def test_triplet_with_distance_custom_fn(self, rng):
+        a, p_, n = (
+            _t(rng.standard_normal((3, 6)).astype(np.float32))
+            for _ in range(3))
+        l1 = lambda x, y: (x - y).abs().sum(axis=-1)  # noqa: E731
+        ours = F.triplet_margin_with_distance_loss(a, p_, n,
+                                                   distance_function=l1)
+        ref = TF.triplet_margin_with_distance_loss(
+            torch.tensor(a.numpy()), torch.tensor(p_.numpy()),
+            torch.tensor(n.numpy()),
+            distance_function=lambda x, y: (x - y).abs().sum(-1))
+        np.testing.assert_allclose(float(ours.numpy()), ref.item(),
+                                   atol=1e-5)
+        layer = nn.TripletMarginWithDistanceLoss(distance_function=l1)
+        assert np.isfinite(float(layer(a, p_, n).numpy()))
+
+    def test_softmax2d_and_pads(self, rng):
+        x = _t(rng.standard_normal((2, 3, 4, 5)).astype(np.float32))
+        out = nn.Softmax2D()(x)
+        np.testing.assert_allclose(out.numpy().sum(axis=1),
+                                   np.ones((2, 4, 5)), atol=1e-5)
+        x1 = _t(rng.standard_normal((1, 2, 5)).astype(np.float32))
+        assert tuple(nn.ZeroPad1D(2)(x1).shape) == (1, 2, 9)
+        x3 = _t(rng.standard_normal((1, 1, 2, 3, 4)).astype(np.float32))
+        assert tuple(nn.ZeroPad3D(1)(x3).shape) == (1, 1, 4, 5, 6)
+
+
+class TestReviewFixes:
+    def test_max_pool2d_return_mask_and_unpool_in_framework(self, rng):
+        x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        out, idx = F.max_pool2d(_t(x), 2, return_mask=True)
+        tout, tidx = TF.max_pool2d(torch.tensor(x), 2, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), tidx.numpy())
+        un = F.max_unpool2d(out, idx, 2)
+        np.testing.assert_allclose(
+            un.numpy(), TF.max_unpool2d(tout, tidx, 2).numpy(), atol=1e-6)
+
+    def test_return_mask_strided_padded(self, rng):
+        x = rng.standard_normal((1, 2, 9, 9)).astype(np.float32)
+        out, idx = F.max_pool2d(_t(x), 3, stride=2, padding=1,
+                                return_mask=True)
+        tout, tidx = TF.max_pool2d(torch.tensor(x), 3, stride=2, padding=1,
+                                   return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), tidx.numpy())
+
+    def test_layer_return_mask(self, rng):
+        x = _t(rng.standard_normal((1, 2, 4, 4)).astype(np.float32))
+        out, idx = nn.MaxPool2D(2, return_mask=True)(x)
+        assert tuple(out.shape) == (1, 2, 2, 2)
+        assert str(idx.dtype).endswith("int32")
+
+    def test_asymmetric_pad_order(self):
+        # paddle convention: innermost axis first — [Wl,Wr,Ht,Hb,(Df,Db)]
+        x2 = _t(np.zeros((1, 1, 2, 3), np.float32))
+        assert tuple(F.pad(x2, [1, 0, 0, 0]).shape) == (1, 1, 2, 4)
+        assert tuple(F.pad(x2, [0, 0, 1, 0]).shape) == (1, 1, 3, 3)
+        x3 = _t(np.zeros((1, 1, 2, 3, 4), np.float32))
+        assert tuple(nn.ZeroPad3D([1, 0, 0, 0, 0, 0])(x3).shape) == \
+            (1, 1, 2, 3, 5)
+
+    def test_ndhwc_pool3d(self, rng):
+        x = rng.standard_normal((1, 4, 6, 8, 2)).astype(np.float32)
+        out = F.max_pool3d(_t(x), 2, data_format="NDHWC")
+        ref = TF.max_pool3d(
+            torch.tensor(x.transpose(0, 4, 1, 2, 3)), 2).numpy()
+        np.testing.assert_allclose(out.numpy().transpose(0, 4, 1, 2, 3),
+                                   ref, atol=1e-6)
+
+    def test_adaptive3d_non_divisible(self, rng):
+        x = rng.standard_normal((1, 2, 5, 7, 9)).astype(np.float32)
+        out = F.adaptive_avg_pool3d(_t(x), 3)
+        ref = TF.adaptive_avg_pool3d(torch.tensor(x), 3)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_loud_rejections(self, rng):
+        x = _t(rng.standard_normal((1, 2, 4, 6, 8)).astype(np.float32))
+        with pytest.raises(NotImplementedError):
+            F.max_pool3d(x, 2, ceil_mode=True)
+        with pytest.raises(NotImplementedError):
+            nn.MaxPool3D(2, return_mask=True)
+        # full-shape output_size accepted for unpool
+        xi = rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+        out, idx = F.max_pool2d(_t(xi), 2, return_mask=True)
+        un = F.max_unpool2d(out, idx, 2, output_size=(1, 2, 8, 8))
+        assert tuple(un.shape) == (1, 2, 8, 8)

@@ -428,6 +428,37 @@ class Tensor:
     def clip_(self, min=None, max=None):
         return self._inplace_op("clip", min=min, max=max)
 
+    def tril_(self, diagonal=0):
+        return self._inplace_op("tril", diagonal=diagonal)
+
+    def triu_(self, diagonal=0):
+        return self._inplace_op("triu", diagonal=diagonal)
+
+    def remainder_(self, y):
+        return self._inplace_op("remainder", y)
+
+    def floor_(self):
+        return self._inplace_op("floor")
+
+    def ceil_(self):
+        return self._inplace_op("ceil")
+
+    def apply_(self, func):
+        """In-place elementwise apply of a python callable on the HOST
+        (paddle.Tensor.apply_ contract: func maps ndarray -> ndarray)."""
+        self._data = jnp.asarray(np.asarray(func(np.asarray(self._data))),
+                                 dtype=self._data.dtype)
+        return self
+
+    def apply(self, func):
+        return Tensor(jnp.asarray(
+            np.asarray(func(np.asarray(self._data))),
+            dtype=self._data.dtype))
+
+    @property
+    def nbytes(self) -> int:
+        return int(self._data.size) * self._data.dtype.itemsize
+
     def zero_(self):
         self._data = jnp.zeros_like(self._data)
         return self

@@ -417,7 +417,8 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
-                 output_size=None, data_format="NCHW", name=None):
+                 data_format="NCHW", output_size=None, name=None):
+    # paddle order: data_format BEFORE output_size
     return apply_op(_op("max_unpool2d"), x, indices,
                     kernel_size=kernel_size, stride=stride, padding=padding,
                     output_size=output_size, data_format=data_format)
@@ -436,7 +437,10 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               data_format="NCDHW", name=None):
+               return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "max_pool3d(return_mask=True) is not implemented")
     return apply_op(_op("max_pool3d"), x, kernel_size=kernel_size,
                     stride=stride, padding=padding, ceil_mode=ceil_mode,
                     data_format=data_format)

@@ -273,19 +273,15 @@ class Unfold(Layer):
                         self.dilations)
 
 
-class ZeroPad1D(Layer):
+class ZeroPad1D(Pad1D):
     def __init__(self, padding, data_format="NCL", name=None):
-        super().__init__()
-        self.padding = padding if isinstance(padding, (list, tuple)) \
-            else [padding, padding]
-        self.data_format = data_format
-
-    def forward(self, x):
-        return F.pad(x, list(self.padding), mode="constant", value=0.0,
-                     data_format=self.data_format)
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
 
 
 class ZeroPad3D(Layer):
+    """Pad3D does not exist yet, so this normalizes its own padding."""
+
     def __init__(self, padding, data_format="NCDHW", name=None):
         super().__init__()
         self.padding = padding if isinstance(padding, (list, tuple)) \

@@ -114,7 +114,7 @@ class MaxPool3D(Layer):
 
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode, self.data_format)
+                            self.ceil_mode, data_format=self.data_format)
 
 
 class AvgPool3D(Layer):
@@ -171,7 +171,7 @@ class LPPool2D(Layer):
 
 class MaxUnPool2D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0,
-                 output_size=None, data_format="NCHW", name=None):
+                 data_format="NCHW", output_size=None, name=None):
         super().__init__()
         self.kernel_size, self.stride, self.padding = (kernel_size, stride,
                                                        padding)

@@ -1219,7 +1219,9 @@ def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
     k = int(kernel_size)
     s = int(stride) if stride is not None else k
     p = int(padding)
-    xp = jnp.abs(x.astype(jnp.float32)) ** norm_type
+    # torch/paddle LP pool is sum(x^p)^(1/p) on the SIGNED values (odd
+    # norm_type can legitimately produce nan on negative windows)
+    xp = x.astype(jnp.float32) ** norm_type
     summed = lax.reduce_window(xp, 0.0, lax.add, (1, 1, k), (1, 1, s),
                                [(0, 0), (0, 0), (p, p)])
     return (summed ** (1.0 / norm_type)).astype(x.dtype)
@@ -1236,7 +1238,7 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     k = _pair(kernel_size)
     s = _pair(stride) if stride is not None else k
     p = _pair(padding)
-    xp = jnp.abs(x.astype(jnp.float32)) ** norm_type
+    xp = x.astype(jnp.float32) ** norm_type
     summed = lax.reduce_window(
         xp, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
         [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
@@ -1282,6 +1284,8 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  output_size=None, data_format="NCHW"):
     """Scatter pooled values back to their argmax positions (indices are
     flat per (n, c) spatial offsets — the paddle/torch convention)."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports data_format='NCHW' only")
     k = _pair(kernel_size)
     s = _pair(stride) if stride is not None else k
     p = _pair(padding)
@@ -1335,6 +1339,12 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
 
     bilinear/nearest; padding zeros/border/reflection. All-gather based —
     XLA lowers the 4 corner gathers the same way deform_conv2d's do."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be 'bilinear' or "
+                         f"'nearest', got {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"grid_sample padding_mode must be zeros/border/"
+                         f"reflection, got {padding_mode!r}")
     n, c, h, w = x.shape
     g = grid.astype(jnp.float32)
 

@@ -338,3 +338,31 @@ class TestRound3Shims:
         st = paddle.get_cuda_rng_state()
         paddle.set_cuda_rng_state(st)
         paddle.set_printoptions(precision=4)
+
+
+class TestRound3TensorMethods:
+    def test_inplace_variants(self):
+        t = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+        t.tril_()
+        assert t.numpy()[0, 2] == 0 and t.numpy()[2, 0] == 6
+        u = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+        u.triu_()
+        assert u.numpy()[2, 0] == 0
+        f = paddle.to_tensor(np.array([1.7, -2.3], np.float32))
+        f.floor_()
+        np.testing.assert_array_equal(f.numpy(), [1.0, -3.0])
+        c = paddle.to_tensor(np.array([1.2], np.float32))
+        c.ceil_()
+        assert c.numpy()[0] == 2.0
+        r = paddle.to_tensor(np.array([7.0, 9.0], np.float32))
+        r.remainder_(paddle.to_tensor(np.array([4.0, 5.0], np.float32)))
+        np.testing.assert_array_equal(r.numpy(), [3.0, 4.0])
+
+    def test_apply_and_nbytes(self):
+        t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = t.apply(lambda a: a * 3)
+        np.testing.assert_array_equal(out.numpy(), [3.0, 6.0])
+        np.testing.assert_array_equal(t.numpy(), [1.0, 2.0])  # not mutated
+        t.apply_(lambda a: a + 1)
+        np.testing.assert_array_equal(t.numpy(), [2.0, 3.0])
+        assert t.nbytes == 8

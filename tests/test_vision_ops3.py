@@ -221,3 +221,19 @@ class TestReviewFixes:
         out, idx = F.max_pool2d(_t(xi), 2, return_mask=True)
         un = F.max_unpool2d(out, idx, 2, output_size=(1, 2, 8, 8))
         assert tuple(un.shape) == (1, 2, 8, 8)
+
+    def test_lp_pool_signed_semantics(self, rng):
+        # odd norm_type on negative-sum windows: torch yields nan (signed
+        # sum to a fractional power) — we must match, not abs() it away
+        x = -np.ones((1, 1, 2, 2), np.float32)
+        ours = F.lp_pool2d(_t(x), 3.0, 2).numpy()
+        ref = TF.lp_pool2d(torch.tensor(x), 3.0, 2).numpy()
+        assert np.isnan(ours).all() == np.isnan(ref).all()
+
+    def test_grid_sample_validates_enums(self, rng):
+        x = _t(np.zeros((1, 1, 4, 4), np.float32))
+        g = _t(np.zeros((1, 2, 2, 2), np.float32))
+        with pytest.raises(ValueError):
+            F.grid_sample(x, g, mode="trilinear")
+        with pytest.raises(ValueError):
+            F.grid_sample(x, g, padding_mode="reflect")

@@ -445,7 +445,13 @@ class Tensor:
 
     def apply_(self, func):
         """In-place elementwise apply of a python callable on the HOST
-        (paddle.Tensor.apply_ contract: func maps ndarray -> ndarray)."""
+        (paddle.Tensor.apply_ contract: func maps ndarray -> ndarray).
+        Like upstream, refuses on grad-requiring tensors — the host
+        callable is invisible to autograd."""
+        if not self.stop_gradient:
+            raise RuntimeError(
+                "apply_ cannot be used on a tensor that requires grad "
+                "(the host callable is outside the autograd graph)")
         self._data = jnp.asarray(np.asarray(func(np.asarray(self._data))),
                                  dtype=self._data.dtype)
         return self
@@ -515,7 +521,7 @@ class Parameter(Tensor):
 
     __slots__ = ("trainable", "optimize_attr", "regularizer",
                  "need_clip", "is_distributed", "_sharding_axes",
-                 "dist_spec", "sequence_parallel")
+                 "dist_spec", "sequence_parallel", "_asp_mask")
 
     def __init__(self, data, dtype=None, name: str = "", trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable,

@@ -4,6 +4,7 @@ Ref: python/paddle/incubate/ (upstream layout, unverified — mount empty).
 """
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401

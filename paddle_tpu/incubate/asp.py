@@ -1,0 +1,98 @@
+"""paddle.incubate.asp — Automatic SParsity (2:4 structured pruning).
+
+Ref: python/paddle/incubate/asp/ (upstream layout, unverified — mount
+empty). The reference maintains 2:4 masks for FC/conv weights and
+re-applies them after each optimizer step (Ampere sparse-tensor-core
+format). The TPU MXU has no 2:4 hardware path, so the masks are a
+MODEL-COMPRESSION feature here: same API, same n:m semantics, dense
+execution (XLA), with the mask kept exact through training.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density"]
+
+_EXCLUDED: set = set()
+#: masks live ON the Parameter object (`_asp_mask`) — no global registry,
+#: so they die with the model and freed-id reuse cannot misapply them
+
+
+def set_excluded_layers(param_names: List[str], main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _mask_1d_nm(flat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|.| entries in every group of m (along axis -1)."""
+    g = flat.reshape(-1, m)
+    order = np.argsort(-np.abs(g), axis=1)
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, order[:, :n], True, axis=1)
+    return mask.reshape(flat.shape)
+
+
+def _prunable(layer, name, param, m):
+    if name in _EXCLUDED:
+        return False
+    if param.ndim < 2:
+        return False
+    return param.shape[-1] % m == 0 or param.shape[0] % m == 0
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune every eligible weight to the n:m pattern in place and record
+    masks (re-applied by a `decorate`d optimizer). Returns
+    {param_name: mask Tensor-shaped ndarray}."""
+    from ..core.tensor import Tensor
+
+    masks = {}
+    for pname, param in model.named_parameters():
+        leaf = pname.rsplit(".", 1)[-1]
+        if leaf == "bias" or not _prunable(model, pname, param, m):
+            continue
+        w = np.asarray(param._data)
+        # group along the input (second-to-last for Linear [in, out]) axis:
+        # transpose so the contiguous m-groups run along axis -1
+        if w.shape[0] % m == 0:
+            wt = np.moveaxis(w, 0, -1)
+            mask = _mask_1d_nm(wt.reshape(-1, wt.shape[-1]), n, m)
+            mask = np.moveaxis(mask.reshape(wt.shape), -1, 0)
+        else:
+            mask = _mask_1d_nm(w.reshape(-1, w.shape[-1]), n, m).reshape(
+                w.shape)
+        param._data = (param._data * jnp.asarray(mask, param._data.dtype))
+        if with_mask:
+            param._asp_mask = jnp.asarray(mask, param._data.dtype)
+        masks[pname] = mask
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step so the recorded masks are re-applied after each
+    update (pruned weights stay exactly zero through training)."""
+    orig_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        for p in optimizer._parameter_list:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._data = p._data * mask
+        return out
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer
+
+
+def calculate_density(param) -> float:
+    w = np.asarray(param._data if hasattr(param, "_data") else param)
+    return float((w != 0).sum()) / max(w.size, 1)

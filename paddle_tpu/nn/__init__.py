@@ -5,7 +5,8 @@ from .layer.layers import Layer, ParamAttr  # noqa: F401
 from .layer.common import (  # noqa: F401
     AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
     Dropout2D, Dropout3D,
-    Embedding, Flatten, Fold, Identity, Linear, Pad1D, Pad2D, PixelShuffle,
+    Embedding, Flatten, Fold, Identity, Linear, Pad1D, Pad2D, Pad3D,
+    PixelShuffle,
     Unflatten, Unfold, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     ZeroPad1D, ZeroPad2D, ZeroPad3D,
 )

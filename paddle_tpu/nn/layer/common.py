@@ -279,15 +279,22 @@ class ZeroPad1D(Pad1D):
                          data_format=data_format)
 
 
-class ZeroPad3D(Layer):
-    """Pad3D does not exist yet, so this normalizes its own padding."""
-
-    def __init__(self, padding, data_format="NCDHW", name=None):
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
         super().__init__()
         self.padding = padding if isinstance(padding, (list, tuple)) \
             else [padding] * 6
+        self.mode = mode
+        self.value = value
         self.data_format = data_format
 
     def forward(self, x):
-        return F.pad(x, list(self.padding), mode="constant", value=0.0,
-                     data_format=self.data_format)
+        return F.pad(x, list(self.padding), mode=self.mode,
+                     value=self.value, data_format=self.data_format)
+
+
+class ZeroPad3D(Pad3D):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)

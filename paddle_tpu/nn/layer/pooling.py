@@ -180,5 +180,5 @@ class MaxUnPool2D(Layer):
 
     def forward(self, x, indices):
         return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
-                              self.padding, self.output_size,
-                              self.data_format)
+                              self.padding, data_format=self.data_format,
+                              output_size=self.output_size)

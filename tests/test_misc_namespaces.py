@@ -243,3 +243,74 @@ class TestIncubateOptimizers:
         assert not np.allclose(applied, live)
         avg.restore()
         np.testing.assert_allclose(np.asarray(net.weight._data), live)
+
+
+class TestASP:
+    """incubate.asp 2:4 automatic sparsity (round 3)."""
+
+    def _net(self):
+        import paddle_tpu.nn as nn
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                             nn.Linear(16, 4))
+
+    def test_prune_gives_2_4_density(self):
+        from paddle_tpu.incubate import asp
+        net = self._net()
+        masks = asp.prune_model(net)
+        assert masks  # both Linear weights pruned
+        for name in masks:
+            p = dict(net.named_parameters())[name]
+            assert abs(asp.calculate_density(p) - 0.5) < 1e-6
+            # every group of 4 along the input axis keeps exactly 2
+            m = masks[name]
+            groups = np.moveaxis(m, 0, -1).reshape(-1, 4)
+            assert (groups.sum(axis=1) == 2).all()
+
+    def test_masks_held_through_training(self):
+        from paddle_tpu.incubate import asp
+        import paddle_tpu.nn.functional as F
+        net = self._net()
+        asp.prune_model(net)
+        opt = asp.decorate(paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=net.parameters()))
+        r = np.random.RandomState(0)
+        x = _t(r.standard_normal((16, 8)).astype(np.float32))
+        y = _t(r.standard_normal((16, 4)).astype(np.float32))
+        losses = []
+        for _ in range(6):
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]  # still learns at 50% density
+        for _, p in net.named_parameters():
+            if p.ndim >= 2:
+                assert abs(asp.calculate_density(p) - 0.5) < 1e-6
+
+    def test_excluded_layers(self):
+        from paddle_tpu.incubate import asp
+        net = self._net()
+        name0 = next(n for n, _ in net.named_parameters()
+                     if n.endswith("0.weight"))
+        asp.set_excluded_layers([name0])
+        try:
+            masks = asp.prune_model(net)
+            assert name0 not in masks
+        finally:
+            asp.reset_excluded_layers()
+
+    def test_custom_nm_pattern(self):
+        from paddle_tpu.incubate import asp
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(6, 4))  # 6 % 2 == 0 only for m=2
+        masks = asp.prune_model(net, n=1, m=2)
+        assert masks
+        p = dict(net.named_parameters())["0.weight"]
+        assert abs(asp.calculate_density(p) - 0.5) < 1e-6
+
+    def test_biases_untouched(self):
+        from paddle_tpu.incubate import asp
+        net = self._net()
+        masks = asp.prune_model(net)
+        assert not any(k.endswith("bias") for k in masks)

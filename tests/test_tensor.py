@@ -366,3 +366,7 @@ class TestRound3TensorMethods:
         t.apply_(lambda a: a + 1)
         np.testing.assert_array_equal(t.numpy(), [2.0, 3.0])
         assert t.nbytes == 8
+        g = paddle.to_tensor(np.array([1.0], np.float32))
+        g.stop_gradient = False
+        with pytest.raises(RuntimeError, match="grad"):
+            g.apply_(lambda a: a)

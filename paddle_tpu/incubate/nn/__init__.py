@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ... import nn
+from . import functional  # noqa: F401
 from ...nn import functional as F
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",

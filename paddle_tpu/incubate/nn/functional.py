@@ -1,0 +1,105 @@
+"""paddle.incubate.nn.functional — fused-op functional surface (ref:
+python/paddle/incubate/nn/functional/ — upstream layout, unverified —
+mount empty). On TPU the "fusion" is XLA's (plus the Pallas flash/norm
+kernels underneath F.scaled_dot_product_attention / F.layer_norm), so
+these wrappers compose the same fused computation the upstream CUDA
+kernels hard-code, and jit compiles it into one program.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+
+__all__ = ["fused_linear", "fused_feedforward",
+           "fused_multi_head_attention", "fused_layer_norm",
+           "fused_bias_dropout_residual_layer_norm"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        weight = weight.t()
+    return F.linear(x, weight, bias)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=1, name=None):
+    shape = list(x.shape[begin_norm_axis:])
+    return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode
+        ="upscale_in_train", name=None):
+    """residual + dropout(x + bias), then LayerNorm — the fused epilogue
+    of the upstream fused attention/ffn kernels."""
+    out = x if bias is None else x + bias
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    out = residual + out
+    shape = [out.shape[-1]]
+    return F.layer_norm(out, shape, ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      name=None):
+    """LN? -> linear1 -> act -> dropout -> linear2 -> dropout -> +res -> LN?"""
+    residual = x
+    d = [x.shape[-1]]
+    if pre_layer_norm:
+        x = F.layer_norm(x, d, ln1_scale, ln1_bias, ln_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, d, ln2_scale, ln2_bias, ln_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, ring_id=-1, num_heads=None,
+                               name=None):
+    """Fused MHA epilogue-inclusive block (upstream fused_attention):
+    LN? -> qkv matmul -> sdpa (Pallas flash on TPU) -> out proj ->
+    dropout -> +residual -> LN?. qkv_weight: (3, heads, head_dim, hid)."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv is not supported here; use the model-level KV-cache "
+            "generation path (paddle_tpu.models.generation)")
+    if ring_id != -1:
+        raise NotImplementedError(
+            "ring_id (tensor-parallel allreduce) is not supported; build "
+            "TP attention from fleet.meta_parallel layers instead")
+    residual = x
+    d = [x.shape[-1]]
+    if pre_layer_norm:
+        x = F.layer_norm(x, d, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    three, n_heads, head_dim, hid = qkv_weight.shape
+    b, s, _ = x.shape
+    qkv = x.matmul(qkv_weight.reshape([3 * n_heads * head_dim, hid]),
+                   transpose_y=True)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.reshape([3 * n_heads * head_dim])
+    qkv = qkv.reshape([b, s, 3, n_heads, head_dim])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ctx = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    out = F.linear(ctx.reshape([b, s, n_heads * head_dim]), linear_weight,
+                   linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, d, ln_scale, ln_bias, ln_epsilon)
+    return out

@@ -314,3 +314,79 @@ class TestASP:
         net = self._net()
         masks = asp.prune_model(net)
         assert not any(k.endswith("bias") for k in masks)
+
+
+class TestIncubateFunctional:
+    """incubate.nn.functional fused-op surface (round 3)."""
+
+    def _data(self):
+        r = np.random.RandomState(0)
+        x = _t(r.standard_normal((2, 6, 16)).astype(np.float32))
+        g = _t(np.ones(16, np.float32))
+        b = _t(np.zeros(16, np.float32))
+        return r, x, g, b
+
+    def test_fused_feedforward_matches_composition(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.nn import functional as IF
+        r, x, g, b = self._data()
+        w1 = _t(r.standard_normal((16, 32)).astype(np.float32))
+        w2 = _t(r.standard_normal((32, 16)).astype(np.float32))
+        out = IF.fused_feedforward(x, w1, w2, ln2_scale=g, ln2_bias=b,
+                                   dropout1_rate=0.0, dropout2_rate=0.0)
+        ref = F.layer_norm(x + F.linear(F.relu(F.linear(x, w1)), w2),
+                           [16], g, b)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_fused_mha_runs_and_matches_manual(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.nn import functional as IF
+        r, x, g, b = self._data()
+        qkvw = _t(r.standard_normal((3, 4, 4, 16)).astype(np.float32))
+        lw = _t(r.standard_normal((16, 16)).astype(np.float32))
+        out = IF.fused_multi_head_attention(
+            x, qkvw, lw, ln_scale=g, ln_bias=b, dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False)
+        # manual composition
+        qkv = x.matmul(_t(qkvw.numpy().reshape(48, 16)), transpose_y=True)
+        qkv = qkv.reshape([2, 6, 3, 4, 4])
+        ctx = F.scaled_dot_product_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], training=False)
+        ref = F.layer_norm(x + F.linear(ctx.reshape([2, 6, 16]), lw),
+                           [16], g, b)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_fused_layer_norm_begin_axis(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.nn import functional as IF
+        r, x, g, b = self._data()
+        out = IF.fused_layer_norm(x, g, b, begin_norm_axis=2)
+        ref = F.layer_norm(x, [16], g, b)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_fused_mha_rejects_unsupported(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        r, x, g, b = self._data()
+        qkvw = _t(r.standard_normal((3, 4, 4, 16)).astype(np.float32))
+        lw = _t(r.standard_normal((16, 16)).astype(np.float32))
+        with pytest.raises(NotImplementedError, match="cache_kv"):
+            IF.fused_multi_head_attention(x, qkvw, lw, cache_kv=x)
+        with pytest.raises(NotImplementedError, match="ring_id"):
+            IF.fused_multi_head_attention(x, qkvw, lw, ring_id=0)
+
+    def test_fused_linear_and_bdrln(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.nn import functional as IF
+        r, x, g, b = self._data()
+        w = _t(r.standard_normal((16, 8)).astype(np.float32))
+        np.testing.assert_allclose(
+            IF.fused_linear(x, w).numpy(), F.linear(x, w).numpy(),
+            atol=1e-6)
+        wt = _t(w.numpy().T)
+        np.testing.assert_allclose(
+            IF.fused_linear(x, wt, transpose_weight=True).numpy(),
+            F.linear(x, w).numpy(), atol=1e-6)
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            x, x, ln_scale=g, ln_bias=b, dropout_rate=0.0)
+        ref = F.layer_norm(x + x, [16], g, b)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)

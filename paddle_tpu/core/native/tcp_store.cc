@@ -217,23 +217,24 @@ void ts_server_stop(void* handle) {
 
 // client: one blocking connection; thread-compatible, not thread-shared
 void* ts_client_connect(const char* host, int port, int timeout_ms) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return nullptr;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-    ::close(fd);
-    return nullptr;
-  }
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return nullptr;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
-  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
-                   sizeof(addr)) != 0) {
-    if (std::chrono::steady_clock::now() > deadline) {
-      ::close(fd);
-      return nullptr;
-    }
+  // POSIX leaves a socket in an unspecified state after a failed connect();
+  // a fresh fd per attempt is the only portable retry (the retry window
+  // exists precisely for workers that start before the master is listening)
+  int fd = -1;
+  for (;;) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0)
+      break;
+    ::close(fd);
+    fd = -1;
+    if (std::chrono::steady_clock::now() > deadline) return nullptr;
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
   int one = 1;

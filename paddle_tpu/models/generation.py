@@ -122,7 +122,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
         # by function identity, so fresh closures per call would recompile
         # every generate() invocation
         cache_key = (b, prompt_len, total, float(temperature), int(top_k),
-                     float(top_p), jnp.dtype(cache_dtype).name)
+                     float(top_p), jnp.dtype(cache_dtype).name,
+                     eos_token_id)
         jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
         if cache_key not in jit_cache:
             def prefill(params, buffers, ids, caches):
@@ -132,13 +133,20 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
                     training=False)
                 return logits[:, -1], new_caches
 
-            def decode(params, buffers, token, caches, pos, key):
+            def decode(params, buffers, token, caches, pos, key, finished):
                 (logits, new_caches), _ = call_functional(
                     model, params, buffers, (Tensor(token[:, None]),),
                     kwargs={"caches": caches, "start_pos": pos},
                     training=False)
                 nxt = _sample(logits[:, 0], key, temperature, top_k, top_p)
-                return nxt, new_caches
+                if eos_token_id is not None:
+                    # already-finished rows keep emitting eos; the finished
+                    # mask lives on device so steady-state decode never
+                    # forces a per-token host round-trip (the host polls it
+                    # only every _EOS_POLL steps)
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                return nxt, new_caches, finished
 
             jit_cache[cache_key] = (jax.jit(prefill),
                                     jax.jit(decode, donate_argnums=(3,)))
@@ -148,22 +156,20 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
         key, sub = jax.random.split(key)
         token = _sample(last_logits, sub, temperature, top_k, top_p)
 
-        out = [ids, token[:, None]]
-        finished = np.zeros((b,), bool)
+        finished = jnp.zeros((b,), bool)
         if eos_token_id is not None:
-            finished |= np.asarray(token) == eos_token_id
+            finished = token == eos_token_id
+        out = [ids, token[:, None]]
+        _EOS_POLL = 16  # host-side early-exit check cadence
         for step in range(1, max_new_tokens):
             key, sub = jax.random.split(key)
-            token, caches = decode_j(params, buffers, token, caches,
-                                     jnp.int32(prompt_len + step - 1), sub)
-            if eos_token_id is not None:
-                # already-finished rows keep emitting eos
-                token = jnp.where(jnp.asarray(finished), eos_token_id,
-                                  token)
-                finished |= np.asarray(token) == eos_token_id
+            token, caches, finished = decode_j(
+                params, buffers, token, caches,
+                jnp.int32(prompt_len + step - 1), sub, finished)
             out.append(token[:, None])
-            if eos_token_id is not None and finished.all():
-                # pad the remaining positions with eos and stop early
+            if (eos_token_id is not None and step % _EOS_POLL == 0
+                    and bool(np.asarray(finished).all())):
+                # all rows hit eos; pad the rest with eos and stop early
                 remaining = max_new_tokens - 1 - step
                 if remaining:
                     out.append(jnp.full((b, remaining), eos_token_id,

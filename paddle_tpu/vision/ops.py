@@ -513,9 +513,17 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
                 jnp.exp((comp[:, None] ** 2 - ious ** 2) * gaussian_sigma),
                 1.0), axis=0)
         else:
-            decay = jnp.min(jnp.where(upper > 0,
-                                      (1 - ious) / (1 - comp[:, None]),
-                                      1.0), axis=0)
+            # comp==1 guard (suppressor is an exact duplicate of a
+            # higher-scored box): the (1-iou)/(1-comp) limit is +inf for
+            # iou<1 — no suppression, clamp to 1 — and 0/0 only when the
+            # candidate duplicates that suppressor too, where full
+            # suppression (0) matches the unguarded NaN's drop behavior
+            denom = 1.0 - comp[:, None]
+            linear = jnp.where(
+                denom > 1e-10,
+                (1 - ious) / jnp.maximum(denom, 1e-10),
+                jnp.where(ious >= 1.0 - 1e-10, 0.0, 1.0))
+            decay = jnp.min(jnp.where(upper > 0, linear, 1.0), axis=0)
         dec_s = top_s * decay
         keep = dec_s >= post_threshold
         kk = min(keep_top_k if keep_top_k > 0 else k, k)

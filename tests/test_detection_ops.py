@@ -181,6 +181,23 @@ class TestMatrixNms:
         assert tuple(boxes.shape) == (1, 8, 4)
         assert np.isfinite(scores.numpy()).all()
 
+    def test_exact_duplicate_suppressor_no_nan_and_no_over_suppress(self):
+        # A' duplicates A exactly (comp==1): its (1-iou)/(1-comp) decay
+        # column hits 0/0 for A' itself (NaN pre-guard) and x/0 for B.
+        # B (iou 1/3 with A) must survive: the comp->1 limit is "A' was
+        # fully suppressed by A, so A' suppresses nothing".
+        bxs = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                         [5, 0, 15, 10]]], np.float32)
+        scs = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+        out, nums = V.matrix_nms(_t(bxs), _t(scs), 0.1, 0.2, 3, 3,
+                                 background_label=-1)
+        res = out.numpy()
+        assert np.isfinite(res).all()
+        # A kept at 0.9; B kept (decayed only by A: 0.7 * 2/3 ≈ 0.467)
+        kept_scores = sorted(res[:, 1].tolist(), reverse=True)
+        assert abs(kept_scores[0] - 0.9) < 1e-6
+        assert any(abs(s - 0.7 * (2 / 3)) < 1e-5 for s in kept_scores)
+
     def test_classes_do_not_suppress_each_other(self):
         bxs = np.array([[[0, 0, 10, 10], [0, 0, 10, 10]]], np.float32)
         scs = np.array([[[0.9, 0.0], [0.0, 0.8]]], np.float32)

@@ -205,7 +205,8 @@ def bench_child() -> None:
     # this copy, never re-extract from the model (advisor r3 finding).
     # Only the sweep's OOM path consumes it, so only take the ~1GB
     # device->host copy when the sweep will actually run.
-    will_sweep = on_tpu and "BENCH_BATCH" not in os.environ
+    will_sweep = (on_tpu and "BENCH_BATCH" not in os.environ
+                  and bool(os.environ.get("BENCH_SWEEP", "64,128")))
     snapshot = jax.tree_util.tree_map(
         lambda a: np.asarray(a),
         (params, buffers, opt_state)) if will_sweep else None
@@ -297,7 +298,7 @@ def bench_child() -> None:
     # --- phase: batch micro-sweep (TPU only, no explicit override) --------
     sweep = os.environ.get("BENCH_SWEEP", "64,128")
     sweep_detail = {batch: round(tps_q, 1)}
-    if will_sweep and sweep:
+    if will_sweep:
         best_b, best_tps = batch, tps_q
         for b in [int(s) for s in sweep.split(",") if s]:
             try:

@@ -131,6 +131,9 @@ class Model:
 
     def _build_train_step(self):
         opt = self._optimizer
+        data_sh, param_sh = self._dp_shardings()
+        net = self.network
+        g_sh = None
 
         def step(params, buffers, opt_state, lr, t, key, input_datas,
                  label_datas):
@@ -142,17 +145,29 @@ class Model:
 
             (_, (losses, outs, new_buffers)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
+            if g_sh is not None:
+                grads = {k: (jax.lax.with_sharding_constraint(v, g_sh[k])
+                             if k in g_sh else v)
+                         for k, v in grads.items()}
             new_params, new_state = opt.functional_step(
                 params, grads, opt_state, lr, t)
             return losses, outs, new_buffers, new_params, new_state
 
-        data_sh, param_sh = self._dp_shardings()
         if data_sh is not None:
             from jax.tree_util import tree_map
 
-            net = self.network
             params, buffers = self._sync_state_in()
             self._ensure_opt_state(params)
+            if hasattr(net, "grad_shardings"):
+                # GroupSharded stage >= 2: constrain grads to the dim-0
+                # sharded layout so XLA materializes reduce-scattered grad
+                # shards inside the step (never a full replicated grad
+                # buffer per device) — the os_g distinction over stage 1.
+                # Replicated entries (stage 1, small params) are dropped:
+                # constraining to P() is a no-op. `step` closes over g_sh;
+                # tracing happens after this assignment.
+                g_sh = {k: s for k, s in net.grad_shardings(params).items()
+                        if tuple(s.spec)} or None
             # per-param sharding trees (GroupSharded stages) when the wrapper
             # provides them; otherwise a uniform prefix (DataParallel)
             if hasattr(net, "param_shardings"):
@@ -164,9 +179,14 @@ class Model:
             else:
                 o_sh = tree_map(lambda _: param_sh, self._opt_state)
             b_sh = tree_map(lambda _: param_sh, buffers)
+            # pin state outputs to the same layouts as the inputs: with the
+            # stage-2 grad constraint in the graph XLA would otherwise pick a
+            # sharded layout for new_params, and the next call's in_shardings
+            # would reject the arrays instead of resharding them
             return jax.jit(step, donate_argnums=(0, 2),
                            in_shardings=(p_sh, b_sh, o_sh,
-                                         None, None, None, data_sh, data_sh))
+                                         None, None, None, data_sh, data_sh),
+                           out_shardings=(None, None, b_sh, p_sh, o_sh))
         return jax.jit(step, donate_argnums=(0, 2))
 
     def _build_eval_step(self):

@@ -1,0 +1,95 @@
+"""ZeRO stage-2 must be real in the PRODUCT train path (verdict r3 #2).
+
+Round 3's grad_shardings were only ever applied by test_zero_depth's
+hand-built step; Model.fit's jitted step applied param/opt-state shardings
+but never grads, so stage 2 ≡ stage 1 everywhere outside that test file.
+These tests drive paddle.Model itself (train_batch -> _build_train_step)
+and inspect the lowered program: stage 2 must emit sharding constraints on
+the gradient tensors that stage 1 does not.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.meta_parallel import group_sharded_parallel
+
+
+def _build_net(hidden=64):
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(16, hidden), nn.ReLU(),
+                         nn.Linear(hidden, 8))
+
+
+def _fit_one_batch(level):
+    """Run ONE product-path train step; return (model, lowered HLO text)."""
+    import jax.numpy as jnp
+
+    net = _build_net()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    wrapped, sharded_opt = group_sharded_parallel(net, opt, level=level)
+    model = paddle.Model(wrapped)
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 16).astype("float32")
+    y = rng.randn(32, 8).astype("float32")
+    loss = model.train_batch([x], [y])
+    assert np.isfinite(np.asarray(loss)).all()
+
+    # lower the exact jitted step Model built, with the live state
+    params, buffers = model._sync_state_in()
+    from paddle_tpu.core.rng import default_generator
+    txt = model._train_step_fn.lower(
+        params, buffers, model._opt_state, jnp.float32(0.01), jnp.int32(2),
+        default_generator().next_key(), (jnp.asarray(x),),
+        (jnp.asarray(y),)).as_text()
+    return model, txt
+
+
+def _sharding_constraint_count(txt):
+    # Shardy lowering emits sdy.sharding_constraint; pre-Shardy XLA used a
+    # custom_call @Sharding — count either so the test survives both
+    return txt.count("sdy.sharding_constraint") + txt.count("@Sharding")
+
+
+def test_stage2_step_constrains_grads_stage1_does_not():
+    _, txt1 = _fit_one_batch("os")
+    _, txt2 = _fit_one_batch("os_g")
+    n1 = _sharding_constraint_count(txt1)
+    n2 = _sharding_constraint_count(txt2)
+    # stage 2 adds one with_sharding_constraint per parameter gradient
+    # (4 params here: 2 weights + 2 biases) on top of whatever stage 1 has
+    assert n2 > n1, (n1, n2)
+    assert n2 - n1 >= 4
+
+
+def test_stage2_grad_constraint_is_dim0_sharded():
+    _, txt = _fit_one_batch("os_g")
+    # at least one constraint must shard dim 0 over the 8-way axis
+    # (the (16,64) weight grad reduce-scattered over it): shardy spells it
+    # sharding_constraint ... [{"sharding"}, {}]
+    assert ('sharding_constraint' in txt and '[{"sharding"}' in txt) \
+        or "devices=[8" in txt, txt[:2000]
+
+
+def test_stage2_product_numerics_match_stage1():
+    """The added constraint must not change the math, only the layout."""
+    def run(level):
+        import jax.numpy as jnp  # noqa: F401
+
+        net = _build_net()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        wrapped, _ = group_sharded_parallel(net, opt, level=level)
+        model = paddle.Model(wrapped)
+        model.prepare(optimizer=opt, loss=nn.MSELoss())
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 16).astype("float32")
+        y = rng.randn(32, 8).astype("float32")
+        losses = [float(np.sum(model.train_batch([x], [y])[0]))
+                  for _ in range(3)]
+        return losses
+
+    np.testing.assert_allclose(run("os"), run("os_g"), rtol=1e-5)

@@ -205,8 +205,10 @@ def bench_child() -> None:
     # this copy, never re-extract from the model (advisor r3 finding).
     # Only the sweep's OOM path consumes it, so only take the ~1GB
     # device->host copy when the sweep will actually run.
+    sweep_batches = [int(s) for s in
+                     os.environ.get("BENCH_SWEEP", "64,128").split(",") if s]
     will_sweep = (on_tpu and "BENCH_BATCH" not in os.environ
-                  and bool(os.environ.get("BENCH_SWEEP", "64,128")))
+                  and bool(sweep_batches))
     snapshot = jax.tree_util.tree_map(
         lambda a: np.asarray(a),
         (params, buffers, opt_state)) if will_sweep else None
@@ -296,11 +298,10 @@ def bench_child() -> None:
          f"(mfu={best['detail']['mfu']:.3f})")
 
     # --- phase: batch micro-sweep (TPU only, no explicit override) --------
-    sweep = os.environ.get("BENCH_SWEEP", "64,128")
     sweep_detail = {batch: round(tps_q, 1)}
     if will_sweep:
         best_b, best_tps = batch, tps_q
-        for b in [int(s) for s in sweep.split(",") if s]:
+        for b in sweep_batches:
             try:
                 bi, bl = data_for(b)
                 run_steps(2, bi, bl, sync_each=True)      # compile + warm

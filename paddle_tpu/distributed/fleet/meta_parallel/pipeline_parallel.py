@@ -355,6 +355,32 @@ class PipelineParallel:
         self._jit_cache[cache_key] = pair
         return pair
 
+    def _place_opt_state(self, c: int, state):
+        """ZeRO-1 placement under PP: when the stage submesh carries a
+        fleet `sharding` axis (hybrid_configs sharding_degree > 1), moment
+        slots of replicated params are sharded dim-0 over it — rank-local
+        optimizer state exactly as GroupSharded stage 1, composed with the
+        pipeline split. TP-sharded params keep their moment layout (their
+        dim-0 may already be mp-sharded)."""
+        mesh = self._chunk_mesh(c)
+        if (mesh is None or "sharding" not in mesh.axis_names
+                or mesh.shape["sharding"] <= 1):
+            return state
+        from .sharding import shard_leaf
+
+        param_sh = self._chunk_param_sh[c] or {}
+        out = {}
+        for pname, acc in state.items():
+            psh = param_sh.get(pname)
+            # P(None, ...) is effectively replicated too (TP mark on an
+            # axis the submesh doesn't shard)
+            replicated = psh is None or not any(tuple(psh.spec))
+            out[pname] = {
+                slot: (jax.device_put(v, shard_leaf(v, mesh, "sharding"))
+                       if replicated and hasattr(v, "shape") else v)
+                for slot, v in acc.items()}
+        return out
+
     def _to_chunk(self, c: int, x):
         """Move an activation/cotangent onto chunk c's stage submesh (the
         explicit send/recv of the schedule — an ICI device-to-device copy).
@@ -460,8 +486,9 @@ class PipelineParallel:
 
         inner = getattr(optimizer, "_inner_opt", optimizer)
         if self._opt_states is None:
-            self._opt_states = [inner.functional_state(p)
-                                for p, _ in self._chunk_state]
+            self._opt_states = [
+                self._place_opt_state(c, inner.functional_state(p))
+                for c, (p, _) in enumerate(self._chunk_state)]
         inner._step_count += 1
         lr = jnp.asarray(inner.get_lr(), dtype=jnp.float32)
         t = jnp.asarray(inner._step_count, dtype=jnp.int32)
@@ -470,6 +497,14 @@ class PipelineParallel:
             scaled = jax.tree_util.tree_map(lambda g: g / M, grads[c])
             new_params, new_state = inner.functional_step(
                 params, scaled, self._opt_states[c], lr, t)
+            # the eager update mixes sharded ZeRO moments into the param
+            # math, which would commit new_params to a P('sharding') layout
+            # the next step's jitted forward (replicated in_shardings)
+            # rejects — pin params back to their stage placement
+            param_sh = self._chunk_param_sh[c]
+            if param_sh:
+                new_params = {k: jax.device_put(v, param_sh[k])
+                              for k, v in new_params.items()}
             self._opt_states[c] = new_state
             self._chunk_state[c] = (new_params, buffers)
             for i, layer in enumerate(self._layers.chunk_layers[c]):

@@ -142,10 +142,30 @@ def _run_gates(on_tpu: bool) -> dict:
         np.asarray(pk.rms_norm_fused(x, w))
         np.asarray(pk.layer_norm_fused(x, w, w))
 
+    def ring_step():
+        # one ring STEP = _fwd_call with SMEM offsets + pl.when block skip
+        # (the new Mosaic surface of the Pallas ring attention); a future
+        # block must come back all-masked (zeros + -inf lse)
+        kw = dict(scale=0.125, sk=256, is_causal=True, has_mask=False,
+                  mask_b_is_one=True, mask_h_is_one=True,
+                  mask_q_is_one=True, block_q=128, block_k=128,
+                  dropout_p=0.0, interpret=False)
+        mask = jnp.zeros((1, 1, 1, 1), jnp.float32)
+        sd = jnp.zeros((1,), jnp.int32)
+        q2 = q[:, :, :, :64]
+        qp = jnp.pad(q2, ((0, 0), (0, 0), (0, 0), (0, 64))).transpose(
+            0, 2, 1, 3)
+        o, lse = pk._fwd_call(qp, qp, qp, mask, sd,
+                              offs=jnp.asarray([0, 4096], jnp.int32),
+                              keep_neg_inf_lse=True, **kw)
+        assert float(np.max(np.abs(np.asarray(o, np.float32)))) == 0.0
+        assert bool(np.all(np.isneginf(np.asarray(lse))))
+
     gate("flash_fwd", flash_fwd)
     gate("flash_bwd", flash_bwd)
     gate("flash_dropout", flash_dropout)
     gate("fused_norms", norms)
+    gate("ring_step", ring_step)
     return gates
 
 
@@ -205,8 +225,13 @@ def bench_child() -> None:
     # this copy, never re-extract from the model (advisor r3 finding).
     # Only the sweep's OOM path consumes it, so only take the ~1GB
     # device->host copy when the sweep will actually run.
-    sweep_batches = [int(s) for s in
-                     os.environ.get("BENCH_SWEEP", "64,128").split(",") if s]
+    try:
+        sweep_batches = [int(s) for s in
+                         os.environ.get("BENCH_SWEEP", "64,128").split(",")
+                         if s.strip()]
+    except ValueError:  # malformed override: skip the sweep, don't crash
+        _log("phase=build: malformed BENCH_SWEEP ignored")
+        sweep_batches = []
     will_sweep = (on_tpu and "BENCH_BATCH" not in os.environ
                   and bool(sweep_batches))
     snapshot = jax.tree_util.tree_map(

@@ -42,24 +42,58 @@ class ReduceOp:
 
 
 def _axis_in_scope(axis_name: str) -> bool:
-    """True when `axis_name` is a live named axis (inside shard_map/pmap)."""
+    """True when `axis_name` is a live named axis (inside shard_map/pmap).
+
+    A false negative here no longer produces a silent wrong answer: the
+    eager fallbacks go through _no_axis_identity_ok, which raises for any
+    >1-rank group. The broad except around the private-API fast path is
+    deliberate — on any jax._src drift we fall THROUGH to the public probe,
+    never out of the collective."""
     try:
         from jax._src import core as jcore
 
-        frame = jcore.get_axis_env() if hasattr(jcore, "get_axis_env") else None
-        if frame is not None:
-            return axis_name in frame.axis_sizes
-    except Exception:
+        if hasattr(jcore, "get_axis_env"):
+            frame = jcore.get_axis_env()
+            if frame is not None:
+                return axis_name in frame.axis_sizes
+    except Exception:  # noqa: BLE001 — private API; fall through to public
         pass
     try:
         jax.lax.axis_size(axis_name)
         return True
-    except (NameError, KeyError, Exception):
+    except (NameError, KeyError, TypeError, ValueError):
         return False
 
 
 def _resolve(group: Optional[Group]) -> Group:
     return group if group is not None else get_default_group()
+
+
+def _no_axis_identity_ok(g: Group, op_name: str) -> None:
+    """Called on the no-named-axis-in-scope path. Identity semantics are the
+    paddle contract only for a trivial (<=1 rank) group; for a >1-rank group
+    the collective would silently return the wrong answer (e.g. a typo'd
+    axis name, or a mesh group used outside its shard_map region) — the
+    silent failure mode the reference's PADDLE_ENFORCE culture forbids."""
+    if g.nranks <= 1:
+        return
+    raise RuntimeError(
+        f"paddle.distributed.{op_name}: group over mesh axis "
+        f"{g.axis_name!r} spans {g.nranks} ranks, but no such named axis is "
+        "in scope here — executing eagerly would silently degrade the "
+        "collective to an identity. Run it inside the shard_map/jit region "
+        "that binds the axis (the fleet engines do this), or use a <=1-rank "
+        "group for eager code.")
+
+
+def _axis_nranks(g: Group) -> int:
+    """Rank count on the traced (axis-in-scope) path: the LIVE axis size —
+    the default group's nranks reflects the process world, which can differ
+    from the mesh axis a shard_map region binds."""
+    try:
+        return int(jax.lax.axis_size(g.axis_name))
+    except (NameError, KeyError, TypeError, ValueError):
+        return g.nranks
 
 
 def _data(x):
@@ -118,6 +152,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
         else:
             out = _REDUCERS[op](x, g.axis_name)
         return _rebind(tensor, out)
+    _no_axis_identity_ok(g, "all_reduce")
     return tensor  # world_size 1
 
 
@@ -138,8 +173,9 @@ def all_gather(tensor_list: Optional[List], tensor=None,
     x = _data(tensor)
     if _axis_in_scope(g.axis_name):
         out = jax.lax.all_gather(x, g.axis_name, axis=0, tiled=False)
-        parts = [out[i] for i in range(g.nranks)]
+        parts = [out[i] for i in range(_axis_nranks(g))]
     else:
+        _no_axis_identity_ok(g, "all_gather")
         parts = [x]
     if tensor_list is not None:
         tensor_list.extend(Tensor(p) for p in parts)
@@ -206,6 +242,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM,
         out = jax.lax.psum_scatter(x, g.axis_name, scatter_dimension=0,
                                    tiled=True)
         return _rebind(tensor, out)
+    _no_axis_identity_ok(g, "reduce_scatter")
     return _rebind(tensor, x)
 
 
@@ -216,7 +253,7 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
         x = _data(tensor)
         if src in g.ranks:
             src_local = g.get_group_rank(src)
-        elif 0 <= src < g.nranks:
+        elif 0 <= src < _axis_nranks(g):
             src_local = src  # already a group-local rank
         else:
             raise ValueError(
@@ -226,6 +263,7 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
         # into a broadcast collective)
         out = jax.lax.all_gather(x, g.axis_name)[src_local]
         return _rebind(tensor, out)
+    _no_axis_identity_ok(g, "broadcast")
     return tensor
 
 
@@ -240,6 +278,7 @@ def scatter(tensor, tensor_list=None, src: int = 0,
             stacked = _data(tensor)
         out = jax.lax.dynamic_index_in_dim(stacked, idx, keepdims=False)
         return _rebind(tensor, out)
+    _no_axis_identity_ok(g, "scatter")
     if tensor_list:
         return _rebind(tensor, _data(tensor_list[src]))
     return tensor
@@ -256,8 +295,9 @@ def alltoall(out_tensor_list, in_tensor_list=None,
         x = jnp.stack([_data(t) for t in in_tensor_list])  # [nranks, ...]
         out = jax.lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0,
                                  tiled=False)
-        parts = [Tensor(out[i]) for i in range(g.nranks)]
+        parts = [Tensor(out[i]) for i in range(_axis_nranks(g))]
     else:
+        _no_axis_identity_ok(g, "alltoall")
         parts = [Tensor(_data(t)) for t in in_tensor_list]
     if out_tensor_list is not None:
         out_tensor_list.clear()
@@ -278,6 +318,7 @@ def alltoall_single(out_tensor, in_tensor=None,
         out = jax.lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0,
                                  tiled=True)
     else:
+        _no_axis_identity_ok(g, "alltoall_single")
         out = x
     if out_tensor is not None:
         return _rebind(out_tensor, out)
@@ -301,6 +342,7 @@ def send(tensor, dst: int = 0, group: Optional[Group] = None,
             "point-to-point send inside shard_map must go through "
             "batch_isend_irecv (ring ppermute); arbitrary src/dst p2p is not "
             "an SPMD primitive")
+    _no_axis_identity_ok(g, "send")
     return tensor
 
 
@@ -311,6 +353,7 @@ def recv(tensor, src: int = 0, group: Optional[Group] = None,
         raise RuntimeError(
             "point-to-point recv inside shard_map must go through "
             "batch_isend_irecv (ring ppermute)")
+    _no_axis_identity_ok(g, "recv")
     return tensor
 
 
@@ -337,8 +380,9 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
     g = _resolve(p2p_op_list[0].group)
     if not _axis_in_scope(g.axis_name):
         # world_size 1: recvs keep their buffers, sends vanish
+        _no_axis_identity_ok(g, "batch_isend_irecv")
         return []
-    n = g.nranks
+    n = _axis_nranks(g)
     sends = [p for p in p2p_op_list if p.op in (send, isend)]
     recvs = [p for p in p2p_op_list if p.op in (recv, irecv)]
     if len(sends) != len(recvs):
@@ -372,6 +416,25 @@ def barrier(group: Optional[Group] = None):
     if _axis_in_scope(g.axis_name):
         # a psum of a scalar is the canonical SPMD barrier
         jax.lax.psum(jnp.zeros((), jnp.float32), g.axis_name)
+        return None
+    from . import env as _env
+
+    world = _env.get_world_size()
+    if world > 1:
+        if g.nranks not in (1, world):
+            # no host-side SUBGROUP barrier exists on jax.distributed;
+            # syncing all processes here would deadlock the ranks outside
+            # the group — refuse loudly instead
+            raise RuntimeError(
+                f"paddle.distributed.barrier: subgroup barrier over "
+                f"{g.nranks} of {world} processes is not supported on the "
+                "eager path; barrier() outside shard_map syncs the WHOLE "
+                "job (or run the barrier inside the group's shard_map "
+                "region)")
+        # multi-controller job: a REAL cross-process sync, not a no-op
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_dist_barrier")
     return None
 
 

@@ -38,6 +38,12 @@ def init_parallel_env():
             process_id=rank,
         )
     _STATE["initialized"] = True
+    # the default group may have been touched (and cached at the pre-init
+    # world size) before this point — rebuild it so eager misuse checks and
+    # get_world_size(default) see the live world
+    from .group import reset_default_group
+
+    reset_default_group()
 
 
 def is_initialized() -> bool:

@@ -31,12 +31,19 @@ def _unwrap(x):
 
 def ring_flash_attention(q, k, v, group=None, causal: bool = False,
                          axis_name: Optional[str] = None,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         impl: Optional[str] = None,
+                         interpret: bool = False):
     """Ring attention over a sequence-sharded axis.
 
     Args are [batch, heads, s_local, head_dim] shards inside shard_map over
     `axis_name` (or group.axis_name). Returns the local attention output
     shard. Outside a named axis, falls back to plain attention.
+
+    impl: None (auto: Pallas on TPU, XLA einsum elsewhere) | "pallas" |
+    "xla". The Pallas path runs the flash kernel per ring step — bf16 MXU
+    matmuls, in-kernel causal offsets, no materialized score block
+    (SURVEY §5's "ring attention as a Pallas splash/flash kernel").
     """
     qd, kd, vd = _unwrap(q), _unwrap(k), _unwrap(v)
     name = axis_name or (group.axis_name if group is not None else "sep")
@@ -49,6 +56,17 @@ def ring_flash_attention(q, k, v, group=None, causal: bool = False,
     if n == 1:
         out = _flash_block(qd, kd, vd, scale, causal, 0, 0, None)
         return Tensor(out.astype(qd.dtype)) if isinstance(q, Tensor) else out
+
+    from ....ops import pallas_kernels as _pk
+
+    use_pallas = impl == "pallas" or (
+        impl is None and _pk._on_tpu() and qd.ndim == 4
+        and 8 <= qd.shape[-1] <= 256)
+    if use_pallas:
+        out = _pk.ring_flash_attention_pallas(
+            qd, kd, vd, name, causal=causal, scale=scale,
+            interpret=interpret)
+        return Tensor(out) if isinstance(q, Tensor) else out
 
     my = jax.lax.axis_index(name)
     s_local = qd.shape[2]

@@ -67,7 +67,15 @@ def _device_mesh(n: Optional[int] = None, axis_name: str = "dp"):
 
 def get_default_group() -> Group:
     if _DEFAULT[0] is None:
-        n = len(jax.devices())
+        # paddle contract: before init_parallel_env the world is the PROCESS
+        # world (1 for a plain script), NOT the local device count — eager
+        # collectives on the default group are identity exactly when the
+        # process world size is 1. Inside shard_map the live axis size is
+        # what counts (communication._axis_nranks), so a 1-rank default
+        # group still psums correctly over the bound axis.
+        from . import env as _env
+
+        n = max(1, _env.get_world_size())
         _DEFAULT[0] = Group(0, list(range(n)), id=0, axis_name="dp")
         _GROUPS[0] = _DEFAULT[0]
     return _DEFAULT[0]
@@ -76,6 +84,15 @@ def get_default_group() -> Group:
 def set_default_group(group: Group):
     _DEFAULT[0] = group
     _GROUPS[group.id] = group
+
+
+def reset_default_group():
+    """Drop the cached default group (it snapshots the world size at first
+    touch); the next get_default_group() rebuilds from the live env. Also
+    evict it from the id registry so get_group(0) can't resurrect the
+    stale pre-init world size."""
+    _DEFAULT[0] = None
+    _GROUPS.pop(0, None)
 
 
 def new_group(ranks: Optional[Sequence[int]] = None, backend: str = "xla",

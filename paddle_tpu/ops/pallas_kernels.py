@@ -92,12 +92,27 @@ def _keep_mask(pltpu, seed_ref, b_, h_, qi, ki, shape, dropout_p,
     return bits >= thresh
 
 
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct with varying-manual-axes when running inside a
+    shard_map region (check_vma=True requires pallas outputs to declare
+    which mesh axes they vary over)."""
+    if vma is not None:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # ---------------------------------------------------------------- forward
 
 def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
               mask_b_is_one, mask_h_is_one, mask_q_is_one, block_q, block_k,
-              dropout_p, interpret):
-    """qt/kt/vt: padded (b, h, S, D). Returns (out_padded, logsumexp)."""
+              dropout_p, interpret, offs=None, keep_neg_inf_lse=False, vma=None):
+    """qt/kt/vt: padded (b, h, S, D). Returns (out_padded, logsumexp).
+
+    `offs` (i32[2] in SMEM: global q-row / k-col offsets) generalizes causal
+    masking to ring attention, where the q and k shards sit at different
+    global sequence positions per step. With `keep_neg_inf_lse`, fully
+    masked rows report lse=-inf (so a ring merge weighs them at zero)
+    instead of the 0.0 clamp the single-call path uses."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -106,6 +121,7 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
     n_q, n_k = sq_p // block_q, sk_p // block_k
     need_k_mask = sk_p != sk
     has_dropout = dropout_p > 0.0
+    dyn_offsets = offs is not None
 
     def kernel(*refs):
         refs = list(refs)
@@ -113,6 +129,7 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
         refs = refs[3:]
         m_in_ref = refs.pop(0) if has_mask else None
         seed_ref = refs.pop(0) if has_dropout else None
+        offs_ref = refs.pop(0) if dyn_offsets else None
         o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
         qi = pl.program_id(2)
         ki = pl.program_id(3)
@@ -123,51 +140,70 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
             m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
             l_ref[...] = jnp.zeros_like(l_ref)
 
-        # qk matmul stays in the INPUT dtype (bf16 rides the MXU natively;
-        # an f32 upcast here triples the MXU passes) with f32 accumulation
-        s = jax.lax.dot_general(
-            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if has_mask:
-            s = s + m_in_ref[0, 0].astype(jnp.float32)
-        cols = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        if is_causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            s = jnp.where(rows >= cols, s, -jnp.inf)
-        if need_k_mask:
-            s = jnp.where(cols < sk, s, -jnp.inf)
-        m_prev = m_ref[...]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        # fully-masked rows keep m=-inf; clamp so exp(-inf - -inf) != nan
-        m_safe = jnp.where(jnp.isfinite(m_cur), m_cur, 0.0)
-        p = jnp.exp(jnp.where(jnp.isfinite(s), s - m_safe, -jnp.inf))
-        alpha = jnp.where(jnp.isfinite(m_prev),
-                          jnp.exp(m_prev - m_safe), 0.0)
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_ref[...] = m_cur
-        vblk = v_ref[0, 0]
-        # attention dropout (upscale_in_train): drop unnormalized weights in
-        # the value accumulation; the softmax denominator l uses UNdropped p
-        p_acc = p
-        if has_dropout:
-            keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
-                              pl.program_id(1), qi, ki,
-                              (block_q, block_k), dropout_p, interpret)
-            p_acc = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
-        # p cast to V's dtype: bf16 inputs keep the PV matmul on the MXU's
-        # native path (f32 accumulation via preferred_element_type)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p_acc.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        def _compute():
+            # qk matmul stays in the INPUT dtype (bf16 rides the MXU
+            # natively; f32 upcast triples the passes) w/ f32 accumulation
+            s = jax.lax.dot_general(
+                q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if has_mask:
+                s = s + m_in_ref[0, 0].astype(jnp.float32)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            if is_causal:
+                rows = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                if dyn_offsets:
+                    s = jnp.where(rows + offs_ref[0] >= cols + offs_ref[1],
+                                  s, -jnp.inf)
+                else:
+                    s = jnp.where(rows >= cols, s, -jnp.inf)
+            if need_k_mask:
+                s = jnp.where(cols < sk, s, -jnp.inf)
+            m_prev = m_ref[...]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            # fully-masked rows keep m=-inf; clamp so exp(-inf--inf) != nan
+            m_safe = jnp.where(jnp.isfinite(m_cur), m_cur, 0.0)
+            p = jnp.exp(jnp.where(jnp.isfinite(s), s - m_safe, -jnp.inf))
+            alpha = jnp.where(jnp.isfinite(m_prev),
+                              jnp.exp(m_prev - m_safe), 0.0)
+            l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1,
+                                                      keepdims=True)
+            m_ref[...] = m_cur
+            vblk = v_ref[0, 0]
+            # attention dropout (upscale_in_train): drop unnormalized
+            # weights in the value accumulation; the softmax denominator l
+            # uses UNdropped p
+            p_acc = p
+            if has_dropout:
+                keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
+                                  pl.program_id(1), qi, ki,
+                                  (block_q, block_k), dropout_p, interpret)
+                p_acc = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+            # p cast to V's dtype: bf16 inputs keep the PV matmul on the
+            # MXU's native path (f32 accumulation)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                p_acc.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if is_causal and dyn_offsets:
+            # splash-style whole-block skip: a causal ring step whose k
+            # block lies entirely in the future contributes nothing — skip
+            # its MXU work (the uniform grid still visits the block, so the
+            # SPMD program stays identical on every rank)
+            q_hi = offs_ref[0] + (qi + 1) * block_q - 1   # max global row
+            k_lo = offs_ref[1] + ki * block_k             # min global col
+            pl.when(q_hi >= k_lo)(_compute)
+        else:
+            _compute()
 
         @pl.when(ki == n_k - 1)
         def _done():
             l_fin = jnp.maximum(l_ref[...], 1e-30)
             o_ref[0, 0] = (acc_ref[...] / l_fin).astype(o_ref.dtype)
             lse = m_ref[...][:, 0] + jnp.log(l_fin[:, 0])
-            lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
+            if not keep_neg_inf_lse:
+                lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
             # lse rows live in a (8, block_q) tile (sublane-broadcast) —
             # Mosaic requires the last two block dims be (8,128)-aligned,
             # so a flat (1,1,block_q) row block is not lowerable
@@ -192,6 +228,9 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
     if dropout_p > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         operands.append(seed)
+    if dyn_offsets:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(offs)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -204,8 +243,8 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
                          lambda b_, h_, qi, ki: (b_, h_, 0, qi)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sq_p, d_p), qt.dtype),
-            jax.ShapeDtypeStruct((b, h, 8, sq_p), jnp.float32),
+            _sds((b, h, sq_p, d_p), qt.dtype, vma),
+            _sds((b, h, 8, sq_p), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d_p), jnp.float32),
@@ -220,8 +259,10 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
 # --------------------------------------------------------------- backward
 
 def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
-                    is_causal, has_mask, need_k_mask, block_q, block_k):
-    """Shared backward recompute: p = exp(s - lse), masked like forward."""
+                    is_causal, has_mask, need_k_mask, block_q, block_k,
+                    offs_ref=None):
+    """Shared backward recompute: p = exp(s - lse), masked like forward.
+    `offs_ref` carries the ring step's global (q, k) position offsets."""
     s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
                             (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -232,7 +273,11 @@ def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
     if is_causal:
         rows = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        s = jnp.where(rows >= cols, s, -jnp.inf)
+        if offs_ref is not None:
+            s = jnp.where(rows + offs_ref[0] >= cols + offs_ref[1],
+                          s, -jnp.inf)
+        else:
+            s = jnp.where(rows >= cols, s, -jnp.inf)
     if need_k_mask:
         s = jnp.where(cols < sk, s, -jnp.inf)
     p = jnp.exp(jnp.where(jnp.isfinite(s), s - lse_blk, -jnp.inf))
@@ -242,7 +287,7 @@ def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
 def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                  is_causal, has_mask, mask_b_is_one, mask_h_is_one,
                  mask_q_is_one, block_q, block_k, dropout_p, want_dmask,
-                 interpret):
+                 interpret, offs=None, vma=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -251,6 +296,7 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
     n_q, n_k = sq_p // block_q, sk_p // block_k
     need_k_mask = sk_p != sk
     has_dropout = dropout_p > 0.0
+    dyn_offsets = offs is not None
 
     def kernel(*refs):
         refs = list(refs)
@@ -258,6 +304,7 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
         refs = refs[3:]
         m_in_ref = refs.pop(0) if has_mask else None
         seed_ref = refs.pop(0) if has_dropout else None
+        offs_ref = refs.pop(0) if dyn_offsets else None
         do_ref, lse_ref, delta_ref = refs[:3]
         outs = refs[3:]
         if want_dmask:
@@ -271,29 +318,39 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
         def _init():
             acc_ref[...] = jnp.zeros_like(acc_ref)
 
-        lse_blk = lse_ref[0, 0, 0][:, None]
-        p = _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki,
-                            scale=scale, sk=sk, is_causal=is_causal,
-                            has_mask=has_mask, need_k_mask=need_k_mask,
-                            block_q=block_q, block_k=block_k)
-        dp = jax.lax.dot_general(do_ref[0, 0], v_ref[0, 0],
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        if has_dropout:
-            # dP = M/(1-r) ∘ dP_dropped — same mask as forward (same seeds)
-            keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
-                              pl.program_id(1), qi, ki,
-                              (block_q, block_k), dropout_p, interpret)
-            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
-        ds = p * (dp - delta_ref[0, 0, 0][:, None])
-        if want_dmask:
-            # s = scale*q·k + mask ⇒ d(mask) = ds, unscaled; per-(h,qi,ki)
-            # blocks are each visited exactly once so a plain store is safe
-            dmask_ref[0, 0] = ds
-        kblk = k_ref[0, 0]
-        acc_ref[...] += jax.lax.dot_general(
-            ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+        def _compute():
+            lse_blk = lse_ref[0, 0, 0][:, None]
+            p = _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki,
+                                scale=scale, sk=sk, is_causal=is_causal,
+                                has_mask=has_mask, need_k_mask=need_k_mask,
+                                block_q=block_q, block_k=block_k,
+                                offs_ref=offs_ref)
+            dp = jax.lax.dot_general(do_ref[0, 0], v_ref[0, 0],
+                                     (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            if has_dropout:
+                # dP = M/(1-r) ∘ dP_dropped — same mask as fwd (same seeds)
+                keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
+                                  pl.program_id(1), qi, ki,
+                                  (block_q, block_k), dropout_p, interpret)
+                dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+            ds = p * (dp - delta_ref[0, 0, 0][:, None])
+            if want_dmask:
+                # s = scale*q·k + mask ⇒ d(mask) = ds, unscaled; per-
+                # (h,qi,ki) blocks are each visited exactly once so a plain
+                # store is safe
+                dmask_ref[0, 0] = ds
+            kblk = k_ref[0, 0]
+            acc_ref[...] += jax.lax.dot_general(
+                ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+        if is_causal and dyn_offsets:
+            q_hi = offs_ref[0] + (qi + 1) * block_q - 1
+            k_lo = offs_ref[1] + ki * block_k
+            pl.when(q_hi >= k_lo)(_compute)
+        else:
+            _compute()
 
         @pl.when(ki == n_k - 1)
         def _done():
@@ -319,15 +376,18 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
     if has_dropout:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         operands.append(seed)
+    if dyn_offsets:
+        assert not want_dmask, "ring offsets and mask grads don't combine"
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(offs)
     in_specs += [q_spec, row_spec, row_spec]
     operands += [dot, lse, delta]
 
     out_specs = [q_spec]
-    out_shape = [jax.ShapeDtypeStruct((b, h, sq_p, d_p), qt.dtype)]
+    out_shape = [_sds((b, h, sq_p, d_p), qt.dtype, vma)]
     if want_dmask:
         out_specs.append(score_spec)
-        out_shape.append(jax.ShapeDtypeStruct((b, h, sq_p, sk_p),
-                                              jnp.float32))
+        out_shape.append(_sds((b, h, sq_p, sk_p), jnp.float32, vma))
 
     result = pl.pallas_call(
         kernel,
@@ -343,7 +403,8 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
 
 def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                   is_causal, has_mask, mask_b_is_one, mask_h_is_one,
-                  mask_q_is_one, block_q, block_k, dropout_p, interpret):
+                  mask_q_is_one, block_q, block_k, dropout_p, interpret,
+                  offs=None, vma=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -352,6 +413,7 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
     n_q, n_k = sq_p // block_q, sk_p // block_k
     need_k_mask = sk_p != sk
     has_dropout = dropout_p > 0.0
+    dyn_offsets = offs is not None
 
     def kernel(*refs):
         refs = list(refs)
@@ -359,6 +421,7 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
         refs = refs[3:]
         m_in_ref = refs.pop(0) if has_mask else None
         seed_ref = refs.pop(0) if has_dropout else None
+        offs_ref = refs.pop(0) if dyn_offsets else None
         do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
         ki = pl.program_id(2)
         qi = pl.program_id(3)   # q innermost: it is the accumulated dim here
@@ -368,34 +431,43 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
             dk_acc[...] = jnp.zeros_like(dk_acc)
             dv_acc[...] = jnp.zeros_like(dv_acc)
 
-        lse_blk = lse_ref[0, 0, 0][:, None]
-        p = _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki,
-                            scale=scale, sk=sk, is_causal=is_causal,
-                            has_mask=has_mask, need_k_mask=need_k_mask,
-                            block_q=block_q, block_k=block_k)
-        doblk = do_ref[0, 0]
-        if has_dropout:
-            # seed args in (b, h, qi, ki) order — identical to fwd/dq even
-            # though this kernel's grid iterates (ki, qi)
-            keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
-                              pl.program_id(1), qi, ki,
-                              (block_q, block_k), dropout_p, interpret)
-            p_d = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+        def _compute():
+            lse_blk = lse_ref[0, 0, 0][:, None]
+            p = _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki,
+                                scale=scale, sk=sk, is_causal=is_causal,
+                                has_mask=has_mask, need_k_mask=need_k_mask,
+                                block_q=block_q, block_k=block_k,
+                                offs_ref=offs_ref)
+            doblk = do_ref[0, 0]
+            if has_dropout:
+                # seed args in (b, h, qi, ki) order — identical to fwd/dq
+                # even though this kernel's grid iterates (ki, qi)
+                keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
+                                  pl.program_id(1), qi, ki,
+                                  (block_q, block_k), dropout_p, interpret)
+                p_d = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+            else:
+                p_d = p
+            dv_acc[...] += jax.lax.dot_general(
+                p_d.astype(doblk.dtype), doblk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # P_dropped^T @ dO
+            dp = jax.lax.dot_general(doblk, v_ref[0, 0],
+                                     (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            if has_dropout:
+                dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+            ds = p * (dp - delta_ref[0, 0, 0][:, None])
+            qblk = q_ref[0, 0]
+            dk_acc[...] += jax.lax.dot_general(
+                ds.astype(qblk.dtype), qblk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # ds^T @ Q
+
+        if is_causal and dyn_offsets:
+            q_hi = offs_ref[0] + (qi + 1) * block_q - 1
+            k_lo = offs_ref[1] + ki * block_k
+            pl.when(q_hi >= k_lo)(_compute)
         else:
-            p_d = p
-        dv_acc[...] += jax.lax.dot_general(
-            p_d.astype(doblk.dtype), doblk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)      # P_dropped^T @ dO
-        dp = jax.lax.dot_general(doblk, v_ref[0, 0],
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        if has_dropout:
-            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
-        ds = p * (dp - delta_ref[0, 0, 0][:, None])
-        qblk = q_ref[0, 0]
-        dk_acc[...] += jax.lax.dot_general(
-            ds.astype(qblk.dtype), qblk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # ds^T @ Q
+            _compute()
 
         @pl.when(qi == n_q - 1)
         def _done():
@@ -420,6 +492,9 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
     if has_dropout:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         operands.append(seed)
+    if dyn_offsets:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(offs)
     in_specs += [q_spec, row_spec, row_spec]
     operands += [dot, lse, delta]
 
@@ -428,8 +503,8 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
         grid=(b, h, n_k, n_q),
         in_specs=in_specs,
         out_specs=[k_spec, k_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d_p), kt.dtype),
-                   jax.ShapeDtypeStruct((b, h, sk_p, d_p), vt.dtype)],
+        out_shape=[_sds((b, h, sk_p, d_p), kt.dtype, vma),
+                   _sds((b, h, sk_p, d_p), vt.dtype, vma)],
         scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
                         pltpu.VMEM((block_k, d_p), jnp.float32)],
         interpret=interpret,
@@ -765,3 +840,146 @@ def rms_norm_fused(x, weight, eps=1e-6, interpret=False):
 def layer_norm_fused(x, weight, bias=None, eps=1e-5, interpret=False):
     return _fused_norm_data(x, weight, bias, eps, subtract_mean=True,
                             interpret=interpret)
+
+
+# ============================================================ ring attention
+#
+# Pallas ring flash attention (SURVEY §5 long-context bullet: "ring attention
+# as a Pallas splash/flash kernel with ppermute"). Inside shard_map over the
+# sep axis each rank holds a sequence shard of Q,K,V; per ring step the LOCAL
+# flash kernel above runs on (q_local, k_block, v_block) with the step's
+# global position offsets driving the causal mask IN-KERNEL (never a
+# materialized score or mask buffer), and the normalized partial outputs are
+# merged with elementwise log-sum-exp weights. Communication is one ppermute
+# of the KV pair per step (ICI neighbor exchange); causal steps whose block
+# lies entirely in the future skip their MXU work via pl.when (splash-style)
+# while keeping the SPMD program uniform across ranks.
+#
+# Backward rotates (k, v, dk_acc, dv_acc) a full loop: each rank folds its
+# local contribution into the passing block's gradient accumulators using the
+# recompute-based dq/dkv kernels with the SAME global lse/delta residuals,
+# so after n shifts every rank holds exactly its own dk/dv.
+
+
+def _ring_merge(o_acc, lse_acc, o_s, lse_s):
+    """Fold one normalized flash partial (o_s, lse_s) into the accumulator.
+    Elementwise over (b,h,s)+(b,h,s,d) — no O(s^2) buffer anywhere."""
+    new_lse = jnp.logaddexp(lse_acc, lse_s)
+    safe = jnp.where(jnp.isfinite(new_lse), new_lse, 0.0)
+    w_acc = jnp.where(jnp.isfinite(lse_acc), jnp.exp(lse_acc - safe), 0.0)
+    w_s = jnp.where(jnp.isfinite(lse_s), jnp.exp(lse_s - safe), 0.0)
+    o = o_acc * w_acc[..., None] + o_s.astype(jnp.float32) * w_s[..., None]
+    return o, new_lse
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_vjp(axis_name: str, n: int, causal: bool, scale: float, sk: int,
+              block_q: int, block_k: int, interpret: bool):
+    """custom_vjp'd ring flash attention over `axis_name` (n ranks), one
+    (b, h, S_pad, D_pad) shard per rank; `sk` is the real (unpadded) local
+    sequence length."""
+    kw = dict(scale=scale, sk=sk, is_causal=causal, has_mask=False,
+              mask_b_is_one=True, mask_h_is_one=True, mask_q_is_one=True,
+              block_q=block_q, block_k=block_k, dropout_p=0.0,
+              interpret=interpret, vma=(axis_name,))
+    perm = tuple((i, (i + 1) % n) for i in range(n))
+
+    def _placeholders():
+        return (jnp.zeros((1, 1, 1, 1), jnp.float32),
+                jnp.zeros((1,), jnp.int32))
+
+    def _offs_for(my, step):
+        if not causal:
+            return None
+        src = (my - step) % n       # whose KV block this rank now holds
+        return jnp.stack([my * sk, src * sk]).astype(jnp.int32)
+
+    def _fwd_impl(qt, kt, vt):
+        mask, seed = _placeholders()
+        my = jax.lax.axis_index(axis_name)
+        b, h, S, D = qt.shape
+        o = jnp.zeros((b, h, S, D), jnp.float32)
+        lse = jnp.full((b, h, S), -jnp.inf, jnp.float32)
+        kv = (kt, vt)
+        for step in range(n):
+            o_s, lse_s = _fwd_call(qt, kv[0], kv[1], mask, seed,
+                                   offs=_offs_for(my, step),
+                                   keep_neg_inf_lse=True, **kw)
+            o, lse = _ring_merge(o, lse, o_s, lse_s[:, :, 0, :])
+            if step != n - 1:
+                kv = jax.lax.ppermute(kv, axis_name, perm)
+        return o.astype(qt.dtype), lse
+
+    @jax.custom_vjp
+    def f(qt, kt, vt):
+        return _fwd_impl(qt, kt, vt)[0]
+
+    def fwd(qt, kt, vt):
+        out, lse = _fwd_impl(qt, kt, vt)
+        return out, (qt, kt, vt, out, lse)
+
+    def bwd(res, do):
+        qt, kt, vt, out, lse = res
+        b, h, S, D = qt.shape
+        mask, seed = _placeholders()
+        my = jax.lax.axis_index(axis_name)
+        # global residuals: p = exp(s - lse_global) inside the per-step
+        # kernels IS the globally-normalized attention weight, so the flash
+        # backward decomposition holds blockwise across the ring
+        lse_b = jnp.broadcast_to(
+            jnp.where(jnp.isfinite(lse), lse, 0.0)[:, :, None, :],
+            (b, h, 8, S))
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)
+        delta_b = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, S))
+        dq = jnp.zeros((b, h, S, D), jnp.float32)
+        ring = (kt, vt, jnp.zeros((b, h, S, D), jnp.float32),
+                jnp.zeros((b, h, S, D), jnp.float32))
+        for step in range(n):
+            kb, vb, dka, dva = ring
+            offs = _offs_for(my, step)
+            dq_s, _ = _bwd_dq_call(qt, kb, vb, mask, seed, do, lse_b,
+                                   delta_b, want_dmask=False, offs=offs,
+                                   **kw)
+            dk_s, dv_s = _bwd_dkv_call(qt, kb, vb, mask, seed, do, lse_b,
+                                       delta_b, offs=offs, **kw)
+            dka = dka + dk_s.astype(jnp.float32)
+            dva = dva + dv_s.astype(jnp.float32)
+            # shift EVERY step: after n shifts each block's gradient
+            # accumulator is back home with all n contributions. The last
+            # shift carries only the accumulators — k/v are dead weight
+            # once no further step will read them
+            if step != n - 1:
+                ring = jax.lax.ppermute((kb, vb, dka, dva), axis_name, perm)
+            else:
+                dka, dva = jax.lax.ppermute((dka, dva), axis_name, perm)
+            dq = dq + dq_s.astype(jnp.float32)
+        return (dq.astype(qt.dtype), dka.astype(kt.dtype),
+                dva.astype(vt.dtype))
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def ring_flash_attention_pallas(q, k, v, axis_name: str, causal=False,
+                                scale=None, interpret=False):
+    """Ring flash attention on raw (b, h, s_local, d) shards inside
+    shard_map over `axis_name`. Differentiable (custom vjp rotating the
+    gradient accumulators around the same ring)."""
+    n = int(jax.lax.axis_size(axis_name))
+    b, h, s, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    block_q = _pick_block(s, _BLOCK_Q)
+    block_k = _pick_block(s, _BLOCK_K)
+    block = max(block_q, block_k)
+    S = _round_up(s, block)
+    d_p = _round_up(d, 128)
+
+    def padp(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, S - s), (0, d_p - d)))
+
+    f = _ring_vjp(axis_name, n, bool(causal), float(scale), s,
+                  block_q, block_k, bool(interpret))
+    out = f(padp(q), padp(k), padp(v))
+    return out[:, :, :s, :d]

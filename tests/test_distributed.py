@@ -134,6 +134,24 @@ def test_batch_isend_irecv_ring():
     np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
 
 
+def test_eager_collective_on_multirank_group_is_loud():
+    """Misuse must raise, not silently degrade to identity (verdict r3 #10):
+    a >1-rank mesh group used outside its shard_map region (or a typo'd axis
+    name) previously returned the input unchanged."""
+    g = dist.new_group(list(range(8)), axis_name="dp")
+    t = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+    with pytest.raises(RuntimeError, match="no such named axis"):
+        dist.all_reduce(t, group=g)
+    with pytest.raises(RuntimeError, match="no such named axis"):
+        dist.all_gather(None, t, group=g)
+    with pytest.raises(RuntimeError, match="no such named axis"):
+        dist.reduce_scatter(t, group=g)
+    with pytest.raises(RuntimeError, match="no such named axis"):
+        dist.broadcast(t, src=0, group=g)
+    with pytest.raises(RuntimeError, match="no such named axis"):
+        dist.alltoall_single(t, group=g)
+
+
 def test_collectives_eager_world1():
     # outside shard_map, groups degenerate to world_size 1
     t = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))

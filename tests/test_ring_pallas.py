@@ -1,0 +1,143 @@
+"""Pallas ring flash attention (verdict r3 #4 / SURVEY §5 long-context).
+
+The ring's per-step block math must be the flash kernel (in-kernel causal
+offsets, online-softmax merge) — not a materialized fp32 einsum. These tests
+run the kernel in interpret mode inside shard_map over a 4-way sep mesh and
+check numerics (fwd + grads) against dense attention, plus the memory claim:
+no O(s_local^2) buffer in the lowered program.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.fleet.meta_parallel.ring_attention import (
+    ring_flash_attention,
+)
+
+SEP = 4
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:SEP]), ("sep",))
+
+
+def _dense_ref(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq = q.shape[2]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def _ring(q, k, v, causal, impl="pallas"):
+    # check_vma=False: interpret-mode pallas expands to dynamic_slices that
+    # mix varying and constant operands, which the vma checker rejects (jax
+    # suggests this exact workaround); the compiled TPU path declares vma on
+    # the kernel outputs and runs under the default checker
+    fn = jax.shard_map(
+        lambda a, b_, c: ring_flash_attention(
+            a, b_, c, axis_name="sep", causal=causal, impl=impl,
+            interpret=True),
+        mesh=_mesh(), in_specs=(P(None, None, "sep", None),) * 3,
+        out_specs=P(None, None, "sep", None), check_vma=False)
+    return fn(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_pallas_matches_dense(causal, rng):
+    b, h, s, d = 1, 2, 32, 16   # s_local = 8 per rank
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+    out = _ring(q, k, v, causal)
+    ref = _dense_ref(q, k, v, causal, d ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_pallas_grads_match_dense(causal, rng):
+    b, h, s, d = 1, 1, 32, 16
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+    w = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(_ring(q, k, v, causal) * w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_ref(q, k, v, causal, d ** -0.5) * w)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd, name in zip(g_ring, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+def test_ring_pallas_no_quadratic_buffer():
+    """At s_local=1024 (block 512) the lowered ring program must contain no
+    1024x1024 tensor; the einsum path materializes exactly that."""
+    b, h, s_total, d = 1, 1, 4096, 64   # s_local = 1024
+    shape = (b, h, s_total, d)
+    args = [jax.ShapeDtypeStruct(shape, jnp.float32)] * 3
+
+    def lowered(impl):
+        fn = jax.shard_map(
+            lambda a, b_, c: ring_flash_attention(
+                a, b_, c, axis_name="sep", causal=True, impl=impl,
+                interpret=True),
+            mesh=_mesh(), in_specs=(P(None, None, "sep", None),) * 3,
+            out_specs=P(None, None, "sep", None), check_vma=False)
+        return jax.jit(fn).lower(*args).as_text()
+
+    assert "1024x1024" not in lowered("pallas")
+    assert "1024x1024" in lowered("xla")   # the buffer the kernel removes
+
+
+def test_ring_pallas_bf16_inputs(rng):
+    b, h, s, d = 1, 2, 32, 16
+    q = jnp.asarray(rng.standard_normal((b, h, s, d))).astype(jnp.bfloat16)
+    out = _ring(q, q, q, True)
+    ref = _dense_ref(q, q, q, True, d ** -0.5)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "tpu",
+                    reason="Mosaic lowering gate needs real TPU")
+def test_ring_step_kernel_lowers_on_tpu(rng):
+    """TPU gate for the new in-kernel pieces (SMEM offsets + pl.when block
+    skip): one ring STEP is a plain _fwd_call with offs — no multi-device
+    mesh needed on the single bench chip."""
+    from paddle_tpu.ops.pallas_kernels import _fwd_call
+
+    q = jnp.asarray(rng.standard_normal((1, 2, 256, 128))).astype(
+        jnp.bfloat16)
+    kw = dict(scale=0.125, sk=256, is_causal=True, has_mask=False,
+              mask_b_is_one=True, mask_h_is_one=True, mask_q_is_one=True,
+              block_q=128, block_k=128, dropout_p=0.0, interpret=False)
+    mask = jnp.zeros((1, 1, 1, 1), jnp.float32)
+    seed = jnp.zeros((1,), jnp.int32)
+    # diagonal step (offsets equal): must equal the static causal kernel
+    out_dyn, _ = _fwd_call(q, q, q, mask, seed,
+                           offs=jnp.asarray([512, 512], jnp.int32),
+                           keep_neg_inf_lse=True, **kw)
+    out_static, _ = _fwd_call(q, q, q, mask, seed, **kw)
+    np.testing.assert_allclose(np.asarray(out_dyn, np.float32),
+                               np.asarray(out_static, np.float32),
+                               rtol=1e-2, atol=1e-2)
+    # fully-future block (q before k): everything masked -> zeros + -inf lse
+    out_f, lse_f = _fwd_call(q, q, q, mask, seed,
+                             offs=jnp.asarray([0, 4096], jnp.int32),
+                             keep_neg_inf_lse=True, **kw)
+    assert float(jnp.max(jnp.abs(out_f.astype(jnp.float32)))) == 0.0
+    assert bool(jnp.all(jnp.isneginf(lse_f)))

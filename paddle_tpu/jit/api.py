@@ -9,9 +9,11 @@ a TranslatedLayer executing the saved module.
 """
 from __future__ import annotations
 
+import inspect
 import json
 import os
 import pickle
+import warnings
 from typing import Optional, Sequence
 
 import jax
@@ -88,11 +90,24 @@ def _sig_of(args):
 
 
 class StaticFunction:
-    """The compiled wrapper returned by @to_static."""
+    """The compiled wrapper returned by @to_static.
+
+    Capture pipeline (upstream's pre-SOT AST path, SURVEY §2.2 jit row):
+    1. the function (or the Layer's forward) goes through the dy2static
+       AST transform, rewriting Python if/while on tensor conditions into
+       static.nn.cond / while_loop (lax control flow under tracing);
+    2. the rewritten function is traced+jitted per input signature;
+    3. a residual graph break at trace time (bool()/int()/.numpy() on a
+       traced value, or control flow the transform skipped) falls back to
+       EAGER execution with a warning — upstream's guard-fallback contract
+       — instead of raising.
+    """
 
     def __init__(self, fn_or_layer, input_spec: Optional[Sequence] = None,
                  build_strategy=None, full_graph=True):
         from ..nn import Layer
+
+        from .dy2static import ast_transform
 
         self._is_layer = isinstance(fn_or_layer, Layer)
         self._layer = fn_or_layer if self._is_layer else getattr(
@@ -100,16 +115,42 @@ class StaticFunction:
         self._fn = fn_or_layer
         self._input_spec = input_spec
         self._cache = {}
+        self._eager_sigs = set()     # signatures that graph-broke
+        self._orig_forward = None    # layer's pre-transform bound forward
         self.__name__ = getattr(fn_or_layer, "__name__",
                                 type(fn_or_layer).__name__)
+        # dy2static: rewrite control flow BEFORE tracing
+        if self._is_layer:
+            inst_fwd = fn_or_layer.__dict__.get("forward")
+            if inst_fwd is not None:
+                # instance-level forward override (hook pattern): respect
+                # it — transform THAT, not the class forward. A plain
+                # function stored on the instance is NOT descriptor-bound,
+                # so its converted form must not be either.
+                base = getattr(inst_fwd, "__func__", inst_fwd)
+                needs_bind = hasattr(inst_fwd, "__func__")
+            else:
+                base = type(fn_or_layer).forward
+                needs_bind = True
+            if inspect.isfunction(base):
+                converted = ast_transform(base)
+                if converted is not base:
+                    self._orig_forward = fn_or_layer.forward
+                    fn_or_layer.forward = (
+                        converted.__get__(fn_or_layer) if needs_bind
+                        else converted)
+        elif inspect.isfunction(fn_or_layer):
+            self._fn = ast_transform(fn_or_layer)
 
     @property
     def input_spec(self):
         return self._input_spec
 
-    def _compiled_for(self, args):
-        training = self._layer.training if self._layer is not None else False
-        sig = (_sig_of(args), training)
+    def _compiled_for(self, args, sig=None):
+        if sig is None:
+            training = (self._layer.training if self._layer is not None
+                        else False)
+            sig = (_sig_of(args), training)
         entry = self._cache.get(sig)
         if entry is not None:
             return entry
@@ -143,20 +184,46 @@ class StaticFunction:
         self._cache[sig] = compiled
         return compiled
 
+    def _run_eager(self, args):
+        """Graph-break fallback: run the ORIGINAL (pre-transform) callable
+        eagerly, so a transform-introduced bug can't poison the fallback."""
+        wrapped = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+                   for a in args]
+        if self._is_layer and self._orig_forward is not None:
+            layer = self._fn
+            converted = layer.forward
+            layer.forward = self._orig_forward
+            try:
+                return layer(*wrapped)
+            finally:
+                layer.forward = converted
+        fn = getattr(self._fn, "__wrapped_original__", self._fn)
+        return fn(*wrapped)
+
     def __call__(self, *args, **kwargs):
         if kwargs:
             raise TypeError("to_static call supports positional args only")
+        training = self._layer.training if self._layer is not None else False
+        sig = (_sig_of(args), training)
+        if sig in self._eager_sigs:   # before any conversion/state walk
+            return self._run_eager(args)
         datas = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
                  for a in args]
         if self._layer is not None:
             params, buffers = extract_state(self._layer)
         else:
             params, buffers = {}, {}
-        compiled = self._compiled_for(args)
+        compiled = self._compiled_for(args, sig)
         try:
             outs, new_buffers = compiled(params, buffers, *datas)
         except _TRACE_LEAK_ERRORS as e:
-            raise _graph_break(self.__name__, e) from e
+            # upstream guard-system contract: graph break -> eager fallback
+            # with a warning, not an exception (the GraphBreakError text
+            # documents how to make the function capturable)
+            warnings.warn(str(_graph_break(self.__name__, e)),
+                          stacklevel=2)
+            self._eager_sigs.add(sig)
+            return self._run_eager(args)
         # write back mutated buffers (BN running stats under training)
         if new_buffers:
             named = {n: b for n, b in self._layer.named_buffers()
@@ -173,11 +240,13 @@ class StaticFunction:
 
     @property
     def code(self):
-        import inspect
-
+        target = self._fn.forward if self._is_layer else self._fn
+        # transformed functions were exec'd (no file); show the original
+        target = getattr(target, "__wrapped_original__", None) or (
+            self._orig_forward if self._is_layer and self._orig_forward
+            is not None else target)
         try:
-            return inspect.getsource(
-                self._fn.forward if self._is_layer else self._fn)
+            return inspect.getsource(target)
         except (OSError, TypeError):
             return "<source unavailable>"
 

@@ -1,0 +1,527 @@
+"""dy2static AST transform — upstream's pre-SOT capture path.
+
+Ref: python/paddle/jit/dy2static/ (program_translator + transformers;
+upstream layout, unverified — mount empty). Rewrites Python `if`/`while`
+statements on (potentially) tensor-valued conditions into calls to the
+static control-flow ops in `static/control_flow.py`, which dispatch at
+runtime: concrete conditions run plain Python, traced conditions lower to
+lax.cond / lax.while_loop. TPU-first consequence: a rewritten model is ONE
+XLA program for all inputs — no per-branch recompilation, no trace
+specialization on a data value.
+
+Transform contract (v1, conservative — anything outside it is left
+untouched and, if it then graph-breaks under tracing, StaticFunction falls
+back to EAGER with a warning instead of raising):
+
+- `if` whose body always returns (early-return pattern): the remainder of
+  the block becomes the else branch; both become zero-arg closures passed
+  to `_jst_ifelse`.
+- `if`/`else` assigning plain names: branches become closures returning the
+  union of assigned names, rebound at the call site.
+- `while` without break/continue/return: condition and body become
+  functions over the carried loop vars (names assigned in the body that
+  already exist before the loop), dispatched via `_jst_while`.
+- `and`/`or`/`not` inside rewritten conditions go through `_jst_and/_or/
+  _not` (jnp.logical_* when tensor-valued, Python semantics otherwise).
+- Skipped (left as-is): branches that store to attributes/subscripts
+  (side effects must not run for the untaken branch at trace time), loops
+  containing break/continue/return, `for` statements, lambdas.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+from typing import List, Optional, Sequence, Set
+
+import jax
+import numpy as np
+
+__all__ = ["ast_transform", "convert_to_static"]
+
+_HELPER_NAMES = ("_jst_ifelse", "_jst_while", "_jst_and", "_jst_or",
+                 "_jst_not")
+
+
+# ------------------------------------------------------------ runtime hooks
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _raw(x):
+    return x._data if hasattr(x, "_data") else x
+
+
+def _jst_ifelse(pred, true_fn, false_fn):
+    """Runtime dispatch for a rewritten `if`: static.nn.cond semantics."""
+    from ..static.control_flow import cond
+
+    return cond(pred, true_fn, false_fn)
+
+
+def _jst_while(cond_fn, body_fn, init_vars):
+    """Runtime dispatch for a rewritten `while` over carried loop vars."""
+    from ..static.control_flow import while_loop
+
+    out = while_loop(cond_fn, body_fn, list(init_vars))
+    return tuple(out)
+
+
+def _jst_and(a, b_thunk):
+    ad = _raw(a)
+    if _is_tracer(ad):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        return Tensor(jnp.logical_and(jnp.asarray(ad).astype(bool),
+                                      jnp.asarray(_raw(b_thunk())).astype(
+                                          bool)))
+    return a and b_thunk()     # Python short-circuit for concrete values
+
+
+def _jst_or(a, b_thunk):
+    ad = _raw(a)
+    if _is_tracer(ad):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        return Tensor(jnp.logical_or(jnp.asarray(ad).astype(bool),
+                                     jnp.asarray(_raw(b_thunk())).astype(
+                                         bool)))
+    return a or b_thunk()
+
+
+def _jst_not(a):
+    ad = _raw(a)
+    if _is_tracer(ad):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        return Tensor(jnp.logical_not(jnp.asarray(ad).astype(bool)))
+    return not a
+
+
+# --------------------------------------------------------------- analysis
+
+def _stored_names(stmts: Sequence[ast.stmt]) -> List[str]:
+    """Plain names stored anywhere in `stmts`, in first-store order."""
+    out: List[str] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store) and node.id not in out:
+                out.append(node.id)
+
+        def visit_FunctionDef(self, node):  # don't descend into nested defs
+            if node.name not in out:
+                out.append(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return out
+
+
+def _loaded_names(node) -> Set[str]:
+    out: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+
+    nodes = node if isinstance(node, (list, tuple)) else [node]
+    for n in nodes:
+        V().visit(n)
+    return out
+
+
+def _has_nonlocal_flow(stmts: Sequence[ast.stmt],
+                       include_return=True) -> bool:
+    """break/continue (not inside a nested loop) or return (not inside a
+    nested function) anywhere in `stmts` — these can't move into a closure."""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_Break(self, n):
+            found[0] = True
+
+        def visit_Continue(self, n):
+            found[0] = True
+
+        def visit_Return(self, n):
+            if include_return:
+                found[0] = True
+
+        def visit_While(self, n):     # its own break/continue are fine
+            for s in n.body + n.orelse:
+                W().visit(s)
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):   # nested defs own their returns
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, n):
+            pass
+
+    class W(V):
+        """Inside a nested loop: break/continue belong to it; returns (and
+        deeper loops' contents) still escape."""
+
+        def visit_Break(self, n):
+            pass
+
+        def visit_Continue(self, n):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return found[0]
+
+
+def _has_side_stores(stmts: Sequence[ast.stmt]) -> bool:
+    """Attribute/subscript stores or del statements: running both branches
+    at trace time would apply the side effect twice — skip such Ifs."""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_Attribute(self, n):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                found[0] = True
+            self.generic_visit(n)
+
+        def visit_Subscript(self, n):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                found[0] = True
+            self.generic_visit(n)
+
+        def visit_Global(self, n):
+            found[0] = True
+
+        def visit_Nonlocal(self, n):
+            found[0] = True
+
+    for s in stmts:
+        V().visit(s)
+    return found[0]
+
+
+def _always_returns(stmts: Sequence[ast.stmt]) -> bool:
+    """Every path through `stmts` ends in `return`."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return (_always_returns(last.body) and last.orelse
+                and _always_returns(last.orelse))
+    return False
+
+
+# ------------------------------------------------------------- transformer
+
+class _TestTransformer(ast.NodeTransformer):
+    """Rewrites and/or/not inside a condition expression."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        name = "_jst_and" if isinstance(node.op, ast.And) else "_jst_or"
+        expr = node.values[0]
+        for nxt in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Name(id=name, ctx=ast.Load()),
+                args=[expr, ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                                       kwonlyargs=[], kw_defaults=[],
+                                       kwarg=None, defaults=[]),
+                    body=nxt)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=ast.Name(id="_jst_not", ctx=ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+def _convert_test(test: ast.expr) -> ast.expr:
+    return _TestTransformer().visit(test)
+
+
+def _fn_def(name: str, args: List[str], body: List[ast.stmt]):
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=a) for a in args],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[]),
+        body=body or [ast.Pass()],
+        decorator_list=[], returns=None, type_params=[])
+
+
+def _names_tuple(names: List[str], ctx) -> ast.expr:
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx) for n in names], ctx=ctx)
+
+
+class _Dy2Static(ast.NodeTransformer):
+    """Statement-level rewriter. Operates on whole blocks so the
+    early-return `if` pattern can absorb the rest of its block."""
+
+    def __init__(self):
+        self._uid = 0
+        self._defined: Set[str] = set()
+
+    def _fresh(self, kind: str) -> str:
+        self._uid += 1
+        return f"_jst_{kind}_{self._uid}"
+
+    # -- blocks ------------------------------------------------------------
+    def _block(self, stmts: List[ast.stmt]) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.If):
+                converted = self._convert_if(st, stmts[i + 1:])
+                if converted is not None:
+                    out.extend(converted)
+                    return out  # the remainder was folded into the else
+                out.extend(self._convert_if_assign(st))
+            elif isinstance(st, ast.While):
+                out.extend(self._convert_while(st))
+            else:
+                out.append(self._recurse(st))
+            self._defined.update(_stored_names([st]))
+        return out
+
+    def _recurse(self, st: ast.stmt) -> ast.stmt:
+        """Transform nested blocks of non-rewritten statements."""
+        for field in ("body", "orelse", "finalbody"):
+            blk = getattr(st, field, None)
+            if blk:
+                saved = set(self._defined)
+                setattr(st, field, self._block(list(blk)))
+                self._defined = saved | _set_of(_stored_names(blk))
+        return st
+
+    def _branch_parts(self, name: str, body: List[ast.stmt]):
+        """(fn_def, zero-arg callable expr) for a branch closure.
+
+        Names the branch both STORES and needs the outer value of become
+        parameters (bound at call time via a lambda): a plain closure would
+        make them local on assignment and hit UnboundLocalError on the
+        first read (`x = x + 1`)."""
+        params = [n for n in _stored_names(body) if n in self._defined]
+        fn = _fn_def(name, params, body)
+        if params:
+            call = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                                   kwonlyargs=[], kw_defaults=[],
+                                   kwarg=None, defaults=[]),
+                body=ast.Call(func=ast.Name(id=name, ctx=ast.Load()),
+                              args=[ast.Name(id=p, ctx=ast.Load())
+                                    for p in params],
+                              keywords=[]))
+        else:
+            call = ast.Name(id=name, ctx=ast.Load())
+        return fn, call
+
+    # -- if ----------------------------------------------------------------
+    def _convert_if(self, st: ast.If,
+                    rest: List[ast.stmt]) -> Optional[List[ast.stmt]]:
+        """Early-return form: `if c: ...return` + rest -> one _jst_ifelse
+        returning from both closures. Returns None when not applicable."""
+        if not _always_returns(st.body):
+            return None
+        if _has_side_stores(st.body) or _has_nonlocal_flow(
+                st.body, include_return=False):
+            return None
+        else_body = list(st.orelse) + list(rest)
+        if _has_side_stores(else_body) or _has_nonlocal_flow(
+                else_body, include_return=False):
+            return None
+
+        saved = set(self._defined)
+        tbody = self._block([_copy(s) for s in st.body])
+        self._defined = set(saved)
+        fbody = self._block([_copy(s) for s in else_body]) or [
+            ast.Return(value=ast.Constant(value=None))]
+        if not _always_returns(fbody):
+            fbody = fbody + [ast.Return(value=ast.Constant(value=None))]
+        self._defined = saved
+
+        tname, fname = self._fresh("true"), self._fresh("false")
+        tdef, tcall = self._branch_parts(tname, tbody)
+        fdef, fcall = self._branch_parts(fname, fbody)
+        call = ast.Return(value=ast.Call(
+            func=ast.Name(id="_jst_ifelse", ctx=ast.Load()),
+            args=[_convert_test(st.test), tcall, fcall],
+            keywords=[]))
+        return [tdef, fdef, call]
+
+    def _convert_if_assign(self, st: ast.If) -> List[ast.stmt]:
+        """Assignment form: branches rebind plain names, no returns."""
+        both = list(st.body) + list(st.orelse)
+        if (_has_nonlocal_flow(both) or _has_side_stores(both)):
+            return [self._recurse(st)]
+        assigned = _stored_names(both)
+        # only names already defined are safe to thread through both
+        # branches at trace time (an undefined name in the untaken branch
+        # would NameError); others leave the If as plain Python
+        if not assigned or not set(assigned) <= self._defined:
+            return [self._recurse(st)]
+
+        saved = set(self._defined)
+        tbody = self._block([_copy(s) for s in st.body])
+        self._defined = set(saved)
+        fbody = self._block([_copy(s) for s in st.orelse])
+        self._defined = saved
+
+        ret = ast.Return(value=_names_tuple(assigned, ast.Load()))
+        tname, fname = self._fresh("true"), self._fresh("false")
+        tdef, tcall = self._branch_parts(tname, tbody + [_copy(ret)])
+        fdef, fcall = self._branch_parts(fname, fbody + [_copy(ret)])
+        target = _names_tuple(assigned, ast.Store())
+        call = ast.Assign(
+            targets=[target],
+            value=ast.Call(
+                func=ast.Name(id="_jst_ifelse", ctx=ast.Load()),
+                args=[_convert_test(st.test), tcall, fcall],
+                keywords=[]))
+        return [tdef, fdef, call]
+
+    # -- while -------------------------------------------------------------
+    def _convert_while(self, st: ast.While) -> List[ast.stmt]:
+        if (st.orelse or _has_nonlocal_flow(st.body)
+                or _has_side_stores(st.body)):
+            return [self._recurse(st)]
+        assigned = _stored_names(st.body)
+        carried = [n for n in assigned if n in self._defined]
+        if not carried or set(assigned) - set(carried):
+            # body creates fresh names: python semantics can't be preserved
+            # through a carried-loop rewrite — leave as-is
+            return [self._recurse(st)]
+
+        saved = set(self._defined)
+        body = self._block([_copy(s) for s in st.body])
+        self._defined = saved
+
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        cond_fn = _fn_def(cname, carried, [
+            ast.Return(value=_convert_test(_copy(st.test)))])
+        body_fn = _fn_def(bname, carried, body + [
+            ast.Return(value=_names_tuple(carried, ast.Load()))])
+        call = ast.Assign(
+            targets=[_names_tuple(carried, ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="_jst_while", ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      _names_tuple(carried, ast.Load())],
+                keywords=[]))
+        return [cond_fn, body_fn, call]
+
+    # -- entry -------------------------------------------------------------
+    def transform_function(self, fndef: ast.FunctionDef) -> ast.FunctionDef:
+        args = fndef.args
+        self._defined = {a.arg for a in (
+            args.posonlyargs + args.args + args.kwonlyargs)}
+        if args.vararg:
+            self._defined.add(args.vararg.arg)
+        if args.kwarg:
+            self._defined.add(args.kwarg.arg)
+        fndef.body = self._block(list(fndef.body))
+        fndef.decorator_list = []
+        return fndef
+
+
+def _set_of(names) -> Set[str]:
+    return set(names)
+
+
+def _copy(node):
+    return ast.fix_missing_locations(ast.parse(ast.unparse(node)).body[0]) \
+        if isinstance(node, ast.stmt) else ast.parse(
+            ast.unparse(node), mode="eval").body
+
+
+# ----------------------------------------------------------------- driver
+
+@functools.lru_cache(maxsize=256)
+def _transform_cached(fn):
+    return _do_transform(fn)
+
+
+def ast_transform(fn):
+    """Return a control-flow-converted version of `fn`, or `fn` itself when
+    the source is unavailable/unparseable (lambdas, builtins, C functions).
+    Safe: any transform failure degrades to the original function."""
+    try:
+        return _transform_cached(fn)
+    except TypeError:          # unhashable callables
+        try:
+            return _do_transform(fn)
+        except Exception:      # noqa: BLE001 — fall back, never break
+            return fn
+
+
+def _do_transform(fn):
+    if not inspect.isfunction(fn):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fndef = tree.body[0] if tree.body else None
+    if not isinstance(fndef, ast.FunctionDef) or fndef.name != fn.__name__:
+        return fn             # lambdas / expressions / drifted source
+
+    has_cf = any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(fndef))
+    if not has_cf:
+        return fn             # nothing to rewrite
+
+    try:
+        new_def = _Dy2Static().transform_function(fndef)
+        module = ast.Module(body=[new_def], type_ignores=[])
+        ast.fix_missing_locations(module)
+        code = compile(module, filename=f"<dy2static {fn.__name__}>",
+                       mode="exec")
+    except Exception:          # noqa: BLE001 — unrewritable: keep original
+        return fn
+
+    # namespace: original globals + materialized closure cells + helpers
+    ns = dict(fn.__globals__)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                ns[name] = cell.cell_contents
+            except ValueError:     # empty cell (self-reference)
+                pass
+    for h in _HELPER_NAMES:
+        ns[h] = globals()[h]
+    exec(code, ns)
+    new_fn = ns[fn.__name__]
+    new_fn.__wrapped_original__ = fn
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    return new_fn
+
+
+def convert_to_static(fn):
+    """Public alias mirroring paddle.jit.dy2static.convert_to_static."""
+    return ast_transform(fn)

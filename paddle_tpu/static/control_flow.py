@@ -82,10 +82,15 @@ def while_loop(cond_fn, body_fn, loop_vars, name=None):
     datas = [_data(v) for v in vals]
 
     if not any(_is_tracer(d) for d in datas):
-        while bool(_data(cond_fn(*vals))):
-            out = body_fn(*vals)
-            vals = list(out) if isinstance(out, (list, tuple)) else [out]
-        return vals if is_seq else vals[0]
+        # probe the condition too: concrete loop vars with a condition that
+        # closes over a TRACED outer value still need the lax path
+        c0 = cond_fn(*vals)
+        if not _is_tracer(_data(c0)):
+            while bool(_data(c0)):
+                out = body_fn(*vals)
+                vals = list(out) if isinstance(out, (list, tuple)) else [out]
+                c0 = cond_fn(*vals)
+            return vals if is_seq else vals[0]
 
     def c(state):
         with tape_mod.no_grad():

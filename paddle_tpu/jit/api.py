@@ -139,6 +139,12 @@ class StaticFunction:
                     fn_or_layer.forward = (
                         converted.__get__(fn_or_layer) if needs_bind
                         else converted)
+        elif inspect.ismethod(fn_or_layer):
+            # bound method (to_static(net.forward)): transform the
+            # underlying function and rebind to the same instance
+            converted = ast_transform(fn_or_layer.__func__)
+            if converted is not fn_or_layer.__func__:
+                self._fn = converted.__get__(fn_or_layer.__self__)
         elif inspect.isfunction(fn_or_layer):
             self._fn = ast_transform(fn_or_layer)
 
@@ -197,7 +203,11 @@ class StaticFunction:
                 return layer(*wrapped)
             finally:
                 layer.forward = converted
-        fn = getattr(self._fn, "__wrapped_original__", self._fn)
+        fn = self._fn
+        orig = getattr(fn, "__wrapped_original__", None)
+        if orig is not None:
+            bound_to = getattr(fn, "__self__", None)
+            fn = orig.__get__(bound_to) if bound_to is not None else orig
         return fn(*wrapped)
 
     def __call__(self, *args, **kwargs):

@@ -33,11 +33,9 @@ import ast
 import functools
 import inspect
 import textwrap
-import warnings
 from typing import List, Optional, Sequence, Set
 
 import jax
-import numpy as np
 
 __all__ = ["ast_transform", "convert_to_static"]
 
@@ -292,14 +290,23 @@ class _Dy2Static(ast.NodeTransformer):
         return f"_jst_{kind}_{self._uid}"
 
     # -- blocks ------------------------------------------------------------
-    def _block(self, stmts: List[ast.stmt]) -> List[ast.stmt]:
+    def _block(self, stmts: List[ast.stmt],
+               fn_suite: bool = False) -> List[ast.stmt]:
+        """Transform one statement block. `fn_suite` marks blocks whose
+        fall-through means RETURNING from the enclosing function (the
+        function body itself, and the branch closures of an already
+        converted early-return if) — only there is the early-return If
+        rewrite sound. In any nested block (loop body, untransformed If
+        branch, with/try suite) fall-through continues the program, so
+        folding the remainder into a `return` would corrupt it."""
         out: List[ast.stmt] = []
         for i, st in enumerate(stmts):
             if isinstance(st, ast.If):
-                converted = self._convert_if(st, stmts[i + 1:])
-                if converted is not None:
-                    out.extend(converted)
-                    return out  # the remainder was folded into the else
+                if fn_suite:
+                    converted = self._convert_if(st, stmts[i + 1:])
+                    if converted is not None:
+                        out.extend(converted)
+                        return out  # remainder folded into the else
                 out.extend(self._convert_if_assign(st))
             elif isinstance(st, ast.While):
                 out.extend(self._convert_while(st))
@@ -315,7 +322,7 @@ class _Dy2Static(ast.NodeTransformer):
             if blk:
                 saved = set(self._defined)
                 setattr(st, field, self._block(list(blk)))
-                self._defined = saved | _set_of(_stored_names(blk))
+                self._defined = saved | set(_stored_names(blk))
         return st
 
     def _branch_parts(self, name: str, body: List[ast.stmt]):
@@ -356,9 +363,12 @@ class _Dy2Static(ast.NodeTransformer):
             return None
 
         saved = set(self._defined)
-        tbody = self._block([_copy(s) for s in st.body])
+        # branch closures: their returns ARE the outer function's returns
+        # (we `return _jst_ifelse(...)`), so their suites are fn_suites
+        tbody = self._block([_copy(s) for s in st.body], fn_suite=True)
         self._defined = set(saved)
-        fbody = self._block([_copy(s) for s in else_body]) or [
+        fbody = self._block([_copy(s) for s in else_body],
+                            fn_suite=True) or [
             ast.Return(value=ast.Constant(value=None))]
         if not _always_returns(fbody):
             fbody = fbody + [ast.Return(value=ast.Constant(value=None))]
@@ -444,13 +454,9 @@ class _Dy2Static(ast.NodeTransformer):
             self._defined.add(args.vararg.arg)
         if args.kwarg:
             self._defined.add(args.kwarg.arg)
-        fndef.body = self._block(list(fndef.body))
+        fndef.body = self._block(list(fndef.body), fn_suite=True)
         fndef.decorator_list = []
         return fndef
-
-
-def _set_of(names) -> Set[str]:
-    return set(names)
 
 
 def _copy(node):
@@ -495,27 +501,38 @@ def _do_transform(fn):
     if not has_cf:
         return fn             # nothing to rewrite
 
+    # helpers and materialized closure cells ride in as FACTORY parameters,
+    # so the rewritten function's __globals__ can be the original module's
+    # LIVE globals dict — forward references (helpers defined later in the
+    # module, monkeypatched names) keep resolving at call time, and nothing
+    # is written into the user's module namespace
+    free = list(fn.__code__.co_freevars)
+    factory_params = list(_HELPER_NAMES) + free
     try:
         new_def = _Dy2Static().transform_function(fndef)
-        module = ast.Module(body=[new_def], type_ignores=[])
+        factory = _fn_def("_dy2st_factory", factory_params,
+                          [new_def,
+                           ast.Return(value=ast.Name(id=new_def.name,
+                                                     ctx=ast.Load()))])
+        module = ast.Module(body=[factory], type_ignores=[])
         ast.fix_missing_locations(module)
         code = compile(module, filename=f"<dy2static {fn.__name__}>",
                        mode="exec")
     except Exception:          # noqa: BLE001 — unrewritable: keep original
         return fn
 
-    # namespace: original globals + materialized closure cells + helpers
-    ns = dict(fn.__globals__)
+    cell_vals = []
     if fn.__closure__:
-        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+        for name, cell in zip(free, fn.__closure__):
             try:
-                ns[name] = cell.cell_contents
+                cell_vals.append(cell.cell_contents)
             except ValueError:     # empty cell (self-reference)
-                pass
-    for h in _HELPER_NAMES:
-        ns[h] = globals()[h]
-    exec(code, ns)
-    new_fn = ns[fn.__name__]
+                cell_vals.append(fn.__globals__.get(name))
+    loc: dict = {}
+    exec(code, fn.__globals__, loc)
+    new_fn = loc["_dy2st_factory"](
+        *[globals()[h] for h in _HELPER_NAMES], *cell_vals)
+    new_fn.__name__ = fn.__name__
     new_fn.__wrapped_original__ = fn
     new_fn.__defaults__ = fn.__defaults__
     new_fn.__kwdefaults__ = fn.__kwdefaults__

@@ -137,6 +137,36 @@ class TestWhileTransform:
         e, s = _both(f, _t([1.0]))
         np.testing.assert_allclose(e, s)
 
+    def test_early_return_if_inside_for_loop_not_folded(self):
+        """Regression (review r4): the early-return rewrite must NOT fire
+        inside a loop body — fall-through there continues the loop, so
+        folding the remainder into a return corrupted f(-5) to None."""
+        def f(x):
+            for _ in range(3):
+                if x.sum() > 0:
+                    return x * 2.0
+                x = x + 1.0
+            return x - 1.0
+
+        for v in ([-5.0], [1.0], [-1.5]):
+            e, s = _both(f, _t(v))
+            np.testing.assert_allclose(e, s)
+
+    def test_early_return_if_inside_plain_if_branch(self):
+        """Same regression, nested in an untransformed outer if branch."""
+        def f(x, flag):
+            if flag:                  # concrete python bool: left as-is
+                if x.sum() > 0:
+                    return x * 2.0
+                x = x + 1.0
+            return x - 1.0
+
+        for v, flag in ([-5.0], True), ([3.0], True), ([3.0], False):
+            e = f(_t(v), flag)
+            s = paddle.jit.to_static(lambda t: f(t, flag))(_t(v))
+            np.testing.assert_allclose(np.asarray(e.numpy()),
+                                       np.asarray(s.numpy()))
+
 
 class TestLayerTransform:
     def test_layer_with_data_dependent_forward(self):
@@ -158,6 +188,31 @@ class TestLayerTransform:
         sf = paddle.jit.to_static(net)
         np.testing.assert_allclose(np.asarray(eager),
                                    np.asarray(sf(x).numpy()), rtol=1e-6)
+
+    def test_forward_referenced_global_resolves_at_call_time(self):
+        """Regression (review r4): the transformed function must share the
+        module's LIVE globals — a helper defined (or monkeypatched) after
+        decoration has to resolve, exactly as it would eagerly."""
+        import types
+
+        mod = types.ModuleType("dy2st_fwdref_mod")
+        src = (
+            "def f(x):\n"
+            "    if x.sum() > 0:\n"
+            "        return helper(x)\n"
+            "    return x - 1.0\n")
+        exec(compile(src, "dy2st_fwdref.py", "exec"), mod.__dict__)
+        import linecache
+
+        linecache.cache["dy2st_fwdref.py"] = (
+            len(src), None, src.splitlines(True), "dy2st_fwdref.py")
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        g = ast_transform(mod.f)
+        assert g is not mod.f            # transform fired
+        mod.helper = lambda t: t * 10.0  # defined AFTER the transform
+        out = paddle.jit.to_static(mod.f)(_t([2.0]))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [20.0])
 
     def test_transform_preserves_untouched_functions(self):
         from paddle_tpu.jit.dy2static import ast_transform

@@ -19,6 +19,9 @@ from .core import (  # noqa: F401
     set_device, set_flags, set_grad_enabled, uint8,
 )
 from .core.rng import get_rng_state, set_rng_state  # noqa: F401
+from .device import (  # noqa: F401
+    is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu,
+)
 from . import autograd  # noqa: F401
 from .autograd import grad, is_grad_enabled  # noqa: F401
 
@@ -36,7 +39,7 @@ from .tensor.creation import (  # noqa: F401
 )
 from .tensor.random import (  # noqa: F401
     bernoulli, multinomial, normal, poisson, rand, randint, randint_like,
-    randn, randperm, standard_normal, uniform,
+    randn, randperm, standard_gamma, standard_normal, uniform,
 )
 
 # subpackages — the full paddle surface. Import failures are FATAL: round 1
@@ -54,6 +57,20 @@ import importlib as _importlib
 for _pkg in _SUBPACKAGES:
     globals()[_pkg] = _importlib.import_module(f".{_pkg}", __name__)
 del _importlib, _pkg
+
+from .nn.layer.layers import ParamAttr  # noqa: F401,E402
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter: a free-standing trainable Parameter
+    (shares Layer.create_parameter's implementation)."""
+    from .nn.layer.layers import make_parameter
+
+    return make_parameter(shape, attr=attr, dtype=dtype, is_bias=is_bias,
+                          default_initializer=default_initializer,
+                          name=name)
+
 
 if "framework" in globals() and hasattr(framework, "save"):  # noqa: F821
     save = framework.save  # noqa: F821

@@ -555,6 +555,7 @@ _METHOD_TABLE = {
     # math
     "add": "add", "subtract": "subtract", "multiply": "multiply",
     "divide": "divide", "floor_divide": "floor_divide", "mod": "mod",
+    "floor_mod": "mod",
     "remainder": "remainder", "pow": "elementwise_pow", "maximum": "maximum",
     "minimum": "minimum", "fmax": "fmax", "fmin": "fmin", "atan2": "atan2",
     "scale": "scale", "neg": "neg", "abs": "abs", "sqrt": "sqrt",

@@ -9,13 +9,15 @@ import jax.numpy as jnp
 
 from .core.tensor import Tensor
 from .tensor import (  # noqa: F401
-    fft, fft2, fftn, fftshift, hfft, ifft, ifft2, ifftn, ifftshift, ihfft,
-    irfft, irfft2, irfftn, rfft, rfft2, rfftn,
+    fft, fft2, fftn, fftshift, hfft, hfft2, hfftn, ifft, ifft2, ifftn,
+    ifftshift, ihfft, ihfft2, ihfftn, irfft, irfft2, irfftn, rfft, rfft2,
+    rfftn,
 )
 
 __all__ = [
     "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
-    "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftshift",
+    "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "hfft2",
+    "hfftn", "ihfft2", "ihfftn", "fftshift",
     "ifftshift", "fftfreq", "rfftfreq",
 ]
 

@@ -506,7 +506,18 @@ def _do_transform(fn):
     # LIVE globals dict — forward references (helpers defined later in the
     # module, monkeypatched names) keep resolving at call time, and nothing
     # is written into the user's module namespace
-    free = list(fn.__code__.co_freevars)
+    # only NON-empty cells become factory params; an empty cell (a nested
+    # function's self-reference) stays out of the factory scope so the name
+    # resolves through the LIVE globals at call time — binding it now would
+    # freeze None over the recursion target
+    free, cell_vals = [], []
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                cell_vals.append(cell.cell_contents)
+                free.append(name)
+            except ValueError:
+                pass
     factory_params = list(_HELPER_NAMES) + free
     try:
         new_def = _Dy2Static().transform_function(fndef)
@@ -521,13 +532,6 @@ def _do_transform(fn):
     except Exception:          # noqa: BLE001 — unrewritable: keep original
         return fn
 
-    cell_vals = []
-    if fn.__closure__:
-        for name, cell in zip(free, fn.__closure__):
-            try:
-                cell_vals.append(cell.cell_contents)
-            except ValueError:     # empty cell (self-reference)
-                cell_vals.append(fn.__globals__.get(name))
     loc: dict = {}
     exec(code, fn.__globals__, loc)
     new_fn = loc["_dy2st_factory"](

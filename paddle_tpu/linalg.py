@@ -12,7 +12,8 @@ __all__ = [
     "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "eig",
     "eigh", "eigvals", "eigvalsh", "householder_product", "inner", "inv",
     "inverse", "lstsq", "lu", "lu_unpack", "matrix_exp", "matrix_power",
-    "matrix_rank", "multi_dot", "norm", "outer", "pinv", "qr", "slogdet",
+    "matrix_rank", "multi_dot", "norm", "ormqr", "outer",
+    "pca_lowrank", "pinv", "qr", "slogdet", "svd_lowrank",
     "solve", "svd", "tensordot", "triangular_solve", "vecdot",
     "vector_norm", "matrix_norm",
 ]

@@ -6,11 +6,14 @@ from .layer.common import (  # noqa: F401
     AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
     Dropout2D, Dropout3D,
     Embedding, Flatten, Fold, Identity, Linear, Pad1D, Pad2D, Pad3D,
-    PixelShuffle,
+    PixelShuffle, PixelUnshuffle,
     Unflatten, Unfold, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     ZeroPad1D, ZeroPad2D, ZeroPad3D,
 )
-from .layer.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,
+    Conv3DTranspose,
+)
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
@@ -19,20 +22,22 @@ from .layer.norm import (  # noqa: F401
 from .layer.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
     LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
-    RReLU, SELU, Sigmoid, SiLU, Softmax, Softplus, Softshrink, Softsign,
-    Swish, Tanh, Tanhshrink, Softmax2D,
+    RReLU, SELU, Sigmoid, SiLU, Silu, Softmax, Softplus, Softshrink,
+    Softsign, Swish, Tanh, Tanhshrink, Softmax2D, ThresholdedReLU,
 )
 from .layer.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
-    AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, AvgPool3D, LPPool1D, LPPool2D,
-    MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool2D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+    AvgPool2D, AvgPool3D, LPPool1D, LPPool2D, MaxPool1D, MaxPool2D,
+    MaxPool3D, MaxUnPool2D,
 )
 from .layer.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
     HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
     MultiLabelSoftMarginLoss, NLLLoss, PairwiseDistance, PoissonNLLLoss,
     RNNTLoss, SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
-    CosineEmbeddingLoss, TripletMarginWithDistanceLoss,
+    CosineEmbeddingLoss, TripletMarginWithDistanceLoss, MultiMarginLoss,
+    AdaptiveLogSoftmaxWithLoss,
 )
 from .layer.container import (  # noqa: F401
     LayerDict, LayerList, ParameterList, Sequential,

@@ -734,3 +734,125 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         "flash_attn_unpadded is not supported on the TPU-native backend "
         "(static shapes); pad to a rectangular batch and pass an additive "
         "attn_mask to scaled_dot_product_attention")
+
+
+# ------------------------------------------------- round-4 coverage fns
+# (tools/api_inventory.py audit — verdict r3 #6)
+
+def log_sigmoid(x, name=None):
+    return apply_op(_op("log_sigmoid"), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(_op("thresholded_relu"), x, threshold=threshold,
+                    value=value)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return apply_op(_op("pixel_unshuffle"), x,
+                    downscale_factor=downscale_factor,
+                    data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    left, right, top, bottom = [int(p) for p in padding]
+    spatial = [(top, bottom), (left, right)]
+    pads = ([(0, 0), (0, 0)] + spatial if data_format == "NCHW"
+            else [(0, 0)] + spatial + [(0, 0)])
+    from ...core.dispatch import apply_callable
+
+    def fn(xd):
+        import jax.numpy as jnp
+
+        return jnp.pad(xd, pads)
+
+    return apply_callable("zeropad2d", fn, x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[i, ..., j] = j < x[i, ...] (paddle.nn.functional.sequence_mask).
+    With maxlen=None the bound comes off-device (data-dependent shape —
+    eager only, like upstream's dynamic-shape op)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...core.dispatch import apply_callable
+
+    if maxlen is None:
+        maxlen = int(np.asarray(x.numpy()).max())
+
+    def fn(xd):
+        ar = jnp.arange(int(maxlen), dtype=xd.dtype)
+        from ...core.dtype import convert_dtype
+
+        return (ar[None] < xd[..., None].astype(ar.dtype)).astype(
+            convert_dtype(dtype))
+
+    return apply_callable("sequence_mask", fn, x)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", name=None):
+    return apply_op(_op("conv1d_transpose"), x, weight, bias, stride=stride,
+                    padding=padding, output_padding=output_padding,
+                    groups=groups, dilation=dilation,
+                    data_format=data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", name=None):
+    return apply_op(_op("conv3d_transpose"), x, weight, bias, stride=stride,
+                    padding=padding, output_padding=output_padding,
+                    groups=groups, dilation=dilation,
+                    data_format=data_format)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return apply_op(_op("adaptive_avg_pool1d"), x, output_size=output_size)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = apply_op(_op("adaptive_max_pool1d"), x, output_size=output_size)
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d(return_mask=True) is not supported")
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = apply_op(_op("adaptive_max_pool3d"), x, output_size=output_size)
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) is not supported")
+    return out
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin loss (paddle.nn.functional.multi_margin_loss)."""
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply_callable
+
+    def fn(logits, lab, *w):
+        n, c = logits.shape
+        lab = lab.reshape(-1).astype(jnp.int32)
+        correct = jnp.take_along_axis(logits, lab[:, None], axis=1)
+        diff = jnp.maximum(margin - correct + logits, 0.0) ** p
+        if w:
+            diff = diff * w[0][lab][:, None]
+        # the true-class term contributes margin^p; upstream excludes it
+        mask = jnp.arange(c)[None, :] != lab[:, None]
+        per = jnp.sum(diff * mask, axis=1) / c
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply_callable("multi_margin_loss", fn, *args)

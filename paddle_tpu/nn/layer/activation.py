@@ -212,3 +212,15 @@ class Softmax2D(Layer):
         if x.ndim not in (3, 4):
             raise ValueError("Softmax2D expects (N, C, H, W) or (C, H, W)")
         return F.softmax(x, axis=-3)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, value=0.0, name=None):
+        super().__init__()
+        self.threshold, self.value = threshold, value
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self.threshold, self.value)
+
+
+Silu = SiLU  # paddle spells both; keep one implementation

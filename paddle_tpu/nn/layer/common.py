@@ -298,3 +298,14 @@ class ZeroPad3D(Pad3D):
     def __init__(self, padding, data_format="NCDHW", name=None):
         super().__init__(padding, mode="constant", value=0.0,
                          data_format=data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor,
+                                 self.data_format)

@@ -48,6 +48,32 @@ class ParamAttr:
         raise TypeError(f"cannot convert {attr!r} to ParamAttr")
 
 
+def make_parameter(shape, attr=None, dtype=None, is_bias=False,
+                   default_initializer=None, name=None):
+    """Single implementation behind Layer.create_parameter AND the free
+    paddle.create_parameter: attr normalization, initializer fallback
+    chain (attr > explicit default > global default > Constant/Xavier),
+    optimize-attr wiring."""
+    from ...core.dtype import get_default_dtype
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    init = attr.initializer or default_initializer
+    if init is None:
+        init = I.global_bias_init() if is_bias else I.global_weight_init()
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    data = init(shape, dtype)
+    p = Parameter(data, name=name or attr.name or "",
+                  trainable=attr.trainable)
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p
+
+
 class HookRemoveHelper:
     def __init__(self, hooks: dict, hook_id: int):
         self._hooks = hooks
@@ -79,22 +105,10 @@ class Layer:
     # --------------------------------------------------------------- params
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
                          default_initializer=None):
-        attr = ParamAttr._to_attr(attr)
-        if attr is False:
-            return None
-        dtype = convert_dtype(dtype) or self._dtype
-        init = attr.initializer or default_initializer
-        if init is None:
-            init = (I.global_bias_init() if is_bias else
-                    I.global_weight_init())
-        if init is None:
-            init = I.Constant(0.0) if is_bias else I.XavierNormal()
-        data = init(shape, dtype)
-        p = Parameter(data, name=attr.name or "", trainable=attr.trainable)
-        p.optimize_attr["learning_rate"] = attr.learning_rate
-        p.regularizer = attr.regularizer
-        p.need_clip = attr.need_clip
-        return p
+        return make_parameter(shape, attr=attr,
+                              dtype=convert_dtype(dtype) or self._dtype,
+                              is_bias=is_bias,
+                              default_initializer=default_initializer)
 
     def add_parameter(self, name: str, parameter: Optional[Parameter]):
         self._parameters[name] = parameter

@@ -246,3 +246,89 @@ class TripletMarginWithDistanceLoss(Layer):
         return F.triplet_margin_with_distance_loss(
             input, positive, negative, self.distance_function, self.margin,
             self.swap, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Efficient softmax approximation for large vocabularies
+    (paddle.nn.AdaptiveLogSoftmaxWithLoss; the Grave et al. hierarchical
+    head): frequent classes in a full head, tail classes in down-projected
+    clusters, exact log-probabilities."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        from .common import Linear
+
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > n_classes - 1 or len(set(cutoffs))
+                != len(cutoffs)):
+            raise ValueError("cutoffs must be unique, positive, increasing "
+                             "and < n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head = Linear(in_features, self.head_size,
+                           bias_attr=None if head_bias else False)
+        self.tail = []
+        for i in range(self.n_clusters):
+            hsz = int(in_features // (div_value ** (i + 1)))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = Linear(in_features, max(hsz, 1), bias_attr=False)
+            out = Linear(max(hsz, 1), osz, bias_attr=False)
+            self.add_sublayer(f"tail_proj_{i}", proj)
+            self.add_sublayer(f"tail_out_{i}", out)
+            self.tail.append((proj, out))
+
+    def log_prob(self, input):
+        """Full (N, n_classes) log-probabilities."""
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        head_out = self.head(input)._data
+        head_lp = head_out - jnp.log(
+            jnp.sum(jnp.exp(head_out - head_out.max(-1, keepdims=True)),
+                    axis=-1, keepdims=True)) - head_out.max(-1, keepdims=True)
+        parts = [head_lp[:, : self.shortlist_size]]
+        for i, (proj, out) in enumerate(self.tail):
+            logits = out(proj(input))._data
+            lse = jnp.log(jnp.sum(
+                jnp.exp(logits - logits.max(-1, keepdims=True)),
+                axis=-1, keepdims=True)) + logits.max(-1, keepdims=True)
+            cluster_lp = logits - lse
+            prior = head_lp[:, self.shortlist_size + i: self.shortlist_size
+                            + i + 1]
+            parts.append(prior + cluster_lp)
+        return Tensor(jnp.concatenate(parts, axis=-1))
+
+    def forward(self, input, label):
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        lp = self.log_prob(input)._data
+        lab = label._data.reshape(-1).astype(jnp.int32)
+        # upstream contract: output = log p(target) (negative values),
+        # loss = -output.mean()
+        out = jnp.take_along_axis(lp, lab[:, None], axis=1)[:, 0]
+        return Tensor(out), Tensor(-jnp.mean(out))
+
+    def predict(self, input):
+        return self.log_prob(input).argmax(axis=-1)

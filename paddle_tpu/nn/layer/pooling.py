@@ -182,3 +182,27 @@ class MaxUnPool2D(Layer):
         return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
                               self.padding, data_format=self.data_format,
                               output_size=self.output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError(
+                "AdaptiveMaxPool1D(return_mask=True) is not implemented")
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError(
+                "AdaptiveMaxPool3D(return_mask=True) is not implemented")
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)

@@ -254,35 +254,12 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      data_format="NCHW"):
-    stride = _pair(stride)
-    dilation = _pair(dilation)
-    output_padding = _pair(output_padding)
-    # weight layout paddle: (in, out//groups, kh, kw)
-    kh, kw = weight.shape[-2], weight.shape[-1]
-    if isinstance(padding, str):
-        raise NotImplementedError("string padding for conv_transpose")
-    padp = _conv_padding(padding, (kh, kw), stride, dilation, 2)
-    # gradient-of-conv formulation: lhs_dilation = stride
-    pads = []
-    for (plo, phi), k, d, op_ in zip(padp, (kh, kw), dilation, output_padding):
-        eff_k = (k - 1) * d + 1
-        pads.append((eff_k - 1 - plo, eff_k - 1 - phi + op_))
-    if groups == 1:
-        w = jnp.swapaxes(weight, 0, 1)  # (out, in, kh, kw)
-    else:
-        cin, cog = weight.shape[0], weight.shape[1]
-        w = weight.reshape(groups, cin // groups, cog, kh, kw)
-        w = jnp.swapaxes(w, 1, 2).reshape(groups * cog, cin // groups, kh, kw)
-    w = jnp.flip(w, axis=(-2, -1))
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
-    out = lax.conv_general_dilated(
-        x, w, window_strides=(1, 1), padding=pads,
-        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-    )
-    if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1)
-    return out
+    if data_format != "NCHW":
+        raise NotImplementedError(
+            "conv2d_transpose supports NCHW only; transpose the input")
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 2,
+                              ("NCHW", "OIHW", "NCHW"))
 
 
 def _pool(x, kernel, stride, padding, init, op, data_format="NCHW",
@@ -1434,3 +1411,109 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
                           for ix in idxs])
     indices = jnp.take_along_axis(flat_idx, tap[None], axis=0)[0]
     return out, indices.astype(jnp.int32)
+
+
+# ------------------------------------------------- round-4 coverage ops
+# (tools/api_inventory.py audit — verdict r3 #6)
+
+@register_op("adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    n, c, l = x.shape
+    if l % o == 0:
+        return x.reshape(n, c, o, l // o).mean(axis=3)
+    cols = [x[:, :, (i * l) // o: -(-((i + 1) * l) // o)].mean(axis=2)
+            for i in range(o)]
+    return jnp.stack(cols, axis=-1)
+
+
+@register_op("adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    n, c, l = x.shape
+    if l % o == 0:
+        return x.reshape(n, c, o, l // o).max(axis=3)
+    cols = [x[:, :, (i * l) // o: -(-((i + 1) * l) // o)].max(axis=2)
+            for i in range(o)]
+    return jnp.stack(cols, axis=-1)
+
+
+@register_op("adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size):
+    out = _triple_(output_size)
+    n, c, d, h, w = x.shape
+    od, oh, ow = out
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        return x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow).max(
+            axis=(3, 5, 7))
+
+    def win_max(di, hi, wi):
+        ds, de = (di * d) // od, -(-((di + 1) * d) // od)
+        hs, he = (hi * h) // oh, -(-((hi + 1) * h) // oh)
+        ws, we = (wi * w) // ow, -(-((wi + 1) * w) // ow)
+        return x[:, :, ds:de, hs:he, ws:we].max(axis=(2, 3, 4))
+
+    planes = [jnp.stack(
+        [jnp.stack([win_max(i, j, l_) for l_ in range(ow)], axis=-1)
+         for j in range(oh)], axis=-2) for i in range(od)]
+    return jnp.stack(planes, axis=-3)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, fmt):
+    """Shared gradient-of-conv formulation (see conv2d_transpose)."""
+    def _nt(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(int(i) for i in v)
+        return (int(v),) * nd
+
+    stride, dilation, output_padding = _nt(stride), _nt(dilation), \
+        _nt(output_padding)
+    ks = weight.shape[-nd:]
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    padp = _conv_padding(padding, ks, stride, dilation, nd)
+    pads = []
+    for (plo, phi), k, dl, op_ in zip(padp, ks, dilation, output_padding):
+        eff_k = (k - 1) * dl + 1
+        pads.append((eff_k - 1 - plo, eff_k - 1 - phi + op_))
+    if groups == 1:
+        w = jnp.swapaxes(weight, 0, 1)
+    else:
+        cin, cog = weight.shape[0], weight.shape[1]
+        w = weight.reshape((groups, cin // groups, cog) + ks)
+        w = jnp.swapaxes(w, 1, 2).reshape(
+            (groups * cog, cin // groups) + ks)
+    w = jnp.flip(w, axis=tuple(range(-nd, 0)))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, fmt)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register_op("conv1d_transpose", amp_list="white")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL"):
+    if data_format != "NCL":
+        raise NotImplementedError(
+            "conv1d_transpose supports NCL only; transpose the input")
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 1,
+                              ("NCH", "OIH", "NCH"))
+
+
+@register_op("conv3d_transpose", amp_list="white")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW"):
+    if data_format != "NCDHW":
+        raise NotImplementedError(
+            "conv3d_transpose supports NCDHW only; transpose the input")
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 3,
+                              ("NCDHW", "OIDHW", "NCDHW"))

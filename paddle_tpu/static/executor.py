@@ -228,6 +228,7 @@ class Executor:
                 getattr(opt, "_inner_opt", opt), loss_var.name,
                 self._trainable_names(program))
             self._hybrid_engines[id(program)] = engine
+            dist_ctx["engine"] = engine   # observability (tests, tooling)
         loss = engine.train_step(feed_arrays)
         outs = [loss] if fetch_names else []
         if return_numpy:

@@ -122,18 +122,43 @@ def data_sharding(mesh):
     return NamedSharding(mesh, P(batch_axes if batch_axes else None))
 
 
-def _replay_ops(ops, env):
+def _amp_cast(val, target):
+    if hasattr(val, "dtype") and jnp.issubdtype(val.dtype, jnp.floating) \
+            and val.dtype != target:
+        return val.astype(target)
+    return val
+
+
+def _replay_ops(ops, env, amp: bool = False):
+    """Replay the SSA op list. With `amp`, the registry's per-op AMP lists
+    drive the static amp pass (the fleet amp meta-optimizer analog): white
+    ops compute in bf16 on the MXU, black ops are pinned to fp32 — the same
+    contract the eager dispatcher applies under auto_cast."""
     from ..ops.registry import get_op
 
     for op in ops:
-        fn = op.fn if getattr(op, "fn", None) is not None else \
-            get_op(op.type).fn
+        opdef = None
+        if getattr(op, "fn", None) is not None:
+            fn = op.fn
+            try:
+                opdef = get_op(op.type)
+            except Exception:  # noqa: BLE001 — fused callables aren't ops
+                opdef = None
+        else:
+            opdef = get_op(op.type)
+            fn = opdef.fn
+        amp_list = getattr(opdef, "amp_list", None) if amp else None
 
         def build(template):
             out = []
             for kind, payload in template:
                 if kind == "var":
-                    out.append(env[op.input_names[payload]])
+                    v = env[op.input_names[payload]]
+                    if amp_list == "white":
+                        v = _amp_cast(v, jnp.bfloat16)
+                    elif amp_list == "black":
+                        v = _amp_cast(v, jnp.float32)
+                    out.append(v)
                 elif kind == "list":
                     out.append([env[op.input_names[p]] if k == "var" else p
                                 for k, p in payload])
@@ -170,6 +195,16 @@ class StaticHybridEngine:
         self.num_stages = int(hc.get("pp_degree", 1))
         pcfg = getattr(strategy, "pipeline_configs", None) or {}
         self.accumulate_steps = int(pcfg.get("accumulate_steps", 1))
+        # static meta-optimizer passes beyond TP+PP (SURVEY §2.3):
+        # recompute -> jax.checkpoint around each stage fn; amp -> per-op
+        # white/black dtype pass in the replay; sharding -> ZeRO grad/
+        # opt-state placement over the mesh's sharding axis
+        self.use_recompute = bool(getattr(strategy, "recompute", False))
+        self.use_amp = bool(getattr(strategy, "amp", False))
+        sh_cfg = getattr(strategy, "sharding_configs", None) or {}
+        self.zero_stage = int(sh_cfg.get("stage", 1)) if (
+            getattr(strategy, "sharding", False)
+            or int(hc.get("sharding_degree", 1)) > 1) else 0
         self.segments = split_for_pipeline(program, self.num_stages)
         # the loss must live in the last segment (uniform split of a
         # forward+loss program always ends with the loss ops)
@@ -186,9 +221,15 @@ class StaticHybridEngine:
         # the first reader; grads from other stages are copied to the owner's
         # submesh before accumulation
         self._owner_sh = {}
+        self._owner_grad_sh = {}
         for s, seg in enumerate(self.segments):
+            g_sh = self._grad_shardings(s)
             for n in seg.param_names:
                 self._owner_sh.setdefault(n, self._stage_param_sh[s][n])
+                if n in g_sh:
+                    # grads accumulate in the ZeRO-sharded layout: no
+                    # allgather between micro-batches
+                    self._owner_grad_sh.setdefault(n, g_sh[n])
         self._jits: Dict = {}
         self._opt_state = None
         self._place_params()
@@ -213,6 +254,49 @@ class StaticHybridEngine:
             self.program, self._stage_meshes[s],
             self.segments[s].param_names)
 
+    def _grad_shardings(self, s: int):
+        """ZeRO stage-2 grad layout: dim-0 sharded over the stage submesh's
+        `sharding` axis for replicated trainables (TP-sharded params keep
+        their layout — their dim 0 may already be mp-sharded)."""
+        mesh_s = self._stage_meshes[s]
+        if (self.zero_stage < 2 or "sharding" not in mesh_s.axis_names
+                or mesh_s.shape["sharding"] <= 1):
+            return {}
+        from ..distributed.fleet.meta_parallel.sharding import shard_leaf
+
+        out = {}
+        for n in self.segments[s].param_names:
+            if n not in self.trainable:
+                continue
+            psh = self._stage_param_sh[s][n]
+            if any(tuple(psh.spec)):
+                continue
+            sh = shard_leaf(self.program.refs[n]._data, mesh_s, "sharding")
+            if any(tuple(sh.spec)):
+                out[n] = sh
+        return out
+
+    def _place_opt_state(self, state):
+        """ZeRO stage >= 1: moment slots of replicated params sharded dim-0
+        over the owner submesh's sharding axis (rank-local optimizer
+        state)."""
+        if self.zero_stage < 1:
+            return state
+        from ..distributed.fleet.meta_parallel.sharding import shard_leaf
+
+        out = {}
+        for n, acc in state.items():
+            own = self._owner_sh.get(n)
+            mesh = own.mesh if own is not None else None
+            ok = (mesh is not None and "sharding" in mesh.axis_names
+                  and mesh.shape["sharding"] > 1
+                  and not any(tuple(own.spec)))
+            out[n] = {
+                slot: (jax.device_put(v, shard_leaf(v, mesh, "sharding"))
+                       if ok and hasattr(v, "shape") else v)
+                for slot, v in acc.items()}
+        return out
+
     def _place_params(self):
         for n, sh in self._owner_sh.items():
             ref = self.program.refs[n]
@@ -229,11 +313,13 @@ class StaticHybridEngine:
         param_sh = self._stage_param_sh[s]
         data_sh = data_sharding(mesh_s)
 
+        use_amp = self.use_amp
+
         def fwd(params, feeds, cuts):
             env = dict(params)
             env.update(feeds)
             env.update(cuts)
-            _replay_ops(seg.ops, env)
+            _replay_ops(seg.ops, env, amp=use_amp)
             if is_last:
                 return jnp.sum(env[self.loss_name]).astype(jnp.float32)
             return {n: env[n] for n in seg.out_cuts}
@@ -244,10 +330,15 @@ class StaticHybridEngine:
                 env.update(tr)
                 env.update(feeds)
                 env.update(ct)
-                _replay_ops(seg.ops, env)
+                _replay_ops(seg.ops, env, amp=use_amp)
                 if is_last:
                     return jnp.sum(env[self.loss_name]).astype(jnp.float32)
                 return {n: env[n] for n in seg.out_cuts}
+            if self.use_recompute:
+                # recompute pass: store only the stage inputs; the vjp
+                # re-runs the stage forward (fleet recompute meta-optimizer
+                # == jax.remat at stage granularity)
+                f = jax.checkpoint(f)
             return f
 
         def _split_params(params):
@@ -257,18 +348,27 @@ class StaticHybridEngine:
                       if n not in self.trainable}
             return trainable, frozen
 
+        g_sh = self._grad_shardings(s)
+
+        def _constrain_grads(dtr):
+            """ZeRO stage-2: reduce-scattered grad layout inside the jit."""
+            if not g_sh:
+                return dtr
+            return {n: (jax.lax.with_sharding_constraint(g, g_sh[n])
+                        if n in g_sh else g) for n, g in dtr.items()}
+
         if is_last:
             def bwd(params, feeds, cuts):
                 trainable, frozen = _split_params(params)
                 loss, vjp = jax.vjp(_seg_fn(frozen, feeds), trainable, cuts)
                 dtr, dcuts = vjp(jnp.ones((), jnp.float32))
-                return loss, dtr, dcuts
+                return loss, _constrain_grads(dtr), dcuts
         else:
             def bwd(params, feeds, cuts, gy):
                 trainable, frozen = _split_params(params)
                 _, vjp = jax.vjp(_seg_fn(frozen, feeds), trainable, cuts)
                 dtr, dcuts = vjp(gy)
-                return dtr, dcuts
+                return _constrain_grads(dtr), dcuts
 
         in_sh_f = (param_sh,
                    {n: data_sh for n in seg.feed_names},
@@ -326,7 +426,8 @@ class StaticHybridEngine:
 
         def accum(dtr):
             for n, g in dtr.items():
-                g = jax.device_put(g, self._owner_sh[n])
+                g = jax.device_put(
+                    g, self._owner_grad_sh.get(n, self._owner_sh[n]))
                 grads[n] = g if n not in grads else grads[n] + g
 
         def run_bwd_chain(m):
@@ -362,9 +463,13 @@ class StaticHybridEngine:
                         if n in grads}
         scaled = {n: grads[n] / M for n in train_params}
         if self._opt_state is None:
-            self._opt_state = self.opt.functional_state(train_params)
+            self._opt_state = self._place_opt_state(
+                self.opt.functional_state(train_params))
         new_params, self._opt_state = self.opt.functional_step(
             train_params, scaled, self._opt_state, lr, t)
         for n, v in new_params.items():
-            refs[n]._data = v
+            # pin back to the owner placement: sharded ZeRO moments would
+            # otherwise commit params to a sharded layout the next step's
+            # jitted forward (param in_shardings) rejects
+            refs[n]._data = jax.device_put(v, self._owner_sh[n])
         return sum(losses) / M

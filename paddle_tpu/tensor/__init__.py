@@ -13,7 +13,8 @@ from .creation import (  # noqa: F401
 )
 from .random import (  # noqa: F401
     bernoulli, multinomial, normal, poisson, rand, rand_like, randint,
-    randint_like, randn, randn_like, randperm, standard_normal, uniform,
+    randint_like, randn, randn_like, randperm, standard_gamma,
+    standard_normal, uniform,
 )
 
 
@@ -243,3 +244,108 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
         PRINT_OPTIONS["linewidth"] = linewidth
     if sci_mode is not None:
         PRINT_OPTIONS["suppress"] = not sci_mode
+
+
+# ------------------------------------------------- round-4 coverage fns
+# (tools/api_inventory.py audit — verdict r3 #6)
+
+def cat(x, axis=0, name=None):
+    """torch-compat alias of concat (upstream paddle exports both)."""
+    return apply_op(get_op("concat"), x, axis=axis)
+
+
+#: alias of the SAME op function (upstream: floor_mod is mod) — patching
+#: one name patches both, per _make_fn's single-object-per-op invariant
+floor_mod = _make_fn("mod")
+
+
+def permute(x, *perm):
+    """Tensor.permute semantics: transpose by explicit axis order."""
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = tuple(perm[0])
+    return apply_op(get_op("transpose"), x, perm=list(perm))
+
+
+def view(x, shape_or_dtype, name=None):
+    """Zero-copy reinterpret: a shape view (reshape) or a dtype view
+    (bitcast over the last axis, same total bytes — paddle.view)."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return apply_op(get_op("reshape"), x, shape=list(shape_or_dtype))
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_callable
+    from ..core.dtype import convert_dtype
+
+    new_dt = jnp.dtype(convert_dtype(shape_or_dtype))
+
+    def fn(xd):
+        old = xd.dtype.itemsize
+        new = new_dt.itemsize
+        if old == new:
+            return jax.lax.bitcast_convert_type(xd, new_dt)
+        if old % new == 0:
+            out = jax.lax.bitcast_convert_type(xd, new_dt)
+            return out.reshape(*xd.shape[:-1], xd.shape[-1] * (old // new))
+        k = new // old
+        out = jax.lax.bitcast_convert_type(
+            xd.reshape(*xd.shape[:-1], xd.shape[-1] // k, k), new_dt)
+        return out.reshape(*xd.shape[:-1], xd.shape[-1] // k)
+
+    return apply_callable("view_dtype", fn, x)
+
+
+def view_as(x, other, name=None):
+    return apply_op(get_op("reshape"), x, shape=list(other.shape))
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (paddle.add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = apply_op(get_op("add"), out, t)
+    return out
+
+
+def broadcast_tensors(inputs, name=None):
+    """Broadcast every input to the common shape (paddle.broadcast_tensors)."""
+    import numpy as _np
+
+    shape = list(_np.broadcast_shapes(*[tuple(t.shape) for t in inputs]))
+    return [apply_op(get_op("broadcast_to"), t, shape=shape)
+            for t in inputs]
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select: out[i] = inputs[index[i]][i] (paddle.multiplex)."""
+    from ..core.dispatch import apply_callable
+
+    def fn(idx, *stacked):
+        import jax.numpy as jnp
+
+        st = jnp.stack(stacked)                       # (n, batch, ...)
+        rows = jnp.arange(st.shape[1])
+        return st[idx.reshape(-1).astype(jnp.int32), rows]
+
+    return apply_callable("multiplex", fn, index, *inputs)
+
+
+def tolist(x):
+    import numpy as _np
+
+    return _np.asarray(x.numpy()).tolist()
+
+
+def is_integer(x):
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(x._data.dtype, jnp.integer)
+
+
+def unfold(x, axis, size, step, name=None):
+    """paddle.unfold == Tensor.unfold: sliding windows along `axis` (the
+    im2col unfold lives in nn.functional)."""
+    return apply_op(get_op("tensor_unfold"), x, axis=axis, size=size,
+                    step=step)

@@ -102,3 +102,9 @@ def rand_like(x, dtype=None):
 def randn_like(x, dtype=None):
     d = convert_dtype(dtype) or x.dtype
     return Tensor(jax.random.normal(next_key(), tuple(x.shape), dtype=d))
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, 1.0) elementwise (paddle.standard_gamma)."""
+    alpha = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.gamma(next_key(), alpha).astype(alpha.dtype))

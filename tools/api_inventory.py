@@ -1,0 +1,184 @@
+"""Paddle public-API coverage audit (verdict r3 #6 / missing #4).
+
+Compares a curated inventory of upstream PaddlePaddle's public API (the
+paddle.* flat tensor namespace + key submodules, ~v2.6 docs surface; the
+reference mount is empty so the list is transcribed from upstream's
+published API index, not read from disk) against what `paddle_tpu`
+actually exports, and writes API_COVERAGE.md.
+
+Run:  python tools/api_inventory.py          (from the repo root)
+"""
+from __future__ import annotations
+
+import sys
+from collections import OrderedDict
+
+# upstream paddle.* flat namespace (tensor API + framework entry points)
+PADDLE_FLAT = """
+abs acos acosh add add_n addmm all allclose amax amin angle any arange
+argmax argmin argsort as_complex as_real as_strided asin asinh assign
+atan atan2 atanh atleast_1d atleast_2d atleast_3d bernoulli bincount
+bitwise_and bitwise_left_shift bitwise_not bitwise_or bitwise_right_shift
+bitwise_xor bmm broadcast_shape broadcast_tensors broadcast_to bucketize
+cast cat ceil chunk clip clone column_stack combinations complex concat
+conj cos cosh count_nonzero cross cummax cummin cumprod cumsum
+cumulative_trapezoid deg2rad diag diag_embed diagflat diagonal
+diagonal_scatter diff digamma dist divide dot dsplit dstack einsum empty
+empty_like equal equal_all erf erfinv exp expand expand_as expm1 eye
+flatten flip floor floor_divide floor_mod fmax fmin frac frexp full
+full_like gammainc gammaincc gammaln gather gather_nd gcd
+get_default_dtype greater_equal greater_than heaviside histogram
+histogramdd hsplit hstack hypot i0 i0e i1 i1e imag increment index_add
+index_fill index_put index_sample index_select inner inverse is_complex
+is_empty is_floating_point is_grad_enabled is_integer is_tensor isclose
+isfinite isin isinf isnan kron kthvalue lcm ldexp lerp less_equal
+less_than lgamma linspace log log10 log1p log2 logaddexp logaddexp2
+logcumsumexp logical_and logical_not logical_or logical_xor logit
+logspace logsumexp masked_fill masked_scatter masked_select matmul max
+maximum mean median meshgrid min minimum mm mod mode moveaxis
+multigammaln multinomial multiplex multiply mv nan_to_num nanmean
+nanmedian nanquantile nansum neg nextafter nonzero norm normal
+not_equal numel ones ones_like outer pdist permute poisson polar
+polygamma pow prod put_along_axis quantile rad2deg rand randint
+randint_like randn randperm rank real reciprocal remainder renorm
+repeat_interleave reshape roll rot90 round rsqrt scale scatter
+scatter_nd scatter_nd_add searchsorted select_scatter set_default_dtype
+sgn shape shard_index sign signbit sin sinh slice slice_scatter sort
+split sqrt square squeeze stack standard_gamma standard_normal stanh
+std strided_slice subtract sum t take take_along_axis tan tanh
+tensor_split tensordot tile to_tensor tolist topk trace transpose
+trapezoid tril tril_indices triu triu_indices trunc unbind unflatten
+unfold uniform unique unique_consecutive unsqueeze unstack vander var
+view view_as vsplit vstack where zeros zeros_like
+seed save load no_grad set_grad_enabled grad summary flops in_dynamic_mode
+enable_static disable_static get_flags set_flags is_compiled_with_cuda
+set_device get_device CPUPlace CUDAPlace Tensor DataParallel Model
+to_tensor ParamAttr create_parameter
+""".split()
+
+# paddle.nn layer surface (names under paddle.nn)
+PADDLE_NN = """
+Layer Sequential LayerList ParameterList LayerDict Linear Conv1D Conv2D
+Conv3D Conv1DTranspose Conv2DTranspose Conv3DTranspose MaxPool1D
+MaxPool2D MaxPool3D AvgPool1D AvgPool2D AvgPool3D AdaptiveAvgPool1D
+AdaptiveAvgPool2D AdaptiveAvgPool3D AdaptiveMaxPool1D AdaptiveMaxPool2D
+AdaptiveMaxPool3D BatchNorm BatchNorm1D BatchNorm2D BatchNorm3D
+LayerNorm GroupNorm InstanceNorm1D InstanceNorm2D InstanceNorm3D
+SyncBatchNorm LocalResponseNorm SpectralNorm RNN LSTM GRU SimpleRNN
+LSTMCell GRUCell SimpleRNNCell BiRNN MultiHeadAttention Transformer
+TransformerEncoder TransformerEncoderLayer TransformerDecoder
+TransformerDecoderLayer Embedding Dropout Dropout2D Dropout3D
+AlphaDropout ReLU ReLU6 LeakyReLU PReLU RReLU ELU CELU SELU GELU GLU
+Hardshrink Hardsigmoid Hardswish Hardtanh LogSigmoid LogSoftmax Maxout
+Mish Sigmoid Silu Softmax Softmax2D Softplus Softshrink Softsign Swish
+Tanh Tanhshrink ThresholdedReLU Identity Pad1D Pad2D Pad3D ZeroPad2D
+CosineSimilarity PairwiseDistance Upsample UpsamplingBilinear2D
+UpsamplingNearest2D PixelShuffle PixelUnshuffle ChannelShuffle Flatten
+Unfold Fold CrossEntropyLoss MSELoss L1Loss NLLLoss BCELoss
+BCEWithLogitsLoss KLDivLoss MarginRankingLoss SmoothL1Loss CTCLoss
+HingeEmbeddingLoss CosineEmbeddingLoss TripletMarginLoss
+TripletMarginWithDistanceLoss MultiLabelSoftMarginLoss SoftMarginLoss
+MultiMarginLoss GaussianNLLLoss PoissonNLLLoss AdaptiveLogSoftmaxWithLoss
+""".split()
+
+# paddle.nn.functional
+PADDLE_NN_F = """
+conv1d conv2d conv3d conv1d_transpose conv2d_transpose conv3d_transpose
+linear embedding one_hot relu relu6 leaky_relu prelu rrelu elu celu selu
+gelu glu hardshrink hardsigmoid hardswish hardtanh log_sigmoid
+log_softmax maxout mish sigmoid silu softmax softplus softshrink
+softsign swish tanhshrink thresholded_relu avg_pool1d avg_pool2d
+avg_pool3d max_pool1d max_pool2d max_pool3d adaptive_avg_pool1d
+adaptive_avg_pool2d adaptive_avg_pool3d adaptive_max_pool1d
+adaptive_max_pool2d adaptive_max_pool3d batch_norm layer_norm group_norm
+instance_norm local_response_norm normalize dropout dropout2d dropout3d
+alpha_dropout pad zeropad2d cosine_similarity pairwise_distance
+interpolate upsample pixel_shuffle pixel_unshuffle channel_shuffle
+affine_grid grid_sample unfold fold cross_entropy mse_loss l1_loss
+nll_loss binary_cross_entropy binary_cross_entropy_with_logits kl_div
+margin_ranking_loss smooth_l1_loss ctc_loss hinge_embedding_loss
+cosine_embedding_loss triplet_margin_loss
+triplet_margin_with_distance_loss multi_label_soft_margin_loss
+soft_margin_loss multi_margin_loss gaussian_nll_loss poisson_nll_loss
+square_error_cost softmax_with_cross_entropy margin_cross_entropy
+sigmoid_focal_loss dice_loss log_loss npair_loss scaled_dot_product_attention
+sequence_mask temporal_shift
+""".split()
+
+# paddle.linalg
+PADDLE_LINALG = """
+cholesky cholesky_solve cond corrcoef cov det eig eigh eigvals eigvalsh
+householder_product inv lstsq lu lu_unpack matrix_exp matrix_norm
+matrix_power matrix_rank multi_dot norm ormqr pca_lowrank pinv qr slogdet
+solve svd svd_lowrank triangular_solve vector_norm
+""".split()
+
+# paddle.fft
+PADDLE_FFT = """
+fft fft2 fftn fftfreq fftshift hfft hfft2 hfftn ifft ifft2 ifftn ihfft
+ihfft2 ihfftn irfft irfft2 irfftn rfft rfft2 rfftn rfftfreq ifftshift
+""".split()
+
+MODULES = OrderedDict([
+    ("paddle", ("paddle_tpu", PADDLE_FLAT)),
+    ("paddle.nn", ("paddle_tpu.nn", PADDLE_NN)),
+    ("paddle.nn.functional", ("paddle_tpu.nn.functional", PADDLE_NN_F)),
+    ("paddle.linalg", ("paddle_tpu.linalg", PADDLE_LINALG)),
+    ("paddle.fft", ("paddle_tpu.fft", PADDLE_FFT)),
+])
+
+
+def audit():
+    import importlib
+
+    rows = []
+    all_missing = {}
+    for up_name, (tpu_name, names) in MODULES.items():
+        mod = importlib.import_module(tpu_name)
+        names = sorted(set(names))
+        missing = [n for n in names if not hasattr(mod, n)]
+        rows.append((up_name, len(names), len(names) - len(missing),
+                     missing))
+        all_missing[up_name] = missing
+    return rows, all_missing
+
+
+def main():
+    rows, all_missing = audit()
+    lines = [
+        "# API coverage vs upstream paddle (curated v2.6 surface)",
+        "",
+        "Generated by `python tools/api_inventory.py` — re-run after",
+        "adding ops. The upstream inventory is transcribed from the",
+        "published API index (reference mount empty; see SURVEY.md).",
+        "",
+        "| module | upstream names | present | coverage | missing |",
+        "|---|---|---|---|---|",
+    ]
+    tot_n = tot_p = 0
+    for up, n, present, missing in rows:
+        tot_n += n
+        tot_p += present
+        lines.append(f"| {up} | {n} | {present} | {present / n:.0%} | "
+                     f"{len(missing)} |")
+    lines.append(f"| **total** | {tot_n} | {tot_p} | {tot_p / tot_n:.0%} "
+                 f"| {tot_n - tot_p} |")
+    lines.append("")
+    for up, missing in all_missing.items():
+        if missing:
+            lines.append(f"## Missing in {up} ({len(missing)})")
+            lines.append("")
+            lines.append(", ".join(f"`{m}`" for m in missing))
+            lines.append("")
+    out = "\n".join(lines) + "\n"
+    with open("API_COVERAGE.md", "w") as f:
+        f.write(out)
+    print(out)
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, ".")
+    main()

@@ -1,0 +1,139 @@
+// Reference custom-device runtime plugin — the "custom_cpu" analog of
+// upstream's test/custom_runtime plugin (ref: paddle/phi/backends/custom/
+// custom_device.cc + paddle/phi/capi, upstream layout, unverified — mount
+// empty).
+//
+// This implements paddle_tpu's C device-runtime API on plain host memory:
+// a vendor bringing real hardware implements the same `cd_*` surface in
+// their .so and loads it through paddle.device.plugin.load_custom_device_
+// runtime — memory, streams, events and stats flow through the identical
+// path this file exercises in CI. (Device COMPUTE on TPU-class hardware
+// goes through PJRT/XLA — register_custom_device(api="pjrt") — exactly as
+// upstream routes kernels through its own registry; the custom-runtime
+// seam covers the runtime half: allocation, transfer, sync, stats.)
+//
+// Build: compiled on first use via utils/cpp_extension's g++ JIT path.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+
+extern "C" {
+
+static std::atomic<int64_t> g_allocated{0};
+static std::atomic<int64_t> g_peak{0};
+static std::atomic<int> g_streams_live{0};
+static std::atomic<int> g_events_live{0};
+static std::mutex g_sizes_mu;
+static std::unordered_map<void*, size_t>* g_sizes = nullptr;
+
+int cd_init(void) {
+  std::lock_guard<std::mutex> lk(g_sizes_mu);
+  if (g_sizes == nullptr) g_sizes = new std::unordered_map<void*, size_t>();
+  return 0;
+}
+
+void cd_finalize(void) {
+  std::lock_guard<std::mutex> lk(g_sizes_mu);
+  delete g_sizes;
+  g_sizes = nullptr;
+  g_allocated = 0;
+}
+
+int cd_device_count(void) { return 1; }
+
+const char* cd_device_name(void) { return "custom_cpu"; }
+
+int cd_runtime_version(void) { return 10000; }
+
+void* cd_malloc(size_t n) {
+  void* p = std::malloc(n);
+  if (p == nullptr) return nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_sizes_mu);
+    if (g_sizes) (*g_sizes)[p] = n;
+  }
+  int64_t cur = g_allocated.fetch_add(static_cast<int64_t>(n)) +
+                static_cast<int64_t>(n);
+  int64_t peak = g_peak.load();
+  while (cur > peak && !g_peak.compare_exchange_weak(peak, cur)) {
+  }
+  return p;
+}
+
+void cd_free(void* p) {
+  if (p == nullptr) return;
+  size_t n = 0;
+  {
+    std::lock_guard<std::mutex> lk(g_sizes_mu);
+    if (g_sizes) {
+      auto it = g_sizes->find(p);
+      if (it != g_sizes->end()) {
+        n = it->second;
+        g_sizes->erase(it);
+      }
+    }
+  }
+  g_allocated.fetch_sub(static_cast<int64_t>(n));
+  std::free(p);
+}
+
+int cd_memcpy_h2d(void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return 0;
+}
+
+int cd_memcpy_d2h(void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return 0;
+}
+
+int cd_memcpy_d2d(void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return 0;
+}
+
+// host memory is synchronous: streams/events are bookkeeping tokens whose
+// lifecycle (create/destroy/record/sync) the framework still drives fully
+void* cd_stream_create(void) {
+  g_streams_live.fetch_add(1);
+  return std::malloc(1);
+}
+
+void cd_stream_destroy(void* s) {
+  if (s) {
+    g_streams_live.fetch_sub(1);
+    std::free(s);
+  }
+}
+
+int cd_stream_synchronize(void*) { return 0; }
+
+void* cd_event_create(void) {
+  g_events_live.fetch_add(1);
+  return std::malloc(1);
+}
+
+void cd_event_destroy(void* e) {
+  if (e) {
+    g_events_live.fetch_sub(1);
+    std::free(e);
+  }
+}
+
+int cd_event_record(void*, void*) { return 0; }
+
+int cd_event_synchronize(void*) { return 0; }
+
+int64_t cd_allocated_bytes(void) { return g_allocated.load(); }
+
+int64_t cd_peak_allocated_bytes(void) { return g_peak.load(); }
+
+int cd_live_streams(void) { return g_streams_live.load(); }
+
+int cd_live_events(void) { return g_events_live.load(); }
+
+}  // extern "C"

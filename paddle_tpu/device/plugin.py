@@ -58,3 +58,228 @@ def list_custom_devices() -> List[str]:
 
 def is_custom_device_registered(device_type: str) -> bool:
     return device_type in _REGISTERED
+
+
+# ------------------------------------------------------- custom runtime API
+#
+# The second half of the plugin seam (ref: paddle/phi/capi + the
+# test/custom_runtime "custom_cpu" plugin): a vendor RUNTIME .so
+# implementing the C `cd_*` surface (init, device memory, h2d/d2h/d2d
+# copies, streams/events, stats). Compute on TPU-class devices rides PJRT
+# (register_custom_device above); this API covers the runtime half and is
+# exercised end-to-end in CI by the in-tree custom_cpu reference plugin.
+
+class CustomDeviceRuntime:
+    """ctypes driver over a loaded `cd_*` runtime library."""
+
+    def __init__(self, device_type: str, library_path: str):
+        import ctypes
+
+        self.device_type = device_type
+        self.library_path = library_path
+        lib = ctypes.CDLL(library_path)
+        self._lib = lib
+        c = ctypes
+        lib.cd_init.restype = c.c_int
+        lib.cd_device_count.restype = c.c_int
+        lib.cd_device_name.restype = c.c_char_p
+        lib.cd_malloc.restype = c.c_void_p
+        lib.cd_malloc.argtypes = [c.c_size_t]
+        lib.cd_free.argtypes = [c.c_void_p]
+        for fn in ("cd_memcpy_h2d", "cd_memcpy_d2h", "cd_memcpy_d2d"):
+            f = getattr(lib, fn)
+            f.restype = c.c_int
+            f.argtypes = [c.c_void_p, c.c_void_p, c.c_size_t]
+        lib.cd_stream_create.restype = c.c_void_p
+        lib.cd_stream_destroy.argtypes = [c.c_void_p]
+        lib.cd_stream_synchronize.restype = c.c_int
+        lib.cd_stream_synchronize.argtypes = [c.c_void_p]
+        lib.cd_event_create.restype = c.c_void_p
+        lib.cd_event_destroy.argtypes = [c.c_void_p]
+        lib.cd_event_record.restype = c.c_int
+        lib.cd_event_record.argtypes = [c.c_void_p, c.c_void_p]
+        lib.cd_event_synchronize.restype = c.c_int
+        lib.cd_event_synchronize.argtypes = [c.c_void_p]
+        lib.cd_allocated_bytes.restype = c.c_int64
+        lib.cd_peak_allocated_bytes.restype = c.c_int64
+        if lib.cd_init() != 0:
+            raise RuntimeError(f"{device_type}: cd_init failed")
+
+    # ------------------------------------------------------------- queries
+    def device_count(self) -> int:
+        return int(self._lib.cd_device_count())
+
+    def device_name(self) -> str:
+        return self._lib.cd_device_name().decode()
+
+    def memory_allocated(self) -> int:
+        return int(self._lib.cd_allocated_bytes())
+
+    def max_memory_allocated(self) -> int:
+        return int(self._lib.cd_peak_allocated_bytes())
+
+    # ------------------------------------------------------------- buffers
+    def to_device(self, array) -> "DeviceBuffer":
+        """H2D: allocate on the plugin device and copy the host array in."""
+        import ctypes
+
+        import numpy as np
+
+        arr = np.ascontiguousarray(array)
+        ptr = self._lib.cd_malloc(arr.nbytes)
+        if not ptr and arr.nbytes:
+            raise MemoryError(f"{self.device_type}: cd_malloc failed")
+        if arr.nbytes:
+            rc = self._lib.cd_memcpy_h2d(
+                ptr, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+            if rc != 0:
+                self._lib.cd_free(ptr)
+                raise RuntimeError(f"{self.device_type}: h2d copy failed")
+        return DeviceBuffer(self, ptr, arr.shape, arr.dtype, arr.nbytes)
+
+    def to_host(self, buf: "DeviceBuffer"):
+        """D2H: copy a device buffer back into a fresh numpy array."""
+        import ctypes
+
+        import numpy as np
+
+        if buf.ptr is None and buf.nbytes:
+            raise RuntimeError("to_host on a freed DeviceBuffer")
+        out = np.empty(buf.shape, buf.dtype)
+        if buf.nbytes:
+            rc = self._lib.cd_memcpy_d2h(
+                out.ctypes.data_as(ctypes.c_void_p), buf.ptr, buf.nbytes)
+            if rc != 0:
+                raise RuntimeError(f"{self.device_type}: d2h copy failed")
+        return out
+
+    # ------------------------------------------------------- streams/events
+    def stream(self):
+        return _PluginStream(self)
+
+
+class DeviceBuffer:
+    """A plugin-device allocation; freed through the plugin on GC."""
+
+    def __init__(self, rt: CustomDeviceRuntime, ptr, shape, dtype, nbytes):
+        self._rt = rt
+        self.ptr = ptr
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.nbytes = nbytes
+
+    def copy_(self, other: "DeviceBuffer"):
+        if self.ptr is None or other.ptr is None:
+            raise RuntimeError("d2d copy on a freed DeviceBuffer")
+        if self.nbytes != other.nbytes:
+            raise ValueError(
+                f"d2d copy size mismatch: {self.nbytes} vs {other.nbytes}")
+        rc = self._rt._lib.cd_memcpy_d2d(self.ptr, other.ptr, self.nbytes)
+        if rc != 0:
+            raise RuntimeError("d2d copy failed")
+        return self
+
+    def numpy(self):
+        return self._rt.to_host(self)
+
+    def free(self):
+        if self.ptr:
+            self._rt._lib.cd_free(self.ptr)
+            self.ptr = None
+
+    def __del__(self):
+        try:
+            self.free()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class _PluginStream:
+    def __init__(self, rt: CustomDeviceRuntime):
+        self._rt = rt
+        self._s = rt._lib.cd_stream_create()
+
+    def synchronize(self):
+        if self._rt._lib.cd_stream_synchronize(self._s) != 0:
+            raise RuntimeError("stream synchronize failed")
+
+    def record_event(self):
+        ev = self._rt._lib.cd_event_create()
+        if not ev:
+            raise RuntimeError("cd_event_create failed")
+        if self._rt._lib.cd_event_record(ev, self._s) != 0:
+            self._rt._lib.cd_event_destroy(ev)
+            raise RuntimeError("cd_event_record failed")
+        return _PluginEvent(self._rt, ev)
+
+    def destroy(self):
+        if self._s:
+            self._rt._lib.cd_stream_destroy(self._s)
+            self._s = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class _PluginEvent:
+    def __init__(self, rt, ev):
+        self._rt = rt
+        self._ev = ev
+
+    def synchronize(self):
+        self._rt._lib.cd_event_synchronize(self._ev)
+
+    def __del__(self):
+        try:
+            if self._ev:
+                self._rt._lib.cd_event_destroy(self._ev)
+                self._ev = None
+        except Exception:  # noqa: BLE001
+            pass
+
+
+_RUNTIMES: Dict[str, CustomDeviceRuntime] = {}
+
+
+def load_custom_device_runtime(device_type: str,
+                               library_path: Optional[str] = None
+                               ) -> CustomDeviceRuntime:
+    """Load a vendor runtime .so implementing the `cd_*` C API and register
+    it as a custom device runtime. With library_path=None and device_type
+    'custom_cpu', the in-tree reference plugin is JIT-compiled — the
+    upstream test/custom_runtime custom_cpu analog."""
+    if device_type in _RUNTIMES:
+        cached = _RUNTIMES[device_type]
+        if library_path is not None and library_path != cached.library_path:
+            raise ValueError(
+                f"{device_type!r} already loaded from "
+                f"{cached.library_path}; refusing to silently ignore "
+                f"{library_path}")
+        return cached
+    if library_path is None:
+        if device_type != "custom_cpu":
+            raise ValueError(
+                "library_path is required for non-reference plugins")
+        from ..utils.cpp_extension import _compile
+
+        src = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "core", "native",
+            "custom_cpu_plugin.cc")
+        library_path = _compile("custom_cpu_plugin", [src], [])
+    rt = CustomDeviceRuntime(device_type, library_path)
+    _RUNTIMES[device_type] = rt
+    return rt
+
+
+def get_custom_device_runtime(device_type: str) -> CustomDeviceRuntime:
+    if device_type not in _RUNTIMES:
+        raise KeyError(f"no runtime loaded for {device_type!r}; call "
+                       "load_custom_device_runtime first")
+    return _RUNTIMES[device_type]
+
+
+__all__ += ["CustomDeviceRuntime", "DeviceBuffer",
+            "load_custom_device_runtime", "get_custom_device_runtime"]

@@ -230,7 +230,8 @@ class _PluginEvent:
         self._ev = ev
 
     def synchronize(self):
-        self._rt._lib.cd_event_synchronize(self._ev)
+        if self._rt._lib.cd_event_synchronize(self._ev) != 0:
+            raise RuntimeError("cd_event_synchronize failed")
 
     def __del__(self):
         try:

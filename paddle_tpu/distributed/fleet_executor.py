@@ -306,9 +306,17 @@ class FleetExecutor:
             c.start()
         for c in self.carriers.values():
             c.join(timeout=timeout)
-        if run.errors:
-            raise run.errors[0]
-        if any(c.alive() for c in self.carriers.values()):
-            run.stop.set()
-            raise TimeoutError("FleetExecutor DAG did not complete")
-        return {tid: results[tid] for tid in fetch_ids}
+        try:
+            if run.errors:
+                raise run.errors[0]
+            if any(c.alive() for c in self.carriers.values()):
+                run.stop.set()
+                raise TimeoutError("FleetExecutor DAG did not complete")
+            return {tid: results[tid] for tid in fetch_ids}
+        finally:
+            # drop per-run interceptors: they hold the _RunState (results,
+            # feeds, channel payloads) and would pin a finished run's data
+            # for the executor's lifetime
+            for c in self.carriers.values():
+                c.interceptors.clear()
+                c._threads = []

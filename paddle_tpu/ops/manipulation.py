@@ -18,11 +18,6 @@ def reshape(x, shape):
     return jnp.reshape(x, shape)
 
 
-@register_op("transpose", inplace_view=True)
-def transpose(x, perm):
-    return jnp.transpose(x, axes=tuple(perm))
-
-
 @register_op("flatten", inplace_view=True)
 def flatten(x, start_axis=0, stop_axis=-1):
     ndim = x.ndim
@@ -57,16 +52,6 @@ def unsqueeze(x, axis):
     for a in sorted(axis):
         out = jnp.expand_dims(out, a)
     return out
-
-
-@register_op("concat")
-def concat(xs, axis=0):
-    return jnp.concatenate(list(xs), axis=int(axis))
-
-
-@register_op("stack")
-def stack(xs, axis=0):
-    return jnp.stack(list(xs), axis=int(axis))
 
 
 @register_op("split", multi_output=True)
@@ -117,11 +102,6 @@ def broadcast_to(x, shape):
     return jnp.broadcast_to(x, tuple(int(s) for s in shape))
 
 
-@register_op("expand_as")
-def expand_as(x, y):
-    return jnp.broadcast_to(x, y.shape)
-
-
 @register_op("tile")
 def tile(x, repeat_times):
     return jnp.tile(x, tuple(int(r) for r in repeat_times))
@@ -144,21 +124,6 @@ def gather(x, index, axis=0):
 def gather_nd(x, index):
     idx = tuple(jnp.moveaxis(index, -1, 0))
     return x[idx]
-
-
-@register_op("index_select")
-def index_select(x, index, axis=0):
-    return jnp.take(x, index.reshape(-1), axis=int(axis))
-
-
-@register_op("index_sample")
-def index_sample(x, index):
-    return jnp.take_along_axis(x, index, axis=1)
-
-
-@register_op("take_along_axis")
-def take_along_axis(x, indices, axis, broadcast=True):
-    return jnp.take_along_axis(x, indices, axis=int(axis))
 
 
 @register_op("put_along_axis")
@@ -195,22 +160,11 @@ def scatter_nd_add(x, index, updates):
     return x.at[idx].add(updates)
 
 
-@register_op("where")
-def where(condition, x, y):
-    return jnp.where(condition, x, y)
-
-
 @register_op("flip")
 def flip(x, axis):
     if isinstance(axis, int):
         axis = (axis,)
     return jnp.flip(x, axis=tuple(axis))
-
-
-@register_op("roll")
-def roll(x, shifts, axis=None):
-    return jnp.roll(x, shifts, axis=axis if axis is None else tuple(
-        axis if isinstance(axis, (list, tuple)) else (axis,)))
 
 
 @register_op("sort")
@@ -268,21 +222,6 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
     return jnp.pad(x, cfg, mode=mode_map[mode])
 
 
-@register_op("repeat_interleave")
-def repeat_interleave(x, repeats, axis=None):
-    return jnp.repeat(x, repeats, axis=axis)
-
-
-@register_op("tril")
-def tril(x, diagonal=0):
-    return jnp.tril(x, k=diagonal)
-
-
-@register_op("triu")
-def triu(x, diagonal=0):
-    return jnp.triu(x, k=diagonal)
-
-
 @register_op("diag")
 def diag(x, offset=0, padding_value=0.0):
     if x.ndim == 1 and padding_value != 0.0:
@@ -290,11 +229,6 @@ def diag(x, offset=0, padding_value=0.0):
         mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
         return jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
     return jnp.diag(x, k=offset)
-
-
-@register_op("diagonal")
-def diagonal(x, offset=0, axis1=0, axis2=1):
-    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
 
 
 @register_op("diag_embed")
@@ -310,11 +244,6 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1):
     src2 = x.ndim
     out = jnp.moveaxis(out, (src1, src2), (dim1, dim2))
     return out
-
-
-@register_op("kron")
-def kron(x, y):
-    return jnp.kron(x, y)
 
 
 @register_op("slice_op", inplace_view=True)
@@ -343,21 +272,6 @@ def as_strided(x, shape, stride, offset=0):
     return flat[idx]
 
 
-@register_op("moveaxis", inplace_view=True)
-def moveaxis(x, source, destination):
-    return jnp.moveaxis(x, source, destination)
-
-
-@register_op("swapaxes", inplace_view=True)
-def swapaxes(x, axis1, axis2):
-    return jnp.swapaxes(x, axis1, axis2)
-
-
-@register_op("rot90")
-def rot90(x, k=1, axes=(0, 1)):
-    return jnp.rot90(x, k=k, axes=tuple(axes))
-
-
 @register_op("one_hot")
 def one_hot(x, num_classes):
     import jax
@@ -371,26 +285,6 @@ def set_value_by_index(x, value, _index_tree=None):
     raise NotImplementedError
 
 
-@register_op("meshgrid", multi_output=True)
-def meshgrid(xs, indexing="ij"):
-    return tuple(jnp.meshgrid(*list(xs), indexing=indexing))
-
-
-@register_op("masked_fill")
-def masked_fill(x, mask, value):
-    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
-
-
-@register_op("full_like")
-def full_like(x, fill_value, dtype=None):
-    return jnp.full_like(x, fill_value, dtype=dtype)
-
-
-@register_op("bincount")
-def bincount(x, weights=None, minlength=0):
-    return jnp.bincount(x, weights=weights, minlength=minlength)
-
-
 @register_op("searchsorted")
 def searchsorted(sorted_sequence, values, out_int32=False, right=False):
     out = jnp.searchsorted(sorted_sequence, values,
@@ -398,6 +292,3 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False):
     return out.astype("int32" if out_int32 else "int64")
 
 
-@register_op("clone")
-def clone(x):
-    return jnp.copy(x)

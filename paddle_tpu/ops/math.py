@@ -6,76 +6,9 @@ chains of these into single kernels, so there is no hand-fusion here.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
-from jax import lax
 
 from .registry import register_op
-
-
-@register_op("add")
-def add(x, y):
-    return jnp.add(x, y)
-
-
-@register_op("subtract")
-def subtract(x, y):
-    return jnp.subtract(x, y)
-
-
-@register_op("multiply")
-def multiply(x, y):
-    return jnp.multiply(x, y)
-
-
-@register_op("divide")
-def divide(x, y):
-    return jnp.divide(x, y)
-
-
-@register_op("floor_divide")
-def floor_divide(x, y):
-    return jnp.floor_divide(x, y)
-
-
-@register_op("mod")
-def mod(x, y):
-    return jnp.mod(x, y)
-
-
-@register_op("remainder")
-def remainder(x, y):
-    return jnp.remainder(x, y)
-
-
-@register_op("elementwise_pow")
-def elementwise_pow(x, y):
-    return jnp.power(x, y)
-
-
-@register_op("maximum")
-def maximum(x, y):
-    return jnp.maximum(x, y)
-
-
-@register_op("minimum")
-def minimum(x, y):
-    return jnp.minimum(x, y)
-
-
-@register_op("fmax")
-def fmax(x, y):
-    return jnp.fmax(x, y)
-
-
-@register_op("fmin")
-def fmin(x, y):
-    return jnp.fmin(x, y)
-
-
-@register_op("atan2")
-def atan2(x, y):
-    return jnp.arctan2(x, y)
 
 
 @register_op("scale")
@@ -85,181 +18,6 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
     return (x + bias) * scale
 
 
-@register_op("neg")
-def neg(x):
-    return jnp.negative(x)
-
-
-@register_op("abs")
-def abs_(x):
-    return jnp.abs(x)
-
-
-@register_op("sqrt", amp_list="black")
-def sqrt(x):
-    return jnp.sqrt(x)
-
-
-@register_op("rsqrt", amp_list="black")
-def rsqrt(x):
-    return lax.rsqrt(x)
-
-
-@register_op("exp", amp_list="black")
-def exp(x):
-    return jnp.exp(x)
-
-
-@register_op("expm1")
-def expm1(x):
-    return jnp.expm1(x)
-
-
-@register_op("log", amp_list="black")
-def log(x):
-    return jnp.log(x)
-
-
-@register_op("log2")
-def log2(x):
-    return jnp.log2(x)
-
-
-@register_op("log10")
-def log10(x):
-    return jnp.log10(x)
-
-
-@register_op("log1p")
-def log1p(x):
-    return jnp.log1p(x)
-
-
-@register_op("sin")
-def sin(x):
-    return jnp.sin(x)
-
-
-@register_op("cos")
-def cos(x):
-    return jnp.cos(x)
-
-
-@register_op("tan")
-def tan(x):
-    return jnp.tan(x)
-
-
-@register_op("asin")
-def asin(x):
-    return jnp.arcsin(x)
-
-
-@register_op("acos")
-def acos(x):
-    return jnp.arccos(x)
-
-
-@register_op("atan")
-def atan(x):
-    return jnp.arctan(x)
-
-
-@register_op("sinh")
-def sinh(x):
-    return jnp.sinh(x)
-
-
-@register_op("cosh")
-def cosh(x):
-    return jnp.cosh(x)
-
-
-@register_op("asinh")
-def asinh(x):
-    return jnp.arcsinh(x)
-
-
-@register_op("acosh")
-def acosh(x):
-    return jnp.arccosh(x)
-
-
-@register_op("atanh")
-def atanh(x):
-    return jnp.arctanh(x)
-
-
-@register_op("tanh")
-def tanh(x):
-    return jnp.tanh(x)
-
-
-@register_op("sigmoid")
-def sigmoid(x):
-    return jax.nn.sigmoid(x)
-
-
-@register_op("erf")
-def erf(x):
-    return lax.erf(x)
-
-
-@register_op("erfinv")
-def erfinv(x):
-    return lax.erf_inv(x)
-
-
-@register_op("floor")
-def floor(x):
-    return jnp.floor(x)
-
-
-@register_op("ceil")
-def ceil(x):
-    return jnp.ceil(x)
-
-
-@register_op("round")
-def round_(x):
-    return jnp.round(x)
-
-
-@register_op("trunc")
-def trunc(x):
-    return jnp.trunc(x)
-
-
-@register_op("frac")
-def frac(x):
-    return x - jnp.trunc(x)
-
-
-@register_op("sign")
-def sign(x):
-    return jnp.sign(x)
-
-
-@register_op("reciprocal")
-def reciprocal(x):
-    return jnp.reciprocal(x)
-
-
-@register_op("square")
-def square(x):
-    return jnp.square(x)
-
-
-@register_op("clip")
-def clip(x, min=None, max=None):
-    return jnp.clip(x, min, max)
-
-
-@register_op("lerp")
-def lerp(x, y, weight):
-    return x + weight * (y - x)
-
-
 @register_op("logit")
 def logit(x, eps=None):
     if eps is not None:
@@ -267,111 +25,3 @@ def logit(x, eps=None):
     return jnp.log(x / (1.0 - x))
 
 
-@register_op("nan_to_num")
-def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
-    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
-
-
-@register_op("angle")
-def angle(x):
-    return jnp.angle(x)
-
-
-@register_op("conj")
-def conj(x):
-    return jnp.conj(x)
-
-
-@register_op("real")
-def real(x):
-    return jnp.real(x)
-
-
-@register_op("imag")
-def imag(x):
-    return jnp.imag(x)
-
-
-@register_op("multiply_scalar")
-def multiply_scalar(x, value):
-    return x * value
-
-
-@register_op("pow_scalar")
-def pow_scalar(x, value):
-    return jnp.power(x, value)
-
-
-@register_op("rpow_scalar")
-def rpow_scalar(x, value):
-    return jnp.power(value, x)
-
-
-@register_op("stanh")
-def stanh(x, scale_a=0.67, scale_b=1.7159):
-    return scale_b * jnp.tanh(scale_a * x)
-
-
-@register_op("logaddexp")
-def logaddexp(x, y):
-    return jnp.logaddexp(x, y)
-
-
-@register_op("heaviside")
-def heaviside(x, y):
-    return jnp.heaviside(x, y)
-
-
-@register_op("copysign")
-def copysign(x, y):
-    return jnp.copysign(x, y)
-
-
-@register_op("hypot")
-def hypot(x, y):
-    return jnp.hypot(x, y)
-
-
-@register_op("ldexp")
-def ldexp(x, y):
-    return jnp.ldexp(x, y)
-
-
-@register_op("digamma")
-def digamma(x):
-    return lax.digamma(x)
-
-
-@register_op("lgamma")
-def lgamma(x):
-    return lax.lgamma(x)
-
-
-@register_op("gammaln")
-def gammaln(x):
-    return lax.lgamma(x)
-
-
-@register_op("polygamma")
-def polygamma(x, n=0):
-    return lax.polygamma(jnp.asarray(float(n), x.dtype), x)
-
-
-@register_op("i0")
-def i0(x):
-    return jnp.i0(x)
-
-
-@register_op("sinc")
-def sinc(x):
-    return jnp.sinc(x)
-
-
-@register_op("deg2rad")
-def deg2rad(x):
-    return jnp.deg2rad(x)
-
-
-@register_op("rad2deg")
-def rad2deg(x):
-    return jnp.rad2deg(x)

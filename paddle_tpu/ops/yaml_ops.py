@@ -18,6 +18,7 @@ Schema per entry:
     multi_output: true        # optional: returns a tuple
     method: exp2|null         # Tensor method name (defaults to op; null=no)
     eager_only: true          # data-dependent output shape; not jittable
+    inplace_view: true        # view op: exempt from AMP casting
 """
 from __future__ import annotations
 
@@ -66,6 +67,7 @@ def load():
         register_op(name,
                     multi_output=bool(spec.get("multi_output", False)),
                     amp_list=spec.get("amp"),
+                    inplace_view=bool(spec.get("inplace_view", False)),
                     eager_only=bool(spec.get("eager_only", False)))(fn)
         GENERATED.append(name)
         method = spec.get("method", name)

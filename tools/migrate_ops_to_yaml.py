@@ -1,0 +1,90 @@
+"""One-time migration: move simple decorator-registered ops (single-return
+jnp expressions) from ops/{math,reduction,manipulation}.py into ops.yaml,
+making the YAML registry the majority source of truth (SURVEY §2.4; verdict
+r3 #6). Conservative: only functions whose body is exactly one `return
+<expr>` whose free names are all in {args, jnp, jax, lax, np} migrate."""
+import ast
+import sys
+
+ALLOWED = {"jnp", "jax", "lax", "np"}
+
+def free_names(expr, bound):
+    names = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            names.add(node.id)
+    import builtins
+    return {n for n in names if n not in bound and n not in ALLOWED
+            and not hasattr(builtins, n)}
+
+def migrate(path):
+    src = open(path).read()
+    tree = ast.parse(src)
+    entries, drop = [], []
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef) or not node.decorator_list:
+            continue
+        dec = node.decorator_list[0]
+        if not (isinstance(dec, ast.Call) and getattr(dec.func, "id", "")
+                == "register_op"):
+            continue
+        if len(node.decorator_list) != 1:
+            continue
+        body = [s for s in node.body
+                if not (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))]  # docstring
+        if len(body) != 1 or not isinstance(body[0], ast.Return):
+            continue
+        a = node.args
+        if a.posonlyargs or a.vararg or a.kwonlyargs or a.kwarg:
+            continue
+        argnames = {x.arg for x in a.args}
+        if free_names(body[0].value, argnames):
+            continue
+        if '"' in ast.unparse(body[0].value):
+            continue   # double quotes would break the quoted impl emission
+        opname = dec.args[0].value
+        kw = {k.arg: getattr(k.value, "value", None) for k in dec.keywords}
+        # signature with defaults
+        defaults = [None] * (len(a.args) - len(a.defaults)) + list(a.defaults)
+        parts = []
+        for arg, d in zip(a.args, defaults):
+            parts.append(arg.arg if d is None
+                         else f"{arg.arg}={ast.unparse(d)}")
+        entry = [f"- op: {opname}",
+                 f'  args: "{", ".join(parts)}"',
+                 f'  impl: "{ast.unparse(body[0].value)}"']
+        if kw.get("amp_list"):
+            entry.append(f"  amp: {kw['amp_list']}")
+        if kw.get("multi_output"):
+            entry.append("  multi_output: true")
+        if kw.get("eager_only"):
+            entry.append("  eager_only: true")
+        if kw.get("inplace_view"):
+            entry.append("  inplace_view: true")
+        entry.append("  method: null")   # hand-written method table owns
+        entries.append("\n".join(entry))
+        drop.append((node.lineno, node.end_lineno, node.decorator_list[0].lineno))
+    # remove migrated functions (incl. decorator line) from source
+    lines = src.splitlines(keepends=True)
+    for fn_start, fn_end, dec_line in sorted(drop, reverse=True):
+        start = dec_line - 1
+        end = fn_end
+        # swallow trailing blank lines (max 2)
+        while end < len(lines) and lines[end].strip() == "":
+            end += 1
+        del lines[start:end]
+    open(path, "w").write("".join(lines))
+    return entries
+
+total = []
+for path in sys.argv[1:]:
+    got = migrate(path)
+    print(f"{path}: migrated {len(got)}")
+    total.extend(got)
+with open("paddle_tpu/ops/ops.yaml", "a") as f:
+    f.write("\n\n# ------------------------------------------------"
+            "-- migrated from decorator registry (round 4)\n\n")
+    f.write("\n\n".join(total))
+    f.write("\n")
+print(f"total {len(total)} entries appended to ops.yaml")

@@ -1,28 +1,431 @@
-"""paddle.onnx — export seam (ref: python/paddle/onnx/export.py, upstream
-layout, unverified — mount empty).
+"""paddle.onnx — native ONNX exporter (ref: python/paddle/onnx/export.py,
+which delegates to the external paddle2onnx package; upstream layout,
+unverified — mount empty).
 
-Upstream delegates to the external `paddle2onnx` package. There is no ONNX
-toolchain in this zero-egress image, so `export` is a gated seam: it uses
-paddle2onnx when importable and otherwise raises with the portable
-alternative (StableHLO via `paddle.jit.save` / `static.save_inference_model`,
-the XLA-native interchange format).
+This zero-egress image has no onnx/paddle2onnx toolchain, so the exporter
+is self-contained: the layer is traced into a static Program (the same
+capture path @to_static uses), each captured op is converted to ONNX
+NodeProto by a converter registry, and the ModelProto is serialized with a
+minimal protobuf wire-format writer (field numbers from the public
+onnx.proto; raw_data little-endian per spec). The artifact is a standard
+`.onnx` file loadable by onnxruntime/netron elsewhere.
+
+Covered op set: the MLP/convnet surface (linear, matmul, elementwise,
+activations, softmax, reshape/transpose/flatten/concat, conv2d, pooling).
+Anything else raises with the op name — no silent partial graphs.
 """
 from __future__ import annotations
+
+import struct
+from typing import Dict, List
+
+import numpy as np
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    try:
-        import paddle2onnx  # noqa: F401
-    except ImportError:
-        raise RuntimeError(
-            "paddle.onnx.export requires the optional 'paddle2onnx' package, "
-            "which is not installed in this environment. For a portable "
-            "compiled artifact use paddle.jit.save (StableHLO, reloadable "
-            "with paddle.jit.load or any XLA runtime) or "
-            "paddle.static.save_inference_model."
-        ) from None
+# ------------------------------------------------------- protobuf writer
+
+def _varint(n: int) -> bytes:
+    out = b""
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _str(field: int, s: str) -> bytes:
+    return _len_delim(field, s.encode())
+
+
+def _int(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v)
+
+
+# ------------------------------------------------------------ onnx protos
+
+_DTYPE = {"float32": 1, "uint8": 2, "int8": 3, "int16": 5, "int32": 6,
+          "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+          "bfloat16": 16}
+
+# AttributeProto.type enum
+_AT_FLOAT, _AT_INT, _AT_STRING, _AT_FLOATS, _AT_INTS = 1, 2, 3, 6, 7
+
+
+def _attribute(name: str, value) -> bytes:
+    body = _str(1, name)
+    if isinstance(value, (bool, int)):
+        body += _tag(3, 0) + _varint(int(value)) + _int(20, _AT_INT)
+    elif isinstance(value, float):
+        body += _tag(2, 5) + struct.pack("<f", value) + _int(20, _AT_FLOAT)
+    elif isinstance(value, str):
+        body += _len_delim(4, value.encode()) + _int(20, _AT_STRING)
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, int) for v in value):
+        for v in value:
+            body += _tag(8, 0) + _varint(v)
+        body += _int(20, _AT_INTS)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            body += _tag(7, 5) + struct.pack("<f", float(v))
+        body += _int(20, _AT_FLOATS)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return body
+
+
+def _node(op_type: str, inputs: List[str], outputs: List[str],
+          name: str = "", attrs: Dict = None) -> bytes:
+    body = b""
+    for i in inputs:
+        body += _str(1, i)
+    for o in outputs:
+        body += _str(2, o)
+    if name:
+        body += _str(3, name)
+    body += _str(4, op_type)
+    for k, v in (attrs or {}).items():
+        body += _len_delim(5, _attribute(k, v))
+    return body
+
+
+def _tensor(name: str, arr: np.ndarray) -> bytes:
+    dt = _DTYPE.get(str(arr.dtype))
+    if dt is None:
+        raise TypeError(f"unsupported initializer dtype {arr.dtype}")
+    body = b""
+    for d in arr.shape:
+        body += _tag(1, 0) + _varint(int(d))
+    body += _int(2, dt)
+    body += _str(8, name)
+    little = arr if arr.dtype.byteorder in ("<", "=", "|") else \
+        arr.astype(arr.dtype.newbyteorder("<"))
+    body += _len_delim(9, np.ascontiguousarray(little).tobytes())
+    return body
+
+
+def _value_info(name: str, shape, dtype: str) -> bytes:
+    dims = b""
+    for i, d in enumerate(shape):
+        if d in (-1, None):
+            dims += _len_delim(1, _str(2, f"dyn_{i}"))
+        else:
+            dims += _len_delim(1, _tag(1, 0) + _varint(int(d)))
+    tensor_type = _int(1, _DTYPE[str(dtype)]) + _len_delim(2, dims)
+    return _str(1, name) + _len_delim(2, _len_delim(1, tensor_type))
+
+
+# ---------------------------------------------------------- op converters
+#
+# each converter: (op, ctx) -> list[bytes NodeProto]; ctx provides fresh
+# names and initializer registration for shape constants etc.
+
+class _Ctx:
+    def __init__(self, program=None):
+        self.program = program
+        self.extra_inits: List[bytes] = []
+        self._uid = 0
+
+    def var_shape(self, name):
+        v = self.program.global_block().vars.get(name)
+        return None if v is None else list(v.shape)
+
+    def fresh(self, base: str) -> str:
+        self._uid += 1
+        return f"{base}_{self._uid}"
+
+    def add_const(self, arr: np.ndarray, base: str) -> str:
+        name = self.fresh(base)
+        self.extra_inits.append(_tensor(name, arr))
+        return name
+
+
+def _pos_consts(op):
+    """Positional constants from the capture template (e.g. a reshape
+    target shape passed positionally rather than as a keyword attr)."""
+    return [payload for kind, payload in op.arg_template
+            if kind == "const"]
+
+
+def _attr_or_pos(op, key, idx_from_consts=0, default=None):
+    if key in op.attrs:
+        return op.attrs[key]
+    consts = _pos_consts(op)
+    if len(consts) > idx_from_consts:
+        return consts[idx_from_consts]
+    if default is not None:
+        return default
     raise NotImplementedError(
-        "paddle2onnx found, but the TPU-native exporter bridge is not "
-        "implemented; export StableHLO via paddle.jit.save instead")
+        f"onnx export: op {op.type!r} missing {key!r} (attrs "
+        f"{sorted(op.attrs)}, {len(consts)} positional consts)")
+
+
+def _resolve_args(op, names, defaults):
+    """Merge keyword attrs with positional consts: positionals fill the
+    first `names` not supplied as keywords, in order (Python call
+    semantics — positional-after-keyword is a syntax error upstream)."""
+    out = dict(defaults)
+    out.update(op.attrs)
+    consts = list(_pos_consts(op))
+    for n in names:
+        if n in op.attrs or not consts:
+            continue
+        out[n] = consts.pop(0)
+    return out
+
+
+def _op_inputs(op, ctx):
+    """Operand names in positional order; scalar/array consts (e.g.
+    `x * 2.0`) become float32 initializers so the node stays valid."""
+    names = []
+    for kind, payload in op.arg_template:
+        if kind == "var":
+            names.append(op.input_names[payload])
+        elif kind == "const" and isinstance(payload, (int, float, bool,
+                                                      np.ndarray)):
+            names.append(ctx.add_const(
+                np.asarray(payload, np.float32), "const"))
+        else:
+            raise NotImplementedError(
+                f"onnx export: op {op.type!r} has a non-scalar positional "
+                f"constant {payload!r}")
+    return names
+
+
+def _simple(onnx_op, **fixed_attrs):
+    def conv(op, ctx):
+        return [_node(onnx_op, _op_inputs(op, ctx), op.output_names,
+                      attrs=fixed_attrs)]
+    return conv
+
+
+def _cv_linear(op, ctx):
+    # y = x @ W (+ b): MatMul then Add
+    x, w = op.input_names[0], op.input_names[1]
+    bias = op.input_names[2] if len(op.input_names) > 2 else None
+    out = op.output_names[0]
+    if bias is None:
+        return [_node("MatMul", [x, w], [out])]
+    mm = ctx.fresh(out + "_mm")
+    return [_node("MatMul", [x, w], [mm]),
+            _node("Add", [mm, bias], [out])]
+
+
+def _cv_matmul(op, ctx):
+    a = _resolve_args(op, ["transpose_x", "transpose_y"],
+                      {"transpose_x": False, "transpose_y": False})
+    nodes = []
+    x, y = op.input_names[:2]
+
+    def swap_last_two(name):
+        shape = ctx.var_shape(name)
+        if shape is None:
+            raise NotImplementedError(
+                f"onnx export: cannot infer rank of {name!r} for matmul "
+                "transpose")
+        r = len(shape)
+        perm = list(range(r - 2)) + [r - 1, r - 2]
+        t = ctx.fresh(name + "_t")
+        nodes.append(_node("Transpose", [name], [t],
+                           attrs={"perm": perm}))
+        return t
+
+    if a["transpose_x"]:
+        x = swap_last_two(x)
+    if a["transpose_y"]:
+        y = swap_last_two(y)
+    nodes.append(_node("MatMul", [x, y], op.output_names))
+    return nodes
+
+
+def _cv_reshape(op, ctx):
+    shape = [int(s) for s in _attr_or_pos(op, "shape")]
+    cname = ctx.add_const(np.asarray(shape, np.int64), "reshape_shape")
+    return [_node("Reshape", [op.input_names[0], cname], op.output_names)]
+
+
+def _cv_transpose(op, ctx):
+    perm = [int(p) for p in _attr_or_pos(op, "perm")]
+    return [_node("Transpose", op.input_names, op.output_names,
+                  attrs={"perm": perm})]
+
+
+def _cv_softmax(op, ctx):
+    return [_node("Softmax", op.input_names, op.output_names,
+                  attrs={"axis": int(op.attrs.get("axis", -1))})]
+
+
+def _cv_flatten(op, ctx):
+    # paddle flatten(start, stop) merges dims [start..stop] into one;
+    # ONNX Flatten is always-2-D, so emit Reshape with the 0-copy/-1
+    # target instead (0 = keep dim, single -1 = merged chunk)
+    a = _resolve_args(op, ["start_axis", "stop_axis"],
+                      {"start_axis": 0, "stop_axis": -1})
+    rank = len(ctx.var_shape(op.input_names[0]) or [])
+    start = int(a["start_axis"]) % max(rank, 1)
+    stop = int(a["stop_axis"]) % max(rank, 1)
+    target = [0] * start + [-1] + [0] * (rank - 1 - stop)
+    cname = ctx.add_const(np.asarray(target, np.int64), "flatten_shape")
+    return [_node("Reshape", [op.input_names[0], cname], op.output_names)]
+
+
+def _cv_concat(op, ctx):
+    axis = int(_attr_or_pos(op, "axis", 0, default=0))
+    return [_node("Concat", op.input_names, op.output_names,
+                  attrs={"axis": axis})]
+
+
+def _pair(v):
+    return [int(v), int(v)] if isinstance(v, int) else [int(i) for i in v]
+
+
+def _onnx_pads(p):
+    """paddle [ph, pw] or [top, bottom, left, right] -> ONNX
+    [x1_begin, x2_begin, x1_end, x2_end] = [top, left, bottom, right]."""
+    p = _pair(p)
+    if len(p) == 2:
+        return [p[0], p[1], p[0], p[1]]
+    if len(p) == 4:
+        t, b, l, r = p
+        return [t, l, b, r]
+    raise NotImplementedError(f"onnx export: padding {p!r}")
+
+
+def _cv_conv2d(op, ctx):
+    a = op.attrs
+    if a.get("data_format", "NCHW") != "NCHW":
+        raise NotImplementedError("onnx export: conv2d NCHW only")
+    s, d = _pair(a.get("stride", 1)), _pair(a.get("dilation", 1))
+    p = a.get("padding", 0)
+    if isinstance(p, str):
+        raise NotImplementedError("onnx export: string conv padding")
+    return [_node("Conv", op.input_names, op.output_names,
+                  attrs={"strides": s, "dilations": d,
+                         "pads": _onnx_pads(p),
+                         "group": int(a.get("groups", 1))})]
+
+
+def _cv_pool(onnx_op):
+    def conv(op, ctx):
+        a = _resolve_args(
+            op, ["kernel_size", "stride", "padding", "ceil_mode"],
+            {"stride": None, "padding": 0, "ceil_mode": False})
+        k = _pair(a["kernel_size"])
+        s = _pair(a["stride"]) if a.get("stride") is not None else k
+        attrs = {"kernel_shape": k, "strides": s,
+                 "pads": _onnx_pads(a.get("padding", 0)),
+                 "ceil_mode": int(bool(a.get("ceil_mode", False)))}
+        if a.get("data_format", "NCHW") != "NCHW":
+            raise NotImplementedError("onnx export: pooling NCHW only")
+        if onnx_op == "AveragePool":
+            attrs["count_include_pad"] = int(
+                bool(a.get("count_include_pad", True)))
+        return [_node(onnx_op, op.input_names[:1], op.output_names[:1],
+                      attrs=attrs)]
+    return conv
+
+
+_CONVERTERS = {
+    "linear": _cv_linear,
+    "matmul": _cv_matmul,
+    "add": _simple("Add"), "subtract": _simple("Sub"),
+    "multiply": _simple("Mul"), "divide": _simple("Div"),
+    "relu": _simple("Relu"), "sigmoid": _simple("Sigmoid"),
+    "tanh": _simple("Tanh"), "exp": _simple("Exp"),
+    "sqrt": _simple("Sqrt"), "abs": _simple("Abs"),
+    "neg": _simple("Neg"), "erf": _simple("Erf"),
+    "softmax": _cv_softmax,
+    "reshape": _cv_reshape,
+    "transpose": _cv_transpose,
+    "flatten": _cv_flatten,
+    "concat": _cv_concat,
+    "conv2d": _cv_conv2d,
+    "max_pool2d": _cv_pool("MaxPool"),
+    "avg_pool2d": _cv_pool("AveragePool"),
+}
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Trace `layer` and write `path + '.onnx'` (upstream name contract).
+
+    input_spec: list of InputSpec/Tensors defining the feed signature.
+    Returns the written file path.
+    """
+    from . import static
+    from .core.tensor import Tensor
+    from .jit.api import InputSpec
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        else:
+            arr = np.asarray(s.numpy() if isinstance(s, Tensor) else s)
+            specs.append(InputSpec(arr.shape, str(arr.dtype)))
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    main = static.Program()
+    static.enable_static()
+    try:
+        with static.program_guard(main, static.Program()):
+            feeds = [static.data(s.name or f"input_{i}", list(s.shape),
+                                 s.dtype) for i, s in enumerate(specs)]
+            result = layer(*feeds)
+    finally:
+        static.disable_static()
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    outputs = result if isinstance(result, (list, tuple)) else [result]
+    if not outputs:
+        raise ValueError("traced layer produced no outputs")
+
+    ctx = _Ctx(main)
+    nodes: List[bytes] = []
+    for op in main.global_block().ops:
+        conv = _CONVERTERS.get(op.type)
+        if conv is None:
+            raise NotImplementedError(
+                f"onnx export: no converter for op {op.type!r}; covered: "
+                f"{sorted(_CONVERTERS)}")
+        nodes.extend(conv(op, ctx))
+
+    graph = b""
+    for n in nodes:
+        graph += _len_delim(1, n)
+    graph += _str(2, type(layer).__name__)
+    for name, t in sorted(main.refs.items()):
+        graph += _len_delim(5, _tensor(name, np.asarray(t.numpy())))
+    for t in ctx.extra_inits:
+        graph += _len_delim(5, t)
+    for v, s in zip(main._data_vars, specs):
+        graph += _len_delim(11, _value_info(v.name, s.shape, s.dtype))
+    for o in outputs:
+        graph += _len_delim(12, _value_info(o.name, list(o.shape),
+                                            str(o.dtype)))
+
+    model = _int(1, 8)                      # ir_version 8
+    model += _str(2, "paddle_tpu")          # producer_name
+    model += _len_delim(7, graph)
+    model += _len_delim(8, _str(1, "") + _int(2, int(opset_version)))
+
+    out_path = str(path) if str(path).endswith(".onnx") \
+        else str(path) + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path

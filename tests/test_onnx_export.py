@@ -1,0 +1,169 @@
+"""Native ONNX exporter (paddle.onnx.export) — the round-3 'gated seam'
+stub is now a real exporter. No onnx package exists in this image, so the
+emitted wire format is verified with a minimal protobuf reader: the model
+must parse, the graph must contain the expected node op_types in order,
+and initializer raw_data must round-trip bit-exact."""
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ------------------------------------------------- tiny protobuf reader
+
+def _read_varint(buf, pos):
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def parse_message(buf):
+    """-> {field_number: [values]}; length-delimited values stay bytes."""
+    fields = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            n, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + n]
+            pos += n
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        else:
+            raise AssertionError(f"unexpected wire type {wire}")
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+def _graph_of(path):
+    model = parse_message(open(path, "rb").read())
+    assert model[1] == [8]                      # ir_version
+    assert model[2] == [b"paddle_tpu"]          # producer
+    opset = parse_message(model[8][0])
+    assert opset[2] == [13]
+    return parse_message(model[7][0])
+
+
+def _nodes(graph):
+    return [parse_message(n) for n in graph.get(1, [])]
+
+
+class TestOnnxExport:
+    def test_mlp_graph_structure_and_weights(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        from paddle_tpu.jit.api import InputSpec
+
+        out = paddle.onnx.export(net, str(tmp_path / "mlp"),
+                                 input_spec=[InputSpec([2, 4], "float32")])
+        graph = _graph_of(out)
+        ops = [n[4][0].decode() for n in _nodes(graph)]
+        assert ops == ["MatMul", "Add", "Relu", "MatMul", "Add"]
+
+        # initializers: every parameter present, raw_data bit-exact
+        inits = {parse_message(t)[8][0].decode(): parse_message(t)
+                 for t in graph.get(5, [])}
+        assert len(inits) == 4
+        # the program uses layer-qualified ref names (linear_0.weight);
+        # match each live parameter to an initializer by bit-exact content
+        decoded = {k: np.frombuffer(t[9][0], np.float32).reshape(
+            [v for v in t[1]] or [1]) for k, t in inits.items()}
+        for name, p in net.named_parameters():
+            val = np.asarray(p.numpy())
+            assert any(d.shape == val.shape and np.array_equal(d, val)
+                       for d in decoded.values()), name
+
+        # graph IO declared
+        g_in = parse_message(graph[11][0])
+        assert g_in[1] == [b"input_0"]
+        assert len(graph.get(12, [])) == 1
+
+    def test_convnet_exports_conv_and_pool(self, tmp_path):
+        paddle.seed(1)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(1, 4, 3, padding=1)
+                self.fc = nn.Linear(4 * 4 * 4, 3)
+
+            def forward(self, x):
+                h = nn.functional.relu(self.conv(x))
+                h = nn.functional.max_pool2d(h, 2)
+                h = h.reshape([-1, 4 * 4 * 4])
+                return self.fc(h)
+
+        from paddle_tpu.jit.api import InputSpec
+
+        out = paddle.onnx.export(Net(), str(tmp_path / "conv"),
+                                 input_spec=[InputSpec([1, 1, 8, 8],
+                                                       "float32")])
+        ops = [n[4][0].decode() for n in _nodes(_graph_of(out))]
+        assert "Conv" in ops and "MaxPool" in ops and "Reshape" in ops
+
+    def test_scalar_operands_become_initializers(self, tmp_path):
+        """x * 2.0 + 1.0: the scalars must materialize as initializers so
+        every Add/Mul node keeps two inputs (review r4 finding)."""
+        class Net(nn.Layer):
+            def forward(self, x):
+                return x * 2.0 + 1.0
+
+        from paddle_tpu.jit.api import InputSpec
+
+        out = paddle.onnx.export(Net(), str(tmp_path / "scal"),
+                                 input_spec=[InputSpec([2, 2], "float32")])
+        graph = _graph_of(out)
+        for n in _nodes(graph):
+            assert len(n[1]) == 2, n   # every node binary
+        consts = [np.frombuffer(parse_message(t)[9][0], np.float32)
+                  for t in graph.get(5, [])]
+        vals = sorted(float(c[0]) for c in consts)
+        assert vals == [1.0, 2.0]
+
+    def test_positional_flatten_and_concat_axis(self, tmp_path):
+        """flatten(2) / concat([a,b], 1) pass args positionally — the
+        exporter must not fall back to wrong defaults (review r4)."""
+        class Net(nn.Layer):
+            def forward(self, x):
+                a = x.flatten(2)                      # (2,3,4,5)->(2,3,20)
+                return paddle.concat([a, a], 1)       # -> (2,6,20)
+
+        from paddle_tpu.jit.api import InputSpec
+
+        out = paddle.onnx.export(Net(), str(tmp_path / "pos"),
+                                 input_spec=[InputSpec([2, 3, 4, 5],
+                                                       "float32")])
+        graph = _graph_of(out)
+        nodes = _nodes(graph)
+        ops = [n[4][0].decode() for n in nodes]
+        assert ops == ["Reshape", "Concat"]
+        # flatten(2) -> Reshape target [0, 0, -1]
+        shape_init = [parse_message(t) for t in graph.get(5, [])][0]
+        target = np.frombuffer(shape_init[9][0], np.int64)
+        np.testing.assert_array_equal(target, [0, 0, -1])
+        # concat axis=1
+        concat_attr = parse_message(nodes[1][5][0])
+        assert concat_attr[1] == [b"axis"] and concat_attr[3] == [1]
+
+    def test_unmapped_op_raises_loudly(self, tmp_path):
+        class Net(nn.Layer):
+            def forward(self, x):
+                return paddle.cumsum(x, axis=0)
+
+        from paddle_tpu.jit.api import InputSpec
+
+        with pytest.raises(NotImplementedError, match="cumsum"):
+            paddle.onnx.export(Net(), str(tmp_path / "bad"),
+                               input_spec=[InputSpec([2, 2], "float32")])

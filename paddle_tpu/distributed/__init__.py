@@ -34,3 +34,5 @@ from . import rpc  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import elastic  # noqa: F401
 from .fleet_executor import FleetExecutor, TaskNode  # noqa: F401
+
+from . import launch  # noqa: F401,E402 — fleetrun module

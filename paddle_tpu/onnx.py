@@ -141,6 +141,10 @@ class _Ctx:
         v = self.program.global_block().vars.get(name)
         return None if v is None else list(v.shape)
 
+    def var_dtype(self, name):
+        v = self.program.global_block().vars.get(name)
+        return None if v is None else str(v.dtype)
+
     def fresh(self, base: str) -> str:
         self._uid += 1
         return f"{base}_{self._uid}"
@@ -189,13 +193,20 @@ def _op_inputs(op, ctx):
     """Operand names in positional order; scalar/array consts (e.g.
     `x * 2.0`) become float32 initializers so the node stays valid."""
     names = []
+    # scalar consts adopt the dtype of the first tensor operand, so mixed
+    # int/float graphs stay type-valid ONNX
+    var_dt = None
+    for kind, payload in op.arg_template:
+        if kind == "var":
+            var_dt = var_dt or ctx.var_dtype(op.input_names[payload])
+    var_dt = var_dt or "float32"
     for kind, payload in op.arg_template:
         if kind == "var":
             names.append(op.input_names[payload])
         elif kind == "const" and isinstance(payload, (int, float, bool,
                                                       np.ndarray)):
             names.append(ctx.add_const(
-                np.asarray(payload, np.float32), "const"))
+                np.asarray(payload, np.dtype(var_dt)), "const"))
         else:
             raise NotImplementedError(
                 f"onnx export: op {op.type!r} has a non-scalar positional "
@@ -272,10 +283,18 @@ def _cv_flatten(op, ctx):
     # target instead (0 = keep dim, single -1 = merged chunk)
     a = _resolve_args(op, ["start_axis", "stop_axis"],
                       {"start_axis": 0, "stop_axis": -1})
-    rank = len(ctx.var_shape(op.input_names[0]) or [])
+    shape = ctx.var_shape(op.input_names[0]) or []
+    rank = len(shape)
     start = int(a["start_axis"]) % max(rank, 1)
     stop = int(a["stop_axis"]) % max(rank, 1)
-    target = [0] * start + [-1] + [0] * (rank - 1 - stop)
+    # 0-copy is positional in the PRE-merge input, so dims AFTER the merged
+    # chunk must be written explicitly (their index shifts); dynamic dims
+    # there cannot be expressed
+    tail = shape[stop + 1:]
+    if any(d in (-1, None) for d in tail):
+        raise NotImplementedError(
+            "onnx export: flatten with dynamic dims after stop_axis")
+    target = [0] * start + [-1] + [int(d) for d in tail]
     cname = ctx.add_const(np.asarray(target, np.int64), "flatten_shape")
     return [_node("Reshape", [op.input_names[0], cname], op.output_names)]
 
@@ -368,6 +387,11 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
 
     if input_spec is None:
         raise ValueError("paddle.onnx.export requires input_spec")
+    if int(opset_version) != 13:
+        raise NotImplementedError(
+            "paddle.onnx.export emits opset-13 semantics; pass "
+            "opset_version=13 (mislabeling the artifact would change "
+            "Reshape/Softmax behavior in other runtimes)")
     specs = []
     for s in input_spec:
         if isinstance(s, InputSpec):

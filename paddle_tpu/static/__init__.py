@@ -19,8 +19,70 @@ __all__ = [
     "default_startup_program", "Executor", "InputSpec", "append_backward",
     "gradients", "enable_static", "disable_static", "in_dynamic_mode",
     "save_inference_model", "load_inference_model", "nn", "cpu_places",
-    "device_guard",
+    "device_guard", "scope_guard", "save", "load", "BuildStrategy",
+    "CompiledProgram",
 ]
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def scope_guard(scope):
+    """paddle.static.scope_guard: swap the global Scope for a region."""
+    from . import executor as _ex
+
+    prev = _ex._GLOBAL_SCOPE
+    _ex._GLOBAL_SCOPE = scope
+    try:
+        yield
+    finally:
+        _ex._GLOBAL_SCOPE = prev
+
+
+def save(program, model_prefix, protocol=4):
+    """paddle.static.save: persist a Program's persistable tensors
+    (params + buffers) as <prefix>.pdparams (the upstream name split into
+    pdparams/pdopt/pdmodel collapses here: the Program IS replayable)."""
+    from ..framework.io import save as _fw_save
+
+    _fw_save(dict(program.refs), str(model_prefix) + ".pdparams",
+             protocol=protocol)
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    """paddle.static.load: restore persistables saved by static.save."""
+    from ..framework.io import load as _fw_load
+
+    state = _fw_load(str(model_prefix) + ".pdparams")
+    for n, val in state.items():
+        if n in program.refs:
+            program.refs[n]._data = val._data if hasattr(val, "_data") \
+                else val
+
+
+class BuildStrategy:
+    """Compilation knobs (ref: paddle CompiledProgram/BuildStrategy).
+    XLA already performs the fusion/memory passes these flags toggled, so
+    the attributes are accepted and recorded for parity."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.build_cuda_graph = False
+
+
+class CompiledProgram:
+    """Wrapper the Executor unwraps; compilation happens in the
+    Executor's pjit cache either way (SURVEY §7: the executable cache IS
+    the InterpreterCore)."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,

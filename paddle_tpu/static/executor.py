@@ -85,6 +85,8 @@ class Executor:
             fetch_list: Optional[Sequence] = None, scope=None,
             return_numpy: bool = True):
         program = program or default_main_program()
+        if hasattr(program, "program") and not hasattr(program, "refs"):
+            program = program.program     # CompiledProgram unwrap
         feed = feed or {}
         fetch_list = list(fetch_list or [])
 

@@ -221,3 +221,58 @@ class TestStaticNNSugar:
             assert abs(out[2].mean()) < 0.2  # normalized
         finally:
             static.disable_static()
+
+
+class TestStaticCoverageRound4:
+    def test_compiled_program_and_build_strategy(self):
+        import numpy as np
+
+        main = static.Program()
+        static.enable_static()
+        try:
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [2, 2], "float32")
+                y = x * 3.0
+        finally:
+            static.disable_static()
+        bs = static.BuildStrategy()
+        bs.memory_optimize = False
+        cp = static.CompiledProgram(main, build_strategy=bs)
+        out = static.Executor().run(cp, feed={"x": np.ones((2, 2),
+                                                           np.float32)},
+                                    fetch_list=[y])
+        np.testing.assert_allclose(out[0], np.full((2, 2), 3.0))
+
+    def test_scope_guard_swaps_global_scope(self):
+        s = static.Scope()
+        base = static.global_scope()
+        with static.scope_guard(s):
+            assert static.global_scope() is s
+        assert static.global_scope() is base
+
+    def test_static_save_load_roundtrip(self, tmp_path):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        net = nn.Linear(3, 2)
+        main = static.Program()
+        static.enable_static()
+        try:
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [1, 3], "float32")
+                net(x)
+        finally:
+            static.disable_static()
+        prefix = str(tmp_path / "m")
+        static.save(main, prefix)
+
+        # clobber the live params, then restore
+        orig = {n: np.asarray(t.numpy()) for n, t in main.refs.items()}
+        for t in main.refs.values():
+            t._data = t._data * 0.0
+        static.load(main, prefix)
+        for n, t in main.refs.items():
+            np.testing.assert_array_equal(np.asarray(t.numpy()), orig[n])

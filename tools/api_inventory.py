@@ -119,12 +119,52 @@ fft fft2 fftn fftfreq fftshift hfft hfft2 hfftn ifft ifft2 ifftn ihfft
 ihfft2 ihfftn irfft irfft2 irfftn rfft rfft2 rfftn rfftfreq ifftshift
 """.split()
 
+# paddle.distributed (collective + fleet entry points)
+PADDLE_DIST = """
+init_parallel_env get_rank get_world_size is_initialized all_reduce
+all_gather all_gather_object reduce reduce_scatter broadcast
+broadcast_object_list scatter scatter_object_list alltoall
+alltoall_single send recv isend irecv barrier wait new_group
+get_backend spawn launch ReduceOp P2POp batch_isend_irecv rpc
+save_state_dict load_state_dict shard_tensor
+""".split()
+
+# paddle.io
+PADDLE_IO = """
+DataLoader Dataset IterableDataset TensorDataset ConcatDataset
+ChainDataset Subset random_split Sampler SequenceSampler RandomSampler
+WeightedRandomSampler BatchSampler DistributedBatchSampler
+SubsetRandomSampler get_worker_info
+""".split()
+
+# paddle.static
+PADDLE_STATIC = """
+Program program_guard default_main_program default_startup_program
+Executor data InputSpec save load save_inference_model
+load_inference_model global_scope scope_guard name_scope gradients
+append_backward CompiledProgram BuildStrategy nn
+""".split()
+
+# paddle.metric / paddle.distribution / misc
+PADDLE_METRIC = "Metric Accuracy Precision Recall Auc accuracy".split()
+PADDLE_DISTRIBUTION = """
+Distribution Normal Uniform Categorical Bernoulli Beta Dirichlet
+Exponential Gamma Geometric Gumbel Laplace LogNormal Multinomial
+Poisson StudentT TransformedDistribution kl_divergence register_kl
+""".split()
+
 MODULES = OrderedDict([
     ("paddle", ("paddle_tpu", PADDLE_FLAT)),
     ("paddle.nn", ("paddle_tpu.nn", PADDLE_NN)),
     ("paddle.nn.functional", ("paddle_tpu.nn.functional", PADDLE_NN_F)),
     ("paddle.linalg", ("paddle_tpu.linalg", PADDLE_LINALG)),
     ("paddle.fft", ("paddle_tpu.fft", PADDLE_FFT)),
+    ("paddle.distributed", ("paddle_tpu.distributed", PADDLE_DIST)),
+    ("paddle.io", ("paddle_tpu.io", PADDLE_IO)),
+    ("paddle.static", ("paddle_tpu.static", PADDLE_STATIC)),
+    ("paddle.metric", ("paddle_tpu.metric", PADDLE_METRIC)),
+    ("paddle.distribution", ("paddle_tpu.distribution",
+                             PADDLE_DISTRIBUTION)),
 ])
 
 

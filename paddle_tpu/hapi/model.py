@@ -88,6 +88,8 @@ class Model:
         self._train_step_fn = None
         self._eval_step_fn = None
         self._predict_step_fn = None
+        self._trees_cache = None
+        self._state_globalized = False
 
     # ------------------------------------------------- functional plumbing
     def _amp_ctx(self):
@@ -129,11 +131,48 @@ class Model:
             return net.data_sharding(), net.param_sharding()
         return None, None
 
+    def _sharding_trees(self):
+        """(data_sh, p_sh, b_sh, o_sh, g_sh) for the wrapped network, or
+        None when the network carries no mesh (plain single-device).
+        Cached: rebuilt only after _invalidate_compiled."""
+        cached = getattr(self, "_trees_cache", None)
+        if cached is not None:
+            return cached
+        from jax.tree_util import tree_map
+
+        data_sh, param_sh = self._dp_shardings()
+        if data_sh is None:
+            return None
+        net = self.network
+        params, buffers = self._sync_state_in()
+        self._ensure_opt_state(params)
+        g_sh = None
+        if hasattr(net, "grad_shardings"):
+            # GroupSharded stage >= 2: constrain grads to the dim-0 sharded
+            # layout so XLA materializes reduce-scattered grad shards inside
+            # the step (never a full replicated grad buffer per device) —
+            # the os_g distinction over stage 1. Replicated entries (stage
+            # 1, small params) are dropped: constraining to P() is a no-op.
+            g_sh = {k: s for k, s in net.grad_shardings(params).items()
+                    if tuple(s.spec)} or None
+        # per-param sharding trees (GroupSharded stages) when the wrapper
+        # provides them; otherwise a uniform prefix (DataParallel)
+        if hasattr(net, "param_shardings"):
+            p_sh = net.param_shardings(params)
+        else:
+            p_sh = tree_map(lambda _: param_sh, params)
+        if hasattr(net, "opt_state_shardings"):
+            o_sh = net.opt_state_shardings(self._opt_state)
+        else:
+            o_sh = tree_map(lambda _: param_sh, self._opt_state)
+        b_sh = tree_map(lambda _: param_sh, buffers)
+        self._trees_cache = (data_sh, p_sh, b_sh, o_sh, g_sh)
+        return self._trees_cache
+
     def _build_train_step(self):
         opt = self._optimizer
-        data_sh, param_sh = self._dp_shardings()
-        net = self.network
-        g_sh = None
+        trees = self._sharding_trees()
+        g_sh = None if trees is None else trees[4]
 
         def step(params, buffers, opt_state, lr, t, key, input_datas,
                  label_datas):
@@ -153,32 +192,8 @@ class Model:
                 params, grads, opt_state, lr, t)
             return losses, outs, new_buffers, new_params, new_state
 
-        if data_sh is not None:
-            from jax.tree_util import tree_map
-
-            params, buffers = self._sync_state_in()
-            self._ensure_opt_state(params)
-            if hasattr(net, "grad_shardings"):
-                # GroupSharded stage >= 2: constrain grads to the dim-0
-                # sharded layout so XLA materializes reduce-scattered grad
-                # shards inside the step (never a full replicated grad
-                # buffer per device) — the os_g distinction over stage 1.
-                # Replicated entries (stage 1, small params) are dropped:
-                # constraining to P() is a no-op. `step` closes over g_sh;
-                # tracing happens after this assignment.
-                g_sh = {k: s for k, s in net.grad_shardings(params).items()
-                        if tuple(s.spec)} or None
-            # per-param sharding trees (GroupSharded stages) when the wrapper
-            # provides them; otherwise a uniform prefix (DataParallel)
-            if hasattr(net, "param_shardings"):
-                p_sh = net.param_shardings(params)
-            else:
-                p_sh = tree_map(lambda _: param_sh, params)
-            if hasattr(net, "opt_state_shardings"):
-                o_sh = net.opt_state_shardings(self._opt_state)
-            else:
-                o_sh = tree_map(lambda _: param_sh, self._opt_state)
-            b_sh = tree_map(lambda _: param_sh, buffers)
+        if trees is not None:
+            data_sh, p_sh, b_sh, o_sh, _ = trees
             # pin state outputs to the same layouts as the inputs: with the
             # stage-2 grad constraint in the graph XLA would otherwise pick a
             # sharded layout for new_params, and the next call's in_shardings
@@ -188,6 +203,50 @@ class Model:
                                          None, None, None, data_sh, data_sh),
                            out_shardings=(None, None, b_sh, p_sh, o_sh))
         return jax.jit(step, donate_argnums=(0, 2))
+
+    # ----------------------------------------------- multi-controller glue
+    def _is_multiprocess(self, data_sh) -> bool:
+        return (data_sh is not None and jax.process_count() > 1
+                and len(data_sh.mesh.devices.flat) > len(
+                    jax.local_devices()))
+
+    @staticmethod
+    def _on_job_mesh(v, mesh) -> bool:
+        sh = getattr(v, "sharding", None)
+        return sh is not None and getattr(sh, "mesh", None) == mesh
+
+    def _globalize_batch(self, data_sh, datas):
+        """Per-host batch shards -> global arrays over the job mesh (the
+        SURVEY §7 'data pipeline at pod scale' recipe: each process feeds
+        its DistributedBatchSampler shard). Accepts host arrays directly —
+        no device round-trip for the local shard."""
+        mesh = data_sh.mesh
+        return tuple(
+            d if self._on_job_mesh(d, mesh)
+            else jax.make_array_from_process_local_data(
+                data_sh, np.asarray(d)) for d in datas)
+
+    def _globalize_state(self, params, buffers, trees):
+        """First-call promotion of host-identical state onto the global
+        mesh: every process holds the same values (same seed), so a
+        device_put with the target sharding places each host's shards
+        without cross-host traffic. No-op after the first call."""
+        if getattr(self, "_state_globalized", False):
+            return params, buffers
+        data_sh, p_sh, b_sh, o_sh, _ = trees
+        mesh = data_sh.mesh
+
+        def place_leaf(v, s):
+            return v if self._on_job_mesh(v, mesh) else \
+                jax.device_put(np.asarray(v), s)
+
+        params = {k: place_leaf(v, p_sh[k]) for k, v in params.items()}
+        buffers = {k: place_leaf(v, b_sh[k]) for k, v in buffers.items()}
+        self._opt_state = jax.tree_util.tree_map(
+            place_leaf, self._opt_state, o_sh,
+            is_leaf=lambda x: not isinstance(x, dict))
+        self._state_globalized = True
+        return params, buffers
 
     def _build_eval_step(self):
         def step(params, buffers, input_datas, label_datas):
@@ -260,9 +319,29 @@ class Model:
                 "hybrid optimizer")
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
-        input_datas = tuple(_to_data(x) for x in _to_list(inputs))
-        label_datas = tuple(_to_data(x) for x in _to_list(labels))
         data_sh, _ = self._dp_shardings()
+        multiproc = self._is_multiprocess(data_sh)
+        if multiproc:
+            # multi-controller: each process feeds ITS sampler shard —
+            # keep batches on the host (no jnp round-trip) and assemble
+            # global arrays directly
+            def _host(x):
+                return np.asarray(x.numpy() if isinstance(x, Tensor)
+                                  else x)
+
+            input_datas = self._globalize_batch(
+                data_sh, tuple(_host(x) for x in _to_list(inputs)))
+            label_datas = self._globalize_batch(
+                data_sh, tuple(_host(x) for x in _to_list(labels)))
+            if self._metrics:
+                raise NotImplementedError(
+                    "metrics in the multi-controller regime are not "
+                    "supported yet: metric updates read dp-sharded "
+                    "outputs host-side; compute metrics on rank-local "
+                    "eval data instead")
+        else:
+            input_datas = tuple(_to_data(x) for x in _to_list(inputs))
+            label_datas = tuple(_to_data(x) for x in _to_list(labels))
         if data_sh is not None and input_datas:
             spec0 = data_sh.spec[0] if data_sh.spec else None
             axes = ((spec0,) if isinstance(spec0, str)
@@ -278,6 +357,9 @@ class Model:
                     "device gets an equal shard")
         params, buffers = self._sync_state_in()
         self._ensure_opt_state(params)
+        if multiproc:
+            params, buffers = self._globalize_state(
+                params, buffers, self._sharding_trees())
         opt = self._optimizer
         opt._step_count += 1
         lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
@@ -297,6 +379,11 @@ class Model:
         return (loss_np, metrics) if metrics else loss_np
 
     def eval_batch(self, inputs, labels=None):
+        if self._is_multiprocess(self._dp_shardings()[0]):
+            raise NotImplementedError(
+                "eval_batch in the multi-controller regime is not "
+                "supported yet; run evaluation on rank-local data with a "
+                "single-process Model")
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
         input_datas = tuple(_to_data(x) for x in _to_list(inputs))
@@ -312,6 +399,11 @@ class Model:
         return (loss_np, metrics) if metrics else loss_np
 
     def predict_batch(self, inputs):
+        if self._is_multiprocess(self._dp_shardings()[0]):
+            raise NotImplementedError(
+                "predict_batch in the multi-controller regime is not "
+                "supported yet; predict on rank-local data with a "
+                "single-process Model")
         if self._predict_step_fn is None:
             self._predict_step_fn = self._build_predict_step()
         input_datas = tuple(_to_data(x) for x in _to_list(inputs))

@@ -114,5 +114,40 @@ def main():
               flush=True)
 
 
+def main_hapi():
+    """Model.fit in the multi-controller regime: per-host DataLoader shard
+    in, global arrays assembled inside train_batch."""
+    assert jax.process_count() == 2
+    rank = jax.process_index()
+
+    model_net = build_model()
+    wrapped = paddle.DataParallel(model_net)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=model_net.parameters())
+    model = paddle.Model(wrapped)
+    from paddle_tpu import nn as pnn
+
+    model.prepare(optimizer=opt, loss=pnn.MSELoss())
+
+    ds = SynthDS()
+    sampler = DistributedBatchSampler(ds, batch_size=LOCAL_BS,
+                                      num_replicas=2, rank=rank,
+                                      shuffle=False)
+    loader = DataLoader(ds, batch_sampler=sampler)
+    t = 0
+    for xb, yb in loader:
+        t += 1
+        if t > STEPS:
+            break
+        losses = model.train_batch([xb], [yb])
+        print(f"rank={rank} hapi_step={t} "
+              f"loss={float(np.sum(losses[0])):.6f}", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "hapi":
+        main_hapi()
+    else:
+        main()

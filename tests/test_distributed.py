@@ -326,6 +326,44 @@ def test_two_process_dp_train_matches_single_process():
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
 
 
+def test_two_process_hapi_fit_matches_single_process():
+    """Model.fit itself in the multi-controller regime (README table row):
+    per-host sampler shards in, the hapi step assembles global arrays and
+    runs ONE jitted update; losses match the functional-step reference."""
+    import socket
+
+    env = dict(os.environ)
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "1", "--nproc_per_node", "2",
+         "--master", f"127.0.0.1:{port}",
+         os.path.join(os.path.dirname(__file__),
+                      "_multiproc_train_worker.py"), "hapi"],
+        capture_output=True, text=True, env=env, timeout=300,
+        cwd="/root/repo")
+    assert out.returncode == 0, (out.stdout[-3000:], out.stderr[-3000:])
+
+    import re
+
+    losses = {}
+    for m in re.finditer(r"rank=(\d) hapi_step=(\d) loss=([\d.]+)",
+                         out.stdout):
+        losses[(int(m.group(1)), int(m.group(2)))] = float(m.group(3))
+    assert len(losses) == 8, out.stdout
+    for t in range(1, 5):
+        assert abs(losses[(0, t)] - losses[(1, t)]) < 1e-6
+    ref = _dp_reference_losses()
+    got = [losses[(0, t)] for t in range(1, 5)]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+
+
 def _dp_reference_losses():
     from tests._multiproc_train_worker import (
         IN, LOCAL_BS, OUT, STEPS, SynthDS, build_model,

@@ -63,6 +63,8 @@ class Model:
         self._eval_step_fn = None
         self._predict_step_fn = None
         self._opt_state = None
+        self._trees_cache = None
+        self._state_globalized = False
 
     # ------------------------------------------------------------- prepare
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -193,15 +195,21 @@ class Model:
             return losses, outs, new_buffers, new_params, new_state
 
         if trees is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
             data_sh, p_sh, b_sh, o_sh, _ = trees
+            repl = NamedSharding(data_sh.mesh, P())
             # pin state outputs to the same layouts as the inputs: with the
             # stage-2 grad constraint in the graph XLA would otherwise pick a
             # sharded layout for new_params, and the next call's in_shardings
-            # would reject the arrays instead of resharding them
+            # would reject the arrays instead of resharding them. Losses are
+            # pinned REPLICATED so host-side logging can read them even when
+            # the job spans processes (a dp-sharded 'none'-reduction loss is
+            # not addressable from one host).
             return jax.jit(step, donate_argnums=(0, 2),
                            in_shardings=(p_sh, b_sh, o_sh,
                                          None, None, None, data_sh, data_sh),
-                           out_shardings=(None, None, b_sh, p_sh, o_sh))
+                           out_shardings=(repl, None, b_sh, p_sh, o_sh))
         return jax.jit(step, donate_argnums=(0, 2))
 
     # ----------------------------------------------- multi-controller glue
@@ -297,6 +305,7 @@ class Model:
 
     # ------------------------------------------------------------ batching
     def _split_batch(self, data):
+        multiproc = self._is_multiprocess(self._dp_shardings()[0])
         data = _to_list(data)
         if self._inputs is not None:
             n_in = len(self._inputs)
@@ -306,8 +315,15 @@ class Model:
             n_in = len(data) - 1
         else:
             n_in = len(data)
-        inputs = [_to_data(d) for d in data[:n_in]]
-        labels = [_to_data(d) for d in data[n_in:]]
+        if multiproc:
+            # keep batches on the HOST: train_batch assembles global arrays
+            # straight from the sampler shard (no device round-trip)
+            def conv(d):
+                return np.asarray(d.numpy() if isinstance(d, Tensor) else d)
+        else:
+            conv = _to_data
+        inputs = [conv(d) for d in data[:n_in]]
+        labels = [conv(d) for d in data[n_in:]]
         return inputs, labels
 
     def train_batch(self, inputs, labels=None, update=True):
@@ -429,6 +445,19 @@ class Model:
         if accumulate_grad_batches != 1:
             raise NotImplementedError(
                 "gradient accumulation lands with the fleet hybrid optimizer")
+        if self._is_multiprocess(self._dp_shardings()[0]):
+            # fail BEFORE training, not one epoch in (multi-controller
+            # limits are knowable here)
+            if eval_data is not None:
+                raise NotImplementedError(
+                    "fit(eval_data=...) in the multi-controller regime is "
+                    "not supported yet; evaluate on rank-local data with a "
+                    "single-process Model")
+            if self._metrics:
+                raise NotImplementedError(
+                    "metrics in the multi-controller regime are not "
+                    "supported yet; compute metrics on rank-local eval "
+                    "data instead")
         train_loader = self._make_loader(train_data, batch_size, shuffle,
                                          num_workers, drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False,

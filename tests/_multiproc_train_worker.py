@@ -115,8 +115,8 @@ def main():
 
 
 def main_hapi():
-    """Model.fit in the multi-controller regime: per-host DataLoader shard
-    in, global arrays assembled inside train_batch."""
+    """Model.fit ITSELF in the multi-controller regime: per-host DataLoader
+    shard in, global arrays assembled inside the fit loop."""
     assert jax.process_count() == 2
     rank = jax.process_index()
 
@@ -126,22 +126,22 @@ def main_hapi():
                                 parameters=model_net.parameters())
     model = paddle.Model(wrapped)
     from paddle_tpu import nn as pnn
+    from paddle_tpu.hapi.callbacks import Callback
 
     model.prepare(optimizer=opt, loss=pnn.MSELoss())
+
+    class PrintLoss(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            print(f"rank={rank} hapi_step={step + 1} "
+                  f"loss={float(np.sum(logs['loss'])):.6f}", flush=True)
 
     ds = SynthDS()
     sampler = DistributedBatchSampler(ds, batch_size=LOCAL_BS,
                                       num_replicas=2, rank=rank,
                                       shuffle=False)
     loader = DataLoader(ds, batch_sampler=sampler)
-    t = 0
-    for xb, yb in loader:
-        t += 1
-        if t > STEPS:
-            break
-        losses = model.train_batch([xb], [yb])
-        print(f"rank={rank} hapi_step={t} "
-              f"loss={float(np.sum(losses[0])):.6f}", flush=True)
+    model.fit(loader, epochs=1, num_iters=STEPS, verbose=0,
+              callbacks=[PrintLoss()])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,137 @@
+"""OpTest harness — SURVEY §4 row 1 (ref: test/legacy_test/op_test.py,
+upstream layout, unverified — mount empty).
+
+Upstream's OpTest runs every op through dygraph AND static graph against a
+NumPy reference, checks analytic gradients against finite differences, and
+sweeps dtypes. The same contract here, over the registry dispatch:
+
+- eager:   the paddle.tensor function (tape dispatch) vs the NumPy ref;
+- static:  the op captured into a Program and replayed by the Executor;
+- jit:     the compiled functional path (to_static-style jax.jit);
+- grad:    Tensor.backward() analytic grads vs central finite differences;
+- dtypes:  float32 exact-ish, bfloat16 forward at loose tolerance.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.core.dispatch import apply_op
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import get_op
+
+
+class OpTest:
+    rtol = 1e-5
+    atol = 1e-6
+    grad_rtol = 2e-2
+    grad_atol = 2e-3
+    fd_eps = 1e-3
+    bf16_rtol = 5e-2
+    bf16_atol = 5e-2
+
+    def __init__(self, op_name: str, np_ref, inputs, kwargs=None,
+                 check_grad: bool = True, bf16: bool = True):
+        """inputs: list of float32 numpy arrays (positional tensor args);
+        kwargs: non-tensor attrs; np_ref(*inputs, **kwargs) -> ndarray."""
+        self.op_name = op_name
+        self.np_ref = np_ref
+        self.inputs = [np.asarray(a, np.float32) for a in inputs]
+        self.kwargs = dict(kwargs or {})
+        self.check_grad = check_grad
+        self.bf16 = bf16
+        self.opdef = get_op(op_name)
+
+    # ------------------------------------------------------------- helpers
+    def _apply(self, arrays):
+        return apply_op(self.opdef,
+                        *[Tensor(paddle.to_tensor(a)._data)
+                          for a in arrays], **self.kwargs)
+
+    def _expect(self):
+        return np.asarray(self.np_ref(*self.inputs, **self.kwargs),
+                          np.float32)
+
+    # -------------------------------------------------------------- checks
+    def check_eager(self):
+        out = self._apply(self.inputs)
+        np.testing.assert_allclose(np.asarray(out.numpy()), self._expect(),
+                                   rtol=self.rtol, atol=self.atol,
+                                   err_msg=f"{self.op_name}: eager")
+
+    def check_static(self):
+        main = static.Program()
+        static.enable_static()
+        try:
+            with static.program_guard(main, static.Program()):
+                feeds = [static.data(f"x{i}", list(a.shape), "float32")
+                         for i, a in enumerate(self.inputs)]
+                out = apply_op(self.opdef, *feeds, **self.kwargs)
+        finally:
+            static.disable_static()
+        got = static.Executor().run(
+            main, feed={f"x{i}": a for i, a in enumerate(self.inputs)},
+            fetch_list=[out])[0]
+        np.testing.assert_allclose(got, self._expect(), rtol=self.rtol,
+                                   atol=self.atol,
+                                   err_msg=f"{self.op_name}: static")
+
+    def check_jit(self):
+        import jax
+
+        def fn(*arrs):
+            return self._apply(arrs)._data
+
+        got = jax.jit(fn)(*self.inputs)
+        np.testing.assert_allclose(np.asarray(got), self._expect(),
+                                   rtol=self.rtol, atol=self.atol,
+                                   err_msg=f"{self.op_name}: jit")
+
+    def check_grads(self):
+        ts = []
+        for a in self.inputs:
+            t = paddle.to_tensor(a)
+            t.stop_gradient = False
+            ts.append(t)
+        out = apply_op(self.opdef, *ts, **self.kwargs)
+        out.sum().backward()
+        analytic = [np.asarray(t.grad.numpy()) if t.grad is not None
+                    else np.zeros_like(a)
+                    for t, a in zip(ts, self.inputs)]
+
+        for idx, base in enumerate(self.inputs):
+            fd = np.zeros_like(base)
+            flat = base.reshape(-1)
+            for j in range(flat.size):
+                for sgn in (+1, -1):
+                    pert = flat.copy()
+                    pert[j] += sgn * self.fd_eps
+                    args = list(self.inputs)
+                    args[idx] = pert.reshape(base.shape)
+                    val = float(np.sum(np.asarray(
+                        self.np_ref(*args, **self.kwargs), np.float64)))
+                    fd.reshape(-1)[j] += sgn * val / (2 * self.fd_eps)
+            np.testing.assert_allclose(
+                analytic[idx], fd, rtol=self.grad_rtol,
+                atol=self.grad_atol,
+                err_msg=f"{self.op_name}: grad of input {idx}")
+
+    def check_bf16(self):
+        import jax.numpy as jnp
+
+        arrays = [Tensor(jnp.asarray(a, jnp.bfloat16)) for a in self.inputs]
+        out = apply_op(self.opdef, *arrays, **self.kwargs)
+        np.testing.assert_allclose(
+            np.asarray(out._data, np.float32), self._expect(),
+            rtol=self.bf16_rtol, atol=self.bf16_atol,
+            err_msg=f"{self.op_name}: bf16")
+
+    def run(self):
+        self.check_eager()
+        self.check_static()
+        self.check_jit()
+        if self.check_grad:
+            self.check_grads()
+        if self.bf16:
+            self.check_bf16()

@@ -80,3 +80,87 @@ def test_op(name, ref, inputs, kwargs):
     grad_free = {"clip"}   # kink at the clip boundary breaks fin-diff rows
     OpTest(name, ref, inputs, kwargs,
            check_grad=name not in grad_free).run()
+
+
+D = np.abs(R.randn(3, 4)).astype(np.float32) + 0.5
+
+
+def _softplus(x):
+    return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+
+
+CASES2 = [
+    ("elementwise_pow", lambda x, y: x ** y, [D, np.full((3, 4), 2.0,
+                                                         np.float32)], {}),
+    ("atan2", np.arctan2, [A, B], {}),
+    ("hypot", np.hypot, [A, B], {}),
+    ("heaviside", np.heaviside, [A, D], {}),
+    ("copysign", np.copysign, [D, A], {}),
+    ("logaddexp", np.logaddexp, [A, B], {}),
+    ("relu", lambda x: np.maximum(x, 0), [A + 0.05], {}),
+    ("relu6", lambda x: np.clip(x, 0, 6), [A * 4 + 0.05], {}),
+    ("softplus", _softplus, [A], {}),
+    ("mish", lambda x: x * np.tanh(_softplus(x)), [A], {}),
+    ("hardtanh", lambda x: np.clip(x, -1, 1), [A * 2 + 0.03], {}),
+    ("leaky_relu", lambda x, negative_slope=0.01:
+        np.where(x > 0, x, negative_slope * x), [A + 0.05], {}),
+    ("elu", lambda x, alpha=1.0:
+        np.where(x > 0, x, alpha * (np.exp(x) - 1)), [A + 0.05], {}),
+    ("selu", None, [A + 0.05], {}),
+    ("gelu", None, [A], {}),
+    ("silu", lambda x: x / (1 + np.exp(-x)), [A], {}),
+    ("log_softmax", None, [A], {"axis": -1}),
+    ("max", lambda x, axis: np.max(x, axis=axis), [A], {"axis": 1}),
+    ("min", lambda x, axis: np.min(x, axis=axis), [A], {"axis": 1}),
+    ("prod", lambda x: np.prod(x), [C], {}),
+    ("std", None, [A], {}),
+    ("var", None, [A], {}),
+    ("amax", lambda x: np.max(x), [A], {}),
+    ("amin", lambda x: np.min(x), [A], {}),
+    ("cumsum", lambda x, axis: np.cumsum(x, axis=axis), [A], {"axis": 1}),
+    ("cumprod", lambda x, dim: np.cumprod(x, axis=dim), [C], {"dim": 1}),
+    ("flip", lambda x, axis: np.flip(x, axis), [A], {"axis": [1]}),
+    ("roll", lambda x, shifts, axis: np.roll(x, shifts, axis), [A],
+     {"shifts": 2, "axis": 1}),
+    ("tril", np.tril, [A], {}),
+    ("triu", np.triu, [A], {}),
+    ("kron", np.kron, [M1[:2, :2], M2[:2, :2]], {}),
+    ("outer", np.outer, [A[0], B[0]], {}),
+    ("trace_op", lambda x: np.trace(x), [M1[:3, :3]], {}),
+    ("logcumsumexp", None, [A], {"axis": 1}),
+    ("nan_to_num", lambda x: np.nan_to_num(x), [A], {}),
+    ("deg2rad", np.deg2rad, [A * 90], {}),
+    ("rad2deg", np.rad2deg, [A], {}),
+]
+
+
+def _fill_refs2():
+    import scipy.special as sp
+
+    _SELU_L, _SELU_A = 1.0507009873554805, 1.6732632423543772
+    refs = {
+        "selu": lambda x: _SELU_L * np.where(
+            x > 0, x, _SELU_A * (np.exp(x) - 1)),
+        "gelu": lambda x: 0.5 * x * (1 + sp.erf(x / np.sqrt(2))),
+        "log_softmax": lambda x, axis=-1:
+            x - sp.logsumexp(x, axis=axis, keepdims=True),
+        "std": lambda x: np.std(x, ddof=1),
+        "var": lambda x: np.var(x, ddof=1),
+        "logcumsumexp": lambda x, axis:
+            np.log(np.cumsum(np.exp(x), axis=axis)),
+    }
+    out = []
+    for name, ref, inputs, kwargs in CASES2:
+        out.append((name, ref or refs[name], inputs, kwargs))
+    return out
+
+
+@pytest.mark.parametrize(
+    "name,ref,inputs,kwargs",
+    _fill_refs2(), ids=[c[0] for c in CASES2])
+def test_op_batch2(name, ref, inputs, kwargs):
+    # kinked/selective ops: finite differences cross the non-smooth point
+    grad_free = {"heaviside", "max", "min", "amax", "amin", "prod",
+                 "nan_to_num", "copysign"}
+    OpTest(name, ref, inputs, kwargs,
+           check_grad=name not in grad_free).run()

@@ -97,10 +97,23 @@ def _sample(logits, key, temperature, top_k, top_p):
 
 def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
              top_k=0, top_p=1.0, eos_token_id: Optional[int] = None,
-             seed: Optional[int] = None, cache_dtype=jnp.float32):
+             seed: Optional[int] = None, cache_dtype=jnp.float32,
+             num_beams: int = 1, length_penalty: float = 0.0):
     """Autoregressive generation. input_ids: Tensor/array (b, prompt_len).
     Returns a Tensor (b, prompt_len + max_new_tokens) of token ids; rows
-    that hit `eos_token_id` are padded with eos afterwards."""
+    that hit `eos_token_id` are padded with eos afterwards.
+
+    num_beams > 1 selects beam search (greedy within beams; temperature/
+    top_k/top_p are sampling knobs and must stay at their defaults)."""
+    if num_beams > 1:
+        # temperature 0.0 (the library's greedy spelling) and 1.0 are both
+        # fine — beam search is greedy within beams either way
+        if temperature not in (0.0, 1.0) or top_k or top_p != 1.0:
+            raise ValueError(
+                "beam search (num_beams>1) does not combine with "
+                "temperature/top_k/top_p sampling")
+        return _beam_generate(model, input_ids, max_new_tokens, num_beams,
+                              eos_token_id, cache_dtype, length_penalty)
     was_training = model.training
     model.eval()
     try:
@@ -180,3 +193,154 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
     finally:
         if was_training:
             model.train()
+
+
+# ------------------------------------------------------------- beam search
+
+def _beam_generate(model, input_ids, max_new_tokens, num_beams,
+                   eos_token_id, cache_dtype, length_penalty):
+    """Beam search over the same static-shape KV cache: beams ride the
+    batch axis (b*k rows), each decode step is ONE jitted call — sample,
+    score, and beam-reorder (a cache gather over the batch axis) all
+    happen on device; the host loop only counts steps.
+
+    Scores are summed token log-probs; finished beams (eos) are frozen
+    and keep emitting eos with no score change. Final ranking divides by
+    length**length_penalty (0.0 = raw sum, paddle's default shape)."""
+    was_training = model.training
+    model.eval()
+    try:
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, prompt_len = ids.shape
+        k = int(num_beams)
+        total = prompt_len + max_new_tokens
+        params, buffers = extract_state(model)
+        caches = init_caches(model, b * k, total, cache_dtype)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        cache_key = ("beam", b, k, prompt_len, total,
+                     jnp.dtype(cache_dtype).name, eos)
+        jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
+        if cache_key not in jit_cache:
+            def prefill(params, buffers, ids_rep, caches):
+                (logits, new_caches), _ = call_functional(
+                    model, params, buffers, (Tensor(ids_rep),),
+                    kwargs={"caches": caches, "start_pos": 0},
+                    training=False)
+                logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+                # row-major beams: batch i occupies rows [i*k, (i+1)*k).
+                # All k beams are identical after prefill, so beam 0 keeps
+                # its top-k candidates and the rest start at -inf (else the
+                # first step would pick k copies of the same argmax)
+                lp = logp.reshape(b, k, -1)
+                mask = jnp.where(jnp.arange(k)[None, :, None] == 0,
+                                 0.0, -jnp.inf)
+                tok, scores, beam_idx = _beam_select(lp + mask)
+                return tok, scores, beam_idx, new_caches
+
+            def decode(params, buffers, token, caches, pos, scores,
+                       finished):
+                (logits, new_caches), _ = call_functional(
+                    model, params, buffers, (Tensor(token[:, None]),),
+                    kwargs={"caches": caches, "start_pos": pos},
+                    training=False)
+                logp = jax.nn.log_softmax(
+                    logits[:, 0].astype(jnp.float32)).reshape(b, k, -1)
+                if eos >= 0:
+                    # a finished beam contributes exactly one continuation:
+                    # eos at zero cost (keeps its score; others -inf)
+                    vocab = logp.shape[-1]
+                    frozen = jnp.where(
+                        jnp.arange(vocab)[None, None, :] == eos, 0.0,
+                        -jnp.inf)
+                    logp = jnp.where(finished.reshape(b, k)[..., None],
+                                     frozen, logp)
+                tok, new_scores, beam_idx = _beam_select(
+                    logp + scores.reshape(b, k)[..., None])
+                flat_src = (jnp.arange(b)[:, None] * k
+                            + beam_idx).reshape(-1)
+                new_caches = [(kc[flat_src], vc[flat_src])
+                              for kc, vc in new_caches]
+                new_finished = finished
+                if eos >= 0:
+                    new_finished = (finished.reshape(b, k)[
+                        jnp.arange(b)[:, None], beam_idx].reshape(-1)
+                        | (tok.reshape(-1) == eos))
+                return (tok.reshape(-1), new_scores.reshape(-1),
+                        flat_src, new_caches, new_finished)
+
+            jit_cache[cache_key] = (jax.jit(prefill),
+                                    jax.jit(decode, donate_argnums=(3,)))
+        prefill_j, decode_j = jit_cache[cache_key]
+
+        ids_rep = jnp.repeat(ids, k, axis=0)           # (b*k, prompt)
+        tok, scores, beam_idx, caches = prefill_j(params, buffers, ids_rep,
+                                                  caches)
+        prev_tok = tok.reshape(-1)
+        scores = scores.reshape(-1)
+        finished = (prev_tok == eos) if eos >= 0 else \
+            jnp.zeros((b * k,), bool)
+        histories = [prev_tok[:, None]]                # per-step columns
+        reorders = []                                  # per-step beam srcs
+
+        _EOS_POLL = 16
+        for step in range(1, max_new_tokens):
+            prev_tok, scores, flat_src, caches, finished = decode_j(
+                params, buffers, prev_tok, caches,
+                jnp.int32(prompt_len + step - 1), scores, finished)
+            reorders.append(flat_src)
+            histories.append(prev_tok[:, None])
+            if (eos >= 0 and step % _EOS_POLL == 0
+                    and bool(np.asarray(finished).all())):
+                break   # history length tracks the early exit
+
+        # reconstruct each surviving beam's token history by walking the
+        # reorder chain backwards (beams swap parents every step)
+        cols = [histories[-1]]
+        src = jnp.arange(b * k)
+        for step in range(len(reorders) - 1, -1, -1):
+            src = reorders[step][src]
+            cols.append(histories[step][src])
+        cols.reverse()
+        gen = jnp.concatenate(cols, axis=1)            # (b*k, steps_run)
+        if gen.shape[1] < max_new_tokens and eos >= 0:
+            gen = jnp.concatenate(
+                [gen, jnp.full((b * k, max_new_tokens - gen.shape[1]),
+                               eos, gen.dtype)], axis=1)
+
+        lengths = (jnp.argmax(gen == eos, axis=1) + 1
+                   if eos >= 0 else jnp.full((b * k,), gen.shape[1]))
+        lengths = jnp.where((gen == eos).any(axis=1) if eos >= 0
+                            else jnp.zeros((b * k,), bool),
+                            lengths, gen.shape[1])
+        ranked = scores / jnp.maximum(
+            lengths.astype(jnp.float32), 1.0) ** length_penalty
+        best = jnp.argmax(ranked.reshape(b, k), axis=1)
+        rows = jnp.arange(b) * k + best
+        out = jnp.concatenate([ids, gen[rows].astype(ids.dtype)], axis=1)
+        if eos >= 0:
+            # pad everything after the first eos with eos
+            gen_best = gen[rows]
+            hit = jnp.cumsum(gen_best == eos, axis=1) > 0
+            after = jnp.concatenate(
+                [jnp.zeros((b, 1), bool), hit[:, :-1]], axis=1)
+            gen_best = jnp.where(after, eos, gen_best)
+            out = jnp.concatenate([ids, gen_best.astype(ids.dtype)],
+                                  axis=1)
+        return Tensor(out)
+    finally:
+        if was_training:
+            model.train()
+
+
+def _beam_select(scored):
+    """(b, k, V) cumulative scores -> top-k over the flattened k*V
+    continuations: returns tokens (b, k), scores (b, k), parent beam
+    indices (b, k)."""
+    b, k, v = scored.shape
+    flat = scored.reshape(b, k * v)
+    top_s, top_i = jax.lax.top_k(flat, k)
+    return top_i % v, top_s, top_i // v

@@ -118,3 +118,85 @@ class TestSampling:
         assert out.shape == (1, 10)
         # after the first eos, everything is eos
         assert (out[0, 4:] == eos).all()
+
+
+class TestBeamSearch:
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(3)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, intermediate_size=64,
+                          max_position_embeddings=64)
+        return LlamaForCausalLM(cfg), cfg
+
+    def _seq_logprob(self, model, seq, prompt_len):
+        """Rescoring: sum of token log-probs of seq[prompt_len:]."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.jit.functional import call_functional, extract_state
+        from paddle_tpu.models.generation import init_caches
+
+        params, buffers = extract_state(model)
+        caches = init_caches(model, 1, seq.shape[0])
+        (logits, _), _ = call_functional(
+            model, params, buffers, (paddle.to_tensor(seq[None]),),
+            kwargs={"caches": caches, "start_pos": 0}, training=False)
+        logp = jax.nn.log_softmax(np.asarray(logits[0], np.float32), axis=-1)
+        total = 0.0
+        for t in range(prompt_len - 1, seq.shape[0] - 1):
+            total += float(logp[t, int(seq[t + 1])])
+        return total
+
+    def test_beam1_equals_greedy(self):
+        from paddle_tpu.models.generation import generate
+
+        model, _ = self._model()
+        prompt = np.array([[1, 5, 9]], np.int64)
+        greedy = generate(model, prompt, max_new_tokens=6,
+                          temperature=0.0).numpy()
+        beam1 = generate(model, prompt, max_new_tokens=6,
+                         num_beams=1, temperature=0.0).numpy()
+        np.testing.assert_array_equal(greedy, beam1)
+
+    def test_beam_search_not_worse_than_greedy(self):
+        """Property: the beam-4 sequence's total log-prob is >= greedy's
+        (beam search explores a superset of greedy's single path)."""
+        from paddle_tpu.models.generation import generate
+
+        model, _ = self._model()
+        prompt = np.array([[2, 7, 11, 3]], np.int64)
+        pl = prompt.shape[1]
+        greedy = generate(model, prompt, max_new_tokens=5,
+                          temperature=0.0).numpy()[0]
+        beam = generate(model, prompt, max_new_tokens=5,
+                        num_beams=4).numpy()[0]
+        lp_g = self._seq_logprob(model, greedy, pl)
+        lp_b = self._seq_logprob(model, beam, pl)
+        assert lp_b >= lp_g - 1e-4, (lp_b, lp_g)
+
+    def test_beam_batch_and_eos(self):
+        from paddle_tpu.models.generation import generate
+
+        model, _ = self._model()
+        prompt = np.array([[1, 2], [3, 4]], np.int64)
+        out = generate(model, prompt, max_new_tokens=4, num_beams=3,
+                       eos_token_id=0).numpy()
+        assert out.shape == (2, 6)
+        # once eos appears, everything after stays eos
+        for row in out:
+            gen = row[2:]
+            if (gen == 0).any():
+                first = int(np.argmax(gen == 0))
+                assert (gen[first:] == 0).all()
+
+    def test_beam_rejects_sampling_knobs(self):
+        from paddle_tpu.models.generation import generate
+
+        model, _ = self._model()
+        with pytest.raises(ValueError, match="beam search"):
+            generate(model, np.array([[1]], np.int64), num_beams=2,
+                     top_k=5)

@@ -127,11 +127,15 @@ def _flash_block(qd, kd, vd, scale, causal, my, src, _):
 
 def ulysses_attention(q, k, v, group=None, causal: bool = False,
                       axis_name: Optional[str] = None,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      impl: Optional[str] = None,
+                      interpret: bool = False):
     """Ulysses: all_to_all seq<->heads, full-sequence attention, reshard back.
 
     Inputs [b, h, s_local, d] sharded on seq inside shard_map; heads must be
-    divisible by the axis size.
+    divisible by the axis size. The full-sequence attention on each head
+    slice runs the Pallas flash kernel on TPU (impl="pallas" to force,
+    "xla" for the materialized reference).
     """
     qd, kd, vd = _unwrap(q), _unwrap(k), _unwrap(v)
     name = axis_name or (group.axis_name if group is not None else "sep")
@@ -156,7 +160,22 @@ def ulysses_attention(q, k, v, group=None, causal: bool = False,
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(qd), seq_to_heads(kd), seq_to_heads(vd)
-    out = _flash_block(qh, kh, vh, scale, causal, 0, 0, None)
+    from ....ops import pallas_kernels as _pk
+
+    default_scale = abs(scale - qd.shape[-1] ** -0.5) < 1e-12
+    if impl == "pallas" and not default_scale:
+        raise ValueError(
+            "ulysses impl='pallas' supports the default 1/sqrt(d) scale "
+            "only; drop the custom scale or use impl='xla'")
+    use_pallas = default_scale and (impl == "pallas" or (
+        impl is None and _pk._on_tpu() and 8 <= qd.shape[-1] <= 256))
+    if use_pallas:
+        # full-sequence flash on the head slice: (b,h,s,d) matches the
+        # kernel's padded layout directly; vma declared for shard_map
+        out = _pk._fwd_flash_for_ulysses(qh, kh, vh, scale, causal, name,
+                                         interpret)
+    else:
+        out = _flash_block(qh, kh, vh, scale, causal, 0, 0, None)
     out = heads_to_seq(out.astype(qd.dtype))
     return Tensor(out) if isinstance(q, Tensor) else out
 

@@ -319,18 +319,15 @@ def _beam_generate(model, input_ids, max_new_tokens, num_beams,
         ranked = scores / jnp.maximum(
             lengths.astype(jnp.float32), 1.0) ** length_penalty
         best = jnp.argmax(ranked.reshape(b, k), axis=1)
-        rows = jnp.arange(b) * k + best
-        out = jnp.concatenate([ids, gen[rows].astype(ids.dtype)], axis=1)
+        gen_best = gen[jnp.arange(b) * k + best]
         if eos >= 0:
             # pad everything after the first eos with eos
-            gen_best = gen[rows]
             hit = jnp.cumsum(gen_best == eos, axis=1) > 0
             after = jnp.concatenate(
                 [jnp.zeros((b, 1), bool), hit[:, :-1]], axis=1)
             gen_best = jnp.where(after, eos, gen_best)
-            out = jnp.concatenate([ids, gen_best.astype(ids.dtype)],
-                                  axis=1)
-        return Tensor(out)
+        return Tensor(jnp.concatenate(
+            [ids, gen_best.astype(ids.dtype)], axis=1))
     finally:
         if was_training:
             model.train()

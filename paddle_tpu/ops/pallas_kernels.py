@@ -518,7 +518,7 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
 def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
                mask_h_is_one: bool, mask_q_is_one: bool, sk: int,
                real_d: int, mask_needs_grad: bool, dropout_p: float,
-               interpret: bool):
+               interpret: bool, vma=None):
     """custom_vjp'd padded-layout flash attention, specialized per config.
     `real_d` is the unpadded head dim — it sets the softmax scale. When
     `mask_needs_grad`, the dq kernel additionally emits d(mask)=ds blocks
@@ -535,7 +535,7 @@ def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
                     block_q=min(_BLOCK_Q, qt.shape[2]),
                     block_k=min(_BLOCK_K, kt.shape[2]),
                     dropout_p=dropout_p,
-                    interpret=interpret)
+                    interpret=interpret, vma=vma)
 
     @jax.custom_vjp
     def f(qt, kt, vt, mask, seed):
@@ -982,4 +982,27 @@ def ring_flash_attention_pallas(q, k, v, axis_name: str, causal=False,
     f = _ring_vjp(axis_name, n, bool(causal), float(scale), s,
                   block_q, block_k, bool(interpret))
     out = f(padp(q), padp(k), padp(v))
+    return out[:, :, :s, :d]
+
+
+def _fwd_flash_for_ulysses(q, k, v, scale, causal, axis_name, interpret):
+    """Full-sequence flash for the Ulysses head slice: inputs already in
+    the kernel's (b, h, s, d) layout inside shard_map over `axis_name`.
+    Differentiable (the standard flash custom vjp); only the default
+    1/sqrt(d) scale is expressible — callers with a custom scale use the
+    XLA reference path."""
+    b, h, s, d = q.shape
+    if abs(float(scale) - d ** -0.5) > 1e-12:
+        raise ValueError("pallas ulysses path supports the default scale")
+    block = max(_pick_block(s, _BLOCK_Q), _pick_block(s, _BLOCK_K))
+    S = _round_up(s, block)
+    d_p = _round_up(d, 128)
+
+    def padp(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, S - s), (0, d_p - d)))
+
+    f = _flash_vjp(bool(causal), False, True, True, True, s, d, False,
+                   0.0, bool(interpret), vma=(axis_name,))
+    out = f(padp(q), padp(k), padp(v), jnp.zeros((1, 1, 1, 1), jnp.float32),
+            jnp.zeros((1,), jnp.int32))
     return out[:, :, :s, :d]

@@ -162,21 +162,53 @@ class TestBeamSearch:
                          num_beams=1, temperature=0.0).numpy()
         np.testing.assert_array_equal(greedy, beam1)
 
-    def test_beam_search_not_worse_than_greedy(self):
-        """Property: the beam-4 sequence's total log-prob is >= greedy's
-        (beam search explores a superset of greedy's single path)."""
+    def test_beam_search_exhaustive_oracle(self):
+        """With beam width >= V^(T-1) nothing is ever pruned, so beam
+        search must return exactly the argmax sequence over ALL V^T
+        continuations (computed by teacher-forcing every candidate)."""
+        import itertools
+
         from paddle_tpu.models.generation import generate
 
         model, _ = self._model()
-        prompt = np.array([[2, 7, 11, 3]], np.int64)
+        prompt = np.array([[2, 7, 3]], np.int64)
         pl = prompt.shape[1]
-        greedy = generate(model, prompt, max_new_tokens=5,
-                          temperature=0.0).numpy()[0]
-        beam = generate(model, prompt, max_new_tokens=5,
-                        num_beams=4).numpy()[0]
-        lp_g = self._seq_logprob(model, greedy, pl)
-        lp_b = self._seq_logprob(model, beam, pl)
-        assert lp_b >= lp_g - 1e-4, (lp_b, lp_g)
+        T = 2                               # 64^2 = 4096 candidates
+        k = model.llama.config.vocab_size   # width 64: exhaustive for T=2
+        beam = generate(model, prompt, max_new_tokens=T,
+                        num_beams=k).numpy()[0]
+
+        best_lp, best_seq = -np.inf, None
+        vocab = model.llama.config.vocab_size
+        for t1 in range(vocab):
+            # score all (t1, t2) pairs in one teacher-forced pass per t1
+            seq_base = np.concatenate([prompt[0], [t1, 0]])
+            # logprob of t1 and distribution over t2 from one pass
+            lp1 = self._seq_logprob(model, seq_base[:pl + 1], pl)
+            lp2 = self._next_logprobs(model, seq_base[:pl + 1])
+            t2 = int(np.argmax(lp2))
+            lp = lp1 + float(lp2[t2])
+            if lp > best_lp:
+                best_lp = lp
+                best_seq = np.concatenate([prompt[0], [t1, t2]])
+        np.testing.assert_array_equal(beam, best_seq)
+        np.testing.assert_allclose(self._seq_logprob(model, beam, pl),
+                                   best_lp, rtol=1e-4)
+
+    def _next_logprobs(self, model, seq):
+        """log-softmax over the next token after `seq`."""
+        import jax
+
+        from paddle_tpu.jit.functional import call_functional, extract_state
+        from paddle_tpu.models.generation import init_caches
+
+        params, buffers = extract_state(model)
+        caches = init_caches(model, 1, seq.shape[0] + 1)
+        (logits, _), _ = call_functional(
+            model, params, buffers, (paddle.to_tensor(seq[None]),),
+            kwargs={"caches": caches, "start_pos": 0}, training=False)
+        return np.asarray(jax.nn.log_softmax(
+            np.asarray(logits[0, -1], np.float32)))
 
     def test_beam_batch_and_eos(self):
         from paddle_tpu.models.generation import generate

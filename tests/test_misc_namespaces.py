@@ -90,8 +90,10 @@ class TestHub:
 
 
 class TestOnnx:
-    def test_export_gated(self):
-        with pytest.raises(RuntimeError, match="paddle2onnx"):
+    def test_export_requires_input_spec(self):
+        # round 4: paddle.onnx.export is a real native exporter (see
+        # tests/test_onnx_export.py); the missing-spec error is loud
+        with pytest.raises(ValueError, match="input_spec"):
             paddle.onnx.export(None, "model.onnx")
 
 

@@ -141,3 +141,28 @@ def test_ring_step_kernel_lowers_on_tpu(rng):
                              keep_neg_inf_lse=True, **kw)
     assert float(jnp.max(jnp.abs(out_f.astype(jnp.float32)))) == 0.0
     assert bool(jnp.all(jnp.isneginf(lse_f)))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_pallas_matches_dense(causal, rng):
+    """Ulysses' full-sequence attention on the head slice runs the flash
+    kernel too (round 4): allclose vs dense over the 4-way sep mesh."""
+    from paddle_tpu.distributed.fleet.meta_parallel.ring_attention import (
+        ulysses_attention,
+    )
+
+    b, h, s, d = 1, 4, 32, 16   # heads divisible by sep=4
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
+
+    fn = jax.shard_map(
+        lambda a, b_, c: ulysses_attention(
+            a, b_, c, axis_name="sep", causal=causal, impl="pallas",
+            interpret=True),
+        mesh=_mesh(), in_specs=(P(None, None, "sep", None),) * 3,
+        out_specs=P(None, None, "sep", None), check_vma=False)
+    out = fn(q, k, v)
+    ref = _dense_ref(q, k, v, causal, d ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

@@ -164,3 +164,82 @@ def test_op_batch2(name, ref, inputs, kwargs):
                  "nan_to_num", "copysign"}
     OpTest(name, ref, inputs, kwargs,
            check_grad=name not in grad_free).run()
+
+
+CASES3 = [
+    ("equal", lambda x, y: x == y, [A, A.copy()], {}),
+    ("not_equal", lambda x, y: x != y, [A, B], {}),
+    ("less_than", lambda x, y: x < y, [A, B], {}),
+    ("less_equal", lambda x, y: x <= y, [A, B], {}),
+    ("greater_than", lambda x, y: x > y, [A, B], {}),
+    ("greater_equal", lambda x, y: x >= y, [A, B], {}),
+    ("isnan", np.isnan, [A], {}),
+    ("isinf", np.isinf, [A], {}),
+    ("isfinite", np.isfinite, [A], {}),
+    ("logical_and", np.logical_and, [C, D], {}),
+    ("logical_or", np.logical_or, [C, np.zeros_like(C)], {}),
+    ("logical_not", np.logical_not, [np.zeros_like(C)], {}),
+    ("logical_xor", np.logical_xor, [C, np.zeros_like(C)], {}),
+    ("sign", np.sign, [A + 0.05], {}),
+    ("floor", np.floor, [A * 3 + 0.03], {}),
+    ("ceil", np.ceil, [A * 3 + 0.03], {}),
+    ("round", None, [A * 3 + 0.03], {}),
+    ("trunc", np.trunc, [A * 3 + 0.03], {}),
+    ("frac", lambda x: x - np.trunc(x), [A * 3 + 0.03], {}),
+    ("expm1", np.expm1, [A], {}),
+    ("log1p", np.log1p, [D], {}),
+    ("log2", np.log2, [D], {}),
+    ("log10", np.log10, [D], {}),
+    ("asinh", np.arcsinh, [A], {}),
+    ("acosh", np.arccosh, [D + 1.0], {}),
+    ("atanh", np.arctanh, [C - 0.5], {}),
+    ("sinh", np.sinh, [A], {}),
+    ("cosh", np.cosh, [A], {}),
+    ("digamma", None, [D + 0.5], {}),
+    ("lgamma", None, [D + 0.5], {}),
+    ("i0", None, [A], {}),
+    ("sinc", None, [A], {}),
+    ("diag", np.diag, [A[0]], {}),
+    ("diagonal", lambda x: np.diagonal(x), [M1[:3, :3]], {}),
+    ("t", lambda x: x.T, [A], {}),
+    ("squeeze", lambda x, axis: np.squeeze(x, axis), [A[None]],
+     {"axis": 0}),
+    ("unsqueeze", lambda x, axis: np.expand_dims(x, axis), [A],
+     {"axis": 1}),
+    ("expand", None, [A[0:1]], {"shape": [3, 4]}),
+    ("tile", lambda x, repeat_times: np.tile(x, repeat_times), [A],
+     {"repeat_times": [2, 1]}),
+    ("broadcast_to", lambda x, shape: np.broadcast_to(x, shape), [A[0:1]],
+     {"shape": [3, 4]}),
+]
+
+
+def _fill_refs3():
+    import scipy.special as sp
+
+    refs = {
+        "round": lambda x: np.round(x),   # banker's rounding both sides
+        "digamma": sp.digamma,
+        "lgamma": sp.gammaln,
+        "i0": sp.i0,
+        "sinc": lambda x: np.sinc(x),
+        "expand": lambda x, shape: np.broadcast_to(x, shape),
+    }
+    out = []
+    for name, ref, inputs, kwargs in CASES3:
+        out.append((name, ref or refs[name], inputs, kwargs))
+    return out
+
+
+_NO_GRAD3 = {"equal", "not_equal", "less_than", "less_equal",
+             "greater_than", "greater_equal", "isnan", "isinf", "isfinite",
+             "logical_and", "logical_or", "logical_not", "logical_xor",
+             "sign", "floor", "ceil", "round", "trunc", "frac"}
+
+
+@pytest.mark.parametrize(
+    "name,ref,inputs,kwargs",
+    _fill_refs3(), ids=[c[0] for c in CASES3])
+def test_op_batch3(name, ref, inputs, kwargs):
+    OpTest(name, ref, inputs, kwargs, check_grad=name not in _NO_GRAD3,
+           bf16=name not in {"digamma", "lgamma", "acosh", "atanh"}).run()

@@ -123,10 +123,22 @@ class Engine:
             return outs[0] if isinstance(outs, (tuple, list)) else outs
 
         if opt is not None:
+            # ZeRO over the mesh's `sharding` axis: moments of replicated
+            # params are dim-0 sharded (rank-local optimizer state);
+            # TP-sharded params keep their moment layout. Outputs are
+            # pinned so sharded moments can't drift new_params' layout
+            # past the next call's in_shardings.
+            params0, _ = extract_state(model)
+            self._opt_state = opt.functional_state(params0)
+            opt_sh = self._opt_state_shardings(param_sh)
+            self._opt_state = jax.tree_util.tree_map(
+                jax.device_put, self._opt_state, opt_sh,
+                is_leaf=lambda x: isinstance(x, jax.Array))
             self._train_jit = jax.jit(
                 train_step,
-                in_shardings=(param_sh, repl, param_sh, repl, repl,
+                in_shardings=(param_sh, repl, opt_sh, repl, repl,
                               data_sh, data_sh),
+                out_shardings=(None, None, param_sh, repl, opt_sh),
                 donate_argnums=(0, 2))
         self._eval_jit = jax.jit(
             eval_step, in_shardings=(param_sh, repl, data_sh, data_sh))
@@ -134,6 +146,39 @@ class Engine:
             predict_step, in_shardings=(param_sh, repl, data_sh))
         self._extract_state = extract_state
         self._prepared = True
+
+    def _opt_state_shardings(self, param_sh):
+        """Per-slot placement: param-layout for TP-sharded params, ZeRO
+        dim-0 over the `sharding` axis for the rest (when the mesh has
+        one), replicated otherwise."""
+        from .fleet.meta_parallel.sharding import shard_leaf
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh
+        zero = ("sharding" in mesh.axis_names
+                and mesh.shape["sharding"] > 1)
+        repl = NamedSharding(mesh, P())
+
+        def slot_sh(psh, tp_sharded, v, pshape):
+            # slots are not guaranteed param-shaped (e.g. ASGD's history
+            # slot prepends a batch dim): the param spec only applies to a
+            # slot whose shape matches the param's
+            if tp_sharded:
+                return psh if getattr(v, "shape", None) == pshape else repl
+            if zero:
+                return shard_leaf(v, mesh, "sharding")
+            return repl
+
+        out = {}
+        for name, acc in self._opt_state.items():
+            psh = param_sh.get(name)
+            tp_sharded = psh is not None and any(tuple(psh.spec))
+            pshape = tuple(self._model.state_dict()[name].shape) \
+                if tp_sharded else None
+            out[name] = {slot: slot_sh(psh, tp_sharded, v, pshape)
+                         for slot, v in acc.items()}
+        return out
 
     # -------------------------------------------------------------- loops
     def _loader(self, data, batch_size, train=False):
@@ -163,9 +208,7 @@ class Engine:
         self.prepare()
         loader = self._loader(train_data, batch_size, train=True)
         params, buffers = self._extract_state(self._model)
-        if self._opt_state is None:
-            self._opt_state = jax.device_put(
-                self._opt.functional_state(params), self._param_sh)
+        # opt state is created and placed in prepare() (ZeRO-aware layout)
         try:
             for epoch in range(epochs):
                 for batch in loader:

@@ -127,18 +127,19 @@ class Engine:
             # params are dim-0 sharded (rank-local optimizer state);
             # TP-sharded params keep their moment layout. Outputs are
             # pinned so sharded moments can't drift new_params' layout
-            # past the next call's in_shardings.
+            # past the next call's in_shardings. Only the state's SHAPE
+            # structure is needed here (eval_shape, no allocation) — the
+            # real buffers materialize on first fit(), so an eval/predict-
+            # only Engine never pays the optimizer-state memory.
             params0, _ = extract_state(model)
-            self._opt_state = opt.functional_state(params0)
-            opt_sh = self._opt_state_shardings(param_sh)
-            self._opt_state = jax.tree_util.tree_map(
-                jax.device_put, self._opt_state, opt_sh,
-                is_leaf=lambda x: isinstance(x, jax.Array))
+            state_shapes = jax.eval_shape(opt.functional_state, params0)
+            self._opt_sh = self._opt_state_shardings(state_shapes, params0,
+                                                     param_sh)
             self._train_jit = jax.jit(
                 train_step,
-                in_shardings=(param_sh, repl, opt_sh, repl, repl,
+                in_shardings=(param_sh, repl, self._opt_sh, repl, repl,
                               data_sh, data_sh),
-                out_shardings=(None, None, param_sh, repl, opt_sh),
+                out_shardings=(None, None, param_sh, repl, self._opt_sh),
                 donate_argnums=(0, 2))
         self._eval_jit = jax.jit(
             eval_step, in_shardings=(param_sh, repl, data_sh, data_sh))
@@ -147,13 +148,13 @@ class Engine:
         self._extract_state = extract_state
         self._prepared = True
 
-    def _opt_state_shardings(self, param_sh):
-        """Per-slot placement: param-layout for TP-sharded params, ZeRO
-        dim-0 over the `sharding` axis for the rest (when the mesh has
-        one), replicated otherwise."""
-        from .fleet.meta_parallel.sharding import shard_leaf
-
+    def _opt_state_shardings(self, state_shapes, params0, param_sh):
+        """Per-slot placement over the state's ShapeDtypeStruct tree:
+        param-layout for TP-sharded params, ZeRO dim-0 over the `sharding`
+        axis for the rest (when the mesh has one), replicated otherwise."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .fleet.meta_parallel.sharding import shard_leaf
 
         mesh = self._mesh
         zero = ("sharding" in mesh.axis_names
@@ -165,20 +166,26 @@ class Engine:
             # slot prepends a batch dim): the param spec only applies to a
             # slot whose shape matches the param's
             if tp_sharded:
-                return psh if getattr(v, "shape", None) == pshape else repl
+                return psh if tuple(getattr(v, "shape", ())) == pshape \
+                    else repl
             if zero:
                 return shard_leaf(v, mesh, "sharding")
             return repl
 
         out = {}
-        for name, acc in self._opt_state.items():
+        for name, acc in state_shapes.items():
             psh = param_sh.get(name)
             tp_sharded = psh is not None and any(tuple(psh.spec))
-            pshape = tuple(self._model.state_dict()[name].shape) \
-                if tp_sharded else None
+            pshape = tuple(params0[name].shape) if tp_sharded else None
             out[name] = {slot: slot_sh(psh, tp_sharded, v, pshape)
                          for slot, v in acc.items()}
         return out
+
+    def _ensure_opt_state(self, params):
+        if self._opt_state is None:
+            self._opt_state = jax.tree_util.tree_map(
+                jax.device_put, self._opt.functional_state(params),
+                self._opt_sh, is_leaf=lambda x: isinstance(x, jax.Array))
 
     # -------------------------------------------------------------- loops
     def _loader(self, data, batch_size, train=False):
@@ -208,7 +215,7 @@ class Engine:
         self.prepare()
         loader = self._loader(train_data, batch_size, train=True)
         params, buffers = self._extract_state(self._model)
-        # opt state is created and placed in prepare() (ZeRO-aware layout)
+        self._ensure_opt_state(params)   # lazy: ZeRO-aware layout
         try:
             for epoch in range(epochs):
                 for batch in loader:

@@ -35,3 +35,11 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
 
 def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
     return norm(x, p=p, axis=axis, keepdim=keepdim)  # noqa: F821
+
+_qr_op = _make_fn("qr")
+
+
+def qr(x, mode="reduced", name=None):
+    """paddle.linalg.qr: (Q, R) for reduced/complete, bare R for 'r'."""
+    out = _qr_op(x, mode=mode)
+    return out[0] if mode == "r" else out

@@ -19,36 +19,6 @@ def matmul(x, y, transpose_x=False, transpose_y=False):
     return jnp.matmul(x, y)
 
 
-@register_op("bmm", amp_list="white")
-def bmm(x, y):
-    return jnp.matmul(x, y)
-
-
-@register_op("mm", amp_list="white")
-def mm(x, y):
-    return jnp.matmul(x, y)
-
-
-@register_op("dot")
-def dot(x, y):
-    return jnp.sum(x * y, axis=-1)
-
-
-@register_op("outer")
-def outer(x, y):
-    return jnp.outer(x, y)
-
-
-@register_op("inner")
-def inner(x, y):
-    return jnp.inner(x, y)
-
-
-@register_op("cross")
-def cross(x, y, axis=-1):
-    return jnp.cross(x, y, axis=axis)
-
-
 @register_op("t", inplace_view=True)
 def t(x):
     if x.ndim < 2:
@@ -76,20 +46,10 @@ def norm(x, p="fro", axis=None, keepdim=False):
     )
 
 
-@register_op("einsum", amp_list="white")
-def einsum(operands, equation):
-    return jnp.einsum(equation, *list(operands))
-
-
 @register_op("cholesky", amp_list="black")
 def cholesky(x, upper=False):
     l = jnp.linalg.cholesky(x)
     return jnp.swapaxes(l, -1, -2) if upper else l
-
-
-@register_op("qr", multi_output=True, amp_list="black")
-def qr(x, mode="reduced"):
-    return tuple(jnp.linalg.qr(x, mode=mode))
 
 
 @register_op("svd", multi_output=True, amp_list="black")
@@ -98,30 +58,10 @@ def svd(x, full_matrices=False):
     return u, s, jnp.swapaxes(vh, -1, -2)
 
 
-@register_op("inverse", amp_list="black")
-def inverse(x):
-    return jnp.linalg.inv(x)
-
-
-@register_op("pinv", amp_list="black")
-def pinv(x, rcond=1e-15):
-    return jnp.linalg.pinv(x, rtol=rcond)
-
-
-@register_op("det", amp_list="black")
-def det(x):
-    return jnp.linalg.det(x)
-
-
 @register_op("slogdet", multi_output=True, amp_list="black")
 def slogdet(x):
     sign, logabs = jnp.linalg.slogdet(x)
     return sign, logabs
-
-
-@register_op("matrix_power", amp_list="black")
-def matrix_power(x, n):
-    return jnp.linalg.matrix_power(x, n)
 
 
 @register_op("eigh", multi_output=True, amp_list="black")
@@ -130,33 +70,10 @@ def eigh(x, UPLO="L"):
     return w, v
 
 
-@register_op("solve", amp_list="black")
-def solve(x, y):
-    return jnp.linalg.solve(x, y)
-
-
-@register_op("triangular_solve", amp_list="black")
-def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
-    return lax.linalg.triangular_solve(
-        x, y, left_side=True, lower=not upper,
-        transpose_a=transpose, unit_diagonal=unitriangular,
-    )
-
-
 @register_op("lstsq", multi_output=True, amp_list="black")
 def lstsq(x, y, rcond=None):
     sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
     return sol, res, rank, sv
-
-
-@register_op("matrix_rank", amp_list="black")
-def matrix_rank(x, tol=None):
-    return jnp.linalg.matrix_rank(x, rtol=tol)
-
-
-@register_op("cond", amp_list="black")
-def cond(x, p=None):
-    return jnp.linalg.cond(x, p=p)
 
 
 @register_op("histogram")
@@ -166,11 +83,3 @@ def histogram(x, bins=100, min=0.0, max=0.0):
     return hist
 
 
-@register_op("mv", amp_list="white")
-def mv(x, vec):
-    return jnp.matmul(x, vec)
-
-
-@register_op("trace_op")
-def trace_op(x, offset=0, axis1=0, axis2=1):
-    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)

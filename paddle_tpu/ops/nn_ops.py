@@ -20,96 +20,10 @@ from .registry import register_op
 # ----------------------------------------------------------------- activations
 
 
-@register_op("relu")
-def relu(x):
-    return jax.nn.relu(x)
-
-
-@register_op("relu6")
-def relu6(x):
-    return jax.nn.relu6(x)
-
-
-@register_op("gelu")
-def gelu(x, approximate=False):
-    return jax.nn.gelu(x, approximate=bool(approximate))
-
-
-@register_op("silu")
-def silu(x):
-    return jax.nn.silu(x)
-
-
-@register_op("swish")
-def swish(x):
-    return jax.nn.silu(x)
-
-
-@register_op("leaky_relu")
-def leaky_relu(x, negative_slope=0.01):
-    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
-
-
-@register_op("elu")
-def elu(x, alpha=1.0):
-    return jax.nn.elu(x, alpha=alpha)
-
-
-@register_op("selu")
-def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
-    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
-
-
-@register_op("celu")
-def celu(x, alpha=1.0):
-    return jax.nn.celu(x, alpha=alpha)
-
-
-@register_op("hardswish")
-def hardswish(x):
-    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
-
-
-@register_op("hardsigmoid")
-def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
-    return jnp.clip(slope * x + offset, 0.0, 1.0)
-
-
-@register_op("hardtanh")
-def hardtanh(x, min=-1.0, max=1.0):
-    return jnp.clip(x, min, max)
-
-
-@register_op("hardshrink")
-def hardshrink(x, threshold=0.5):
-    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros_like(x))
-
-
-@register_op("softshrink")
-def softshrink(x, threshold=0.5):
-    return jnp.where(x > threshold, x - threshold,
-                     jnp.where(x < -threshold, x + threshold, jnp.zeros_like(x)))
-
-
-@register_op("tanhshrink")
-def tanhshrink(x):
-    return x - jnp.tanh(x)
-
-
-@register_op("mish")
-def mish(x):
-    return x * jnp.tanh(jax.nn.softplus(x))
-
-
 @register_op("softplus")
 def softplus(x, beta=1.0, threshold=20.0):
     bx = beta * x
     return jnp.where(bx > threshold, x, jax.nn.softplus(bx) / beta)
-
-
-@register_op("softsign")
-def softsign(x):
-    return jax.nn.soft_sign(x)
 
 
 @register_op("prelu")
@@ -127,21 +41,6 @@ def prelu(x, weight):
 def rrelu(x, lower=0.125, upper=1.0 / 3.0, training=False):
     slope = (lower + upper) / 2.0
     return jnp.where(x >= 0, x, slope * x)
-
-
-@register_op("softmax", amp_list="black")
-def softmax(x, axis=-1):
-    return jax.nn.softmax(x, axis=axis)
-
-
-@register_op("log_softmax", amp_list="black")
-def log_softmax(x, axis=-1):
-    return jax.nn.log_softmax(x, axis=axis)
-
-
-@register_op("glu")
-def glu(x, axis=-1):
-    return jax.nn.glu(x, axis=axis)
 
 
 @register_op("maxout")
@@ -714,11 +613,6 @@ def label_smooth(label, epsilon=0.1, prior_dist=None):
     return (1.0 - epsilon) * label + epsilon / n
 
 
-@register_op("square_error_cost")
-def square_error_cost(input, label):
-    return jnp.square(input - label)
-
-
 # ------------------------------------------------------------------ attention
 
 
@@ -929,12 +823,6 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
         d_neg = jnp.minimum(d_neg, dist(positive, negative))
     loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
     return _reduce_loss(loss, reduction)
-
-
-@register_op("log_loss")
-def log_loss(input, label, epsilon=1e-4):
-    return -label * jnp.log(input + epsilon) \
-        - (1.0 - label) * jnp.log(1.0 - input + epsilon)
 
 
 @register_op("dice_loss")

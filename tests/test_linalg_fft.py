@@ -153,3 +153,15 @@ class TestReviewRegressions:
         assert p.shape == [3, 3]
         p2, l2, u2 = linalg.lu_unpack(lu_p, piv, unpack_pivots=False)
         assert p2.shape == [0, 0] and l2.shape == [3, 3]
+
+
+def test_qr_mode_r_returns_bare_matrix(rng):
+    """Regression (review r4): mode='r' must return the (k, n) R matrix,
+    not a row-split tuple (jnp returns a bare array for mode='r' which
+    multi_output used to iterate)."""
+    a = rng.standard_normal((5, 3)).astype(np.float32)
+    r = linalg.qr(_t(a), mode="r")
+    assert tuple(r.shape) == (3, 3)
+    q, rr = linalg.qr(_t(a))
+    np.testing.assert_allclose(np.abs(r.numpy()), np.abs(rr.numpy()),
+                               rtol=1e-4, atol=1e-5)

@@ -122,3 +122,22 @@ def test_stage3_product_path_shards_params():
     shard = max(s.data.size * s.data.dtype.itemsize
                 for s in arr.addressable_shards)
     assert shard * 8 == full
+
+
+def test_stage2_with_amp_o1_trains():
+    """Feature interaction: ZeRO stage-2 + amp O1 through Model.fit —
+    grads constrained, loss finite and decreasing at bf16 tolerance."""
+    net = _build_net()
+    opt = paddle.optimizer.Adam(learning_rate=0.02,
+                                parameters=net.parameters())
+    wrapped, _ = group_sharded_parallel(net, opt, level="os_g")
+    model = paddle.Model(wrapped)
+    model.prepare(optimizer=opt, loss=nn.MSELoss(),
+                  amp_configs={"level": "O1"})
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 16).astype("float32")
+    y = rng.randn(32, 8).astype("float32")
+    losses = [float(np.sum(model.train_batch([x], [y])[0]))
+              for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

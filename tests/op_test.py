@@ -33,11 +33,16 @@ class OpTest:
 
     def __init__(self, op_name: str, np_ref, inputs, kwargs=None,
                  check_grad: bool = True, bf16: bool = True):
-        """inputs: list of float32 numpy arrays (positional tensor args);
-        kwargs: non-tensor attrs; np_ref(*inputs, **kwargs) -> ndarray."""
+        """inputs: list of numpy arrays (positional tensor args; integer
+        arrays keep their dtype — index operands — floats normalize to
+        float32); kwargs: non-tensor attrs; np_ref(*inputs, **kwargs) ->
+        ndarray."""
         self.op_name = op_name
         self.np_ref = np_ref
-        self.inputs = [np.asarray(a, np.float32) for a in inputs]
+        self.inputs = [
+            a if np.issubdtype(np.asarray(a).dtype, np.integer)
+            or np.asarray(a).dtype == bool
+            else np.asarray(a, np.float32) for a in map(np.asarray, inputs)]
         self.kwargs = dict(kwargs or {})
         self.check_grad = check_grad
         self.bf16 = bf16
@@ -65,7 +70,7 @@ class OpTest:
         static.enable_static()
         try:
             with static.program_guard(main, static.Program()):
-                feeds = [static.data(f"x{i}", list(a.shape), "float32")
+                feeds = [static.data(f"x{i}", list(a.shape), str(a.dtype))
                          for i, a in enumerate(self.inputs)]
                 out = apply_op(self.opdef, *feeds, **self.kwargs)
         finally:
@@ -92,7 +97,8 @@ class OpTest:
         ts = []
         for a in self.inputs:
             t = paddle.to_tensor(a)
-            t.stop_gradient = False
+            if np.issubdtype(a.dtype, np.floating):
+                t.stop_gradient = False
             ts.append(t)
         out = apply_op(self.opdef, *ts, **self.kwargs)
         out.sum().backward()
@@ -101,6 +107,8 @@ class OpTest:
                     for t, a in zip(ts, self.inputs)]
 
         for idx, base in enumerate(self.inputs):
+            if not np.issubdtype(base.dtype, np.floating):
+                continue
             fd = np.zeros_like(base)
             flat = base.reshape(-1)
             for j in range(flat.size):
@@ -120,7 +128,9 @@ class OpTest:
     def check_bf16(self):
         import jax.numpy as jnp
 
-        arrays = [Tensor(jnp.asarray(a, jnp.bfloat16)) for a in self.inputs]
+        arrays = [Tensor(jnp.asarray(
+            a, jnp.bfloat16 if np.issubdtype(a.dtype, np.floating)
+            else a.dtype)) for a in self.inputs]
         out = apply_op(self.opdef, *arrays, **self.kwargs)
         np.testing.assert_allclose(
             np.asarray(out._data, np.float32), self._expect(),

@@ -243,3 +243,68 @@ _NO_GRAD3 = {"equal", "not_equal", "less_than", "less_equal",
 def test_op_batch3(name, ref, inputs, kwargs):
     OpTest(name, ref, inputs, kwargs, check_grad=name not in _NO_GRAD3,
            bf16=name not in {"digamma", "lgamma", "acosh", "atanh"}).run()
+
+
+IDX1 = np.array([2, 0, 1], np.int64)
+IDX2 = np.array([[0, 2], [1, 3], [2, 0]], np.int64)
+MASK = (R.rand(3, 4) > 0.5)
+
+
+CASES4 = [
+    ("gather", lambda x, index: x[index], [A, IDX1], {}),
+    ("index_select", lambda x, index, axis:
+        np.take(x, index, axis=axis), [A, IDX1], {"axis": 1}),
+    ("take_along_axis", lambda x, indices, axis:
+        np.take_along_axis(x, indices, axis), [A, IDX2], {"axis": 1}),
+    ("where", lambda c, x, y: np.where(c, x, y), [MASK, A, B], {}),
+    ("masked_fill", lambda x, mask, value:
+        np.where(mask, value, x), [A, MASK], {"value": -1.0}),
+    ("index_sample", lambda x, index:
+        np.take_along_axis(x, index, 1), [A, IDX2], {}),
+    ("one_hot", None, [IDX1], {"num_classes": 4}),
+    ("tensor_unfold", None, [np.arange(8, dtype=np.float32)],
+     {"axis": 0, "size": 3, "step": 2}),
+    ("masked_scatter", None, [A, MASK, B], {}),
+    ("select_scatter", lambda x, values, axis, index:
+        _select_scatter_ref(x, values, axis, index),
+     [A, B[:, 0]], {"axis": 1, "index": 2}),
+]
+
+
+def _select_scatter_ref(x, values, axis, index):
+    out = x.copy()
+    out[:, index] = values
+    return out
+
+
+def _fill_refs4():
+    refs = {
+        "one_hot": lambda x, num_classes: np.eye(num_classes)[x],
+        "tensor_unfold": lambda x, axis, size, step: np.stack(
+            [x[i * step:i * step + size]
+             for i in range((x.shape[0] - size) // step + 1)]),
+        "masked_scatter": lambda x, mask, value:
+            _masked_scatter_ref(x, mask, value),
+    }
+    out = []
+    for name, ref, inputs, kwargs in CASES4:
+        out.append((name, ref or refs[name], inputs, kwargs))
+    return out
+
+
+def _masked_scatter_ref(x, mask, value):
+    out = x.copy().reshape(-1)
+    m = np.broadcast_to(mask, x.shape).reshape(-1)
+    out[m] = value.reshape(-1)[:m.sum()]
+    return out.reshape(x.shape)
+
+
+@pytest.mark.parametrize(
+    "name,ref,inputs,kwargs",
+    _fill_refs4(), ids=[c[0] for c in CASES4])
+def test_op_batch4(name, ref, inputs, kwargs):
+    # index/selection ops: grads flow through the float operands only;
+    # where/masked_fill keep finite-difference checks (smooth in values)
+    OpTest(name, ref, inputs, kwargs,
+           check_grad=name in {"where", "masked_fill", "gather",
+                               "index_select", "take_along_axis"}).run()

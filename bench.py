@@ -169,6 +169,39 @@ def _run_gates(on_tpu: bool) -> dict:
     return gates
 
 
+def make_train_step(model, opt):
+    """The bench train step (fwd + MLM loss + grad + Adam, bf16 autocast).
+
+    Shared with tests/test_hlo_perf.py, which lowers this exact step for the
+    TPU target and asserts on its HLO structure (flash custom-call present,
+    bf16 matmuls, donation) — the chip-independent perf gate.
+    """
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.core import tape as tape_mod
+    from paddle_tpu.jit.functional import call_functional
+
+    def train_step(params, buffers, opt_state, lr, t, key, ids, labels):
+        def loss_of(p):
+            with amp.auto_cast(level="O1", dtype="bfloat16"):
+                (logits, nsp), new_buffers = call_functional(
+                    model, p, buffers, (ids,), rng_key=key, training=True)
+            with tape_mod.no_grad():
+                loss = model.loss(paddle.Tensor(logits), paddle.Tensor(nsp),
+                                  paddle.Tensor(labels))
+            return loss._data, new_buffers
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        new_params, new_opt = opt.functional_step(params, grads, opt_state,
+                                                  lr, t)
+        return loss, new_params, new_buffers, new_opt
+
+    return train_step
+
+
 def bench_child() -> None:
     _start_watchdog(float(os.environ.get("BENCH_WATCHDOG_SECS", "720")))
     _log("phase=init: importing jax")
@@ -246,23 +279,7 @@ def bench_child() -> None:
     labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
     _log(f"phase=build: model built, batch={batch} seq={seq}")
 
-    def train_step(params, buffers, opt_state, lr, t, key, ids, labels):
-        def loss_of(p):
-            with amp.auto_cast(level="O1", dtype="bfloat16"):
-                (logits, nsp), new_buffers = call_functional(
-                    model, p, buffers, (ids,), rng_key=key, training=True)
-            with tape_mod.no_grad():
-                loss = model.loss(paddle.Tensor(logits), paddle.Tensor(nsp),
-                                  paddle.Tensor(labels))
-            return loss._data, new_buffers
-
-        (loss, new_buffers), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(params)
-        new_params, new_opt = opt.functional_step(params, grads, opt_state,
-                                                  lr, t)
-        return loss, new_params, new_buffers, new_opt
-
-    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    jitted = jax.jit(make_train_step(model, opt), donate_argnums=(0, 1, 2))
     lr = jnp.float32(1e-4)
     step_no = [0]
 

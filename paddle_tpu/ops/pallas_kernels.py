@@ -142,10 +142,15 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
 
         def _compute():
             # qk matmul stays in the INPUT dtype (bf16 rides the MXU
-            # natively; f32 upcast triples the passes) w/ f32 accumulation
+            # natively; f32 upcast triples the passes) w/ f32 accumulation.
+            # precision is pinned on every kernel dot: a global
+            # jax_default_matmul_precision="highest" would otherwise force
+            # an fp32 contract on bf16 vectors, which Mosaic rejects
+            # ("Bad lhs type" — caught by the AOT tier of test_hlo_perf)
             s = jax.lax.dot_general(
                 q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT) * scale
             if has_mask:
                 s = s + m_in_ref[0, 0].astype(jnp.float32)
             cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -184,7 +189,8 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
             # MXU's native path (f32 accumulation)
             acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
                 p_acc.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT)
 
         if is_causal and dyn_offsets:
             # splash-style whole-block skip: a causal ring step whose k
@@ -265,7 +271,8 @@ def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
     `offs_ref` carries the ring step's global (q, k) position offsets."""
     s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
                             (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+                            preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT) * scale
     if has_mask:
         s = s + m_in_ref[0, 0].astype(jnp.float32)
     cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -327,7 +334,8 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                                 offs_ref=offs_ref)
             dp = jax.lax.dot_general(do_ref[0, 0], v_ref[0, 0],
                                      (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+                                     preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT)
             if has_dropout:
                 # dP = M/(1-r) ∘ dP_dropped — same mask as fwd (same seeds)
                 keep = _keep_mask(pltpu, seed_ref, pl.program_id(0),
@@ -343,7 +351,8 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
             kblk = k_ref[0, 0]
             acc_ref[...] += jax.lax.dot_general(
                 ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT) * scale
 
         if is_causal and dyn_offsets:
             q_hi = offs_ref[0] + (qi + 1) * block_q - 1
@@ -450,17 +459,20 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                 p_d = p
             dv_acc[...] += jax.lax.dot_general(
                 p_d.astype(doblk.dtype), doblk, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # P_dropped^T @ dO
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT)      # P_dropped^T @ dO
             dp = jax.lax.dot_general(doblk, v_ref[0, 0],
                                      (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+                                     preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT)
             if has_dropout:
                 dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
             ds = p * (dp - delta_ref[0, 0, 0][:, None])
             qblk = q_ref[0, 0]
             dk_acc[...] += jax.lax.dot_general(
                 ds.astype(qblk.dtype), qblk, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # ds^T @ Q
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT) * scale  # ds^T @ Q
 
         if is_causal and dyn_offsets:
             q_hi = offs_ref[0] + (qi + 1) * block_q - 1

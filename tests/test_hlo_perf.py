@@ -1,0 +1,262 @@
+"""Chip-independent performance gates (VERDICT r4 #1).
+
+Most of the remaining MFU risk — fusion structure, dtype upcasts, collective
+placement, donation — is visible in the compiled/lowered HLO without any TPU
+hardware. Two tiers:
+
+* Default tier (always on): cross-platform *lowering* of the exact bench
+  train step (bench.make_train_step, ERNIE-base, batch 32 x seq 512, bf16
+  autocast) for the TPU target via
+  ``jit(step).trace(...).lower(lowering_platforms=("tpu",))``. Asserts on
+  the StableHLO text: Pallas flash custom-calls present (no materialized
+  softmax(qk^T)v), every matmul operand bf16 (no f32 upcasts), input
+  buffers donated.
+
+* AOT tier (``PADDLE_TPU_AOT=1``, ~6 min): full TPU *compilation* through
+  the real v5e compiler pipeline — including the Mosaic kernel compiler —
+  using ``jax.experimental.topologies`` device-less topologies (libtpu is
+  installed; no chip needed). This discharges the "Pallas kernels are
+  CPU-interpret-verified only" risk (VERDICT r4 weak #6) and checks what
+  GSPMD actually emits for ZeRO-2 (reduce-scatter creation happens in the
+  TPU pipeline, NOT in the CPU pipeline — verified r5) plus the HBM budget
+  via ``compiled.memory_analysis()``.
+
+Ref: SURVEY.md §6/§7; BASELINE.md north star >= 40% MFU; roofline numbers
+recorded in PERF_NOTES.md.
+"""
+import os
+import re
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+AOT = os.environ.get("PADDLE_TPU_AOT") == "1"
+
+BATCH, SEQ = 32, 512
+
+
+def _patch_tpu_gates(monkeypatch):
+    """Make the functional layer pick the TPU kernel paths while tracing on
+    the CPU host — the lowering target is TPU, the gate must agree."""
+    from paddle_tpu.ops import pallas_kernels
+
+    monkeypatch.setattr(pallas_kernels, "_on_tpu", lambda: True)
+
+
+@pytest.fixture(scope="module")
+def bench_step_lowered():
+    """Lower the exact bench train step for the TPU target, once."""
+    from paddle_tpu.ops import pallas_kernels
+
+    orig = pallas_kernels._on_tpu
+    pallas_kernels._on_tpu = lambda: True
+    try:
+        import paddle_tpu as paddle
+        from paddle_tpu.jit.functional import extract_state
+        from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+        import bench
+
+        cfg = ErnieConfig.ernie_base()
+        model = ErnieForPretraining(cfg)
+        model.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                    parameters=model.parameters())
+        params, buffers = extract_state(model)
+        opt_state = opt.functional_state(params)
+
+        jitted = jax.jit(bench.make_train_step(model, opt),
+                         donate_argnums=(0, 1, 2))
+        data = jax.ShapeDtypeStruct((BATCH, SEQ), jnp.int32)
+        lowered = jitted.trace(
+            params, buffers, opt_state, jnp.float32(1e-4), jnp.int32(1),
+            jax.random.key(0), data, data,
+        ).lower(lowering_platforms=("tpu",))
+        n_leaves = len(jax.tree_util.tree_leaves((params, buffers,
+                                                  opt_state)))
+        return lowered.as_text(), n_leaves
+    finally:
+        pallas_kernels._on_tpu = orig
+
+
+def test_flash_custom_call_in_bench_step(bench_step_lowered):
+    """The train step must reach the Pallas flash kernel in fwd AND bwd —
+    one Mosaic custom-call per layer per kernel (12 layers: fwd, dq, dkv),
+    not a materialized softmax(qk^T)v."""
+    txt, _ = bench_step_lowered
+    assert txt.count("tpu_custom_call") >= 36, txt.count("tpu_custom_call")
+
+
+def test_no_materialized_attention(bench_step_lowered):
+    """No (batch, heads, seq, seq) buffer may exist at any dtype — that is
+    the O(s^2) materialization flash attention exists to avoid."""
+    txt, _ = bench_step_lowered
+    pat = re.compile(r"tensor<%dx12x%dx%dx(f32|bf16|f16)>"
+                     % (BATCH, SEQ, SEQ))
+    assert not pat.search(txt)
+
+
+def test_all_matmuls_bf16(bench_step_lowered):
+    """Every dot_general in the step must consume bf16 operands: one f32
+    matmul forfeits the MXU's bf16 rate (VERDICT r4 next #1 item (b))."""
+    txt, _ = bench_step_lowered
+    combos = Counter()
+    for operands in re.findall(
+            r"stablehlo\.dot_general[^:]*:\s*\(([^)]*)\)\s*->", txt):
+        tys = re.findall(r"tensor<([^>]*)>", operands)
+        combos[tuple(t.split("x")[-1] for t in tys)] += 1
+    assert combos, "no dot_general found — wrong module?"
+    assert set(combos) == {("bf16", "bf16")}, dict(combos)
+
+
+def test_state_buffers_donated(bench_step_lowered):
+    """params/buffers/opt_state are donated (donate_argnums=(0,1,2)); the
+    lowered module records each aliased input as tf.aliasing_output. Without
+    donation the step holds two copies of the 1.2 GB state."""
+    txt, n_leaves = bench_step_lowered
+    n_aliased = txt.count("tf.aliasing_output")
+    assert n_aliased >= int(0.9 * n_leaves), (n_aliased, n_leaves)
+
+
+# ---------------------------------------------------------------- AOT tier
+
+aot = pytest.mark.skipif(not AOT, reason="set PADDLE_TPU_AOT=1 (slow: runs "
+                         "the real TPU compiler via libtpu topologies)")
+
+
+def _topology_mesh(topology_name, axes):
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=topology_name)
+    devs = np.array(topo.devices)
+    sizes = []
+    n = len(topo.devices)
+    for a in axes[:-1]:
+        sizes.append(1)
+    sizes.append(n)
+    return jax.sharding.Mesh(devs.reshape(sizes), axes), topo
+
+
+@aot
+def test_bench_step_compiles_with_mosaic(monkeypatch):
+    """Full bench step through the real v5e compiler: every Pallas kernel in
+    the step (flash fwd/bwd with in-kernel dropout, fused norms) must pass
+    Mosaic compilation — the r3/r4 hardware-gate debt, discharged without a
+    chip. Also enforces the HBM budget: the step must fit a 16 GB v5e."""
+    _patch_tpu_gates(monkeypatch)
+    from jax.experimental import topologies
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.functional import extract_state
+    from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+    import bench
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+    dev = topo.devices[0]
+    sh = jax.sharding.SingleDeviceSharding(dev)
+
+    cfg = ErnieConfig.ernie_base()
+    model = ErnieForPretraining(cfg)
+    model.train()
+    opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                parameters=model.parameters())
+    params, buffers = extract_state(model)
+    opt_state = opt.functional_state(params)
+
+    def absify(t):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh), t)
+
+    jitted = jax.jit(bench.make_train_step(model, opt),
+                     donate_argnums=(0, 1, 2))
+    scalar = lambda dt: jax.ShapeDtypeStruct((), dt, sharding=sh)  # noqa:E731
+    data = jax.ShapeDtypeStruct((BATCH, SEQ), jnp.int32, sharding=sh)
+    compiled = jitted.lower(
+        absify(params), absify(buffers), absify(opt_state),
+        scalar(jnp.float32), scalar(jnp.int32),
+        scalar(jax.random.key(0).dtype), data, data).compile()
+
+    mem = compiled.memory_analysis()
+    hbm = (mem.argument_size_in_bytes + mem.temp_size_in_bytes
+           + mem.generated_code_size_in_bytes
+           - mem.alias_size_in_bytes + mem.output_size_in_bytes)
+    assert hbm < 16e9, f"step needs {hbm/1e9:.1f} GB > v5e 16 GB HBM"
+
+
+@aot
+def test_zero2_step_emits_reduce_scatter():
+    """ZeRO-2 through the PRODUCT hapi step on an 8-chip v5e topology: the
+    TPU pipeline must turn the grad all-reduce + shard-slice into
+    reduce-scatter (the bandwidth halving that is stage 2's whole point).
+    The CPU pipeline never creates reduce-scatter, so only this AOT tier
+    can check it."""
+    from types import SimpleNamespace
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        group_sharded_parallel)
+
+    mesh, topo = _topology_mesh("v5e:2x4", ("sharding",))
+    group = SimpleNamespace(mesh=mesh, axis_name="sharding")
+
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(64, 256), nn.ReLU(), nn.Linear(256, 64))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    wrapped, _ = group_sharded_parallel(net, opt, level="os_g", group=group)
+    model = paddle.Model(wrapped)
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+
+    params, buffers = model._sync_state_in()
+    model._ensure_opt_state(params)
+    step = model._build_train_step()
+
+    def absify(t):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+
+    data = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    compiled = step.lower(
+        absify(params), absify(buffers), absify(model._opt_state),
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((), jax.random.key(0).dtype),
+        (data,), (data,)).compile()
+    txt = compiled.as_text()
+    assert txt.count("reduce-scatter") >= 1, (
+        "ZeRO-2 step compiled without any reduce-scatter:\n"
+        + "\n".join(ln for ln in txt.splitlines() if "all-reduce(" in ln))
+
+
+@aot
+def test_ring_attention_kernel_compiles_with_mosaic(monkeypatch):
+    """The ring STEP kernel (SMEM offsets + pl.when block skip) has never
+    passed Mosaic off-CPU (VERDICT r4 weak #6); compile the sep=4 ring
+    attention through the real pipeline."""
+    _patch_tpu_gates(monkeypatch)
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    mesh, _ = _topology_mesh("v5e:2x2", ("sep",))
+
+    def ring(q, k, v):
+        return pk.ring_flash_attention_pallas(q, k, v, axis_name="sep",
+                                              causal=True)
+
+    b, s, h, d = 2, 1024, 4, 64
+    spec = P(None, "sep", None, None)
+    f = shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+                  out_specs=spec)
+    jitted = jax.jit(f, in_shardings=NamedSharding(mesh, spec),
+                     out_shardings=NamedSharding(mesh, spec))
+    x = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+    compiled = jitted.lower(x, x, x).compile()
+    assert compiled.as_text().count("custom-call") >= 4

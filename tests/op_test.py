@@ -9,7 +9,13 @@ sweeps dtypes. The same contract here, over the registry dispatch:
 - static:  the op captured into a Program and replayed by the Executor;
 - jit:     the compiled functional path (to_static-style jax.jit);
 - grad:    Tensor.backward() analytic grads vs central finite differences;
-- dtypes:  float32 exact-ish, bfloat16 forward at loose tolerance.
+- dtypes:  float32 exact-ish; bfloat16 and float16 forward at loose
+           tolerance; bfloat16 analytic grads vs the float32 analytic
+           grads (finite differences are meaningless at 8 mantissa bits).
+
+Multi-output ops are supported: a NumPy ref returning a tuple is compared
+leaf-by-leaf against the op's tuple/list output. Integer/bool outputs are
+compared exactly.
 """
 from __future__ import annotations
 
@@ -22,6 +28,20 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.ops.registry import get_op
 
 
+def _is_float(dtype):
+    """np.issubdtype misses ml_dtypes (bfloat16 etc.); jnp's handles both."""
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def _leaves(out):
+    """Normalize an op output (Tensor | tuple/list of Tensor) to a list."""
+    if isinstance(out, (tuple, list)):
+        return list(out)
+    return [out]
+
+
 class OpTest:
     rtol = 1e-5
     atol = 1e-6
@@ -30,13 +50,19 @@ class OpTest:
     fd_eps = 1e-3
     bf16_rtol = 5e-2
     bf16_atol = 5e-2
+    fp16_rtol = 1e-2
+    fp16_atol = 1e-2
+    bf16_grad_rtol = 1e-1
+    bf16_grad_atol = 1e-1
 
     def __init__(self, op_name: str, np_ref, inputs, kwargs=None,
-                 check_grad: bool = True, bf16: bool = True):
+                 check_grad: bool = True, bf16: bool = True,
+                 fp16: bool = True, bf16_grad: bool | None = None,
+                 rtol=None, atol=None):
         """inputs: list of numpy arrays (positional tensor args; integer
         arrays keep their dtype — index operands — floats normalize to
         float32); kwargs: non-tensor attrs; np_ref(*inputs, **kwargs) ->
-        ndarray."""
+        ndarray or tuple of ndarrays."""
         self.op_name = op_name
         self.np_ref = np_ref
         self.inputs = [
@@ -46,6 +72,15 @@ class OpTest:
         self.kwargs = dict(kwargs or {})
         self.check_grad = check_grad
         self.bf16 = bf16
+        self.fp16 = fp16
+        # default: sweep bf16 grads wherever fp32 grads are checked and the
+        # bf16 forward is in scope
+        self.bf16_grad = (check_grad and bf16) if bf16_grad is None \
+            else bf16_grad
+        if rtol is not None:
+            self.rtol = rtol
+        if atol is not None:
+            self.atol = atol
         self.opdef = get_op(op_name)
 
     # ------------------------------------------------------------- helpers
@@ -55,15 +90,33 @@ class OpTest:
                           for a in arrays], **self.kwargs)
 
     def _expect(self):
-        return np.asarray(self.np_ref(*self.inputs, **self.kwargs),
-                          np.float32)
+        out = self.np_ref(*self.inputs, **self.kwargs)
+        if isinstance(out, (tuple, list)):
+            return [np.asarray(o) for o in out]
+        return [np.asarray(out)]
+
+    def _compare(self, got_leaves, tag, rtol=None, atol=None):
+        expect = self._expect()
+        assert len(got_leaves) == len(expect), (
+            f"{self.op_name}: {tag}: {len(got_leaves)} outputs vs "
+            f"{len(expect)} reference outputs")
+        for i, (g, e) in enumerate(zip(got_leaves, expect)):
+            g = np.asarray(g)
+            suffix = f" (output {i})" if len(expect) > 1 else ""
+            if e.dtype == bool or np.issubdtype(e.dtype, np.integer):
+                np.testing.assert_array_equal(
+                    g, e, err_msg=f"{self.op_name}: {tag}{suffix}")
+            else:
+                np.testing.assert_allclose(
+                    g.astype(np.float64), e.astype(np.float64),
+                    rtol=self.rtol if rtol is None else rtol,
+                    atol=self.atol if atol is None else atol,
+                    err_msg=f"{self.op_name}: {tag}{suffix}")
 
     # -------------------------------------------------------------- checks
     def check_eager(self):
-        out = self._apply(self.inputs)
-        np.testing.assert_allclose(np.asarray(out.numpy()), self._expect(),
-                                   rtol=self.rtol, atol=self.atol,
-                                   err_msg=f"{self.op_name}: eager")
+        out = _leaves(self._apply(self.inputs))
+        self._compare([np.asarray(t.numpy()) for t in out], "eager")
 
     def check_static(self):
         main = static.Program()
@@ -72,39 +125,44 @@ class OpTest:
             with static.program_guard(main, static.Program()):
                 feeds = [static.data(f"x{i}", list(a.shape), str(a.dtype))
                          for i, a in enumerate(self.inputs)]
-                out = apply_op(self.opdef, *feeds, **self.kwargs)
+                out = _leaves(apply_op(self.opdef, *feeds, **self.kwargs))
         finally:
             static.disable_static()
         got = static.Executor().run(
             main, feed={f"x{i}": a for i, a in enumerate(self.inputs)},
-            fetch_list=[out])[0]
-        np.testing.assert_allclose(got, self._expect(), rtol=self.rtol,
-                                   atol=self.atol,
-                                   err_msg=f"{self.op_name}: static")
+            fetch_list=out)
+        self._compare(got, "static")
 
     def check_jit(self):
         import jax
 
         def fn(*arrs):
-            return self._apply(arrs)._data
+            return [t._data for t in _leaves(self._apply(arrs))]
 
-        got = jax.jit(fn)(*self.inputs)
-        np.testing.assert_allclose(np.asarray(got), self._expect(),
-                                   rtol=self.rtol, atol=self.atol,
-                                   err_msg=f"{self.op_name}: jit")
+        self._compare(jax.jit(fn)(*self.inputs), "jit")
 
-    def check_grads(self):
+    def _analytic_grads(self, dtype=None):
+        """Analytic input grads of sum(first float output) at `dtype`."""
+        import jax.numpy as jnp
+
         ts = []
         for a in self.inputs:
-            t = paddle.to_tensor(a)
+            if dtype is not None and np.issubdtype(a.dtype, np.floating):
+                t = Tensor(jnp.asarray(a, dtype))
+            else:
+                t = paddle.to_tensor(a)
             if np.issubdtype(a.dtype, np.floating):
                 t.stop_gradient = False
             ts.append(t)
-        out = apply_op(self.opdef, *ts, **self.kwargs)
-        out.sum().backward()
-        analytic = [np.asarray(t.grad.numpy()) if t.grad is not None
-                    else np.zeros_like(a)
-                    for t, a in zip(ts, self.inputs)]
+        outs = _leaves(apply_op(self.opdef, *ts, **self.kwargs))
+        target = next(t for t in outs if _is_float(t.numpy().dtype))
+        target.sum().backward()
+        return [np.asarray(t.grad.numpy(), np.float32)
+                if t.grad is not None else np.zeros(a.shape, np.float32)
+                for t, a in zip(ts, self.inputs)]
+
+    def check_grads(self):
+        analytic = self._analytic_grads()
 
         for idx, base in enumerate(self.inputs):
             if not np.issubdtype(base.dtype, np.floating):
@@ -117,31 +175,69 @@ class OpTest:
                     pert[j] += sgn * self.fd_eps
                     args = list(self.inputs)
                     args[idx] = pert.reshape(base.shape)
-                    val = float(np.sum(np.asarray(
-                        self.np_ref(*args, **self.kwargs), np.float64)))
+                    out = self.np_ref(*args, **self.kwargs)
+                    first = next(
+                        np.asarray(o) for o in
+                        (out if isinstance(out, (tuple, list)) else [out])
+                        if np.issubdtype(np.asarray(o).dtype, np.floating))
+                    val = float(np.sum(first.astype(np.float64)))
                     fd.reshape(-1)[j] += sgn * val / (2 * self.fd_eps)
             np.testing.assert_allclose(
                 analytic[idx], fd, rtol=self.grad_rtol,
                 atol=self.grad_atol,
                 err_msg=f"{self.op_name}: grad of input {idx}")
+        return analytic
+
+    def check_bf16_grads(self, fp32_analytic):
+        """bf16 analytic grads vs the fp32 analytic grads — the dtype sweep
+        upstream's OpTest runs on grads (finite differences can't resolve
+        8 mantissa bits, so fp32-analytic is the reference)."""
+        import jax.numpy as jnp
+
+        bf16 = self._analytic_grads(jnp.bfloat16)
+        for idx, base in enumerate(self.inputs):
+            if not np.issubdtype(base.dtype, np.floating):
+                continue
+            np.testing.assert_allclose(
+                bf16[idx], fp32_analytic[idx],
+                rtol=self.bf16_grad_rtol, atol=self.bf16_grad_atol,
+                err_msg=f"{self.op_name}: bf16 grad of input {idx}")
+
+    def _check_low_precision(self, dtype, tag, rtol, atol):
+        import jax.numpy as jnp
+
+        arrays = [Tensor(jnp.asarray(
+            a, dtype if np.issubdtype(a.dtype, np.floating)
+            else a.dtype)) for a in self.inputs]
+        out = _leaves(apply_op(self.opdef, *arrays, **self.kwargs))
+        self._compare([np.asarray(t._data, np.float32)
+                       if np.issubdtype(np.asarray(t._data).dtype,
+                                        np.floating)
+                       else np.asarray(t._data) for t in out],
+                      tag, rtol=rtol, atol=atol)
 
     def check_bf16(self):
         import jax.numpy as jnp
 
-        arrays = [Tensor(jnp.asarray(
-            a, jnp.bfloat16 if np.issubdtype(a.dtype, np.floating)
-            else a.dtype)) for a in self.inputs]
-        out = apply_op(self.opdef, *arrays, **self.kwargs)
-        np.testing.assert_allclose(
-            np.asarray(out._data, np.float32), self._expect(),
-            rtol=self.bf16_rtol, atol=self.bf16_atol,
-            err_msg=f"{self.op_name}: bf16")
+        self._check_low_precision(jnp.bfloat16, "bf16",
+                                  self.bf16_rtol, self.bf16_atol)
+
+    def check_fp16(self):
+        import jax.numpy as jnp
+
+        self._check_low_precision(jnp.float16, "fp16",
+                                  self.fp16_rtol, self.fp16_atol)
 
     def run(self):
         self.check_eager()
         self.check_static()
         self.check_jit()
+        analytic = None
         if self.check_grad:
-            self.check_grads()
+            analytic = self.check_grads()
         if self.bf16:
             self.check_bf16()
+        if self.fp16:
+            self.check_fp16()
+        if self.bf16_grad and analytic is not None:
+            self.check_bf16_grads(analytic)

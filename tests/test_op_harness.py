@@ -308,3 +308,146 @@ def test_op_batch4(name, ref, inputs, kwargs):
     OpTest(name, ref, inputs, kwargs,
            check_grad=name in {"where", "masked_fill", "gather",
                                "index_select", "take_along_axis"}).run()
+
+
+# ===================================================================
+# batch 5 (r5): yaml elementwise math / special functions / scalar ops
+# ===================================================================
+
+E1 = R.randn(3, 4).astype(np.float32)            # generic
+POS = np.abs(R.randn(3, 4)).astype(np.float32) + 0.5
+UNIT = (R.rand(3, 4).astype(np.float32) * 1.6 - 0.8)   # in (-0.8, 0.8)
+I32A = R.randint(1, 20, (3, 4)).astype(np.int32)
+I32B = R.randint(1, 20, (3, 4)).astype(np.int32)
+BOOLA = R.rand(3, 4) > 0.5
+BOOLB = R.rand(3, 4) > 0.5
+
+
+def _glu_ref(x, axis=-1):
+    a, b = np.split(x, 2, axis=axis)
+    return a / (1 + np.exp(-b))
+
+
+CASES5 = [
+    ("acos", np.arccos, [UNIT], {}),
+    ("asin", np.arcsin, [UNIT], {}),
+    ("tan", np.tan, [UNIT], {}),
+    ("exp2", np.exp2, [E1], {}),
+    ("neg", lambda x: -x, [E1], {}),
+    ("negative", lambda x: -x, [E1], {}),
+    ("positive", lambda x: +x, [E1], {}),
+    ("conj", np.conj, [E1], {}),
+    ("real", np.real, [E1], {}),
+    ("imag", lambda x: np.zeros_like(x), [E1], {}),
+    ("angle", lambda x: np.angle(x).astype(np.float32), [E1], {}),
+    ("sgn", np.sign, [E1 + 0.05], {}),
+    ("signbit", np.signbit, [E1], {}),
+    ("isneginf", np.isneginf, [E1], {}),
+    ("isposinf", np.isposinf, [E1], {}),
+    ("floor_divide", np.floor_divide, [E1 * 4, POS], {}),
+    ("mod", lambda x, y: np.mod(x, y), [E1 * 4 + 0.03, POS], {}),
+    ("remainder", lambda x, y: np.mod(x, y), [E1 * 4 + 0.03, POS], {}),
+    ("fmax", np.fmax, [E1, POS - 0.5], {}),
+    ("fmin", np.fmin, [E1, POS - 0.5], {}),
+    ("gcd", np.gcd, [I32A, I32B], {}),
+    ("lcm", np.lcm, [I32A, I32B], {}),
+    ("ldexp", lambda x, y: np.ldexp(x, y), [E1, I32A % 5], {}),
+    ("nextafter", np.nextafter, [E1, POS], {}),
+    ("xlogy", None, [POS, POS + 0.5], {}),
+    ("logaddexp2", np.logaddexp2, [E1, E1 * 0.5], {}),
+    ("erfinv", None, [UNIT], {}),
+    ("i0e", None, [E1], {}),
+    ("i1", None, [E1], {}),
+    ("i1e", None, [E1], {}),
+    ("gammaln", None, [POS], {}),
+    ("multigammaln", None, [POS + 1.5], {"p": 2}),
+    ("polygamma", None, [POS], {"n": 1}),
+    ("gammainc", None, [POS, POS + 0.3], {}),
+    ("gammaincc", None, [POS, POS + 0.3], {}),
+    ("frexp", np.frexp, [E1 * 3 + 0.03], {}),
+    ("celu", lambda x, alpha=1.0:
+        np.where(x > 0, x, alpha * (np.exp(x / alpha) - 1)),
+     [E1 + 0.05], {"alpha": 1.2}),
+    ("glu", _glu_ref, [E1], {"axis": -1}),
+    ("hardshrink", lambda x, threshold=0.5:
+        np.where(np.abs(x) > threshold, x, 0.0), [E1 * 2 + 0.07], {}),
+    ("hardsigmoid", lambda x, slope=1 / 6, offset=0.5:
+        np.clip(slope * x + offset, 0, 1), [E1 * 4 + 0.07], {}),
+    ("hardswish", lambda x:
+        x * np.clip(x + 3, 0, 6) / 6, [E1 * 4 + 0.07], {}),
+    ("log_sigmoid", lambda x:
+        -(np.log1p(np.exp(-np.abs(x))) + np.maximum(-x, 0)), [E1], {}),
+    ("softshrink", lambda x, threshold=0.5: np.where(
+        x > threshold, x - threshold,
+        np.where(x < -threshold, x + threshold, 0.0)),
+     [E1 * 2 + 0.07], {}),
+    ("softsign", lambda x: x / (1 + np.abs(x)), [E1 + 0.05], {}),
+    ("swish", lambda x: x / (1 + np.exp(-x)), [E1], {}),
+    ("tanhshrink", lambda x: x - np.tanh(x), [E1], {}),
+    ("thresholded_relu", lambda x, threshold=1.0, value=0.0:
+        np.where(x > threshold, x, value), [E1 * 2 + 0.07], {}),
+    ("square_error_cost", lambda i, l: (i - l) ** 2, [E1, POS], {}),
+    ("log_loss", lambda i, l, epsilon=1e-4:
+        -l * np.log(i + epsilon) - (1 - l) * np.log(1 - i + epsilon),
+     [C, (C > 0.5).astype(np.float32)], {}),
+    ("multiply_scalar", lambda x, value: x * value, [E1], {"value": 2.5}),
+    ("pow_scalar", lambda x, value: x ** value, [POS], {"value": 1.7}),
+    ("rpow_scalar", lambda x, value: value ** x, [E1], {"value": 1.7}),
+    ("scale", lambda x, scale=1.0, bias=0.0, bias_after_scale=True:
+        x * scale + bias, [E1], {"scale": 3.0, "bias": 0.5}),
+    ("clone", lambda x: x.copy(), [E1], {}),
+    ("full_like", lambda x, fill_value: np.full_like(x, fill_value),
+     [E1], {"fill_value": 2.5}),
+    ("cast", lambda x, dtype: x.astype(np.int32), [E1 * 5],
+     {"dtype": "int32"}),
+    ("allclose", lambda x, y, rtol=1e-5, atol=1e-8:
+        np.array(np.allclose(x, y, rtol, atol)), [E1, E1 + 1e-9], {}),
+    ("isclose", np.isclose, [E1, E1 + 1e-9], {}),
+    ("equal_all", lambda x, y: np.array(np.array_equal(x, y)),
+     [E1, E1.copy()], {}),
+    ("bitwise_and", np.bitwise_and, [I32A, I32B], {}),
+    ("bitwise_or", np.bitwise_or, [I32A, I32B], {}),
+    ("bitwise_xor", np.bitwise_xor, [I32A, I32B], {}),
+    ("bitwise_not", np.invert, [I32A], {}),
+    ("bitwise_left_shift", np.left_shift, [I32A, I32B % 4], {}),
+    ("bitwise_right_shift", np.right_shift, [I32A, I32B % 4], {}),
+]
+
+
+def _fill_refs5():
+    import scipy.special as sp
+
+    refs = {
+        "xlogy": sp.xlogy,
+        "erfinv": sp.erfinv,
+        "i0e": sp.i0e,
+        "i1": sp.i1,
+        "i1e": sp.i1e,
+        "gammaln": sp.gammaln,
+        "multigammaln": lambda x, p: sp.multigammaln(x, p),
+        "polygamma": lambda x, n: sp.polygamma(n, x),
+        "gammainc": sp.gammainc,
+        "gammaincc": sp.gammaincc,
+    }
+    return [(n, r or refs[n], i, k) for n, r, i, k in CASES5]
+
+
+_NO_GRAD5 = {"sgn", "signbit", "isneginf", "isposinf", "floor_divide",
+             "mod", "remainder", "fmax", "fmin", "frexp", "cast",
+             "allclose", "isclose", "equal_all", "full_like", "angle",
+             "imag", "nextafter", "hardshrink", "softshrink",
+             "thresholded_relu", "log_loss"}
+# scipy-special ops whose bf16/fp16 ulp behavior is too coarse to bound
+_NO_LOWP5 = {"erfinv", "gammaln", "multigammaln", "polygamma", "gammainc",
+             "gammaincc", "i1", "i1e", "i0e", "cast", "frexp", "exp2",
+             "rpow_scalar", "nextafter", "log_loss"}
+
+
+@pytest.mark.parametrize(
+    "name,ref,inputs,kwargs",
+    _fill_refs5(), ids=[c[0] for c in CASES5])
+def test_op_batch5(name, ref, inputs, kwargs):
+    OpTest(name, ref, inputs, kwargs,
+           check_grad=name not in _NO_GRAD5,
+           bf16=name not in _NO_LOWP5,
+           fp16=name not in _NO_LOWP5).run()

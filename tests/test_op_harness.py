@@ -451,3 +451,265 @@ def test_op_batch5(name, ref, inputs, kwargs):
            check_grad=name not in _NO_GRAD5,
            bf16=name not in _NO_LOWP5,
            fp16=name not in _NO_LOWP5).run()
+
+
+# ===================================================================
+# batch 6 (r5): manipulation / stacking / indexing / scatter
+# ===================================================================
+
+X4 = R.randn(2, 3, 4, 5).astype(np.float32)
+X3 = R.randn(2, 4, 6).astype(np.float32)
+SEQ1 = np.sort(R.randn(6).astype(np.float32))
+IDXR = np.array([0, 2], np.int64)
+ND_IDX = np.array([[0, 1], [1, 3], [0, 0]], np.int64)   # rows into (3,4)
+
+
+def _scatter_ref(x, index, updates, overwrite=True):
+    out = x.copy()
+    if overwrite:
+        out[index] = updates[:len(index)]
+    else:
+        np.add.at(out, index, updates[:len(index)])
+    return out
+
+
+def _scatter_nd_ref(index, updates, shape):
+    out = np.zeros(shape, updates.dtype)
+    np.add.at(out, tuple(index.T), updates)
+    return out
+
+
+def _scatter_nd_add_ref(x, index, updates):
+    out = x.copy()
+    np.add.at(out, tuple(index.T), updates)
+    return out
+
+
+def _diag_embed_ref(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    out = np.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = np.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out[..., r, c] = x
+    return out
+
+
+def _diagonal_scatter_ref(x, y, offset=0, axis1=0, axis2=1):
+    out = x.copy()
+    idx = np.arange(y.shape[-1])
+    out[idx + max(-offset, 0), idx + max(offset, 0)] = y
+    return out
+
+
+def _slice_ref(x, axes, starts, ends):
+    sl = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sl[ax] = slice(s, e)
+    return x[tuple(sl)]
+
+
+def _strided_slice_ref(x, axes, starts, ends, strides):
+    sl = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax] = slice(s, e, st)
+    return x[tuple(sl)]
+
+
+def _slice_scatter_ref(x, value, axes=None, starts=None, ends=None,
+                       strides=None):
+    out = x.copy()
+    sl = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax] = slice(s, e, st)
+    out[tuple(sl)] = value
+    return out
+
+
+def _as_strided_ref(x, shape, stride, offset=0):
+    it = x.itemsize
+    return np.lib.stride_tricks.as_strided(
+        x.reshape(-1)[offset:], shape,
+        [s * it for s in stride]).copy()
+
+
+def _put_along_axis_ref(x, indices, values, axis, reduce="assign"):
+    out = x.copy()
+    np.put_along_axis(out, indices, values, axis)
+    return out
+
+
+def _index_add_ref(x, index, axis, value):
+    out = x.copy()
+    sl = [slice(None)] * x.ndim
+    for j, i in enumerate(index):
+        sli = list(sl)
+        sli[axis] = i
+        slv = list(sl)
+        slv[axis] = j
+        out[tuple(sli)] += value[tuple(slv)]
+    return out
+
+
+def _index_fill_ref(x, index, axis, value):
+    out = x.copy()
+    sl = [slice(None)] * x.ndim
+    for i in index:
+        sli = list(sl)
+        sli[axis] = i
+        out[tuple(sli)] = value
+    return out
+
+
+def _unique_consecutive_ref(x):
+    flat = x.reshape(-1)
+    keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+    return flat[keep]
+
+
+def _shard_index_ref(x, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return np.where(in_shard, x % shard_size, ignore_value)
+
+
+def _combinations_ref(x, r=2, with_replacement=False):
+    import itertools
+    it = (itertools.combinations_with_replacement(x, r)
+          if with_replacement else itertools.combinations(x, r))
+    return np.array(list(it), x.dtype)
+
+
+CASES6 = [
+    ("argsort", lambda x, axis=-1: np.argsort(x, axis=axis, kind="stable"),
+     [A], {"axis": -1}),
+    ("sort", lambda x, axis=-1: np.sort(x, axis=axis), [A], {"axis": -1}),
+    ("as_strided", _as_strided_ref, [np.arange(24, dtype=np.float32)],
+     {"shape": [3, 4], "stride": [8, 2], "offset": 1}),
+    ("atleast_1d", np.atleast_1d, [np.float32(3.5)], {}),
+    ("atleast_2d", np.atleast_2d, [np.arange(4, dtype=np.float32)], {}),
+    ("atleast_3d", np.atleast_3d, [A], {}),
+    ("block_diag", None, [M1[:2, :2], M2[:3, :3]], {}),
+    ("bucketize", lambda x, s, right=False: np.searchsorted(
+        s, x, side="right" if right else "left"), [A, SEQ1],
+     {"right": True}),
+    ("combinations", _combinations_ref,
+     [np.arange(4, dtype=np.float32)], {"r": 2}),
+    ("concat", lambda *xs, axis=0: np.concatenate(xs, axis), [A, B],
+     {"axis": 1}),
+    ("stack", lambda *xs, axis=0: np.stack(xs, axis), [A, B], {"axis": 1}),
+    ("hstack", lambda *xs: np.hstack(xs), [A, B], {}),
+    ("vstack", lambda *xs: np.vstack(xs), [A, B], {}),
+    ("dstack", lambda *xs: np.dstack(xs), [A, B], {}),
+    ("column_stack", lambda *xs: np.column_stack(xs), [A, B], {}),
+    ("row_stack", lambda *xs: np.vstack(xs), [A, B], {}),
+    ("meshgrid", lambda *xs, indexing="ij": tuple(
+        np.meshgrid(*xs, indexing=indexing)),
+     [np.arange(3, dtype=np.float32), np.arange(4, dtype=np.float32)], {}),
+    ("diag_embed", _diag_embed_ref, [A], {"offset": 1}),
+    ("diagonal_scatter", _diagonal_scatter_ref,
+     [M2[:4, :4].copy(), np.arange(4, dtype=np.float32)], {}),
+    ("diff", lambda x, n=1, axis=-1: np.diff(x, n, axis), [A], {}),
+    ("expand_as", lambda x, y: np.broadcast_to(x, y.shape), [A[0:1], A],
+     {}),
+    ("flatten", lambda x, start_axis=0, stop_axis=-1:
+        x.reshape(2, 12, 5), [X4], {"start_axis": 1, "stop_axis": 2}),
+    ("gather_nd", lambda x, idx: x[tuple(idx.T)], [A, ND_IDX], {}),
+    ("isin", lambda x, t: np.isin(x, t), [I32A, I32B], {}),
+    ("moveaxis", np.moveaxis, [X3], {"source": 0, "destination": 2}),
+    ("swapaxes", np.swapaxes, [X3], {"axis1": 0, "axis2": 2}),
+    ("rot90", lambda x, k=1, axes=(0, 1): np.rot90(x, k, axes), [A],
+     {"k": 3}),
+    ("pad", None, [X4], {"pad": [1, 2, 0, 1], "value": 1.5}),
+    ("put_along_axis", _put_along_axis_ref, [A, IDX2, B[:, :2]],
+     {"axis": 1}),
+    ("index_add", lambda x, index, axis, value: _index_add_ref(
+        x, index, axis, value), [A, IDXR],
+     {"axis": 1, "value": np.ones((3, 2), np.float32)}),
+    ("index_fill", _index_fill_ref, [A, IDXR], {"axis": 1, "value": -2.0}),
+    ("repeat_interleave", lambda x, repeats, axis=None:
+        np.repeat(x, repeats, axis), [A], {"repeats": 3, "axis": 1}),
+    ("scatter", _scatter_ref, [A, IDX1, B], {}),
+    ("scatter_nd", _scatter_nd_ref, [ND_IDX, np.ones(3, np.float32)],
+     {"shape": [3, 4]}),
+    ("scatter_nd_add", _scatter_nd_add_ref,
+     [A, ND_IDX, np.ones(3, np.float32)], {}),
+    ("searchsorted", lambda s, v: np.searchsorted(s, v), [SEQ1, A], {}),
+    ("slice_op", _slice_ref, [X3],
+     {"axes": [0, 2], "starts": [0, 1], "ends": [2, 5]}),
+    ("strided_slice", _strided_slice_ref, [X3],
+     {"axes": [1, 2], "starts": [0, 1], "ends": [4, 6], "strides": [2, 2]}),
+    ("slice_scatter", _slice_scatter_ref, [X3, np.zeros((2, 2, 6),
+                                                        np.float32)],
+     {"axes": [1], "starts": [0], "ends": [4], "strides": [2]}),
+    ("split", lambda x, num_or_sections, axis=0: tuple(
+        np.split(x, num_or_sections, axis)), [X3],
+     {"num_or_sections": 2, "axis": 1}),
+    ("tensor_split", lambda x, num_or_indices, axis=0: tuple(
+        np.array_split(x, num_or_indices, axis)), [X3],
+     {"num_or_indices": 3, "axis": 2}),
+    ("hsplit", lambda x, num_or_indices: tuple(
+        np.hsplit(x, num_or_indices)), [A], {"num_or_indices": 2}),
+    ("vsplit", lambda x, num_or_indices: tuple(
+        np.vsplit(x, num_or_indices)), [M2[:4]], {"num_or_indices": 2}),
+    ("dsplit", lambda x, num_or_indices: tuple(
+        np.dsplit(x, num_or_indices)), [X3], {"num_or_indices": 3}),
+    ("take", lambda x, index: np.take(x, index), [A, IDX2 % 12], {}),
+    ("topk_indices", None, [A], {"k": 2, "axis": -1}),
+    ("unbind", lambda x, axis=0: tuple(np.moveaxis(x, axis, 0)), [X3],
+     {"axis": 1}),
+    ("unflatten", lambda x, axis, shape: x.reshape(2, 2, 2, 6), [X3],
+     {"axis": 1, "shape": [2, 2]}),
+    ("vander", lambda x, n=None, increasing=False:
+        np.vander(x, n, increasing), [np.arange(1, 5, dtype=np.float32)],
+     {"n": 3}),
+    ("is_empty", lambda x: np.array(x.size == 0), [A], {}),
+    ("shard_index", _shard_index_ref, [np.arange(8).astype(np.int64)],
+     {"index_num": 8, "nshards": 2, "shard_id": 1}),
+    ("reduce_as", lambda x, target: x.sum(0, keepdims=True), [A, A[0:1]],
+     {}),
+]
+
+
+def _fill_refs6():
+    import scipy.linalg as sl
+
+    def _pad_ref(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+        wl, wr, ht, hb = pad
+        return np.pad(x, ((0, 0), (0, 0), (ht, hb), (wl, wr)),
+                      constant_values=value)
+
+    def _topk_indices_ref(x, k, axis=-1, largest=True):
+        order = np.argsort(-x if largest else x, axis=axis, kind="stable")
+        return np.take(order, np.arange(k), axis=axis)
+
+    refs = {
+        "block_diag": lambda *xs: sl.block_diag(*xs),
+        "pad": _pad_ref,
+        "topk_indices": _topk_indices_ref,
+    }
+    return [(n, r or refs[n], i, k) for n, r, i, k in CASES6]
+
+
+_LIST6 = {"concat", "stack", "hstack", "vstack", "dstack", "column_stack",
+          "row_stack", "meshgrid", "block_diag"}
+_GRAD6 = {"concat", "stack", "hstack", "vstack", "dstack", "column_stack",
+          "row_stack", "pad", "flatten", "moveaxis", "swapaxes", "diff",
+          "diag_embed", "expand_as", "repeat_interleave", "unflatten",
+          "slice_op", "split", "unbind", "rot90", "gather_nd", "take"}
+_NO_LOWP6 = {"argsort", "sort", "bucketize", "searchsorted",
+             "topk_indices", "isin", "as_strided", "combinations",
+             "vander",
+             # kwargs carry f32 constants the sweep can't re-dtype
+             "index_add", "index_fill", "slice_scatter"}
+
+
+@pytest.mark.parametrize(
+    "name,ref,inputs,kwargs",
+    _fill_refs6(), ids=[c[0] for c in CASES6])
+def test_op_batch6(name, ref, inputs, kwargs):
+    OpTest(name, ref, inputs, kwargs,
+           check_grad=name in _GRAD6,
+           bf16=name not in _NO_LOWP6,
+           fp16=name not in _NO_LOWP6,
+           list_input=name in _LIST6).run()

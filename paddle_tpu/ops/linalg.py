@@ -54,8 +54,11 @@ def cholesky(x, upper=False):
 
 @register_op("svd", multi_output=True, amp_list="black")
 def svd(x, full_matrices=False):
+    """paddle.linalg.svd contract: returns (U, S, VH) with VH of shape
+    (..., K, N) so x == U @ diag(S) @ VH (an earlier revision returned V
+    transposed — caught by the OpTest harness against numpy r5)."""
     u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
-    return u, s, jnp.swapaxes(vh, -1, -2)
+    return u, s, vh
 
 
 @register_op("slogdet", multi_output=True, amp_list="black")

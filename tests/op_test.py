@@ -76,9 +76,11 @@ class OpTest:
         self.op_name = op_name
         self.np_ref = np_ref
         self.inputs = [
-            a if np.issubdtype(np.asarray(a).dtype, np.integer)
-            or np.asarray(a).dtype == bool
-            else np.asarray(a, np.float32) for a in map(np.asarray, inputs)]
+            np.ascontiguousarray(
+                a if np.issubdtype(np.asarray(a).dtype, np.integer)
+                or np.asarray(a).dtype == bool
+                else np.asarray(a, np.float32))
+            for a in map(np.asarray, inputs)]
         self.kwargs = dict(kwargs or {})
         self.check_grad = check_grad
         self.bf16 = bf16
@@ -193,7 +195,10 @@ class OpTest:
         for idx, base in enumerate(self.inputs):
             if not np.issubdtype(base.dtype, np.floating):
                 continue
-            fd = np.zeros_like(base)
+            # flat C-order accumulator: zeros_like on a non-contiguous
+            # input view would be F-ordered, making reshape(-1) a COPY and
+            # the writes below silently lost (caught by multi_dot r5)
+            fd_flat = np.zeros(base.size, np.float32)
             flat = base.reshape(-1)
             for j in range(flat.size):
                 for sgn in (+1, -1):
@@ -207,7 +212,8 @@ class OpTest:
                         (out if isinstance(out, (tuple, list)) else [out])
                         if np.issubdtype(np.asarray(o).dtype, np.floating))
                     val = float(np.sum(first.astype(np.float64)))
-                    fd.reshape(-1)[j] += sgn * val / (2 * self.fd_eps)
+                    fd_flat[j] += sgn * val / (2 * self.fd_eps)
+            fd = fd_flat.reshape(base.shape)
             np.testing.assert_allclose(
                 analytic[idx], fd, rtol=self.grad_rtol,
                 atol=self.grad_atol,

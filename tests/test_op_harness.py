@@ -713,3 +713,206 @@ def test_op_batch6(name, ref, inputs, kwargs):
            bf16=name not in _NO_LOWP6,
            fp16=name not in _NO_LOWP6,
            list_input=name in _LIST6).run()
+
+
+# ===================================================================
+# batch 7 (r5): linalg — products, factorizations, solvers
+# ===================================================================
+
+S3 = (M1[:3, :3] @ M1[:3, :3].T + 3 * np.eye(3)).astype(np.float32)  # SPD
+G3 = (M2[:3, :3] + 0.1 * np.eye(3)).astype(np.float32)   # general, invertible
+BMA = R.randn(2, 3, 4).astype(np.float32)
+BMB = R.randn(2, 4, 5).astype(np.float32)
+V4 = R.randn(4).astype(np.float32)
+OVR = R.randn(5, 3).astype(np.float32)    # overdetermined lstsq
+OVRY = R.randn(5, 2).astype(np.float32)
+
+
+def _cummax_ref(x, axis=None):
+    vals = np.maximum.accumulate(x, axis=axis)
+    n = x.shape[axis]
+    idx = np.zeros(x.shape, np.int64)
+    run = np.zeros(np.delete(x.shape, axis), np.int64)
+    best = np.take(x, 0, axis=axis).copy()
+    for i in range(n):
+        cur = np.take(x, i, axis=axis)
+        upd = cur >= best
+        best = np.where(upd, cur, best)
+        run = np.where(upd, i, run)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = i
+        idx[tuple(sl)] = run
+    return vals, idx
+
+
+def _cummin_ref(x, axis=None):
+    vals, idx = _cummax_ref(-x, axis=axis)
+    return -vals, idx
+
+
+def _hh_q(x, tau):
+    """Accumulate the householder reflectors (LAPACK orgqr semantics)."""
+    m, k = x.shape[0], len(tau)
+    q = np.eye(m, dtype=np.float64)
+    for i in range(k):
+        v = np.zeros(m, np.float64)
+        v[i] = 1.0
+        v[i + 1:] = x[i + 1:, i]
+        q = q @ (np.eye(m) - tau[i] * np.outer(v, v))
+    return q
+
+
+def _lu_ref(x, pivot=True):
+    import scipy.linalg as sl
+    lu, piv = sl.lu_factor(x)
+    # LAPACK ipiv is a sequence of row swaps, 1-based in paddle's contract
+    return lu.astype(np.float32), (piv + 1).astype(np.int32)
+
+
+def _lu_unpack_ref(lu_data, pivots, unpack_ludata=True,
+                   unpack_pivots=True):
+    n = lu_data.shape[0]
+    lo = np.tril(lu_data, -1) + np.eye(n, dtype=lu_data.dtype)
+    up = np.triu(lu_data)
+    perm = np.arange(n)
+    for i, p in enumerate(pivots):   # 1-based swap sequence -> permutation
+        perm[i], perm[p - 1] = perm[p - 1], perm[i].copy()
+    pm = np.zeros((n, n), lu_data.dtype)
+    pm[perm, np.arange(n)] = 1.0
+    return pm, lo, up
+
+
+CASES7 = [
+    ("addmm", lambda inp, x, y, beta=1.0, alpha=1.0:
+        beta * inp + alpha * (x @ y), [M1[:3, :3], M1[:3, :4], M2[:4, :3]],
+     {"beta": 0.5, "alpha": 2.0}),
+    ("bmm", lambda x, y: x @ y, [BMA, BMB], {}),
+    ("mm", lambda x, y: x @ y, [M1, M2], {}),
+    ("mv", lambda x, vec: x @ vec, [M1, V4], {}),
+    ("dot", np.dot, [V4, V4 + 1], {}),
+    ("inner", np.inner, [A, B], {}),
+    ("vecdot", lambda x, y, axis=-1: (x * y).sum(axis), [A, B], {}),
+    ("tensordot", lambda x, y, axes=2: np.tensordot(x, y, axes),
+     [BMA, R.randn(3, 4, 5).astype(np.float32)], {"axes": 2}),
+    ("multi_dot", lambda *xs: np.linalg.multi_dot(xs),
+     [M1, M2, M2.T[:5, :3]], {}),
+    ("einsum", lambda *xs, equation="": np.einsum(equation, *xs),
+     [M1, M2], {"equation": "ij,jk->ik"}),
+    ("cross", lambda x, y, axis=-1:
+        np.cross(x, y, axisa=axis, axisb=axis, axisc=axis),
+     [R.randn(2, 3).astype(np.float32), R.randn(2, 3).astype(np.float32)],
+     {}),
+    ("cdist", None, [A, B[:2]], {"p": 2.0}),
+    ("pdist", None, [A], {"p": 2.0}),
+    ("dist", lambda x, y, p=2.0: np.array(
+        np.linalg.norm((x - y).ravel(), p), np.float32), [A, B], {}),
+    ("norm", lambda x, p=2, axis=None, keepdim=False:
+        np.linalg.norm(x, p, axis, keepdim), [A], {"p": 2, "axis": 1}),
+    ("det", np.linalg.det, [S3], {}),
+    ("slogdet", np.linalg.slogdet, [G3], {}),
+    ("inverse", np.linalg.inv, [S3], {}),
+    ("pinv", lambda x, rcond=1e-15: np.linalg.pinv(x, rcond), [M1], {}),
+    ("solve", np.linalg.solve, [S3, M1[:3, :2]], {}),
+    ("cholesky", lambda x, upper=False: np.linalg.cholesky(x), [S3], {}),
+    ("cholesky_solve", lambda x, y, upper=False:
+        np.linalg.solve(y @ y.T, x),
+     [M1[:3, :2], np.linalg.cholesky(S3).astype(np.float32)], {}),
+    ("triangular_solve", None,
+     [np.triu(S3).astype(np.float32), M1[:3, :2]], {"upper": True}),
+    ("matrix_exp", None, [G3 * 0.3], {}),
+    ("matrix_power", np.linalg.matrix_power, [G3], {"n": 3}),
+    ("matrix_rank", lambda x, tol=None:
+        np.asarray(np.linalg.matrix_rank(x), np.int64), [S3], {}),
+    ("cond", lambda x, p=None: np.asarray(np.linalg.cond(x), np.float32),
+     [S3], {}),
+    ("lstsq", None, [OVR, OVRY], {}),
+    ("qr", lambda x, mode="reduced": np.linalg.qr(x, mode), [M1], {}),
+    ("lu", _lu_ref, [G3], {}),
+    ("lu_unpack", _lu_unpack_ref, [_lu_ref(G3)[0], _lu_ref(G3)[1]], {}),
+    ("svd", None, [M1], {"full_matrices": False}),
+    ("eigh", lambda x, UPLO="L": np.linalg.eigh(x), [S3], {}),
+    ("eigvalsh", lambda x, UPLO="L": np.linalg.eigvalsh(x), [S3], {}),
+    ("eigvals", np.linalg.eigvals, [G3], {}),
+    ("householder_product", None, [np.linalg.qr(OVR)[0] * 0 + OVR,
+                                   np.array([1.2, 0.8, 1.5], np.float32)],
+     {}),
+    ("ormqr", None, [OVR, np.array([1.2, 0.8, 1.5], np.float32),
+                     R.randn(5, 2).astype(np.float32)], {}),
+    ("cov", lambda x, rowvar=True, ddof=True, fweights=None,
+        aweights=None: np.cov(x, rowvar=rowvar, ddof=1 if ddof else 0),
+     [A], {}),
+    ("corrcoef", lambda x, rowvar=True: np.corrcoef(x, rowvar=rowvar),
+     [A], {}),
+    ("trapezoid", lambda y, x=None, dx=None, axis=-1:
+        np.trapz(y, x, dx if dx is not None else 1.0, axis), [A],
+     {"dx": 0.5}),
+    ("cumulative_trapezoid", None, [A], {"dx": 0.5}),
+    ("cummax", _cummax_ref, [A], {"axis": 1}),
+    ("cummin", _cummin_ref, [A], {"axis": 1}),
+]
+
+
+def _fill_refs7():
+    import scipy.integrate as si
+    import scipy.linalg as sl
+    import scipy.spatial.distance as sd
+
+    def _svd_ref(x, full_matrices=False):
+        u, s, vh = np.linalg.svd(x, full_matrices=full_matrices)
+        return u, s, vh
+
+    def _hhprod_ref(x, tau):
+        return _hh_q(x, tau)[:, :x.shape[1]].astype(np.float32)
+
+    def _ormqr_ref(x, tau, y, left=True, transpose=False):
+        q = _hh_q(x, tau)[:, :x.shape[0]]
+        if transpose:
+            q = q.T
+        return (q @ y if left else y @ q).astype(np.float32)
+
+    refs = {
+        "cdist": lambda x, y, p=2.0: sd.cdist(x, y, "minkowski", p=p),
+        "pdist": lambda x, p=2.0: sd.pdist(x, "minkowski", p=p),
+        "triangular_solve": lambda x, y, upper=True, transpose=False,
+        unitriangular=False: sl.solve_triangular(
+            x, y, lower=not upper, trans="T" if transpose else "N",
+            unit_diagonal=unitriangular),
+        "matrix_exp": sl.expm,
+        "lstsq": lambda x, y, rcond=None: np.linalg.lstsq(x, y,
+                                                          rcond=rcond),
+        "svd": _svd_ref,
+        "householder_product": _hhprod_ref,
+        "ormqr": _ormqr_ref,
+        "cumulative_trapezoid": lambda y, x=None, dx=None, axis=-1:
+            si.cumulative_trapezoid(y, x, dx if dx is not None else 1.0,
+                                    axis),
+    }
+    return [(n, r or refs[n], i, k) for n, r, i, k in CASES7]
+
+
+_LIST7 = {"multi_dot", "einsum"}
+_GRAD7 = {"addmm", "bmm", "mm", "mv", "dot", "inner", "vecdot",
+          "tensordot", "multi_dot", "einsum", "cross", "cdist", "dist",
+          "norm", "det", "inverse", "solve", "trapezoid",
+          "cumulative_trapezoid", "matrix_power"}
+# factorizations/solvers hit f64-less lax.linalg paths; keep lowp to the
+# MXU product ops where a tolerance is meaningful
+_LOWP7 = {"addmm", "bmm", "mm", "mv", "dot", "inner", "vecdot",
+          "tensordot", "multi_dot", "einsum", "cross", "trapezoid"}
+# gauge freedom: Q/R, U/Vh, eigenvectors are sign-ambiguous columns
+_ABS7 = {"qr", "svd", "eigh", "householder_product"}
+# eigvals order is backend-defined: compare as sorted complex spectra
+_POST7 = dict.fromkeys(_ABS7, np.abs)
+_POST7["eigvals"] = np.sort_complex
+
+
+@pytest.mark.parametrize(
+    "name,ref,inputs,kwargs",
+    _fill_refs7(), ids=[c[0] for c in CASES7])
+def test_op_batch7(name, ref, inputs, kwargs):
+    OpTest(name, ref, inputs, kwargs,
+           check_grad=name in _GRAD7,
+           bf16=name in _LOWP7, fp16=name in _LOWP7,
+           list_input=name in _LIST7,
+           post=_POST7.get(name),
+           rtol=1e-4, atol=1e-4).run()

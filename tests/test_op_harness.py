@@ -916,3 +916,169 @@ def test_op_batch7(name, ref, inputs, kwargs):
            list_input=name in _LIST7,
            post=_POST7.get(name),
            rtol=1e-4, atol=1e-4).run()
+
+
+# ===================================================================
+# batch 8 (r5): FFT family (paddle.fft — SURVEY §2.2 Tensor-API row)
+# ===================================================================
+
+FR = R.randn(4, 8).astype(np.float32)
+FC = (R.randn(4, 8) + 1j * R.randn(4, 8)).astype(np.complex64)
+# hermitian-symmetric spectrum input for hfft: irfft's natural domain
+FH = (R.randn(4, 5) + 1j * R.randn(4, 5)).astype(np.complex64)
+
+CASES8 = [
+    ("fft", lambda x, n=None, axis=-1, norm="backward":
+        np.fft.fft(x, n, axis, norm), [FR], {}),
+    ("ifft", lambda x, n=None, axis=-1, norm="backward":
+        np.fft.ifft(x, n, axis, norm), [FC], {}),
+    ("fft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+        np.fft.fft2(x, s, axes, norm), [FR], {}),
+    ("ifft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+        np.fft.ifft2(x, s, axes, norm), [FC], {}),
+    ("fftn", lambda x, s=None, axes=None, norm="backward":
+        np.fft.fftn(x, s, axes, norm), [FR], {}),
+    ("ifftn", lambda x, s=None, axes=None, norm="backward":
+        np.fft.ifftn(x, s, axes, norm), [FC], {}),
+    ("rfft", lambda x, n=None, axis=-1, norm="backward":
+        np.fft.rfft(x, n, axis, norm), [FR], {}),
+    ("irfft", lambda x, n=None, axis=-1, norm="backward":
+        np.fft.irfft(x, n, axis, norm), [FH], {}),
+    ("rfft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+        np.fft.rfft2(x, s, axes, norm), [FR], {}),
+    ("irfft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+        np.fft.irfft2(x, s, axes, norm), [FH], {}),
+    ("rfftn", lambda x, s=None, axes=None, norm="backward":
+        np.fft.rfftn(x, s, axes, norm), [FR], {}),
+    ("irfftn", lambda x, s=None, axes=None, norm="backward":
+        np.fft.irfftn(x, s, axes, norm), [FH], {}),
+    ("hfft", lambda x, n=None, axis=-1, norm="backward":
+        np.fft.hfft(x, n, axis, norm), [FH], {}),
+    ("ihfft", lambda x, n=None, axis=-1, norm="backward":
+        np.fft.ihfft(x, n, axis, norm), [FR], {}),
+    ("hfft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+        np.fft.hfft(np.fft.fft(x, axis=-2), axis=-1), [FH], {}),
+    ("ihfft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+        np.fft.ifft(np.fft.ihfft(x, axis=-1), axis=-2), [FR], {}),
+    ("hfftn", lambda x, s=None, axes=None, norm="backward":
+        np.fft.hfft(np.fft.fftn(x, axes=(0,)), axis=-1), [FH], {}),
+    ("ihfftn", lambda x, s=None, axes=None, norm="backward":
+        np.fft.ifftn(np.fft.ihfft(x, axis=-1), axes=(0,)), [FR], {}),
+    ("fftshift", np.fft.fftshift, [FR], {}),
+    ("ifftshift", np.fft.ifftshift, [FR], {}),
+]
+
+
+@pytest.mark.parametrize(
+    "name,ref,inputs,kwargs",
+    CASES8, ids=[c[0] for c in CASES8])
+def test_op_batch8(name, ref, inputs, kwargs):
+    # FFT kernels compute in f32/c64 regardless of input precision; the
+    # low-precision sweeps would only measure the input cast
+    OpTest(name, ref, inputs, kwargs,
+           check_grad=name in {"fftshift", "ifftshift"},
+           bf16=False, fp16=False, rtol=1e-3, atol=1e-3).run()
+
+
+# ===================================================================
+# batch 9 (r5): reductions, order statistics, histograms
+# ===================================================================
+
+NANX = A.copy()
+NANX[0, 1] = np.nan
+NANX[2, 3] = np.nan
+MODEX = np.array([[1., 2., 2., 3.], [4., 4., 1., 1.]], np.float32)
+HDD = R.rand(20, 2).astype(np.float32)
+
+
+def _mode_ref(x, axis=-1, keepdim=False):
+    # paddle contract: smallest most-frequent value, LAST occurrence index
+    vals = np.zeros(x.shape[:-1], x.dtype)
+    idxs = np.zeros(x.shape[:-1], np.int64)
+    it = np.ndindex(*x.shape[:-1])
+    for i in it:
+        row = x[i]
+        uniq, counts = np.unique(row, return_counts=True)
+        v = uniq[np.argmax(counts)]          # np.unique sorts: smallest wins
+        vals[i] = v
+        idxs[i] = np.max(np.nonzero(row == v)[0])
+    return vals, idxs
+
+
+def _kthvalue_ref(x, k, axis=-1, keepdim=False):
+    order = np.argsort(x, axis=axis, kind="stable")
+    idx = np.take(order, k - 1, axis=axis)
+    vals = np.take_along_axis(x, np.expand_dims(idx, axis),
+                              axis).squeeze(axis)
+    return vals, idx
+
+
+CASES9 = [
+    ("all", lambda x, axis=None, keepdim=False:
+        np.all(x, axis=axis, keepdims=keepdim), [C > 0.05], {"axis": 1}),
+    ("any", lambda x, axis=None, keepdim=False:
+        np.any(x, axis=axis, keepdims=keepdim), [C > 0.5], {"axis": 1}),
+    ("argmax", lambda x, axis=None, keepdim=False, dtype="int64":
+        np.argmax(x, axis=axis), [A], {"axis": 1}),
+    ("argmin", lambda x, axis=None, keepdim=False, dtype="int64":
+        np.argmin(x, axis=axis), [A], {"axis": 1}),
+    ("count_nonzero", lambda x, axis=None, keepdim=False:
+        np.count_nonzero(x, axis=axis), [MASK.astype(np.float32)],
+     {"axis": 1}),
+    ("median", lambda x, axis=None, keepdim=False:
+        np.median(x, axis=axis, keepdims=keepdim), [A], {"axis": 1}),
+    ("nanmean", lambda x, axis=None, keepdim=False:
+        np.nanmean(x, axis=axis, keepdims=keepdim), [NANX], {"axis": 1}),
+    ("nansum", lambda x, axis=None, keepdim=False:
+        np.nansum(x, axis=axis, keepdims=keepdim), [NANX], {"axis": 1}),
+    ("nanmedian", lambda x, axis=None, keepdim=False:
+        np.nanmedian(x, axis=axis, keepdims=keepdim), [NANX], {"axis": 1}),
+    ("quantile", lambda x, q, axis=None, keepdim=False,
+        interpolation="linear": np.quantile(
+            x, q, axis=axis, keepdims=keepdim, method=interpolation),
+     [A], {"q": 0.3, "axis": 1}),
+    ("nanquantile", lambda x, q, axis=None, keepdim=False,
+        interpolation="linear": np.nanquantile(
+            x, q, axis=axis, keepdims=keepdim, method=interpolation),
+     [NANX], {"q": 0.3, "axis": 1}),
+    ("kthvalue", _kthvalue_ref, [MODEX], {"k": 2, "axis": -1}),
+    ("mode", _mode_ref, [MODEX], {"axis": -1}),
+    ("histogram", lambda x, bins=100, min=0.0, max=0.0:  # noqa: A002
+        np.histogram(x, bins, (min, max))[0], [C], {
+            "bins": 5, "min": 0.0, "max": 1.0}),
+    ("bincount", lambda x, weights=None, minlength=0:
+        np.bincount(x, weights, minlength), [I32A.reshape(-1) % 6],
+     {"minlength": 8}),
+    ("histogramdd", None, [HDD],
+     {"bins": 4, "ranges": [[0.0, 1.0], [0.0, 1.0]]}),
+]
+
+
+def _fill_refs9():
+    def _hdd_ref(x, bins=10, ranges=None, density=False, weights=None):
+        h, edges = np.histogramdd(x, bins, ranges, density=density,
+                                  weights=weights)
+        return (h,) + tuple(e.astype(np.float32) for e in edges)
+
+    refs = {"histogramdd": _hdd_ref}
+    return [(n, r or refs[n], i, k) for n, r, i, k in CASES9]
+
+
+@pytest.mark.parametrize(
+    "name,ref,inputs,kwargs",
+    _fill_refs9(), ids=[c[0] for c in CASES9])
+def test_op_batch9(name, ref, inputs, kwargs):
+    # order statistics are selection ops (FD crosses ties); NaN inputs
+    # break FD entirely — grads for these live with the smooth reductions
+    # already covered in batches 1-2
+    OpTest(name, ref, inputs, kwargs, check_grad=False,
+           bf16=name in {"nansum", "nanmean"},
+           fp16=name in {"nansum", "nanmean"}).run()
+
+
+# unique_consecutive is eager-only (data-dependent output shape); the
+# harness asserts the static capture refuses cleanly and skips jit
+def test_op_unique_consecutive():
+    OpTest("unique_consecutive", _unique_consecutive_ref,
+           [np.array([1., 1., 2., 2., 3., 1.], np.float32)], {},
+           check_grad=False, bf16=False, fp16=False).run()

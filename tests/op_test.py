@@ -59,7 +59,7 @@ class OpTest:
                  check_grad: bool = True, bf16: bool = True,
                  fp16: bool = True, bf16_grad: bool | None = None,
                  rtol=None, atol=None, list_input: bool = False,
-                 post=None):
+                 post=None, grad_inputs=None):
         """inputs: list of numpy arrays (positional tensor args; integer
         arrays keep their dtype — index operands — floats normalize to
         float32); kwargs: non-tensor attrs; np_ref(*inputs, **kwargs) ->
@@ -98,6 +98,10 @@ class OpTest:
             self.atol = atol
         self.list_input = list_input
         self.post = post
+        # restrict FD grad checks to these input indices (None = all
+        # floats) — for ops where some float operand is semantically
+        # discrete (e.g. 0/1 labels) and d/d(label) is not meaningful
+        self.grad_inputs = grad_inputs
         self.opdef = get_op(op_name)
 
     # ------------------------------------------------------------- helpers
@@ -210,6 +214,8 @@ class OpTest:
 
         for idx, base in enumerate(self.inputs):
             if not np.issubdtype(base.dtype, np.floating):
+                continue
+            if self.grad_inputs is not None and idx not in self.grad_inputs:
                 continue
             # flat C-order accumulator: zeros_like on a non-contiguous
             # input view would be F-ordered, making reshape(-1) a COPY and

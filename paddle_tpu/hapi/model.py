@@ -192,7 +192,10 @@ class Model:
                          for k, v in grads.items()}
             new_params, new_state = opt.functional_step(
                 params, grads, opt_state, lr, t)
-            return losses, outs, new_buffers, new_params, new_state
+            # labels echoed so the multi-controller+metrics variant can pin
+            # them (with outs) REPLICATED for host-side metric updates
+            return (losses, outs, new_buffers, new_params, new_state,
+                    label_datas)
 
         if trees is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -205,11 +208,17 @@ class Model:
             # would reject the arrays instead of resharding them. Losses are
             # pinned REPLICATED so host-side logging can read them even when
             # the job spans processes (a dp-sharded 'none'-reduction loss is
-            # not addressable from one host).
+            # not addressable from one host). With metrics in the
+            # multi-controller regime, outs+labels are ALSO replicated so
+            # every process updates metrics with the full global batch.
+            gather_for_metrics = (bool(self._metrics)
+                                  and self._is_multiprocess(data_sh))
+            out_lbl = repl if gather_for_metrics else None
             return jax.jit(step, donate_argnums=(0, 2),
                            in_shardings=(p_sh, b_sh, o_sh,
                                          None, None, None, data_sh, data_sh),
-                           out_shardings=(repl, None, b_sh, p_sh, o_sh))
+                           out_shardings=(repl, out_lbl, b_sh, p_sh, o_sh,
+                                          out_lbl))
         return jax.jit(step, donate_argnums=(0, 2))
 
     # ----------------------------------------------- multi-controller glue
@@ -264,8 +273,20 @@ class Model:
                 _, losses = self._loss_pure(outs, label_datas)
             else:
                 losses = []
-            return losses, outs
+            # labels ride through the step so the sharded variant can hand
+            # them back REPLICATED: host-side metric updates then see the
+            # full global batch on every process (multi-controller eval)
+            return losses, outs, label_datas
 
+        trees = self._sharding_trees()
+        if trees is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            data_sh, p_sh, b_sh, _, _ = trees
+            repl = NamedSharding(data_sh.mesh, P())
+            return jax.jit(step,
+                           in_shardings=(p_sh, b_sh, data_sh, data_sh),
+                           out_shardings=(repl, repl, repl))
         return jax.jit(step)
 
     def _build_predict_step(self):
@@ -274,6 +295,16 @@ class Model:
                                          training=False)
             return outs
 
+        trees = self._sharding_trees()
+        if trees is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            data_sh, p_sh, b_sh, _, _ = trees
+            repl = NamedSharding(data_sh.mesh, P())
+            # predict gathers: replicated outputs are host-readable on
+            # every process (the per-host gather SURVEY §2.2 hapi row)
+            return jax.jit(step, in_shardings=(p_sh, b_sh, data_sh),
+                           out_shardings=repl)
         return jax.jit(step)
 
     def _sync_state_in(self):
@@ -349,12 +380,6 @@ class Model:
                 data_sh, tuple(_host(x) for x in _to_list(inputs)))
             label_datas = self._globalize_batch(
                 data_sh, tuple(_host(x) for x in _to_list(labels)))
-            if self._metrics:
-                raise NotImplementedError(
-                    "metrics in the multi-controller regime are not "
-                    "supported yet: metric updates read dp-sharded "
-                    "outputs host-side; compute metrics on rank-local "
-                    "eval data instead")
         else:
             input_datas = tuple(_to_data(x) for x in _to_list(inputs))
             label_datas = tuple(_to_data(x) for x in _to_list(labels))
@@ -381,7 +406,7 @@ class Model:
         lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
         t = jnp.asarray(opt._step_count, dtype=jnp.int32)
         key = default_generator().next_key()
-        losses, outs, new_buffers, new_params, new_state = \
+        losses, outs, new_buffers, new_params, new_state, labels_out = \
             self._train_step_fn(params, buffers, self._opt_state, lr, t, key,
                                 input_datas, label_datas)
         self._opt_state = new_state
@@ -389,41 +414,56 @@ class Model:
 
         metrics = []
         for m in self._metrics:
-            pre = m.compute(*(list(outs) + [Tensor(l) for l in label_datas]))
+            pre = m.compute(*(list(outs) + [Tensor(l) for l in labels_out]))
             metrics.append(m.update(pre))
         loss_np = [np.asarray(l) for l in losses]
         return (loss_np, metrics) if metrics else loss_np
 
+    def _eval_data_in(self, inputs, labels=None):
+        """(input_datas, label_datas, params, buffers) for eval/predict —
+        in the multi-controller regime each process feeds its sampler
+        shard and the global arrays are assembled here (same recipe as
+        train_batch)."""
+        data_sh, _ = self._dp_shardings()
+        if self._is_multiprocess(data_sh):
+            def _host(x):
+                return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+            input_datas = self._globalize_batch(
+                data_sh, tuple(_host(x) for x in _to_list(inputs)))
+            label_datas = self._globalize_batch(
+                data_sh, tuple(_host(x) for x in _to_list(labels)))
+            params, buffers = self._sync_state_in()
+            self._ensure_opt_state(params)
+            params, buffers = self._globalize_state(
+                params, buffers, self._sharding_trees())
+        else:
+            input_datas = tuple(_to_data(x) for x in _to_list(inputs))
+            label_datas = tuple(_to_data(x) for x in _to_list(labels))
+            params, buffers = self._sync_state_in()
+        return input_datas, label_datas, params, buffers
+
     def eval_batch(self, inputs, labels=None):
-        if self._is_multiprocess(self._dp_shardings()[0]):
-            raise NotImplementedError(
-                "eval_batch in the multi-controller regime is not "
-                "supported yet; run evaluation on rank-local data with a "
-                "single-process Model")
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
-        input_datas = tuple(_to_data(x) for x in _to_list(inputs))
-        label_datas = tuple(_to_data(x) for x in _to_list(labels))
-        params, buffers = self._sync_state_in()
-        losses, outs = self._eval_step_fn(params, buffers, input_datas,
-                                          label_datas)
+        input_datas, label_datas, params, buffers = \
+            self._eval_data_in(inputs, labels)
+        losses, outs, labels_out = self._eval_step_fn(
+            params, buffers, input_datas, label_datas)
         metrics = []
         for m in self._metrics:
-            pre = m.compute(*(list(outs) + [Tensor(l) for l in label_datas]))
+            # labels as returned by the step: replicated under sharding, so
+            # every process updates its metric with the FULL global batch —
+            # per-process metric states stay identical (no reduction needed)
+            pre = m.compute(*(list(outs) + [Tensor(l) for l in labels_out]))
             metrics.append(m.update(pre))
         loss_np = [np.asarray(l) for l in losses]
         return (loss_np, metrics) if metrics else loss_np
 
     def predict_batch(self, inputs):
-        if self._is_multiprocess(self._dp_shardings()[0]):
-            raise NotImplementedError(
-                "predict_batch in the multi-controller regime is not "
-                "supported yet; predict on rank-local data with a "
-                "single-process Model")
         if self._predict_step_fn is None:
             self._predict_step_fn = self._build_predict_step()
-        input_datas = tuple(_to_data(x) for x in _to_list(inputs))
-        params, buffers = self._sync_state_in()
+        input_datas, _, params, buffers = self._eval_data_in(inputs)
         outs = self._predict_step_fn(params, buffers, input_datas)
         return [np.asarray(o) for o in outs]
 
@@ -445,19 +485,6 @@ class Model:
         if accumulate_grad_batches != 1:
             raise NotImplementedError(
                 "gradient accumulation lands with the fleet hybrid optimizer")
-        if self._is_multiprocess(self._dp_shardings()[0]):
-            # fail BEFORE training, not one epoch in (multi-controller
-            # limits are knowable here)
-            if eval_data is not None:
-                raise NotImplementedError(
-                    "fit(eval_data=...) in the multi-controller regime is "
-                    "not supported yet; evaluate on rank-local data with a "
-                    "single-process Model")
-            if self._metrics:
-                raise NotImplementedError(
-                    "metrics in the multi-controller regime are not "
-                    "supported yet; compute metrics on rank-local eval "
-                    "data instead")
         train_loader = self._make_loader(train_data, batch_size, shuffle,
                                          num_workers, drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False,

@@ -3,114 +3,91 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .registry import register_op
 
 
-@register_op("equal")
 def equal(x, y):
     return jnp.equal(x, y)
 
 
-@register_op("not_equal")
 def not_equal(x, y):
     return jnp.not_equal(x, y)
 
 
-@register_op("less_than")
 def less_than(x, y):
     return jnp.less(x, y)
 
 
-@register_op("less_equal")
 def less_equal(x, y):
     return jnp.less_equal(x, y)
 
 
-@register_op("greater_than")
 def greater_than(x, y):
     return jnp.greater(x, y)
 
 
-@register_op("greater_equal")
 def greater_equal(x, y):
     return jnp.greater_equal(x, y)
 
 
-@register_op("equal_all")
 def equal_all(x, y):
     return jnp.array_equal(x, y)
 
 
-@register_op("isclose")
 def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
     return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
-@register_op("allclose")
 def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
     return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
-@register_op("isnan")
 def isnan(x):
     return jnp.isnan(x)
 
 
-@register_op("isinf")
 def isinf(x):
     return jnp.isinf(x)
 
 
-@register_op("isfinite")
 def isfinite(x):
     return jnp.isfinite(x)
 
 
-@register_op("logical_and")
 def logical_and(x, y):
     return jnp.logical_and(x, y)
 
 
-@register_op("logical_or")
 def logical_or(x, y):
     return jnp.logical_or(x, y)
 
 
-@register_op("logical_xor")
 def logical_xor(x, y):
     return jnp.logical_xor(x, y)
 
 
-@register_op("logical_not")
 def logical_not(x):
     return jnp.logical_not(x)
 
 
-@register_op("bitwise_and")
 def bitwise_and(x, y):
     return jnp.bitwise_and(x, y)
 
 
-@register_op("bitwise_or")
 def bitwise_or(x, y):
     return jnp.bitwise_or(x, y)
 
 
-@register_op("bitwise_xor")
 def bitwise_xor(x, y):
     return jnp.bitwise_xor(x, y)
 
 
-@register_op("bitwise_not")
 def bitwise_not(x):
     return jnp.bitwise_not(x)
 
 
-@register_op("bitwise_left_shift")
 def bitwise_left_shift(x, y):
     return jnp.left_shift(x, y)
 
 
-@register_op("bitwise_right_shift")
 def bitwise_right_shift(x, y):
     return jnp.right_shift(x, y)

@@ -7,10 +7,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register_op
 
 
-@register_op("matmul", amp_list="white")
 def matmul(x, y, transpose_x=False, transpose_y=False):
     if transpose_x:
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
@@ -19,14 +17,12 @@ def matmul(x, y, transpose_x=False, transpose_y=False):
     return jnp.matmul(x, y)
 
 
-@register_op("t", inplace_view=True)
 def t(x):
     if x.ndim < 2:
         return x
     return jnp.swapaxes(x, -1, -2)
 
 
-@register_op("norm", amp_list="black")
 def norm(x, p="fro", axis=None, keepdim=False):
     if axis is None and p in ("fro", 2):
         return jnp.sqrt(jnp.sum(jnp.square(x)))
@@ -46,13 +42,11 @@ def norm(x, p="fro", axis=None, keepdim=False):
     )
 
 
-@register_op("cholesky", amp_list="black")
 def cholesky(x, upper=False):
     l = jnp.linalg.cholesky(x)
     return jnp.swapaxes(l, -1, -2) if upper else l
 
 
-@register_op("svd", multi_output=True, amp_list="black")
 def svd(x, full_matrices=False):
     """paddle.linalg.svd contract: returns (U, S, VH) with VH of shape
     (..., K, N) so x == U @ diag(S) @ VH (an earlier revision returned V
@@ -61,25 +55,21 @@ def svd(x, full_matrices=False):
     return u, s, vh
 
 
-@register_op("slogdet", multi_output=True, amp_list="black")
 def slogdet(x):
     sign, logabs = jnp.linalg.slogdet(x)
     return sign, logabs
 
 
-@register_op("eigh", multi_output=True, amp_list="black")
 def eigh(x, UPLO="L"):
     w, v = jnp.linalg.eigh(x, UPLO=UPLO)
     return w, v
 
 
-@register_op("lstsq", multi_output=True, amp_list="black")
 def lstsq(x, y, rcond=None):
     sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
     return sol, res, rank, sv
 
 
-@register_op("histogram")
 def histogram(x, bins=100, min=0.0, max=0.0):
     rng = None if (min == 0.0 and max == 0.0) else (min, max)
     hist, _ = jnp.histogram(x, bins=bins, range=rng)

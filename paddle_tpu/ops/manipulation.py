@@ -9,16 +9,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register_op
 
 
-@register_op("reshape", inplace_view=True)
 def reshape(x, shape):
     shape = tuple(int(s) for s in shape)
     return jnp.reshape(x, shape)
 
 
-@register_op("flatten", inplace_view=True)
 def flatten(x, start_axis=0, stop_axis=-1):
     ndim = x.ndim
     if ndim == 0:
@@ -33,7 +30,6 @@ def flatten(x, start_axis=0, stop_axis=-1):
     return jnp.reshape(x, new_shape)
 
 
-@register_op("squeeze", inplace_view=True)
 def squeeze(x, axis=None):
     if axis is None:
         return jnp.squeeze(x)
@@ -44,7 +40,6 @@ def squeeze(x, axis=None):
     return jnp.squeeze(x, axis=axis) if axis else x
 
 
-@register_op("unsqueeze", inplace_view=True)
 def unsqueeze(x, axis):
     if isinstance(axis, int):
         axis = (axis,)
@@ -54,7 +49,6 @@ def unsqueeze(x, axis):
     return out
 
 
-@register_op("split", multi_output=True)
 def split(x, num_or_sections, axis=0):
     axis = int(axis)
     dim = x.shape[axis]
@@ -76,7 +70,6 @@ def split(x, num_or_sections, axis=0):
     return tuple(jnp.split(x, offsets, axis=axis))
 
 
-@register_op("unbind", multi_output=True)
 def unbind(x, axis=0):
     axis = int(axis)
     return tuple(
@@ -85,7 +78,6 @@ def unbind(x, axis=0):
     )
 
 
-@register_op("expand")
 def expand(x, shape):
     shape = list(shape)
     # paddle: -1 keeps the original size
@@ -97,36 +89,30 @@ def expand(x, shape):
     return jnp.broadcast_to(x.reshape(xshape), out_shape)
 
 
-@register_op("broadcast_to")
 def broadcast_to(x, shape):
     return jnp.broadcast_to(x, tuple(int(s) for s in shape))
 
 
-@register_op("tile")
 def tile(x, repeat_times):
     return jnp.tile(x, tuple(int(r) for r in repeat_times))
 
 
-@register_op("cast", inplace_view=True)
 def cast(x, dtype):
     from ..core.dtype import convert_dtype
 
     return x.astype(convert_dtype(dtype))
 
 
-@register_op("gather")
 def gather(x, index, axis=0):
     index = index.reshape(-1) if index.ndim > 1 else index
     return jnp.take(x, index, axis=int(axis))
 
 
-@register_op("gather_nd")
 def gather_nd(x, index):
     idx = tuple(jnp.moveaxis(index, -1, 0))
     return x[idx]
 
 
-@register_op("put_along_axis")
 def put_along_axis(x, indices, values, axis, reduce="assign"):
     values = jnp.broadcast_to(values, indices.shape).astype(x.dtype)
     dims = [i for i in range(x.ndim) if i != axis % x.ndim]
@@ -144,7 +130,6 @@ def put_along_axis(x, indices, values, axis, reduce="assign"):
     raise ValueError(f"unsupported reduce mode {reduce!r}")
 
 
-@register_op("scatter")
 def scatter(x, index, updates, overwrite=True):
     index = index.reshape(-1)
     if overwrite:
@@ -154,20 +139,17 @@ def scatter(x, index, updates, overwrite=True):
     return zeroed.at[index].add(updates)
 
 
-@register_op("scatter_nd_add")
 def scatter_nd_add(x, index, updates):
     idx = tuple(jnp.moveaxis(index, -1, 0))
     return x.at[idx].add(updates)
 
 
-@register_op("flip")
 def flip(x, axis):
     if isinstance(axis, int):
         axis = (axis,)
     return jnp.flip(x, axis=tuple(axis))
 
 
-@register_op("sort")
 def sort(x, axis=-1, descending=False, stable=False):
     out = jnp.sort(x, axis=axis, stable=stable)
     if descending:
@@ -175,13 +157,11 @@ def sort(x, axis=-1, descending=False, stable=False):
     return out
 
 
-@register_op("argsort")
 def argsort(x, axis=-1, descending=False, stable=False):
     out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
     return out.astype("int64")
 
 
-@register_op("topk_indices")
 def topk_indices(x, k, axis=-1, largest=True):
     """Indices of top-k (nondifferentiable); values come from take_along_axis."""
     axis = axis % x.ndim
@@ -192,7 +172,6 @@ def topk_indices(x, k, axis=-1, largest=True):
     return jnp.moveaxis(idx, -1, axis).astype("int64")
 
 
-@register_op("pad")
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
     # paddle F.pad: `pad` is per-axis lo/hi list, innermost axes first for
     # the NCHW/NCL/NCDHW forms, or len == 2*ndim covering all axes.
@@ -222,7 +201,6 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
     return jnp.pad(x, cfg, mode=mode_map[mode])
 
 
-@register_op("diag")
 def diag(x, offset=0, padding_value=0.0):
     if x.ndim == 1 and padding_value != 0.0:
         out = jnp.diag(x, k=offset)
@@ -231,7 +209,6 @@ def diag(x, offset=0, padding_value=0.0):
     return jnp.diag(x, k=offset)
 
 
-@register_op("diag_embed")
 def diag_embed(x, offset=0, dim1=-2, dim2=-1):
     n = x.shape[-1] + abs(offset)
     base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
@@ -246,7 +223,6 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1):
     return out
 
 
-@register_op("slice_op", inplace_view=True)
 def slice_op(x, axes, starts, ends):
     idx = [slice(None)] * x.ndim
     for ax, st, en in zip(axes, starts, ends):
@@ -254,7 +230,6 @@ def slice_op(x, axes, starts, ends):
     return x[tuple(idx)]
 
 
-@register_op("strided_slice", inplace_view=True)
 def strided_slice(x, axes, starts, ends, strides):
     idx = [slice(None)] * x.ndim
     for ax, st, en, sd in zip(axes, starts, ends, strides):
@@ -262,7 +237,6 @@ def strided_slice(x, axes, starts, ends, strides):
     return x[tuple(idx)]
 
 
-@register_op("as_strided", inplace_view=True)
 def as_strided(x, shape, stride, offset=0):
     flat = x.reshape(-1)
     idx = jnp.zeros(tuple(shape), dtype=jnp.int32) + offset
@@ -272,20 +246,17 @@ def as_strided(x, shape, stride, offset=0):
     return flat[idx]
 
 
-@register_op("one_hot")
 def one_hot(x, num_classes):
     import jax
 
     return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
 
 
-@register_op("set_value_by_index")
 def set_value_by_index(x, value, _index_tree=None):
     # used by Tensor.__setitem__ through apply_callable; kept for Program mode
     raise NotImplementedError
 
 
-@register_op("searchsorted")
 def searchsorted(sorted_sequence, values, out_int32=False, right=False):
     out = jnp.searchsorted(sorted_sequence, values,
                            side="right" if right else "left")

@@ -8,17 +8,14 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .registry import register_op
 
 
-@register_op("scale")
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
     if bias_after_scale:
         return x * scale + bias
     return (x + bias) * scale
 
 
-@register_op("logit")
 def logit(x, eps=None):
     if eps is not None:
         x = jnp.clip(x, eps, 1.0 - eps)

@@ -15,18 +15,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register_op
 
 # ----------------------------------------------------------------- activations
 
 
-@register_op("softplus")
 def softplus(x, beta=1.0, threshold=20.0):
     bx = beta * x
     return jnp.where(bx > threshold, x, jax.nn.softplus(bx) / beta)
 
 
-@register_op("prelu")
 def prelu(x, weight):
     w = weight
     if w.size > 1 and x.ndim >= 2:
@@ -37,13 +34,11 @@ def prelu(x, weight):
     return jnp.where(x > 0, x, w * x)
 
 
-@register_op("rrelu")
 def rrelu(x, lower=0.125, upper=1.0 / 3.0, training=False):
     slope = (lower + upper) / 2.0
     return jnp.where(x >= 0, x, slope * x)
 
 
-@register_op("maxout")
 def maxout(x, groups, axis=1):
     axis = axis % x.ndim
     c = x.shape[axis]
@@ -78,7 +73,6 @@ def _conv_padding(padding, k, stride, dilation, n_spatial):
     raise ValueError(f"bad padding {padding!r}")
 
 
-@register_op("conv2d", amp_list="white")
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW"):
     stride = _pair(stride)
@@ -102,7 +96,6 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return out
 
 
-@register_op("conv1d", amp_list="white")
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL"):
     stride = (int(stride) if isinstance(stride, int) else int(stride[0]),)
@@ -125,7 +118,6 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return out
 
 
-@register_op("conv3d", amp_list="white")
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW"):
     def _triple(v):
@@ -149,7 +141,6 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return out
 
 
-@register_op("conv2d_transpose", amp_list="white")
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      data_format="NCHW"):
@@ -192,21 +183,18 @@ def _pool(x, kernel, stride, padding, init, op, data_format="NCHW",
     return out
 
 
-@register_op("max_pool2d")
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                data_format="NCHW"):
     return _pool(x, kernel_size, stride, padding, -jnp.inf, lax.max,
                  data_format)
 
 
-@register_op("avg_pool2d")
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                count_include_pad=True, data_format="NCHW"):
     return _pool(x, kernel_size, stride, padding, 0.0, lax.add, data_format,
                  count_include_pad=count_include_pad, is_avg=True)
 
 
-@register_op("adaptive_avg_pool2d")
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     oh, ow = _pair(output_size)
     if data_format != "NCHW":
@@ -229,7 +217,6 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     return out
 
 
-@register_op("adaptive_max_pool2d")
 def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
     oh, ow = _pair(output_size)
     n, c, h, w = x.shape
@@ -245,7 +232,6 @@ def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
     return jnp.stack(rows, axis=-2)
 
 
-@register_op("max_pool1d")
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
     k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
     s = k if stride is None else (stride if isinstance(stride, int) else stride[0])
@@ -256,7 +242,6 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
     )
 
 
-@register_op("avg_pool1d")
 def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
     k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
     s = k if stride is None else (stride if isinstance(stride, int) else stride[0])
@@ -270,7 +255,6 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
 # -------------------------------------------------------------- normalization
 
 
-@register_op("layer_norm", amp_list="black")
 def layer_norm(x, weight=None, bias=None, epsilon=1e-5,
                begin_norm_axis=-1):
     if isinstance(begin_norm_axis, int) and begin_norm_axis >= 0:
@@ -289,7 +273,6 @@ def layer_norm(x, weight=None, bias=None, epsilon=1e-5,
     return out
 
 
-@register_op("rms_norm", amp_list="black")
 def rms_norm(x, weight=None, epsilon=1e-6):
     x32 = x.astype(jnp.float32)
     ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
@@ -299,7 +282,6 @@ def rms_norm(x, weight=None, epsilon=1e-6):
     return out
 
 
-@register_op("batch_norm_infer", amp_list="black")
 def batch_norm_infer(x, running_mean, running_var, weight=None, bias=None,
                      epsilon=1e-5, data_format="NCHW"):
     c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
@@ -314,7 +296,6 @@ def batch_norm_infer(x, running_mean, running_var, weight=None, bias=None,
     return out.astype(x.dtype)
 
 
-@register_op("batch_norm_train", multi_output=True, amp_list="black")
 def batch_norm_train(x, weight=None, bias=None, epsilon=1e-5,
                      data_format="NCHW"):
     """Returns (out, batch_mean, batch_var). Running-stat update is the
@@ -334,7 +315,6 @@ def batch_norm_train(x, weight=None, bias=None, epsilon=1e-5,
     return out.astype(x.dtype), mean, var
 
 
-@register_op("group_norm", amp_list="black")
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
                data_format="NCHW"):
     if data_format != "NCHW":
@@ -356,7 +336,6 @@ def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
     return out.astype(x.dtype)
 
 
-@register_op("instance_norm", amp_list="black")
 def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
     axes = tuple(range(2, x.ndim))
     x32 = x.astype(jnp.float32)
@@ -372,7 +351,6 @@ def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
     return out.astype(x.dtype)
 
 
-@register_op("local_response_norm")
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
     sq = jnp.square(x)
     half = size // 2
@@ -389,7 +367,6 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
 # --------------------------------------------------------- dropout/emb/linear
 
 
-@register_op("dropout")
 def dropout(x, key, p=0.5, training=True, mode="upscale_in_train", axis=None):
     if not training or p == 0.0:
         return x
@@ -405,7 +382,6 @@ def dropout(x, key, p=0.5, training=True, mode="upscale_in_train", axis=None):
     return jnp.where(keep, x, jnp.zeros_like(x))
 
 
-@register_op("embedding")
 def embedding(ids, weight, padding_idx=None, sparse=False):
     out = jnp.take(weight, ids, axis=0)
     if padding_idx is not None and padding_idx >= 0:
@@ -414,7 +390,6 @@ def embedding(ids, weight, padding_idx=None, sparse=False):
     return out
 
 
-@register_op("linear", amp_list="white")
 def linear(x, weight, bias=None):
     # paddle weight layout: (in_features, out_features)
     out = jnp.matmul(x, weight)
@@ -426,7 +401,6 @@ def linear(x, weight, bias=None):
 # --------------------------------------------------------------------- losses
 
 
-@register_op("cross_entropy", amp_list="black")
 def cross_entropy(logits, label, weight=None, soft_label=False, axis=-1,
                   ignore_index=-100, reduction="mean",
                   label_smoothing=0.0):
@@ -465,7 +439,6 @@ def cross_entropy(logits, label, weight=None, soft_label=False, axis=-1,
     return jnp.sum(loss) / denom
 
 
-@register_op("nll_loss", amp_list="black")
 def nll_loss(logp, label, weight=None, ignore_index=-100, reduction="mean"):
     lbl = label.astype(jnp.int32)
     valid = (lbl != ignore_index).astype(jnp.float32)
@@ -483,7 +456,6 @@ def nll_loss(logp, label, weight=None, ignore_index=-100, reduction="mean"):
     return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
 
 
-@register_op("mse_loss")
 def mse_loss(input, label, reduction="mean"):
     loss = jnp.square(input - label)
     if reduction == "none":
@@ -491,7 +463,6 @@ def mse_loss(input, label, reduction="mean"):
     return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
 
 
-@register_op("l1_loss")
 def l1_loss(input, label, reduction="mean"):
     loss = jnp.abs(input - label)
     if reduction == "none":
@@ -499,7 +470,6 @@ def l1_loss(input, label, reduction="mean"):
     return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
 
 
-@register_op("smooth_l1_loss")
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
     diff = jnp.abs(input - label)
     loss = jnp.where(diff < delta, 0.5 * diff * diff / delta,
@@ -509,7 +479,6 @@ def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
     return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
 
 
-@register_op("binary_cross_entropy", amp_list="black")
 def binary_cross_entropy(input, label, weight=None, reduction="mean"):
     eps = 1e-12
     x = jnp.clip(input.astype(jnp.float32), eps, 1.0 - eps)
@@ -521,7 +490,6 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean"):
     return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
 
 
-@register_op("binary_cross_entropy_with_logits", amp_list="black")
 def binary_cross_entropy_with_logits(logit, label, weight=None,
                                      reduction="mean", pos_weight=None):
     logit = logit.astype(jnp.float32)
@@ -543,7 +511,6 @@ def binary_cross_entropy_with_logits(logit, label, weight=None,
     return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
 
 
-@register_op("kl_div", amp_list="black")
 def kl_div(input, label, reduction="mean", log_target=False):
     if log_target:
         loss = jnp.exp(label) * (label - input)
@@ -560,7 +527,6 @@ def kl_div(input, label, reduction="mean", log_target=False):
     return jnp.mean(loss)
 
 
-@register_op("hinge_embedding_loss")
 def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
     loss = jnp.where(label == 1.0, input,
                      jnp.clip(margin - input, 0.0, None))
@@ -569,7 +535,6 @@ def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
     return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
 
 
-@register_op("cosine_similarity")
 def cosine_similarity(x1, x2, axis=1, eps=1e-8):
     dot_ = jnp.sum(x1 * x2, axis=axis)
     n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
@@ -577,7 +542,6 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
     return dot_ / jnp.maximum(n1 * n2, eps)
 
 
-@register_op("margin_ranking_loss")
 def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
     loss = jnp.clip(-label * (input - other) + margin, 0.0, None)
     if reduction == "none":
@@ -585,7 +549,6 @@ def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
     return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
 
 
-@register_op("sigmoid_focal_loss", amp_list="black")
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
                        reduction="sum"):
     p = jax.nn.sigmoid(logit)
@@ -605,7 +568,6 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
     return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
 
 
-@register_op("label_smooth")
 def label_smooth(label, epsilon=0.1, prior_dist=None):
     n = label.shape[-1]
     if prior_dist is not None:
@@ -616,7 +578,6 @@ def label_smooth(label, epsilon=0.1, prior_dist=None):
 # ------------------------------------------------------------------ attention
 
 
-@register_op("scaled_dot_product_attention", amp_list="white")
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  rng_key=None, dropout_p=0.0,
                                  is_causal=False, scale=None):
@@ -652,7 +613,6 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 # ---------------------------------------------------------------------- misc
 
 
-@register_op("interpolate")
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, data_format="NCHW"):
     n, c, h, w = x.shape
@@ -667,7 +627,6 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     return out.astype(x.dtype)
 
 
-@register_op("pixel_shuffle")
 def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
     r = upscale_factor
     if data_format == "NHWC":
@@ -684,7 +643,6 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
     return out.reshape(n, oc, h * r, w * r)
 
 
-@register_op("channel_shuffle")
 def channel_shuffle(x, groups, data_format="NCHW"):
     """Interleave channels across `groups` (ShuffleNet block glue; ref:
     paddle.nn.functional.channel_shuffle, upstream phi kernel — mount
@@ -698,7 +656,6 @@ def channel_shuffle(x, groups, data_format="NCHW"):
     return jnp.swapaxes(out, 1, 2).reshape(n, c, h, w)
 
 
-@register_op("unfold")
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
     kh, kw = _pair(kernel_sizes)
     sh, sw = _pair(strides)
@@ -718,7 +675,6 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
     return out.reshape(n, c * kh * kw, oh * ow)
 
 
-@register_op("npair_loss")
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
     sim = jnp.matmul(anchor, positive.T)
     lbl = labels.reshape(-1, 1)
@@ -731,7 +687,6 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     return ce + reg
 
 
-@register_op("temporal_shift")
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
     nt, c, h, w = x.shape
     n = nt // seg_num
@@ -754,7 +709,6 @@ def _reduce_loss(loss, reduction):
     return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
 
 
-@register_op("huber_loss")
 def huber_loss(input, label, delta=1.0, reduction="mean"):
     diff = jnp.abs(input - label)
     loss = jnp.where(diff <= delta, 0.5 * diff * diff,
@@ -762,14 +716,12 @@ def huber_loss(input, label, delta=1.0, reduction="mean"):
     return _reduce_loss(loss, reduction)
 
 
-@register_op("soft_margin_loss")
 def soft_margin_loss(input, label, reduction="mean"):
     # softplus(-y*x): overflow-stable form of log(1 + exp(-y*x))
     loss = jax.nn.softplus(-label.astype(input.dtype) * input)
     return _reduce_loss(loss, reduction)
 
 
-@register_op("multi_label_soft_margin_loss")
 def multi_label_soft_margin_loss(input, label, weight=None,
                                  reduction="mean"):
     lab = label.astype(input.dtype)
@@ -781,7 +733,6 @@ def multi_label_soft_margin_loss(input, label, weight=None,
     return _reduce_loss(loss, reduction)
 
 
-@register_op("poisson_nll_loss")
 def poisson_nll_loss(input, label, log_input=True, full=False,
                      epsilon=1e-8, reduction="mean"):
     if log_input:
@@ -796,7 +747,6 @@ def poisson_nll_loss(input, label, log_input=True, full=False,
     return _reduce_loss(loss, reduction)
 
 
-@register_op("gaussian_nll_loss")
 def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
                       reduction="mean"):
     var = jnp.maximum(variance, epsilon)
@@ -806,13 +756,11 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
     return _reduce_loss(loss, reduction)
 
 
-@register_op("pairwise_distance")
 def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
     d = x - y + epsilon
     return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
 
 
-@register_op("triplet_margin_loss")
 def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
                         epsilon=1e-6, swap=False, reduction="mean"):
     def dist(a, b):
@@ -825,7 +773,6 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
     return _reduce_loss(loss, reduction)
 
 
-@register_op("dice_loss")
 def dice_loss(input, label, epsilon=1e-5):
     # input: (N, ..., C) probabilities; label: (N, ..., 1) int class ids
     n_classes = input.shape[-1]
@@ -837,7 +784,6 @@ def dice_loss(input, label, epsilon=1e-5):
     return jnp.mean(1.0 - dice)
 
 
-@register_op("margin_cross_entropy")
 def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
                          margin3=0.0, scale=64.0, return_softmax=False,
                          reduction="mean"):
@@ -857,7 +803,6 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
     return loss
 
 
-@register_op("ctc_loss")
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     """CTC forward algorithm in log space via lax.scan over time.
@@ -921,7 +866,6 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return _reduce_loss(nll, reduction)
 
 
-@register_op("rnnt_loss")
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
               fastemit_lambda=0.0, reduction="mean"):
     """RNN-Transducer loss (Graves 2012) — forward-variable DP.
@@ -1009,7 +953,6 @@ def _check_pool3d_args(ceil_mode, data_format):
         raise ValueError(f"unsupported data_format {data_format!r}")
 
 
-@register_op("max_pool3d")
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                data_format="NCDHW"):
     _check_pool3d_args(ceil_mode, data_format)
@@ -1025,7 +968,6 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
 
 
-@register_op("avg_pool3d")
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                count_include_pad=True, data_format="NCDHW"):
     _check_pool3d_args(ceil_mode, data_format)
@@ -1046,7 +988,6 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return summed / counts
 
 
-@register_op("adaptive_avg_pool3d")
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
     out = _triple_(output_size)
     if data_format != "NCDHW":
@@ -1073,7 +1014,6 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
     return res
 
 
-@register_op("lp_pool1d")
 def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCL"):
     if ceil_mode:
@@ -1092,7 +1032,6 @@ def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
     return (summed ** (1.0 / norm_type)).astype(x.dtype)
 
 
-@register_op("lp_pool2d")
 def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCHW"):
     if ceil_mode:
@@ -1110,7 +1049,6 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     return (summed ** (1.0 / norm_type)).astype(x.dtype)
 
 
-@register_op("fold")
 def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
     """col2im — inverse of unfold: x (N, C*kh*kw, L) -> (N, C, H, W) with
     overlapping patches summed (scatter-add via .at[])."""
@@ -1144,7 +1082,6 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
     return out[:, :, ph:ph + oh, pw:pw + ow]
 
 
-@register_op("max_unpool2d")
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  output_size=None, data_format="NCHW"):
     """Scatter pooled values back to their argmax positions (indices are
@@ -1169,7 +1106,6 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     return flat.reshape(n, c, oh, ow)
 
 
-@register_op("cosine_embedding_loss")
 def cosine_embedding_loss(input1, input2, label, margin=0.0,
                           reduction="mean"):
     x1 = input1.astype(jnp.float32)
@@ -1181,7 +1117,6 @@ def cosine_embedding_loss(input1, input2, label, margin=0.0,
     return _reduce_loss(loss, reduction)
 
 
-@register_op("affine_grid")
 def affine_grid(theta, out_shape, align_corners=True):
     """theta (N, 2, 3) -> sampling grid (N, H, W, 2) in [-1, 1] coords."""
     n, _, h, w = [int(v) for v in out_shape]
@@ -1197,7 +1132,6 @@ def affine_grid(theta, out_shape, align_corners=True):
                       theta.astype(jnp.float32)).astype(theta.dtype)
 
 
-@register_op("grid_sample")
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True):
     """Sample x (N, C, H, W) at normalized grid (N, Hg, Wg, 2) coords.
@@ -1259,7 +1193,6 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     return jnp.moveaxis(out, -1, 1)                       # (n, c, hg, wg)
 
 
-@register_op("max_pool2d_with_index", multi_output=True)
 def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
                           ceil_mode=False, data_format="NCHW"):
     """Max pool returning (values, flat argmax indices over H*W) — the
@@ -1304,7 +1237,6 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
 # ------------------------------------------------- round-4 coverage ops
 # (tools/api_inventory.py audit — verdict r3 #6)
 
-@register_op("adaptive_avg_pool1d")
 def adaptive_avg_pool1d(x, output_size):
     o = output_size if isinstance(output_size, int) else output_size[0]
     n, c, l = x.shape
@@ -1315,7 +1247,6 @@ def adaptive_avg_pool1d(x, output_size):
     return jnp.stack(cols, axis=-1)
 
 
-@register_op("adaptive_max_pool1d")
 def adaptive_max_pool1d(x, output_size):
     o = output_size if isinstance(output_size, int) else output_size[0]
     n, c, l = x.shape
@@ -1326,7 +1257,6 @@ def adaptive_max_pool1d(x, output_size):
     return jnp.stack(cols, axis=-1)
 
 
-@register_op("adaptive_max_pool3d")
 def adaptive_max_pool3d(x, output_size):
     out = _triple_(output_size)
     n, c, d, h, w = x.shape
@@ -1383,7 +1313,6 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
     return out
 
 
-@register_op("conv1d_transpose", amp_list="white")
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      data_format="NCL"):
@@ -1395,7 +1324,6 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                               ("NCH", "OIH", "NCH"))
 
 
-@register_op("conv3d_transpose", amp_list="white")
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      data_format="NCDHW"):

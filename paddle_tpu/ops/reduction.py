@@ -3,7 +3,6 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .registry import register_op
 
 
 def _norm_axis(axis):
@@ -12,103 +11,84 @@ def _norm_axis(axis):
     return axis
 
 
-@register_op("sum")
 def sum_(x, axis=None, keepdim=False, dtype=None):
     return jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
 
 
-@register_op("mean")
 def mean(x, axis=None, keepdim=False):
     return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("max")
 def max_(x, axis=None, keepdim=False):
     return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("min")
 def min_(x, axis=None, keepdim=False):
     return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("amax")
 def amax(x, axis=None, keepdim=False):
     return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("amin")
 def amin(x, axis=None, keepdim=False):
     return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("prod")
 def prod(x, axis=None, keepdim=False, dtype=None):
     return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
 
 
-@register_op("all")
 def all_(x, axis=None, keepdim=False):
     return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("any")
 def any_(x, axis=None, keepdim=False):
     return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("argmax")
 def argmax(x, axis=None, keepdim=False, dtype="int64"):
     out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
     return out.astype(dtype)
 
 
-@register_op("argmin")
 def argmin(x, axis=None, keepdim=False, dtype="int64"):
     out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
     return out.astype(dtype)
 
 
-@register_op("logsumexp", amp_list="black")
 def logsumexp(x, axis=None, keepdim=False):
     from jax.scipy.special import logsumexp as lse
 
     return lse(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("std")
 def std(x, axis=None, unbiased=True, keepdim=False):
     return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
                    keepdims=keepdim)
 
 
-@register_op("var")
 def var(x, axis=None, unbiased=True, keepdim=False):
     return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
                    keepdims=keepdim)
 
 
-@register_op("median")
 def median(x, axis=None, keepdim=False):
     return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("nanmean")
 def nanmean(x, axis=None, keepdim=False):
     return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("nansum")
 def nansum(x, axis=None, keepdim=False):
     return jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdim)
 
 
-@register_op("count_nonzero")
 def count_nonzero(x, axis=None, keepdim=False):
     return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim).astype("int64")
 
 
-@register_op("cumsum")
 def cumsum(x, axis=None, dtype=None):
     if axis is None:
         x = x.reshape(-1)
@@ -116,7 +96,6 @@ def cumsum(x, axis=None, dtype=None):
     return jnp.cumsum(x, axis=axis, dtype=dtype)
 
 
-@register_op("cumprod")
 def cumprod(x, dim=None, dtype=None):
     if dim is None:
         x = x.reshape(-1)
@@ -124,7 +103,6 @@ def cumprod(x, dim=None, dtype=None):
     return jnp.cumprod(x, axis=dim, dtype=dtype)
 
 
-@register_op("cummax", multi_output=True)
 def cummax(x, axis=None):
     if axis is None:
         x = x.reshape(-1)
@@ -141,7 +119,6 @@ def cummax(x, axis=None):
     return vals, run_idx.astype("int64")
 
 
-@register_op("logcumsumexp")
 def logcumsumexp(x, axis=None):
     if axis is None:
         x = x.reshape(-1)

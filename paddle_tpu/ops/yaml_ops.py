@@ -14,6 +14,11 @@ Schema per entry:
     op: exp2                  # registry + namespace name
     args: "x"                 # python signature (defaults allowed)
     impl: "jnp.exp2(x)"       # expression, or a block with `return`
+    kernel: nn_ops.conv2d     # ALTERNATIVE to impl: implementing function
+                              # in ops/<module>.py (the phi-kernel split:
+                              # yaml declares, kernels implement); the
+                              # declared args are validated against the
+                              # kernel's real signature at load
     amp: white|black          # optional AMP list
     multi_output: true        # optional: returns a tuple
     method: exp2|null         # Tensor method name (defaults to op; null=no)
@@ -56,6 +61,22 @@ def _compile_fn(name: str, args: str, impl: str):
     return fn
 
 
+def _resolve_kernel(name: str, ref: str, declared_args: str):
+    import importlib
+    import inspect
+
+    mod_name, fn_name = ref.rsplit(".", 1)
+    mod = importlib.import_module(f"paddle_tpu.ops.{mod_name}")
+    fn = getattr(mod, fn_name)
+    real = str(inspect.signature(fn))[1:-1]
+    if declared_args is not None and real != declared_args:
+        raise ValueError(
+            f"ops.yaml entry {name!r}: declared args {declared_args!r} do "
+            f"not match kernel {ref} signature {real!r} — the yaml is the "
+            f"source of truth; update both together")
+    return fn
+
+
 def load():
     import yaml
 
@@ -63,7 +84,10 @@ def load():
         specs = yaml.safe_load(f)
     for spec in specs:
         name = spec["op"]
-        fn = _compile_fn(name, spec.get("args", "x"), spec["impl"])
+        if "kernel" in spec:
+            fn = _resolve_kernel(name, spec["kernel"], spec.get("args"))
+        else:
+            fn = _compile_fn(name, spec.get("args", "x"), spec["impl"])
         register_op(name,
                     multi_output=bool(spec.get("multi_output", False)),
                     amp_list=spec.get("amp"),

@@ -144,10 +144,77 @@ def main_hapi():
               callbacks=[PrintLoss()])
 
 
+
+
+# ---------------------------------------------- r5: eval/predict/metrics
+NCLS = 4
+
+
+class ClsDS(Dataset):
+    """Deterministic classification data keyed by index."""
+
+    def __len__(self):
+        return N
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(2000 + i)
+        x = rng.randn(IN).astype(np.float32)
+        y = np.int64(i % NCLS)
+        return x, y
+
+
+def build_cls_model():
+    paddle.seed(11)
+    return nn.Sequential(nn.Linear(IN, 16), nn.ReLU(), nn.Linear(16, NCLS))
+
+
+def run_hapi_eval(model, rank_loaders):
+    """fit + evaluate + predict through paddle.Model; returns printables."""
+    train_loader, eval_loader, pred_loader = rank_loaders
+    model.fit(train_loader, eval_data=eval_loader, epochs=1,
+              num_iters=STEPS, verbose=0)
+    logs = model.evaluate(eval_loader, verbose=0)
+    preds = model.predict(pred_loader, stack_outputs=True, verbose=0)
+    return (float(np.sum(logs["loss"])), float(logs["acc"]),
+            float(np.sum(preds[0])), tuple(preds[0].shape))
+
+
+def main_hapi_eval():
+    """VERDICT r4 #4: evaluate/predict/metrics in the multi-controller
+    regime — each process feeds its DistributedBatchSampler shard; outputs
+    and labels come back replicated so every process updates metrics with
+    the full global batch."""
+    assert jax.process_count() == 2
+    rank = jax.process_index()
+
+    net = build_cls_model()
+    wrapped = paddle.DataParallel(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+    model = paddle.Model(wrapped)
+    model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss(),
+                  metrics=paddle.metric.Accuracy())
+
+    ds = ClsDS()
+
+    def shard_loader():
+        sampler = DistributedBatchSampler(ds, batch_size=LOCAL_BS,
+                                          num_replicas=2, rank=rank,
+                                          shuffle=False)
+        return DataLoader(ds, batch_sampler=sampler)
+
+    loss, acc, psum, pshape = run_hapi_eval(
+        model, (shard_loader(), shard_loader(), shard_loader()))
+    print(f"rank={rank} eval_loss={loss:.6f} acc={acc:.6f} "
+          f"pred_sum={psum:.6f} pred_rows={pshape[0]}", flush=True)
+
+
 if __name__ == "__main__":
     import sys
 
     if len(sys.argv) > 1 and sys.argv[1] == "hapi":
         main_hapi()
+    elif len(sys.argv) > 1 and sys.argv[1] == "hapi_eval":
+        main_hapi_eval()
     else:
         main()

@@ -347,3 +347,14 @@ class TestRound3BreadthOps:
         v.sum().backward()
         # d(sum of running min)/dx: x0 contributes once, x1 twice, x2 never
         np.testing.assert_allclose(x.grad.numpy(), [1.0, 2.0, 0.0])
+
+
+def test_yaml_is_the_single_source_of_truth():
+    """r5: every registered op comes from ops.yaml (inline impl or a
+    kernel: reference) — the decorator-only registration path is retired
+    (SURVEY §2.4; VERDICT r4 next #3)."""
+    from paddle_tpu.ops.registry import OPS
+
+    assert set(OPS) == set(GENERATED), (
+        sorted(set(OPS) ^ set(GENERATED)))
+    assert len(OPS) == 392

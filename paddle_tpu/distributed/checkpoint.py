@@ -8,6 +8,15 @@ meshes. Here each host writes the shards of the jax.Arrays it addresses
 shard index ranges); load assembles the requested global arrays from any
 shard layout and re-places them under the current sharding — load-time
 resharding across different mesh shapes/degrees for free.
+
+async_save=True (SURVEY §5 checkpoint bullet: the Orbax-style async sharded
+checkpoint): the device->host snapshot is taken synchronously (so training
+may donate/overwrite the arrays immediately), then the file writes run on a
+background thread. The cross-process barrier + coordinator metadata merge
+are DEFERRED to the join point — the next save_state_dict() call (barrier-
+on-next-save) or an explicit wait_save() — and always run on the calling
+thread, never the writer thread (interleaving collectives from a second
+thread could deadlock a real multihost job).
 """
 from __future__ import annotations
 
@@ -21,9 +30,22 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_save"]
 
 _META = "metadata.json"
+
+#: in-flight async save: [(writer_thread, finalize_fn)]
+_PENDING: list = []
+
+
+def wait_save():
+    """Block until the in-flight async save (if any) is fully durable —
+    local shard files written AND the coordinator's metadata merged. Safe
+    to call with nothing pending."""
+    while _PENDING:
+        thread, finalize = _PENDING.pop()
+        thread.join()
+        finalize()
 
 
 def _unwrap(v):
@@ -34,12 +56,19 @@ def _unwrap(v):
 
 def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, async_save: bool = False):
-    """Write per-shard files + metadata under directory `path`."""
+    """Write per-shard files + metadata under directory `path`.
+
+    async_save=True returns after the device->host snapshot; file writes
+    happen in the background and the metadata merge at the next save /
+    wait_save() (barrier-on-next-save)."""
+    wait_save()   # join any in-flight async save FIRST (ordering + merge)
     os.makedirs(path, exist_ok=True)
     pid = jax.process_index()
     meta = {"version": 1, "tensors": {}, "world": jax.process_count()}
     shard_file = os.path.join(path, f"shard_{pid}.pkl")
     payload = {}
+    # device->host snapshot: ALWAYS synchronous, so the caller may donate
+    # or overwrite the live arrays the moment this returns
     for name, val in _flatten(state_dict).items():
         arr = _unwrap(val)
         if isinstance(arr, jax.Array):
@@ -65,43 +94,61 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             payload[name] = arr
             meta["tensors"][name] = {"scalar": True,
                                      "file": os.path.basename(shard_file)}
-    with open(shard_file, "wb") as f:
-        pickle.dump(payload, f, protocol=4)
-    # every process records the shards IT addressed; the coordinator merges
-    # all ranks' records into the global metadata (a coordinator-only view
-    # would silently drop every other host's slice of each tensor on load)
-    rank_meta = os.path.join(path, f"meta_rank{pid}.json")
-    with open(rank_meta + ".tmp", "w") as f:
-        json.dump(meta, f)
-    os.replace(rank_meta + ".tmp", rank_meta)  # atomic: never seen half-written
-    _barrier_across_processes()  # all ranks' files fresh before the merge;
-    # without this a stale meta_rank{r}.json from a previous save to the
-    # same path could be merged while rank r is still writing
-    if pid == coordinator_rank:
-        world = jax.process_count()
-        merged = {"version": 1, "tensors": {}, "world": world}
-        for r in range(world):
-            rmeta_path = os.path.join(path, f"meta_rank{r}.json")
-            _wait_for_file(rmeta_path)
-            with open(rmeta_path) as f:
-                rmeta = json.load(f)
-            for name, info in rmeta["tensors"].items():
-                have = merged["tensors"].get(name)
-                if have is None:
-                    merged["tensors"][name] = info
-                elif not info.get("scalar"):
-                    seen = {json.dumps(s["index"]) for s in have["shards"]}
-                    have.setdefault("files", [have["file"]])
-                    for s in info["shards"]:
-                        if json.dumps(s["index"]) not in seen:
-                            have["shards"].append(s)
-                    if info["file"] not in have["files"]:
-                        have["files"].append(info["file"])
-        meta_path = os.path.join(path, _META)
-        with open(meta_path + ".tmp", "w") as f:
-            json.dump(merged, f)
-        os.replace(meta_path + ".tmp", meta_path)
-    _barrier_across_processes()  # no rank returns before metadata.json lands
+
+    def write_local():
+        with open(shard_file, "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+        # every process records the shards IT addressed; the coordinator
+        # merges all ranks' records into the global metadata (a
+        # coordinator-only view would silently drop every other host's
+        # slice of each tensor on load)
+        rank_meta = os.path.join(path, f"meta_rank{pid}.json")
+        with open(rank_meta + ".tmp", "w") as f:
+            json.dump(meta, f)
+        # atomic: never seen half-written
+        os.replace(rank_meta + ".tmp", rank_meta)
+
+    def finalize():
+        _barrier_across_processes()  # all ranks' files fresh before the
+        # merge; without this a stale meta_rank{r}.json from a previous
+        # save to the same path could be merged while rank r still writes
+        if pid == coordinator_rank:
+            world = jax.process_count()
+            merged = {"version": 1, "tensors": {}, "world": world}
+            for r in range(world):
+                rmeta_path = os.path.join(path, f"meta_rank{r}.json")
+                _wait_for_file(rmeta_path)
+                with open(rmeta_path) as f:
+                    rmeta = json.load(f)
+                for name, info in rmeta["tensors"].items():
+                    have = merged["tensors"].get(name)
+                    if have is None:
+                        merged["tensors"][name] = info
+                    elif not info.get("scalar"):
+                        seen = {json.dumps(s["index"])
+                                for s in have["shards"]}
+                        have.setdefault("files", [have["file"]])
+                        for s in info["shards"]:
+                            if json.dumps(s["index"]) not in seen:
+                                have["shards"].append(s)
+                        if info["file"] not in have["files"]:
+                            have["files"].append(info["file"])
+            meta_path = os.path.join(path, _META)
+            with open(meta_path + ".tmp", "w") as f:
+                json.dump(merged, f)
+            os.replace(meta_path + ".tmp", meta_path)
+        _barrier_across_processes()  # no rank returns before metadata lands
+
+    if async_save:
+        import threading
+
+        t = threading.Thread(target=write_local, daemon=True,
+                             name="paddle-tpu-async-ckpt")
+        t.start()
+        _PENDING.append((t, finalize))
+        return
+    write_local()
+    finalize()
 
 
 def _barrier_across_processes():

@@ -153,19 +153,43 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Periodic checkpointing. Default: paddle.save pickle files via
+    Model.save. With use_dist_checkpoint=True the network state_dict goes
+    through paddle.distributed.checkpoint instead — per-rank shard files
+    with load-time resharding — and async_save=True makes each epoch's
+    write an Orbax-style background save: training resumes right after the
+    device->host snapshot, and the write is joined at the next save
+    (barrier-on-next-save) or at on_train_end."""
+
+    def __init__(self, save_freq=1, save_dir=None,
+                 use_dist_checkpoint=False, async_save=False):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.use_dist_checkpoint = use_dist_checkpoint or async_save
+        self.async_save = async_save
+
+    def _save(self, path, async_save=False):
+        if not self.use_dist_checkpoint:
+            self.model.save(path)
+            return
+        from ..distributed import checkpoint as dck
+
+        sd = self.model.network.state_dict()
+        dck.save_state_dict(sd, path, async_save=async_save)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and (epoch + 1) % self.save_freq == 0:
             path = os.path.join(self.save_dir, str(epoch), "model")
-            self.model.save(path)
+            self._save(path, async_save=self.async_save)
 
     def on_train_end(self, logs=None):
         if self.save_dir:
-            self.model.save(os.path.join(self.save_dir, "final", "model"))
+            self._save(os.path.join(self.save_dir, "final", "model"))
+            if self.async_save:
+                from ..distributed import checkpoint as dck
+
+                dck.wait_save()
 
 
 class EarlyStopping(Callback):

@@ -576,3 +576,76 @@ def test_two_process_pipeline_parallel():
     ref = sequential_reference_losses()
     got = [losses[(0, t)] for t in range(1, 5)]
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_async_dist_checkpoint_through_model_checkpoint(tmp_path):
+    """VERDICT r4 #10: Orbax-style async sharded checkpoint, driven through
+    the hapi ModelCheckpoint callback under the 8-device mesh (ZeRO-3:
+    params dim-0 sharded). Training continues past each epoch's save; the
+    barrier-on-next-save ordering makes every epoch dir durable by the
+    time on_train_end joins; load reshards to a fresh replicated model."""
+    from paddle_tpu.distributed import checkpoint as dck
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        group_sharded_parallel,
+    )
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+
+    def build():
+        paddle.seed(21)
+        return paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                    paddle.nn.ReLU(),
+                                    paddle.nn.Linear(16, 4))
+
+    net = build()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    wrapped, _ = group_sharded_parallel(net, opt, level="p_g_os")
+    model = paddle.Model(wrapped)
+    model.prepare(optimizer=opt, loss=paddle.nn.MSELoss())
+
+    rng = np.random.RandomState(3)
+    xs = rng.randn(16, 8).astype("float32")
+    ys = rng.randn(16, 4).astype("float32")
+    data = [(xs[i:i + 8], ys[i:i + 8]) for i in range(0, 16, 8)]
+
+    cb = ModelCheckpoint(save_freq=1, save_dir=str(tmp_path),
+                         async_save=True)
+    model.fit(data, epochs=3, verbose=0, callbacks=[cb])
+    assert not dck._PENDING, "on_train_end must join the async save"
+
+    # every epoch dir + final must be complete (metadata.json merged)
+    for sub in ("0", "1", "2", "final"):
+        assert os.path.exists(os.path.join(tmp_path, sub, "model",
+                                           "metadata.json")), sub
+
+    # resharding load: fresh replicated net gets the trained (sharded)
+    # values back
+    fresh = build()
+    sd = fresh.state_dict()
+    dck.load_state_dict(sd, os.path.join(tmp_path, "final", "model"))
+    for (name, p_new) in fresh.state_dict().items():
+        trained = dict(net.state_dict())[name]
+        np.testing.assert_allclose(
+            np.asarray(p_new._data if hasattr(p_new, "_data") else p_new),
+            np.asarray(trained._data if hasattr(trained, "_data")
+                       else trained), rtol=1e-6)
+
+
+def test_async_save_overlaps_and_orders(tmp_path):
+    """Two async saves back-to-back: the second joins the first before
+    writing (ordering), and wait_save makes both durable."""
+    from paddle_tpu.distributed import checkpoint as dck
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+    w = jax.device_put(jnp.arange(32.0).reshape(8, 4),
+                       NamedSharding(mesh, P("dp", None)))
+    dck.save_state_dict({"w": Tensor(w)}, str(tmp_path / "a"),
+                        async_save=True)
+    dck.save_state_dict({"w": Tensor(w * 2)}, str(tmp_path / "b"),
+                        async_save=True)
+    dck.wait_save()
+    assert not dck._PENDING
+    got = {"w": Tensor(jnp.zeros((8, 4)))}
+    dck.load_state_dict(got, str(tmp_path / "b"))
+    np.testing.assert_allclose(np.asarray(got["w"]._data),
+                               np.arange(32.0).reshape(8, 4) * 2)

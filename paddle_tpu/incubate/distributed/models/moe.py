@@ -90,8 +90,16 @@ class MoELayer(nn.Layer):
         self.capacity_factor = getattr(gate, "capacity_factor", 2.0)
         self.aux_loss: Optional[Tensor] = None
 
-    def _routed_forward(self, flat_data, gate_w, expert_run):
-        """Pure routing math over raw arrays (shared by eager vjp and jit)."""
+    def _routed_forward(self, flat_data, gate_w, expert_run, fused=None):
+        """Pure routing math over raw arrays (shared by eager vjp and jit).
+
+        fused=None auto-selects the Pallas gather dispatch on TPU (SURVEY
+        §7 fused-MoE-dispatch kernel): expert queues are filled by row
+        GATHERS over routing indices instead of the [T, E, C] one-hot
+        einsum — no materialized mask, no dead MXU work. fused=True forces
+        it (interpret mode off-TPU, for the hermetic parity tests)."""
+        from ....ops import pallas_kernels as pk
+
         tokens, d = flat_data.shape
         E = self.num_experts
         k = getattr(self.gate, "topk", 2)
@@ -108,16 +116,38 @@ class MoELayer(nn.Layer):
         gates = topv[..., None] * keep                       # [T, k, E]
         denom = jnp.maximum(gates.sum(axis=(1, 2), keepdims=True), 1e-9)
         gates = gates / denom * topv.sum(-1)[:, None, None]
-        pos_onehot = jax.nn.one_hot(
-            jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
-            dtype=probs.dtype) * keep[..., None]             # [T,k,E,C]
-        dispatch = (pos_onehot.sum(1) > 0).astype(probs.dtype)  # [T, E, C]
-        combine = jnp.einsum("tke,tkec->tec", gates, pos_onehot)
 
         # aux load-balance loss (GShard): E * sum(me * ce)
         me = probs.mean(axis=0)
         ce = onehot[:, 0].mean(axis=0)
         aux = E * jnp.sum(me * ce)
+
+        if fused is None:
+            fused = pk.moe_dispatch_available(flat_data)
+        if fused:
+            interpret = not pk._on_tpu()
+            pos_tk = (pos * onehot).sum(-1)                  # [T, k]
+            keep_tk = keep.sum(-1)                           # [T, k] 0/1
+            slot_token, tok_slot = pk.moe_dispatch_indices(
+                topi, pos_tk.astype(jnp.int32), keep_tk, E, capacity)
+            expert_in = pk.gather_rows(
+                flat_data, slot_token, interpret=interpret
+            ).reshape(E, capacity, d)
+            expert_out = expert_run(expert_in)               # [E, C, d']
+            d_out = expert_out.shape[-1]
+            per_k = pk.gather_rows(
+                expert_out.reshape(E * capacity, d_out),
+                tok_slot.reshape(-1), interpret=interpret
+            ).reshape(tokens, k, d_out)
+            gate_tk = gates.sum(-1)                          # [T, k]
+            y = (gate_tk[..., None] * per_k).sum(1)
+            return y, aux
+
+        pos_onehot = jax.nn.one_hot(
+            jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
+            dtype=probs.dtype) * keep[..., None]             # [T,k,E,C]
+        dispatch = (pos_onehot.sum(1) > 0).astype(probs.dtype)  # [T, E, C]
+        combine = jnp.einsum("tke,tkec->tec", gates, pos_onehot)
 
         expert_in = jnp.einsum("tec,td->ecd", dispatch, flat_data)
         expert_out = expert_run(expert_in)                   # [E, C, d']

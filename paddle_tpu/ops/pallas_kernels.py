@@ -1018,3 +1018,133 @@ def _fwd_flash_for_ulysses(q, k, v, scale, causal, axis_name, interpret):
     out = f(padp(q), padp(k), padp(v), jnp.zeros((1, 1, 1, 1), jnp.float32),
             jnp.zeros((1,), jnp.int32))
     return out[:, :, :s, :d]
+
+
+# ============================================================ MoE dispatch
+#
+# Fused MoE dispatch (SURVEY §7's Pallas fusion set; the global_scatter/
+# global_gather analog, ref paddle/fluid/operators/collective/
+# global_scatter_op.* — upstream layout, unverified). The XLA reference
+# path dispatches with a [T, E, C] one-hot einsum: O(T*E*C*d) mostly-zero
+# MXU work plus a materialized [T, E, C] mask. The fused form is a row
+# GATHER: expert_in[e, c] = x[token_of_slot[e, c]] — one DMA per routed
+# row, no dead FLOPs. The same kernel serves the combine stage
+# (out[t, k] = expert_out[slot_of_token[t, k]]), so `gather_rows` is the
+# single primitive:
+#
+#   gather_rows(src [N, d], idx [M] int32) -> [M, d]
+#     out[m] = src[idx[m]]  (idx < 0 -> zero row: over-capacity slots)
+#
+# Forward: Pallas kernel — idx rides in SMEM via scalar prefetch, each
+# output row is an async HBM->VMEM copy. Backward: the transpose of a
+# gather is scatter-add, which XLA lowers well — jnp .at[].add, no
+# hand-written kernel needed (documented asymmetry).
+
+_GATHER_BLOCK_M = 256
+
+
+def _gather_rows_kernel(idx_ref, src_ref, out_ref, sem, *, block_m, d_pad):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    base = pl.program_id(0) * block_m
+    m_total = idx_ref.shape[0]
+
+    def body(j, _):
+        row = idx_ref[jnp.minimum(base + j, m_total - 1)]
+        # clamped gather; empty slots (idx < 0) copy row 0 and are zeroed
+        # OUTSIDE the kernel (an in-kernel masked store at a dynamic row
+        # is not sublane-aligned; Mosaic rejects it — AOT tier finding).
+        # src/out ride FLAT (1-D): a row slice of a (8,128)-tiled 2-D
+        # memref can't start at an arbitrary dynamic row, but a 1-D slice
+        # of length d_pad at offset row*d_pad is provably 128-aligned.
+        safe = jnp.maximum(row, 0)
+        copy = pltpu.make_async_copy(
+            src_ref.at[pl.ds(safe * d_pad, d_pad)],
+            out_ref.at[pl.ds(j * d_pad, d_pad)], sem)
+        copy.start()
+        copy.wait()
+        return 0
+
+    jax.lax.fori_loop(0, block_m, body, 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def gather_rows(src, idx, n_src=None, interpret=False):
+    """out[m] = src[idx[m]] (zero row where idx < 0). Differentiable: the
+    vjp scatter-adds cotangent rows back into src."""
+    return _gather_rows_fwd_impl(src, idx, interpret)
+
+
+def _gather_rows_fwd_impl(src, idx, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = idx.shape[0]
+    n, d = src.shape
+    block_m = min(_GATHER_BLOCK_M, _round_up(m, 8))
+    m_pad = _round_up(m, block_m)
+    # flat 1-D memrefs tile at 1024 elements (8 sublanes x 128 lanes); row
+    # slices must start and span on that boundary
+    d_pad = _round_up(d, 1024)
+    srcp = jnp.pad(src, ((0, 0), (0, d_pad - d)))
+    idxp = jnp.pad(idx.astype(jnp.int32), (0, m_pad - m),
+                   constant_values=-1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m_pad // block_m,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec((block_m * d_pad,),
+                               lambda i, idx_ref: (i,)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+    )
+    out = pl.pallas_call(
+        functools.partial(_gather_rows_kernel, block_m=block_m,
+                          d_pad=d_pad),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_pad * d_pad,), src.dtype),
+        interpret=interpret,
+    )(idxp, srcp.reshape(-1))
+    out = out.reshape(m_pad, d_pad)
+    out = jnp.where((idxp >= 0)[:, None], out, 0)   # empty slots -> zero
+    return out[:m, :d]
+
+
+def _gather_rows_bwd_fwd(src, idx, n_src, interpret):
+    return _gather_rows_fwd_impl(src, idx, interpret), (idx, src.shape[0])
+
+
+def _gather_rows_bwd(n_src, interpret, res, g):
+    idx, n = res
+    safe = jnp.maximum(idx, 0)
+    g = jnp.where((idx >= 0)[:, None], g, 0)
+    dsrc = jnp.zeros((n, g.shape[1]), g.dtype).at[safe].add(g)
+    return dsrc, None
+
+
+gather_rows.defvjp(_gather_rows_bwd_fwd, _gather_rows_bwd)
+
+
+def moe_dispatch_available(x) -> bool:
+    xd = x._data if hasattr(x, "_data") else x
+    return _on_tpu() and xd.ndim == 2
+
+
+def moe_dispatch_indices(topi, pos, keep, num_experts, capacity):
+    """Routing metadata -> gather indices, pure jnp (cheap).
+
+    topi/pos/keep: [T, k] expert id, in-expert position, capacity mask.
+    Returns (slot_token [E*C] int32: which token fills each expert slot,
+    tok_slot [T, k] int32: which flat slot serves each (token, k) — both
+    -1 where unrouted/empty)."""
+    t, k = topi.shape
+    flat_slot = topi * capacity + jnp.clip(pos, 0, capacity - 1)
+    routed = keep > 0
+    tok_slot = jnp.where(routed, flat_slot, -1).astype(jnp.int32)
+    token_ids = jnp.broadcast_to(jnp.arange(t)[:, None], (t, k))
+    slot_token = jnp.full((num_experts * capacity,), -1, jnp.int32)
+    slot_token = slot_token.at[jnp.where(routed, flat_slot,
+                                         num_experts * capacity)].set(
+        token_ids.astype(jnp.int32), mode="drop")
+    return slot_token, tok_slot

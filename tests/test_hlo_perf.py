@@ -260,3 +260,21 @@ def test_ring_attention_kernel_compiles_with_mosaic(monkeypatch):
     x = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
     compiled = jitted.lower(x, x, x).compile()
     assert compiled.as_text().count("custom-call") >= 4
+
+
+@aot
+def test_moe_gather_dispatch_compiles_with_mosaic():
+    """The fused MoE dispatch gather (scalar-prefetched indices + per-row
+    async HBM->VMEM copies) must pass the real Mosaic compiler."""
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+    sh = jax.sharding.SingleDeviceSharding(topo.devices[0])
+    src = jax.ShapeDtypeStruct((1024, 512), jnp.bfloat16, sharding=sh)
+    idx = jax.ShapeDtypeStruct((2048,), jnp.int32, sharding=sh)
+    compiled = jax.jit(
+        lambda s, i: pk.gather_rows(s, i)).lower(src, idx).compile()
+    assert compiled.as_text().count("custom-call") >= 1

@@ -294,3 +294,15 @@ class GradScaler:
         self._scale = state["scale"]
         self._good_steps = state.get("incr_count", 0)
         self._bad_steps = state.get("decr_count", 0)
+
+
+def is_float16_supported(device=None) -> bool:
+    """XLA computes fp16 on every backend we target (TPU runs it through
+    the bf16/fp32 units; CPU emulates) — supported, though bfloat16 is the
+    native/recommended low-precision dtype on TPU."""
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bfloat16 is the TPU MXU's native input dtype."""
+    return True

@@ -185,20 +185,55 @@ def vjp(func, xs, v=None):
     return outs_w, grads_w
 
 
+#: active (pack, unpack) hook pair installed by saved_tensors_hooks
+_SAVED_TENSOR_HOOKS: list = []
+
+
+class saved_tensors_hooks:
+    """paddle.autograd.saved_tensors_hooks: intercept what
+    ctx.save_for_backward stores. `pack` runs when a tensor is saved (its
+    return value is stored instead — e.g. a host copy, or a compressed
+    form); `unpack` runs when the backward reads it back. The activation-
+    offload / recompute customization seam."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self._pair = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        _SAVED_TENSOR_HOOKS.append(self._pair)
+        return self
+
+    def __exit__(self, *exc):
+        _SAVED_TENSOR_HOOKS.remove(self._pair)
+        return False
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
+        self._hooks = None
         self.materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tuple(tensors)
+        if _SAVED_TENSOR_HOOKS:
+            self._hooks = _SAVED_TENSOR_HOOKS[-1]
+            pack, _ = self._hooks
+            self._saved = tuple(pack(t) for t in tensors)
+        else:
+            self._saved = tuple(tensors)
+
+    def _unpacked(self):
+        if self._hooks is not None:
+            _, unpack = self._hooks
+            return tuple(unpack(t) for t in self._saved)
+        return self._saved
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._unpacked()
 
     def saved_tensors(self):
-        return self._saved
+        return self._unpacked()
 
 
 class PyLayerMeta(type):

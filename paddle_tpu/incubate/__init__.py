@@ -8,3 +8,100 @@ from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+# ---- segment ops (paddle.incubate.segment_*; SURVEY §2.2 incubate row).
+# TPU-native: jax.ops.segment_* lower to one sorted scatter-reduce each —
+# the XLA shape for what upstream runs as custom CUDA kernels.
+import jax as _jax
+import jax.numpy as _jnp
+
+
+def _seg_ids(segment_ids):
+    ids = segment_ids._data if hasattr(segment_ids, "_data") else segment_ids
+    return ids.astype(_jnp.int32)
+
+
+def _seg_apply(name, data, segment_ids):
+    from ..core.dispatch import apply_callable
+
+    def fn(xd, ids):
+        n = int(ids.shape[0])
+        num = int(_jnp.max(ids).item() + 1) if not isinstance(
+            ids, _jax.core.Tracer) else None
+        if num is None:
+            raise NotImplementedError(
+                f"segment_{name} needs concrete segment ids under jit; "
+                "pad to a fixed segment count outside the jit region")
+        seg = getattr(_jax.ops, f"segment_{name}")
+        return seg(xd, ids, num_segments=num)
+
+    return apply_callable(f"segment_{name}", fn, data, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _seg_apply("sum", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    from ..core.tensor import Tensor
+
+    total = segment_sum(data, segment_ids)
+    ids = _seg_ids(segment_ids)
+    counts = _jax.ops.segment_sum(_jnp.ones_like(ids, _jnp.float32), ids,
+                                  num_segments=total.shape[0])
+    return Tensor(total._data / _jnp.maximum(counts, 1.0)[
+        (slice(None),) + (None,) * (total._data.ndim - 1)])
+
+
+def segment_max(data, segment_ids, name=None):
+    return _seg_apply("max", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _seg_apply("min", data, segment_ids)
+
+
+def identity_loss(x, reduction="none"):
+    """paddle.incubate.identity_loss: mark a value as a loss (identity fwd,
+    unit cotangent seed); reduction in none|mean|sum."""
+    if reduction in (1, "sum"):
+        return x.sum()
+    if reduction in (0, "mean"):
+        return x.mean()
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (paddle.incubate.softmax_mask_fuse): one
+    XLA fusion — no materialized intermediate sum on TPU."""
+    from ..core.dispatch import apply_callable
+
+    def fn(xd, md):
+        return _jax.nn.softmax(xd + md.astype(xd.dtype), axis=-1)
+
+    return apply_callable("softmax_mask_fuse", fn, x, mask)
+
+
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum",
+                    out_size=None, name=None):
+    """Message passing gather-scatter (paddle.incubate.graph_send_recv /
+    paddle.geometric.send_u_recv): out[d] = reduce over edges e with
+    dst_index[e]=d of x[src_index[e]]."""
+    from ..core.dispatch import apply_callable
+
+    def fn(xd, src, dst):
+        n = int(out_size) if out_size is not None else int(xd.shape[0])
+        msgs = xd[src.astype(_jnp.int32)]
+        seg = {"sum": _jax.ops.segment_sum, "mean": _jax.ops.segment_sum,
+               "max": _jax.ops.segment_max,
+               "min": _jax.ops.segment_min}[reduce_op]
+        out = seg(msgs, dst.astype(_jnp.int32), num_segments=n)
+        if reduce_op == "mean":
+            counts = _jax.ops.segment_sum(
+                _jnp.ones(dst.shape[0], _jnp.float32),
+                dst.astype(_jnp.int32), num_segments=n)
+            out = out / _jnp.maximum(counts, 1.0)[
+                (slice(None),) + (None,) * (out.ndim - 1)]
+        return out
+
+    return apply_callable("graph_send_recv", fn, x, src_index, dst_index)

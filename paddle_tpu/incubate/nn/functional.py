@@ -103,3 +103,14 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     if not pre_layer_norm:
         out = F.layer_norm(out, d, ln_scale, ln_bias, ln_epsilon)
     return out
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """paddle.incubate.nn.functional.fused_matmul_bias — matmul+bias as
+    one epilogue fusion (XLA fuses the add into the MXU output stream)."""
+    import paddle_tpu as paddle
+
+    out = paddle.matmul(x, y, transpose_x=transpose_x,
+                        transpose_y=transpose_y)
+    return out + bias if bias is not None else out

@@ -8,4 +8,7 @@ role of InterpreterCore. jit.save/load serialize StableHLO (L4, static
 module).
 """
 from .functional import bind_state, call_functional, extract_state  # noqa: F401
-from .api import TranslatedLayer, load, save, to_static  # noqa: F401
+from .api import (  # noqa: F401
+    TranslatedLayer, enable_to_static, ignore_module, load, not_to_static,
+    save, set_code_level, set_verbosity, to_static,
+)

@@ -227,6 +227,8 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if kwargs:
             raise TypeError("to_static call supports positional args only")
+        if not _TO_STATIC_ENABLED[0]:
+            return self._run_eager(args)   # paddle.jit.enable_to_static(False)
         training = self._layer.training if self._layer is not None else False
         sig = (_sig_of(args), training)
         if sig in self._eager_sigs:   # before any conversion/state walk
@@ -292,6 +294,31 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     if function is not None:
         return deco(function)
     return deco
+
+
+_TO_STATIC_ENABLED = [True]
+_CODE_LEVEL = [0]
+_VERBOSITY = [0]
+
+
+def enable_to_static(enable: bool = True):
+    """Globally toggle @to_static capture (paddle.jit.enable_to_static):
+    with False every StaticFunction runs its original callable eagerly —
+    the debugging escape hatch."""
+    _TO_STATIC_ENABLED[0] = bool(enable)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Log transformed code at/below `level` (paddle.jit.set_code_level).
+    Here: level > 0 prints each function's dy2static-converted source once
+    at transform time."""
+    _CODE_LEVEL[0] = int(level)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """dy2static logging verbosity (paddle.jit.set_verbosity); level > 0
+    also prints the per-function conversion report."""
+    _VERBOSITY[0] = int(level)
 
 
 def not_to_static(fn=None):

@@ -764,6 +764,15 @@ def _do_transform(fn):
     new_fn.__defaults__ = fn.__defaults__
     new_fn.__kwdefaults__ = fn.__kwdefaults__
     new_fn.__dy2static_report__ = list(transformer.report)
+    from . import api as _api
+
+    if _api._CODE_LEVEL[0] > 0:
+        print(f"[dy2static] converted {fn.__qualname__}:\n"
+              + ast.unparse(new_def))
+    if _api._VERBOSITY[0] > 0:
+        for kind, lineno, status in transformer.report:
+            print(f"[dy2static] {fn.__qualname__}:{lineno} {kind}: "
+                  f"{status}")
     return new_fn
 
 

@@ -24,7 +24,7 @@ from .lr import LRScheduler
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
     "AdamWDL", "RMSProp", "Adadelta", "Lamb", "LRScheduler", "lr",
-    "Rprop", "ASGD", "LBFGS",
+    "Rprop", "ASGD", "LBFGS", "NAdam", "RAdam",
 ]
 
 lr = lr_mod
@@ -576,3 +576,83 @@ class ASGD(Optimizer):
 
 
 from .lbfgs import LBFGS  # noqa: E402,F401
+
+
+class NAdam(Optimizer):
+    """Nesterov-accelerated Adam (Dozat 2016; ref python/paddle/optimizer/
+    nadam.py, upstream layout, unverified — mount empty)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._momentum_decay = momentum_decay
+
+    def _create_accumulators(self, p_data):
+        return {"moment1": jnp.zeros_like(p_data, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p_data, dtype=jnp.float32),
+                # product of mu_1..mu_t rides as a scalar accumulator
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g32 = g.astype(jnp.float32)
+        t_f = t.astype(jnp.float32)
+        psi = 0.96
+        mu_t = self._beta1 * (1.0 - 0.5 * jnp.power(
+            psi, t_f * self._momentum_decay))
+        mu_next = self._beta1 * (1.0 - 0.5 * jnp.power(
+            psi, (t_f + 1.0) * self._momentum_decay))
+        mu_prod = acc["mu_product"] * mu_t
+        m = self._beta1 * acc["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * acc["moment2"] + (1 - self._beta2) * g32 * g32
+        m_hat = (mu_next * m / (1.0 - mu_prod * mu_next)
+                 + (1.0 - mu_t) * g32 / (1.0 - mu_prod))
+        v_hat = v / (1.0 - jnp.power(self._beta2, t_f))
+        new_p = (p.astype(jnp.float32) - (lr_val * lr_scale) * m_hat
+                 / (jnp.sqrt(v_hat) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v, "mu_product": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (Liu et al. 2020; ref python/paddle/optimizer/
+    radam.py): warms up the adaptive term by the variance-rectification
+    factor, falling back to un-adapted SGD-with-momentum while the
+    second-moment estimate is too short to trust."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, p_data):
+        return {"moment1": jnp.zeros_like(p_data, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p_data, dtype=jnp.float32)}
+
+    def _apply_update(self, p, g, acc, lr_val, t, lr_scale=1.0):
+        g32 = g.astype(jnp.float32)
+        t_f = t.astype(jnp.float32)
+        m = self._beta1 * acc["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * acc["moment2"] + (1 - self._beta2) * g32 * g32
+        m_hat = m / (1.0 - jnp.power(self._beta1, t_f))
+        rho_inf = 2.0 / (1.0 - self._beta2) - 1.0
+        beta2_t = jnp.power(self._beta2, t_f)
+        rho_t = rho_inf - 2.0 * t_f * beta2_t / (1.0 - beta2_t)
+        # rectification only when the SMA length is > 4 (else momentum SGD)
+        r_num = (rho_t - 4.0) * (rho_t - 2.0) * rho_inf
+        r_den = (rho_inf - 4.0) * (rho_inf - 2.0) * jnp.maximum(rho_t, 5.0)
+        r_t = jnp.sqrt(jnp.maximum(r_num, 0.0) / r_den)
+        v_hat = jnp.sqrt(v / (1.0 - beta2_t)) + self._epsilon
+        adaptive = r_t * m_hat / v_hat
+        plain = m_hat
+        upd = jnp.where(rho_t > 4.0, adaptive, plain)
+        new_p = (p.astype(jnp.float32)
+                 - (lr_val * lr_scale) * upd).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}

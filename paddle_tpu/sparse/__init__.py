@@ -20,7 +20,7 @@ from ..core.tensor import Tensor
 __all__ = [
     "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
     "SparseCsrTensor", "is_same_shape", "matmul", "masked_matmul", "mv",
-    "add", "subtract", "multiply", "divide", "transpose",
+    "add", "subtract", "multiply", "divide", "transpose", "reshape",
     "relu", "tanh", "sin", "sinh", "asin", "asinh", "atan", "atanh",
     "sqrt", "square", "abs", "neg", "pow", "cast", "coalesce", "nn",
 ]
@@ -353,3 +353,26 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+def reshape(x, shape: Sequence[int]):
+    """Reshape a sparse COO tensor: flat positions are preserved, indices
+    recomputed for the new shape (paddle.sparse.reshape)."""
+    t = x.coalesce() if isinstance(x, SparseCooTensor) else x.to_sparse_coo()
+    shape = list(shape)
+    n_elem = 1
+    for d in t.shape:
+        n_elem *= d
+    if -1 in shape:
+        known = 1
+        for d in shape:
+            if d != -1:
+                known *= d
+        shape[shape.index(-1)] = n_elem // known
+    strides_old = np.cumprod([1] + list(t.shape[::-1]))[::-1][1:]
+    flat = sum(t.indices_[i] * int(strides_old[i])
+               for i in range(len(t.shape)))
+    strides_new = np.cumprod([1] + shape[::-1])[::-1][1:]
+    new_idx = jnp.stack([(flat // int(strides_new[i])) % shape[i]
+                         for i in range(len(shape))])
+    return SparseCooTensor(new_idx, t.values_, shape)

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 
@@ -545,3 +546,263 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
     if return_rois_num:
         res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
     return tuple(res) if len(res) > 1 else out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (R-FCN; ref paddle/vision/ops.py
+    psroi_pool, upstream layout, unverified): input channels factor as
+    out_channels * ph * pw; output bin (i, j) AVERAGE-pools its own
+    channel group over the bin's region."""
+    xd = _unwrap(x).astype(jnp.float32)
+    bx = _unwrap(boxes).astype(jnp.float32)
+    bn = _unwrap(boxes_num)
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    N, C, H, W = xd.shape
+    if C % (oh * ow):
+        raise ValueError(f"psroi_pool: channels {C} not divisible by "
+                         f"{oh}x{ow} bins")
+    oc = C // (oh * ow)
+    batch_idx = jnp.repeat(jnp.arange(N), bn,
+                           total_repeat_length=bx.shape[0])
+    sr = 2   # samples per bin side
+
+    def one_roi(b_i, box):
+        x1, y1, x2, y2 = box * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        ys = y1 + (jnp.arange(oh * sr) + 0.5) * rh / (oh * sr)
+        xs = x1 + (jnp.arange(ow * sr) + 0.5) * rw / (ow * sr)
+        yi = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
+        img = xd[b_i].reshape(oc, oh, ow, H, W)    # channel groups
+        v = img[:, :, :, yi[:, None], xi[None, :]]  # [oc,oh,ow,ohsr,owsr]
+        v = v.reshape(oc, oh, ow, oh, sr, ow, sr)
+        # bin (i, j) pools its own spatial window AND channel group
+        ii = jnp.arange(oh)
+        jj = jnp.arange(ow)
+        picked = v[:, ii[:, None], jj[None, :], ii[:, None], :,
+                   jj[None, :], :]
+        # the broadcast advanced indices land FIRST: picked is
+        # (oh, ow, oc, sr, sr) — put channels back in front
+        return picked.mean(axis=(-1, -2)).transpose(2, 0, 1)
+
+    out = jax.vmap(one_roi)(batch_idx, bx)
+    return Tensor(out)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign each RoI to its FPN pyramid level by box scale (ref
+    paddle/vision/ops.py distribute_fpn_proposals): level = floor(
+    refer_level + log2(sqrt(area) / refer_scale)), clipped to
+    [min_level, max_level]. Returns (per-level RoI lists, per-level
+    rois_num or None, restore index mapping concat(levels) -> input
+    order). Host-side (data-dependent sizes): eager only."""
+    rois = np.asarray(_unwrap(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-9))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+
+    multi_rois, level_ids = [], []
+    for l in range(min_level, max_level + 1):
+        keep = np.nonzero(lvl == l)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[keep])))
+        level_ids.append(keep)
+    order = np.concatenate(level_ids) if level_ids else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    nums = None
+    if rois_num is not None:
+        nums = [Tensor(jnp.asarray(np.array([len(i)], np.int32)))
+                for i in level_ids]
+    return multi_rois, nums, Tensor(jnp.asarray(restore))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (ref paddle/vision/ops.py
+    generate_proposals): decode anchor deltas, clip to the image, drop
+    boxes below min_size, keep pre_nms_top_n by score, NMS, keep
+    post_nms_top_n. Host-side (data-dependent sizes): eager only;
+    single-image and batched inputs."""
+    sc = np.asarray(_unwrap(scores))
+    dl = np.asarray(_unwrap(bbox_deltas))
+    an = np.asarray(_unwrap(anchors)).reshape(-1, 4)
+    va = np.asarray(_unwrap(variances)).reshape(-1, 4)
+    im = np.asarray(_unwrap(img_size))
+    batched = sc.ndim == 4
+    if not batched:
+        sc, dl, im = sc[None], dl[None], im[None]
+
+    all_rois, all_scores, all_nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for b in range(sc.shape[0]):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = dl[b].transpose(1, 2, 0).reshape(-1, 4)
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        acx = an[:, 0] + aw * 0.5
+        acy = an[:, 1] + ah * 0.5
+        cx = va[:, 0] * d[:, 0] * aw + acx
+        cy = va[:, 1] * d[:, 1] * ah + acy
+        bw = np.exp(np.minimum(va[:, 2] * d[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(va[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - off, cy + bh * 0.5 - off], -1)
+        hmax, wmax = im[b][0], im[b][1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, wmax - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, hmax - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        order = np.argsort(-s)[:pre_nms_top_n]
+        boxes, s = boxes[order], s[order]
+        kept = np.asarray(_unwrap(nms(Tensor(jnp.asarray(boxes)),
+                                      nms_thresh,
+                                      Tensor(jnp.asarray(s)))))
+        kept = kept[:post_nms_top_n]
+        all_rois.append(boxes[kept])
+        all_scores.append(s[kept])
+        all_nums.append(len(kept))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois)))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores)))
+    nums = Tensor(jnp.asarray(np.array(all_nums, np.int32)))
+    if return_rois_num:
+        return rois, rscores, nums
+    return rois, rscores
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss for one detection head (ref
+    paddle/vision/ops.py yolo_loss): BCE on the sigmoid xy offsets, L1 on
+    the wh logs (both scaled by 2 - w*h), objectness BCE with the
+    ignore-threshold rule (predictions overlapping any gt above
+    ignore_thresh are not penalized as background), class BCE. gt boxes
+    are (cx, cy, w, h) normalized to [0, 1]; returns the per-image loss
+    [N]."""
+    xd = _unwrap(x).astype(jnp.float32)
+    gb = _unwrap(gt_box).astype(jnp.float32)
+    gl = _unwrap(gt_label)
+    n, c, h, w = xd.shape
+    a = len(anchor_mask)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an_mask = jnp.asarray(an_all[jnp.asarray(anchor_mask)])
+    in_h, in_w = h * downsample_ratio, w * downsample_ratio
+
+    pred = xd.reshape(n, a, 5 + class_num, h, w)
+    tx, ty = pred[:, :, 0], pred[:, :, 1]
+    tw, th = pred[:, :, 2], pred[:, :, 3]
+    tobj = pred[:, :, 4]
+    tcls = pred[:, :, 5:]
+
+    gx = jax.nn.sigmoid(tx)
+    gy = jax.nn.sigmoid(ty)
+    grid_x = jnp.arange(w)[None, None, None, :]
+    grid_y = jnp.arange(h)[None, None, :, None]
+    px = (gx + grid_x) / w                              # pred cx in [0,1]
+    py = (gy + grid_y) / h
+    pw = jnp.exp(tw) * an_mask[None, :, 0, None, None] / in_w
+    ph = jnp.exp(th) * an_mask[None, :, 1, None, None] / in_h
+
+    bsz = gb.shape[1]
+    valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)          # [N, B]
+
+    # best anchor per gt by shape IoU against ALL anchors
+    gw = gb[..., 2] * in_w
+    gh = gb[..., 3] * in_h
+    inter = (jnp.minimum(gw[..., None], an_all[None, None, :, 0])
+             * jnp.minimum(gh[..., None], an_all[None, None, :, 1]))
+    union = (gw[..., None] * gh[..., None]
+             + an_all[None, None, :, 0] * an_all[None, None, :, 1] - inter)
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # [N,B]
+
+    gi = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    mask_pos = jnp.zeros((n, a, h, w))
+    t_x = jnp.zeros((n, a, h, w))
+    t_y = jnp.zeros((n, a, h, w))
+    t_w = jnp.zeros((n, a, h, w))
+    t_h = jnp.zeros((n, a, h, w))
+    t_cls = jnp.zeros((n, a, h, w, class_num))
+    box_scale = jnp.zeros((n, a, h, w))
+    bidx = jnp.arange(n)[:, None].repeat(bsz, 1)
+    amap = jnp.asarray([list(anchor_mask).index(i) if i in anchor_mask
+                        else -1 for i in range(an_all.shape[0])])
+    la = amap[best_anchor]                               # local anchor or -1
+    on = valid & (la >= 0)
+    la_s = jnp.clip(la, 0, a - 1)
+    sc = _unwrap(gt_score).astype(jnp.float32) if gt_score is not None \
+        else jnp.ones((n, bsz), jnp.float32)
+    mask_pos = mask_pos.at[bidx, la_s, gj, gi].max(on.astype(jnp.float32))
+    t_x = t_x.at[bidx, la_s, gj, gi].set(
+        jnp.where(on, gb[..., 0] * w - gi, 0.0))
+    t_y = t_y.at[bidx, la_s, gj, gi].set(
+        jnp.where(on, gb[..., 1] * h - gj, 0.0))
+    t_w = t_w.at[bidx, la_s, gj, gi].set(jnp.where(on, jnp.log(
+        jnp.maximum(gw, 1e-9) / an_all[best_anchor][..., 0]), 0.0))
+    t_h = t_h.at[bidx, la_s, gj, gi].set(jnp.where(on, jnp.log(
+        jnp.maximum(gh, 1e-9) / an_all[best_anchor][..., 1]), 0.0))
+    box_scale = box_scale.at[bidx, la_s, gj, gi].set(
+        jnp.where(on, (2.0 - gb[..., 2] * gb[..., 3]) * sc, 0.0))
+    onehot = jax.nn.one_hot(jnp.clip(gl, 0, class_num - 1), class_num)
+    if use_label_smooth:
+        delta = 1.0 / max(class_num, 1)
+        onehot = onehot * (1.0 - delta) + delta * 1.0 / class_num
+    t_cls = t_cls.at[bidx, la_s, gj, gi].set(
+        onehot * jnp.where(on, 1.0, 0.0)[..., None])
+
+    # ignore mask: pred boxes with IoU > thresh vs ANY valid gt
+    px1, py1 = px - pw / 2, py - ph / 2
+    px2, py2 = px + pw / 2, py + ph / 2
+    gx1 = (gb[..., 0] - gb[..., 2] / 2)[:, None, None, None]
+    gy1 = (gb[..., 1] - gb[..., 3] / 2)[:, None, None, None]
+    gx2 = (gb[..., 0] + gb[..., 2] / 2)[:, None, None, None]
+    gy2 = (gb[..., 1] + gb[..., 3] / 2)[:, None, None, None]
+    iw = jnp.maximum(jnp.minimum(px2[..., None], gx2)
+                     - jnp.maximum(px1[..., None], gx1), 0)
+    ih = jnp.maximum(jnp.minimum(py2[..., None], gy2)
+                     - jnp.maximum(py1[..., None], gy1), 0)
+    inter2 = iw * ih
+    uni2 = (pw * ph)[..., None] + (gb[..., 2] * gb[..., 3])[
+        :, None, None, None] - inter2
+    iou = inter2 / jnp.maximum(uni2, 1e-9)
+    iou = jnp.where(valid[:, None, None, None], iou, 0.0)
+    ignore = (iou.max(-1) > ignore_thresh) & (mask_pos < 0.5)
+
+    def bce(logit, target):
+        return (jnp.maximum(logit, 0) - logit * target
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    loss_xy = (bce(tx, t_x) + bce(ty, t_y)) * mask_pos * box_scale
+    loss_wh = (jnp.abs(tw - t_w) + jnp.abs(th - t_h)) * mask_pos * box_scale
+    obj_target = mask_pos
+    loss_obj = bce(tobj, obj_target) * jnp.where(
+        ignore, 0.0, 1.0)
+    loss_cls = (bce(tcls.transpose(0, 1, 3, 4, 2), t_cls)
+                * mask_pos[..., None])
+    per_img = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+               + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+    return Tensor(per_img)

@@ -1,0 +1,219 @@
+"""Behavioral tests for the round-5 API-audit closures (VERDICT r4 #7):
+every name added to reach 100% coverage does real work, not just import."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _fit_quadratic(opt_cls, **kw):
+    paddle.seed(0)
+    lin = nn.Linear(1, 1, bias_attr=False)
+    lin.weight.set_value(np.array([[3.0]], np.float32))
+    opt = opt_cls(learning_rate=0.1, parameters=lin.parameters(), **kw)
+    for _ in range(150):
+        loss = (lin.weight * lin.weight).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return abs(float(np.asarray(lin.weight.numpy())[0, 0]))
+
+
+class TestNewOptimizers:
+    def test_nadam_converges(self):
+        assert _fit_quadratic(paddle.optimizer.NAdam) < 0.3
+
+    def test_radam_converges(self):
+        assert _fit_quadratic(paddle.optimizer.RAdam) < 0.3
+
+
+class TestAmpSupportFlags:
+    def test_flags(self):
+        assert paddle.amp.is_bfloat16_supported() is True
+        assert paddle.amp.is_float16_supported() is True
+
+
+class TestJitToggles:
+    def test_enable_to_static_off_runs_eager(self):
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            return x * 2
+
+        sf = paddle.jit.to_static(f)
+        paddle.jit.enable_to_static(False)
+        try:
+            out = sf(paddle.to_tensor(np.ones(2, np.float32)))
+            np.testing.assert_allclose(np.asarray(out.numpy()), [2., 2.])
+            assert not sf._cache, "disabled to_static still compiled"
+        finally:
+            paddle.jit.enable_to_static(True)
+        out = sf(paddle.to_tensor(np.ones(2, np.float32)))
+        assert sf._cache, "re-enabled to_static did not compile"
+
+    def test_verbosity_setters_exist(self):
+        paddle.jit.set_code_level(0)
+        paddle.jit.set_verbosity(0)
+
+
+class TestSavedTensorHooks:
+    def test_pack_unpack_intercept(self):
+        packed, unpacked = [], []
+
+        def pack(t):
+            packed.append(t)
+            return np.asarray(t.numpy())      # e.g. offload to host
+
+        def unpack(h):
+            unpacked.append(h)
+            return paddle.to_tensor(h)
+
+        class Square(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor
+                return dy * 2.0 * x
+
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        with paddle.autograd.saved_tensors_hooks(pack, unpack):
+            y = Square.apply(x)
+        y.backward()
+        assert len(packed) == 1 and isinstance(packed[0], paddle.Tensor)
+        assert len(unpacked) == 1 and isinstance(unpacked[0], np.ndarray)
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [6.0])
+
+
+class TestSparseReshape:
+    def test_roundtrip_dense(self):
+        dense = np.zeros((2, 6), np.float32)
+        dense[0, 1] = 3.0
+        dense[1, 4] = -2.0
+        sp = paddle.sparse.sparse_coo_tensor(
+            paddle.to_tensor(np.array([[0, 1], [1, 4]])),
+            paddle.to_tensor(np.array([3.0, -2.0], np.float32)),
+            shape=[2, 6])
+        out = paddle.sparse.reshape(sp, [3, 4])
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                                   dense.reshape(3, 4))
+
+
+class TestSegmentOps:
+    def test_segment_family(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1, 1, 2]))
+        inc = paddle.incubate
+        np.testing.assert_allclose(
+            np.asarray(inc.segment_sum(x, ids).numpy()),
+            [[2, 4], [18, 21], [10, 11]])
+        np.testing.assert_allclose(
+            np.asarray(inc.segment_mean(x, ids).numpy()),
+            [[1, 2], [6, 7], [10, 11]])
+        np.testing.assert_allclose(
+            np.asarray(inc.segment_min(x, ids).numpy()),
+            [[0, 1], [4, 5], [10, 11]])
+
+    def test_softmax_mask_fuse_and_identity_loss(self):
+        x = paddle.to_tensor(np.zeros((1, 4), np.float32))
+        mask = paddle.to_tensor(
+            np.array([[0., 0., -1e9, -1e9]], np.float32))
+        out = np.asarray(paddle.incubate.softmax_mask_fuse(x, mask).numpy())
+        np.testing.assert_allclose(out, [[0.5, 0.5, 0.0, 0.0]], atol=1e-6)
+        v = paddle.incubate.identity_loss(
+            paddle.to_tensor(np.array([2.0, 4.0], np.float32)), "mean")
+        assert float(np.asarray(v.numpy())) == 3.0
+
+    def test_graph_send_recv(self):
+        x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 2]))
+        dst = paddle.to_tensor(np.array([1, 0, 0, 1]))
+        out = paddle.incubate.graph_send_recv(x, src, dst, "sum")
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   [[0, 1, 1], [1, 0, 1], [0, 0, 0]])
+
+
+class TestDetectionOpsR5:
+    def test_psroi_pool_constant_plane(self):
+        # channel layout (oc, ph, pw): c = o*4 + i*2 + j. Constant planes
+        # per channel: output bin (i, j), channel o must read exactly
+        # channel o*4 + i*2 + j's constant
+        x = np.zeros((1, 8, 4, 4), np.float32)
+        for c in range(8):
+            x[0, c] = 10 * (c // 4) + (c % 4)
+        boxes = paddle.to_tensor(np.array([[0., 0., 3., 3.]], np.float32))
+        out = paddle.vision.ops.psroi_pool(
+            paddle.to_tensor(x), boxes,
+            paddle.to_tensor(np.array([1])), 2)
+        got = np.asarray(out.numpy())[0]
+        for o in range(2):
+            for i in range(2):
+                for j in range(2):
+                    np.testing.assert_allclose(got[o, i, j],
+                                               10 * o + i * 2 + j)
+
+    def test_distribute_fpn_proposals_levels(self):
+        rois = paddle.to_tensor(np.array(
+            [[0, 0, 16, 16], [0, 0, 220, 220], [0, 0, 56, 56]], np.float32))
+        mr, nums, restore = paddle.vision.ops.distribute_fpn_proposals(
+            rois, 2, 5, 4, 224, rois_num=paddle.to_tensor(np.array([3])))
+        sizes = [np.asarray(m.numpy()).shape[0] for m in mr]
+        assert sum(sizes) == 3
+        assert sizes[0] >= 1          # the 16x16 box lands on min_level
+        # restore maps each input RoI to its row in concat(levels)
+        cat = np.concatenate([np.asarray(m.numpy())
+                              for m in mr if len(np.asarray(m.numpy()))])
+        orig = np.asarray(paddle.to_tensor(np.array(
+            [[0, 0, 16, 16], [0, 0, 220, 220], [0, 0, 56, 56]],
+            np.float32)).numpy())
+        np.testing.assert_allclose(cat[np.asarray(restore.numpy())], orig)
+
+    def test_generate_proposals_shapes(self):
+        R = np.random.RandomState(0)
+        h = w = 4
+        scores = paddle.to_tensor(R.rand(3, h, w).astype("float32"))
+        deltas = paddle.to_tensor(
+            (R.randn(12, h, w) * 0.1).astype("float32"))
+        anchors = paddle.to_tensor(R.rand(h, w, 3, 4).astype("float32")
+                                   * 32)
+        var = paddle.to_tensor(np.ones((h, w, 3, 4), np.float32))
+        rois, rsc, nums = paddle.vision.ops.generate_proposals(
+            scores, deltas, paddle.to_tensor(np.array([64., 64.])),
+            anchors, var, pre_nms_top_n=20, post_nms_top_n=6,
+            return_rois_num=True)
+        n = int(np.asarray(nums.numpy())[0])
+        assert 1 <= n <= 6
+        assert np.asarray(rois.numpy()).shape == (n, 4)
+        # scores sorted descending after NMS keep-order
+        s = np.asarray(rsc.numpy())
+        assert (np.diff(s) <= 1e-6).all()
+
+    def test_yolo_loss_finite_and_positive(self):
+        R = np.random.RandomState(0)
+        x = paddle.to_tensor(R.randn(2, 24, 4, 4).astype("float32"))
+        gtb = paddle.to_tensor(np.array(
+            [[[0.5, 0.5, 0.3, 0.4], [0, 0, 0, 0]]] * 2, np.float32))
+        gtl = paddle.to_tensor(np.array([[1, 0]] * 2, np.int64))
+        loss = paddle.vision.ops.yolo_loss(
+            x, gtb, gtl,
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2][:3],
+            class_num=3, ignore_thresh=0.7, downsample_ratio=32)
+        v = np.asarray(loss.numpy())
+        assert v.shape == (2,) and np.isfinite(v).all() and (v > 0).all()
+
+    def test_fused_matmul_bias(self):
+        R = np.random.RandomState(1)
+        x = paddle.to_tensor(R.randn(3, 4).astype("float32"))
+        y = paddle.to_tensor(R.randn(4, 5).astype("float32"))
+        b = paddle.to_tensor(R.randn(5).astype("float32"))
+        out = paddle.incubate.nn.functional.fused_matmul_bias(x, y, b)
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()),
+            np.asarray(x.numpy()) @ np.asarray(y.numpy())
+            + np.asarray(b.numpy()), rtol=1e-5)

@@ -77,9 +77,21 @@ class Config:
     # -------------------------------------------------------------- devices
     def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
                        device_id: int = 0, precision=None):
-        # accelerator selection is owned by the jax backend; record intent
+        # accelerator selection is owned by the jax backend; record intent.
+        # precision is ACTED ON: Half/Bfloat16 select the bf16 StableHLO
+        # variant exported next to the f32 module (see Predictor)
         self._use_device = "accelerator"
         self._memory_pool_init_mb = memory_pool_init_size_mb
+        if precision is not None:
+            self._flags["precision"] = precision
+
+    def set_precision(self, precision):
+        """Select the executed artifact's precision (PrecisionType.*):
+        Half/Bfloat16 run the bf16-compute StableHLO module."""
+        self._flags["precision"] = precision
+
+    def precision(self):
+        return self._flags["precision"]
 
     def disable_gpu(self):
         self._use_device = "cpu"
@@ -173,7 +185,12 @@ class Predictor:
                 f"no inference artifact at {path!r} (expected a directory "
                 "or a save_inference_model/jit.save prefix)")
         self._config = config
-        self._model = LoadedInferenceModel(out_dir)
+        prec = config._flags.get("precision", PrecisionType.Float32)
+        prec_name = {PrecisionType.Float32: "float32",
+                     PrecisionType.Half: "float16",
+                     PrecisionType.Bfloat16: "bfloat16"}.get(prec,
+                                                             "float32")
+        self._model = LoadedInferenceModel(out_dir, precision=prec_name)
         self._inputs = {
             d["name"]: Tensor(d["name"], d.get("shape"), d.get("dtype"))
             for d in self._model.meta["feed"]

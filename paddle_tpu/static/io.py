@@ -95,12 +95,35 @@ def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
     blob = exported.serialize()
     hlo_text = jax.jit(pure).lower(param_arrays, *abstract).as_text()
 
+    # bfloat16 variant: same call signature (f32 params/feeds, cast
+    # in-module, outputs cast back) so ONE weights file serves both;
+    # inference.Config precision=Bfloat16/Half executes THIS module —
+    # the toggle changes the artifact, not just a recorded flag
+    def _cast_tree(t, dt):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(dt)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a, t)
+
+    def pure_bf16(params, *feeds):
+        outs = pure(_cast_tree(params, jnp.bfloat16),
+                    *[f.astype(jnp.bfloat16)
+                      if jnp.issubdtype(f.dtype, jnp.floating) else f
+                      for f in feeds])
+        return [o.astype(jnp.float32)
+                if jnp.issubdtype(o.dtype, jnp.floating) else o
+                for o in outs]
+
+    blob_bf16 = jax_export.export(jax.jit(pure_bf16))(
+        param_arrays, *abstract).serialize()
+
     out_dir = str(path_prefix) + ".tpu_model"
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, _HLO), "w") as f:
         f.write(hlo_text)
     with open(os.path.join(out_dir, _HLO + ".bin"), "wb") as f:
         f.write(blob)
+    with open(os.path.join(out_dir, _HLO + ".bf16.bin"), "wb") as f:
+        f.write(blob_bf16)
     with open(os.path.join(out_dir, _WEIGHTS), "wb") as f:
         pickle.dump({"params": {k: np.asarray(v)
                                 for k, v in param_arrays.items()}}, f,
@@ -119,14 +142,27 @@ class LoadedInferenceModel:
     """Stands in for the inference Program after load: executes the
     deserialized StableHLO module. Executor.run dispatches on this type."""
 
-    def __init__(self, out_dir: str):
+    def __init__(self, out_dir: str, precision: str = "float32"):
         self._dir = out_dir
+        self.precision = precision
         with open(os.path.join(out_dir, _META)) as f:
             self.meta = json.load(f)
         with open(os.path.join(out_dir, _WEIGHTS), "rb") as f:
             w = pickle.load(f)
         self._params = {k: jnp.asarray(v) for k, v in w["params"].items()}
-        with open(os.path.join(out_dir, _HLO + ".bin"), "rb") as f:
+        blob_path = os.path.join(out_dir, _HLO + ".bin")
+        if precision in ("bfloat16", "float16"):
+            # the low-precision module exported next to the f32 one (same
+            # signature: casts ride inside the module)
+            lp = os.path.join(out_dir, _HLO + ".bf16.bin")
+            if os.path.exists(lp):
+                blob_path = lp
+            else:
+                raise FileNotFoundError(
+                    f"artifact at {out_dir} predates the bf16 variant; "
+                    "re-save with save_inference_model to use "
+                    f"precision={precision!r}")
+        with open(blob_path, "rb") as f:
             blob = f.read()
         from jax import export as jax_export
 

@@ -199,7 +199,10 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
 
     level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3).
     """
-    assert level in ("os", "os_g", "p_g_os"), f"bad level {level!r}"
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(
+            f"group_sharded_parallel level must be 'os' (ZeRO-1), 'os_g' "
+            f"(ZeRO-2) or 'p_g_os' (ZeRO-3); got {level!r}")
     if level == "p_g_os":
         wrapped = GroupShardedStage3(model, optimizer=optimizer, group=group,
                                      offload=offload)

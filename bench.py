@@ -308,6 +308,10 @@ def bench_child() -> None:
     if on_tpu:
         cfg = ErnieConfig.ernie_base()  # ERNIE-1.0: L12 H768 A12 vocab 18k
         batch, seq, steps, warmup = 32, 512, 20, 3
+        # BENCH_REMAT=1: checkpoint encoder layers — AOT memory analysis
+        # (PERF_NOTES r5) shows batch 64+ needs it to fit 16 GB
+        if os.environ.get("BENCH_REMAT") == "1":
+            cfg.recompute = True
     else:  # CPU smoke fallback; driver runs on TPU
         cfg = ErnieConfig.tiny()
         batch, seq, steps, warmup = 8, 128, 5, 1

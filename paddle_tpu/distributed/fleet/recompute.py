@@ -46,7 +46,10 @@ def recompute(function, *args, use_reentrant: bool = True,
 
     layer = _find_layer(function)
     arg_tensors = [a for a in args if isinstance(a, Tensor)]
-    template = [a if not isinstance(a, Tensor) else None for a in args]
+    # distinct sentinel: a literal None argument (e.g. attention_mask=None)
+    # must NOT read a tensor slot (it did — r5 ERNIE recompute fix)
+    _slot = object()
+    template = [_slot if isinstance(a, Tensor) else a for a in args]
     if layer is not None:
         named = [(n, p) for n, p in layer.named_parameters()
                  if not p.stop_gradient]
@@ -61,7 +64,7 @@ def recompute(function, *args, use_reentrant: bool = True,
         arg_datas = datas[:n_args]
         param_datas = datas[n_args:]
         it = iter(arg_datas)
-        rebuilt = [Tensor(next(it)) if t is None else t for t in template]
+        rebuilt = [Tensor(next(it)) if t is _slot else t for t in template]
 
         def unwrap(x):
             return x._data if isinstance(x, Tensor) else x

@@ -33,6 +33,11 @@ class ErnieConfig:
     attention_probs_dropout_prob: float = 0.1
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
+    # activation checkpointing: rerun each encoder layer's forward in the
+    # backward instead of keeping its activations (jax.remat via
+    # fleet.recompute) — trades ~1/3 more FLOPs for O(layers) less HBM,
+    # unlocking larger bench batches (PERF_NOTES r5)
+    recompute: bool = False
 
     @classmethod
     def ernie_base(cls):
@@ -154,8 +159,14 @@ class ErnieModel(nn.Layer):
             attention_mask = ((1.0 - attention_mask.astype("float32"))
                               * -1e4).unsqueeze(1).unsqueeze(1)
         x = self.embeddings(input_ids, token_type_ids, position_ids)
-        for layer in self.layers:
-            x = layer(x, attention_mask)
+        if self.config.recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+
+            for layer in self.layers:
+                x = recompute(layer, x, attention_mask)
+        else:
+            for layer in self.layers:
+                x = layer(x, attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 

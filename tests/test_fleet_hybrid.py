@@ -547,3 +547,43 @@ def test_hybrid_clip_psum_inside_shard_map():
                                np.full(4, expect), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(r_clipped),
                                np.full(1, 2 * expect), rtol=1e-5)
+
+
+def test_recompute_accepts_none_args_and_matches():
+    """r5 regression: a literal None argument (attention_mask=None) used to
+    collide with recompute's tensor-slot sentinel and crash; and the
+    rematerialized backward must reproduce the exact losses (dropout keys
+    ride the functional trace stream)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.jit.functional import extract_state
+    from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+    import bench
+
+    def run(recompute):
+        paddle.seed(3)
+        cfg = ErnieConfig.tiny()
+        cfg.recompute = recompute
+        model = ErnieForPretraining(cfg)
+        model.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        params, buffers = extract_state(model)
+        opt_state = opt.functional_state(params)
+        step = jax.jit(bench.make_train_step(model, opt))
+        ids = jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (2, 32)))
+        paddle.seed(7)
+        from paddle_tpu.core.rng import default_generator
+
+        losses = []
+        for t in range(1, 3):
+            key = default_generator().next_key()
+            loss, params, buffers, opt_state = step(
+                params, buffers, opt_state, jnp.float32(1e-3),
+                jnp.int32(t), key, ids, ids)
+            losses.append(float(np.asarray(loss)))
+        return losses
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-5)

@@ -215,11 +215,27 @@ def _run_aot_gates() -> dict:
 
     gates: dict[str, str] = {"mode": "aot-compile (no chip; real v5e "
                              "compiler via libtpu topology)"}
-    try:
+    def topo_devices():
         from jax.experimental import topologies
         topo = topologies.get_topology_desc(platform="tpu",
                                             topology_name="v5e:2x2")
-        sh = jax.sharding.SingleDeviceSharding(topo.devices[0])
+        return topo.devices
+
+    try:
+        try:
+            devs = topo_devices()
+        except Exception as first:  # noqa: BLE001
+            # a dead process can leave the libtpu lockfile behind; clear
+            # it once and retry (the error message itself says to)
+            if "lockfile" in str(first):
+                try:
+                    os.remove("/tmp/libtpu_lockfile")
+                except OSError:
+                    pass
+                devs = topo_devices()
+            else:
+                raise
+        sh = jax.sharding.SingleDeviceSharding(devs[0])
     except Exception as e:  # noqa: BLE001
         gates["mode"] = f"aot unavailable: {type(e).__name__}: {str(e)[:200]}"
         return gates
@@ -333,10 +349,18 @@ def bench_child() -> None:
     # this copy, never re-extract from the model (advisor r3 finding).
     # Only the sweep's OOM path consumes it, so only take the ~1GB
     # device->host copy when the sweep will actually run.
+    # sweep entries: "64" = plain, "64r" = with activation checkpointing
+    # (remat). Defaults are remat batches: AOT memory analysis (PERF_NOTES
+    # r5) shows the un-checkpointed step already needs 16.9 GB at batch 64
+    # — plain 64/128 would only exercise the OOM-recovery path.
     try:
-        sweep_batches = [int(s) for s in
-                         os.environ.get("BENCH_SWEEP", "64,128").split(",")
-                         if s.strip()]
+        sweep_batches = []
+        for tok in os.environ.get("BENCH_SWEEP", "64r,128r").split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            use_r = tok.endswith("r")
+            sweep_batches.append((int(tok[:-1] if use_r else tok), use_r))
     except ValueError:  # malformed override: skip the sweep, don't crash
         _log("phase=build: malformed BENCH_SWEEP ignored")
         sweep_batches = []
@@ -357,15 +381,30 @@ def bench_child() -> None:
     jitted = jax.jit(make_train_step(model, opt), donate_argnums=(0, 1, 2))
     lr = jnp.float32(1e-4)
     step_no = [0]
+    _remat_step = [None]
 
-    def run_steps(n, ids, labels, sync_each=False):
+    def remat_step():
+        """Lazily-jitted step over the SAME weights with encoder-layer
+        checkpointing (the 'r' sweep entries / final phase)."""
+        if _remat_step[0] is None:
+            import dataclasses
+
+            cfg_r = dataclasses.replace(cfg, recompute=True)
+            model_r = ErnieForPretraining(cfg_r)
+            model_r.train()
+            _remat_step[0] = jax.jit(make_train_step(model_r, opt),
+                                     donate_argnums=(0, 1, 2))
+        return _remat_step[0]
+
+    def run_steps(n, ids, labels, sync_each=False, step_fn=None):
         nonlocal params, buffers, opt_state
+        fn = step_fn or jitted
         loss = None
         t0 = time.perf_counter()
         for _ in range(n):
             step_no[0] += 1
             key = default_generator().next_key()
-            loss, params, buffers, opt_state = jitted(
+            loss, params, buffers, opt_state = fn(
                 params, buffers, opt_state, lr, jnp.int32(step_no[0]), key,
                 ids, labels)
             if sync_each:
@@ -415,32 +454,38 @@ def bench_child() -> None:
          f"(mfu={best['detail']['mfu']:.3f})")
 
     # --- phase: batch micro-sweep (TPU only, no explicit override) --------
-    sweep_detail = {batch: round(tps_q, 1)}
+    sweep_detail = {str(batch): round(tps_q, 1)}
+    best_r = False
     if will_sweep:
         best_b, best_tps = batch, tps_q
-        for b in sweep_batches:
+        for b, use_r in sweep_batches:
+            tag = f"{b}{'r' if use_r else ''}"
             try:
+                sf = remat_step() if use_r else jitted
                 bi, bl = data_for(b)
-                run_steps(2, bi, bl, sync_each=True)      # compile + warm
-                dt_s, _ = run_steps(5, bi, bl)
+                run_steps(2, bi, bl, sync_each=True,
+                          step_fn=sf)                     # compile + warm
+                dt_s, _ = run_steps(5, bi, bl, step_fn=sf)
                 tps = b * seq * 5 / dt_s
-                sweep_detail[b] = round(tps, 1)
-                _log(f"phase=sweep: batch={b} -> {tps:,.0f} tok/s")
+                sweep_detail[tag] = round(tps, 1)
+                _log(f"phase=sweep: batch={tag} -> {tps:,.0f} tok/s")
                 if tps > best_tps:
-                    best_b, best_tps = b, tps
-            except Exception as e:  # OOM etc.: keep the last good batch
-                _log(f"phase=sweep: batch={b} failed ({type(e).__name__})")
+                    best_b, best_tps, best_r = b, tps, use_r
+            except Exception as e:  # OOM etc.: try the NEXT entry (a later
+                # remat entry may fit where a plain one OOMed)
+                _log(f"phase=sweep: batch={tag} failed ({type(e).__name__})")
                 # the failed jitted call donated/poisoned the state arrays;
                 # restore from the host snapshot (NOT extract_state — those
                 # buffers were donated and deleted)
                 params, buffers, opt_state = restore_state()
-                break
         batch = best_b
-        _log(f"phase=sweep: picked batch={batch}")
+        _log(f"phase=sweep: picked batch={batch}"
+             + (" (remat)" if best_r else ""))
         ids, labels = data_for(batch)
 
     # --- phase: final measurement with profiler trace ---------------------
-    run_steps(warmup, ids, labels, sync_each=True)
+    final_step = remat_step() if best_r else jitted
+    run_steps(warmup, ids, labels, sync_each=True, step_fn=final_step)
     _log(f"phase=warmup: {warmup} steps done (batch={batch})")
     trace_ok = False
     if on_tpu and os.environ.get("BENCH_TRACE", "1") == "1":
@@ -449,7 +494,8 @@ def bench_child() -> None:
             trace_ok = True
         except Exception as e:  # noqa: BLE001
             _log(f"phase=trace: start failed ({type(e).__name__}: {e})")
-    dt, final_loss = run_steps(steps, ids, labels)
+    dt, final_loss = run_steps(steps, ids, labels,
+                               step_fn=final_step)
     if trace_ok:
         try:
             jax.profiler.stop_trace()
@@ -461,6 +507,7 @@ def bench_child() -> None:
     tokens_per_sec = batch * seq * steps / dt
     final = result_json(tokens_per_sec, batch, steps, dt, final_loss, "final")
     final["detail"]["sweep"] = {str(k): v for k, v in sweep_detail.items()}
+    final["detail"]["remat"] = best_r
     _write_partial(final)
     _emit(final)
 

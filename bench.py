@@ -183,13 +183,22 @@ def make_train_step(model, opt):
     from paddle_tpu.core import tape as tape_mod
     from paddle_tpu.jit.functional import call_functional
 
+    fused_loss = bool(getattr(getattr(model, "config", None),
+                              "fused_mlm_loss", False))
+
     def train_step(params, buffers, opt_state, lr, t, key, ids, labels):
         def loss_of(p):
+            # fused: forward returns the MLM loss directly via the chunked
+            # fused_linear_cross_entropy head — no (b*s, vocab) logits
+            args = ((ids, None, None, None, labels) if fused_loss
+                    else (ids,))
             with amp.auto_cast(level="O1", dtype="bfloat16"):
-                (logits, nsp), new_buffers = call_functional(
-                    model, p, buffers, (ids,), rng_key=key, training=True)
+                (out, nsp), new_buffers = call_functional(
+                    model, p, buffers, args, rng_key=key, training=True)
+            if fused_loss:
+                return out, new_buffers
             with tape_mod.no_grad():
-                loss = model.loss(paddle.Tensor(logits), paddle.Tensor(nsp),
+                loss = model.loss(paddle.Tensor(out), paddle.Tensor(nsp),
                                   paddle.Tensor(labels))
             return loss._data, new_buffers
 
@@ -323,6 +332,11 @@ def bench_child() -> None:
 
     if on_tpu:
         cfg = ErnieConfig.ernie_base()  # ERNIE-1.0: L12 H768 A12 vocab 18k
+        cfg.fused_mlm_loss = True       # chunked CE head (PERF_NOTES r5)
+        # dropout masks from the hardware PRNG instead of threefry's 20 u32
+        # rounds per element (PERF_NOTES r5 trace); opt-out by pre-setting
+        # the var to ""
+        os.environ.setdefault("PADDLE_TPU_RNG_IMPL", "rbg")
         batch, seq, steps, warmup = 32, 512, 20, 3
         # BENCH_REMAT=1: checkpoint encoder layers — AOT memory analysis
         # (PERF_NOTES r5) shows batch 64+ needs it to fit 16 GB

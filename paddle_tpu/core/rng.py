@@ -13,10 +13,22 @@ TPU-native design: threefry counter keys. Two modes:
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Optional
 
 import jax
 import numpy as np
+
+
+def _key_impl() -> Optional[str]:
+    """RNG implementation for framework keys. Default: threefry (jax's
+    default — reproducible across backends). PADDLE_TPU_RNG_IMPL=rbg swaps
+    in XLA's RngBitGenerator, which lowers to the TPU's hardware PRNG —
+    ~10x cheaper per dropout mask than threefry's 20 u32 rounds (PERF_NOTES
+    r5 trace: threefry bits dominate the per-layer residual fusions). Masks
+    are then not bit-reproducible across backends, which Paddle's dropout
+    contract does not promise."""
+    return os.environ.get("PADDLE_TPU_RNG_IMPL") or None
 
 
 class Generator:
@@ -34,7 +46,9 @@ class Generator:
 
     def _ensure_key(self):
         if self._key is None:
-            self._key = jax.random.key(self._seed)
+            impl = _key_impl()
+            self._key = (jax.random.key(self._seed, impl=impl) if impl
+                         else jax.random.key(self._seed))
         return self._key
 
     def manual_seed(self, seed: int):
@@ -59,7 +73,17 @@ class Generator:
         return jax.random.key_data(self._ensure_key())
 
     def set_state(self, state):
-        self._key = jax.random.wrap_key_data(np.asarray(state, dtype=np.uint32))
+        data = np.asarray(state, dtype=np.uint32)
+        # the impl is recoverable from the data shape (threefry2x32 keys
+        # are (2,) u32, rbg/unsafe_rbg (4,)), so state saved under one
+        # PADDLE_TPU_RNG_IMPL setting restores under any other
+        if data.shape and data.shape[-1] == 4:
+            impl = _key_impl()
+            if impl not in ("rbg", "unsafe_rbg"):
+                impl = "rbg"
+            self._key = jax.random.wrap_key_data(data, impl=impl)
+        else:
+            self._key = jax.random.wrap_key_data(data)
 
     @contextlib.contextmanager
     def trace_mode(self, base_key):

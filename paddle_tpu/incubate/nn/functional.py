@@ -11,13 +11,29 @@ from ...nn import functional as F
 
 __all__ = ["fused_linear", "fused_feedforward",
            "fused_multi_head_attention", "fused_layer_norm",
-           "fused_bias_dropout_residual_layer_norm"]
+           "fused_bias_dropout_residual_layer_norm",
+           "fused_linear_cross_entropy"]
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
     if transpose_weight:
         weight = weight.t()
     return F.linear(x, weight, bias)
+
+
+def fused_linear_cross_entropy(x, weight, bias=None, label=None,
+                               ignore_index=-100, transpose_y=False,
+                               reduction="mean", chunk_size=2048, name=None):
+    """Chunked linear + softmax CE that never materializes (N, vocab)
+    logits (custom-VJP recompute; see ops.nn_ops.fused_linear_cross_entropy
+    for the kernel)."""
+    from ...core.dispatch import apply_op
+    from ...ops.registry import get_op
+
+    return apply_op(get_op("fused_linear_cross_entropy"), x, weight, bias,
+                    label, ignore_index=ignore_index,
+                    transpose_y=transpose_y, reduction=reduction,
+                    chunk_size=chunk_size)
 
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,

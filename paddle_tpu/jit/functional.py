@@ -66,7 +66,7 @@ def call_functional(layer, params, buffers, args, kwargs=None, rng_key=None,
     this function), not via the eager tape.
     """
     kwargs = kwargs or {}
-    wrapped_args = [Tensor(a) if not isinstance(a, Tensor) else a
+    wrapped_args = [a if a is None or isinstance(a, Tensor) else Tensor(a)
                     for a in args]
     old_training = layer.training
     if training is not None:

@@ -38,6 +38,10 @@ class ErnieConfig:
     # fleet.recompute) — trades ~1/3 more FLOPs for O(layers) less HBM,
     # unlocking larger bench batches (PERF_NOTES r5)
     recompute: bool = False
+    # MLM head via fused_linear_cross_entropy: forward(…, masked_lm_labels=)
+    # returns the loss without materializing (b*s, vocab) f32 logits
+    # (PERF_NOTES r5 trace: ~10 ms + ~2.4 GB at base/batch-32)
+    fused_mlm_loss: bool = False
 
     @classmethod
     def ernie_base(cls):
@@ -181,6 +185,7 @@ class ErnieForPretraining(nn.Layer):
         super().__init__()
         self.ernie = ErnieModel(cfg)
         cfg = self.ernie.config
+        self.config = cfg
         self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
                                      epsilon=cfg.layer_norm_eps)
@@ -189,11 +194,27 @@ class ErnieForPretraining(nn.Layer):
         self.nsp = nn.Linear(cfg.hidden_size, 2)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, masked_lm_labels=None):
         seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
                                  attention_mask)
         h = self.mlm_norm(F.gelu(self.transform(seq)))
         word_emb = self.ernie.embeddings.word_embeddings.weight
+        if masked_lm_labels is not None:
+            if self.config.fused_mlm_loss:
+                # tied-weight LM head + CE in one chunked pass — the f32
+                # (b*s, vocab) logits tensor never exists
+                from .. import incubate
+
+                mlm_loss = incubate.nn.functional.fused_linear_cross_entropy(
+                    h.reshape([-1, self.config.hidden_size]), word_emb,
+                    self.mlm_bias, masked_lm_labels.reshape([-1]),
+                    ignore_index=-100, transpose_y=True)
+            else:
+                logits = h.matmul(word_emb, transpose_y=True) + self.mlm_bias
+                mlm_loss = F.cross_entropy(
+                    logits.reshape([-1, self.config.vocab_size]),
+                    masked_lm_labels.reshape([-1]), ignore_index=-100)
+            return mlm_loss, self.nsp(pooled)
         logits = h.matmul(word_emb, transpose_y=True) + self.mlm_bias
         return logits, self.nsp(pooled)
 

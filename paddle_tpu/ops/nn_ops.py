@@ -8,6 +8,7 @@ and is selected automatically when shapes allow.
 """
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Sequence
 
@@ -453,6 +454,141 @@ def nll_loss(logp, label, weight=None, ignore_index=-100, reduction="mean"):
         return loss
     if reduction == "sum":
         return jnp.sum(loss)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def _flce_dims(transpose_y):
+    # x (c, H) contracted with w: (V, H) when transpose_y else (H, V)
+    return (((1,), (1,)), ((), ())) if transpose_y else (((1,), (0,)), ((), ()))
+
+
+def _flce_chunks(x2, lbl, ignore_index, chunk):
+    n = x2.shape[0]
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+        # padded rows carry ignore_index, so they drop out of loss and grads
+        lbl = jnp.pad(lbl, (0, pad), constant_values=ignore_index)
+    return (x2.reshape(n_chunks, chunk, x2.shape[1]),
+            lbl.reshape(n_chunks, chunk))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flce_rows(x2, w, b, lbl, ignore_index, transpose_y, chunk):
+    loss, _ = _flce_fwd(x2, w, b, lbl, ignore_index, transpose_y, chunk)
+    return loss
+
+
+def _flce_fwd(x2, w, b, lbl, ignore_index, transpose_y, chunk):
+    n = x2.shape[0]
+    dims = _flce_dims(transpose_y)
+    xs, ls = _flce_chunks(x2, lbl, ignore_index, chunk)
+    bf = b.astype(jnp.float32)
+
+    def body(_, xe):
+        x_c, l_c = xe
+        # the matmul runs in the INPUT dtype (bf16 rides the MXU natively)
+        # with f32 accumulation; only the (chunk, V) block is ever resident
+        logits = jax.lax.dot_general(
+            x_c, w, dims, preferred_element_type=jnp.float32) + bf
+        m = jnp.max(logits, axis=1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=1))
+        valid = l_c != ignore_index
+        safe = jnp.where(valid, l_c, 0).astype(jnp.int32)
+        gold = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+        return 0, (jnp.where(valid, lse - gold, 0.0), lse)
+
+    _, (loss, lse) = jax.lax.scan(body, 0, (xs, ls))
+    return loss.reshape(-1)[:n], lse.reshape(-1)[:n]
+
+
+def _flce_fwd_vjp(x2, w, b, lbl, ignore_index, transpose_y, chunk):
+    loss, lse = _flce_fwd(x2, w, b, lbl, ignore_index, transpose_y, chunk)
+    return loss, (x2, w, b, lbl, lse)
+
+
+def _flce_bwd(ignore_index, transpose_y, chunk, res, g):
+    x2, w, b, lbl, lse = res
+    n, hdim = x2.shape
+    vocab = w.shape[0] if transpose_y else w.shape[1]
+    dims = _flce_dims(transpose_y)
+    # dx chunk: coeff (c, V) x w -> (c, H)
+    dx_dims = ((((1,), (0,)), ((), ())) if transpose_y
+               else (((1,), (1,)), ((), ())))
+    xs, ls = _flce_chunks(x2, lbl, ignore_index, chunk)
+    n_chunks = xs.shape[0]
+    pad = n_chunks * chunk - n
+    lse_s = jnp.pad(lse, (0, pad)).reshape(n_chunks, chunk)
+    g_s = jnp.pad(g.astype(jnp.float32), (0, pad)).reshape(n_chunks, chunk)
+    bf = b.astype(jnp.float32)
+
+    def body(carry, xe):
+        dw_acc, db_acc = carry
+        x_c, l_c, lse_c, g_c = xe
+        logits = jax.lax.dot_general(
+            x_c, w, dims, preferred_element_type=jnp.float32) + bf
+        p = jnp.exp(logits - lse_c[:, None])
+        valid = l_c != ignore_index
+        safe = jnp.where(valid, l_c, 0).astype(jnp.int32)
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (chunk, vocab), 1)
+                  == safe[:, None])
+        coeff = (p - onehot) * (g_c * valid)[:, None]
+        coeff_l = coeff.astype(x_c.dtype)  # bf16 dgrad/wgrad on the MXU
+        dx_c = jax.lax.dot_general(
+            coeff_l, w, dx_dims, preferred_element_type=jnp.float32)
+        # wgrad: (V, H) = coeff^T x_c when transpose_y, else (H, V)
+        if transpose_y:
+            dw_c = jax.lax.dot_general(
+                coeff_l, x_c, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            dw_c = jax.lax.dot_general(
+                x_c, coeff_l, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return ((dw_acc + dw_c, db_acc + jnp.sum(coeff, axis=0)),
+                dx_c)
+
+    (dw, db), dxs = jax.lax.scan(
+        body, (jnp.zeros(w.shape, jnp.float32),
+               jnp.zeros((vocab,), jnp.float32)),
+        (xs, ls, lse_s, g_s))
+    dx = dxs.reshape(-1, hdim)[:n].astype(x2.dtype)
+    return dx, dw.astype(w.dtype), db.astype(b.dtype), None
+
+
+_flce_rows.defvjp(_flce_fwd_vjp, _flce_bwd)
+
+
+def fused_linear_cross_entropy(x, weight, bias=None, label=None,
+                               ignore_index=-100, transpose_y=False,
+                               reduction="mean", chunk_size=2048):
+    """Linear projection + softmax cross-entropy that never materializes the
+    (N, vocab) logits: a scanned chunk loop computes per-row lse/gold in one
+    pass, and a custom VJP recomputes each chunk's logits in the backward
+    (flash-attention's trick applied to the LM head). Cuts the f32 logits
+    buffer (batch*seq x vocab) from the train step's live set and removes
+    the layout copies XLA spends on it (PERF_NOTES round-5 trace: ~10 ms and
+    ~2.4 GB at ERNIE-base batch 32 x seq 512).
+
+    Upstream analog: paddle.incubate's fused CE path (upstream layout,
+    unverified — mount empty). Semantics match
+    cross_entropy(linear(x, w, b), label) with hard labels.
+    """
+    hdim = x.shape[-1]
+    x2 = x.reshape(-1, hdim)
+    lbl = label.reshape(-1).astype(jnp.int32)
+    vocab = weight.shape[0] if transpose_y else weight.shape[1]
+    b = (jnp.zeros((vocab,), jnp.float32) if bias is None
+         else bias)
+    chunk = max(1, int(min(chunk_size, x2.shape[0])))
+    loss = _flce_rows(x2, weight, b, lbl, int(ignore_index),
+                      bool(transpose_y), chunk)
+    if reduction == "none":
+        return loss.reshape(label.shape)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    valid = (lbl != ignore_index).astype(jnp.float32)
     return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
 
 

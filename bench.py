@@ -364,12 +364,13 @@ def bench_child() -> None:
     # Only the sweep's OOM path consumes it, so only take the ~1GB
     # device->host copy when the sweep will actually run.
     # sweep entries: "64" = plain, "64r" = with activation checkpointing
-    # (remat). Defaults are remat batches: AOT memory analysis (PERF_NOTES
-    # r5) shows the un-checkpointed step already needs 16.9 GB at batch 64
-    # — plain 64/128 would only exercise the OOM-recovery path.
+    # (remat). With the fused CE head the plain batch-64 step fits a v5e
+    # (AOT memory analysis: 15.74 GB of 16 — PERF_NOTES r5); the OOM
+    # recovery below stays armed for the 0.26 GB of headroom. Remat legs
+    # remain as fallbacks (measured slower: recompute > batch efficiency).
     try:
         sweep_batches = []
-        for tok in os.environ.get("BENCH_SWEEP", "64r,128r").split(","):
+        for tok in os.environ.get("BENCH_SWEEP", "64,64r,128r").split(","):
             tok = tok.strip()
             if not tok:
                 continue

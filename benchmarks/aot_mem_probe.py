@@ -1,0 +1,49 @@
+"""AOT memory probe: fused-CE bench step at batch 32/64 through the real
+v5e compiler (no chip needed). Prints HBM high-water per config."""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+from jax.experimental import topologies
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import paddle_tpu as paddle
+from paddle_tpu.jit.functional import extract_state
+from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+from paddle_tpu.ops import pallas_kernels
+import bench
+
+pallas_kernels._on_tpu = lambda: True
+try:
+    topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2")
+except Exception as e:
+    if "lockfile" in str(e):
+        os.remove("/tmp/libtpu_lockfile")
+        topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2")
+    else:
+        raise
+sh = jax.sharding.SingleDeviceSharding(topo.devices[0])
+
+for batch in (int(a) for a in sys.argv[1:] or (32, 64)):
+    cfg = ErnieConfig.ernie_base()
+    cfg.fused_mlm_loss = True
+    model = ErnieForPretraining(cfg); model.train()
+    opt = paddle.optimizer.Adam(learning_rate=1e-4, parameters=model.parameters())
+    params, buffers = extract_state(model)
+    opt_state = opt.functional_state(params)
+    absify = lambda t: jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh), t)
+    jitted = jax.jit(bench.make_train_step(model, opt), donate_argnums=(0, 1, 2))
+    scalar = lambda dt: jax.ShapeDtypeStruct((), dt, sharding=sh)
+    data = jax.ShapeDtypeStruct((batch, 512), jnp.int32, sharding=sh)
+    compiled = jitted.lower(
+        absify(params), absify(buffers), absify(opt_state),
+        scalar(jnp.float32), scalar(jnp.int32),
+        scalar(jax.random.key(0).dtype), data, data).compile()
+    mem = compiled.memory_analysis()
+    hbm = (mem.argument_size_in_bytes + mem.temp_size_in_bytes
+           + mem.generated_code_size_in_bytes - mem.alias_size_in_bytes
+           + mem.output_size_in_bytes)
+    print(f"batch={batch}: args={mem.argument_size_in_bytes/1e9:.2f} "
+          f"temp={mem.temp_size_in_bytes/1e9:.2f} "
+          f"total_hbm={hbm/1e9:.2f} GB (fit16={hbm<16e9})", flush=True)

@@ -104,7 +104,7 @@ class StaticFunction:
     """
 
     def __init__(self, fn_or_layer, input_spec: Optional[Sequence] = None,
-                 build_strategy=None, full_graph=True):
+                 build_strategy=None, full_graph=True, backend=None):
         from ..nn import Layer
 
         from .dy2static import ast_transform
@@ -119,6 +119,15 @@ class StaticFunction:
         self._orig_forward = None    # layer's pre-transform bound forward
         self.__name__ = getattr(fn_or_layer, "__name__",
                                 type(fn_or_layer).__name__)
+        # upstream contract: full_graph=False selects the SOT (bytecode
+        # capture + guards) tier; backend="sot"/"SOT" forces it explicitly
+        self._backend = ("sot" if (str(backend).lower() == "sot"
+                                   or (backend is None and not full_graph))
+                         else "ast")
+        if self._backend == "sot":
+            # per-signature guarded entries: sig -> [(guards, compiled)]
+            self._sot_cache = {}
+            return  # no source rewrite — capture happens at trace time
         # dy2static: rewrite control flow BEFORE tracing
         if self._is_layer:
             inst_fwd = fn_or_layer.__dict__.get("forward")
@@ -224,6 +233,126 @@ class StaticFunction:
             fn = orig.__get__(bound_to) if bound_to is not None else orig
         return fn(*wrapped)
 
+    # ------------------------------------------------------------ SOT tier
+
+    def _sot_target(self):
+        """(function_to_interpret, leading_args) for capture + guards."""
+        if self._is_layer:
+            fwd = self._layer.forward
+            return getattr(fwd, "__func__", fwd), (self._layer,)
+        fn = self._fn
+        if inspect.ismethod(fn):
+            return fn.__func__, (fn.__self__,)
+        return fn, ()
+
+    def _sot_lookup(self, sig, guard_args):
+        """Cached guarded entry whose guards pass, or None."""
+        from .sot import evaluate_guards
+
+        for guards, compiled in self._sot_cache.get(sig, ()):
+            if evaluate_guards(guards, guard_args):
+                return compiled
+        return None
+
+    def _sot_entry(self, sig, fn, lead, guard_args, params, buffers, datas):
+        """Find a cached guarded entry or capture a new one (an abstract
+        eval_shape trace discovers the guard set without executing)."""
+        compiled = self._sot_lookup(sig, guard_args)
+        if compiled is not None:
+            return compiled, None
+        # miss: capture now; the symbolic interpreter fills the guard sink
+        from .sot import symbolic_call
+
+        sink: list = []
+        layer = self._layer
+        training = layer.training if layer is not None else False
+
+        if layer is not None:
+            def pure(params, buffers, *datas):
+                real_forward = layer.forward
+
+                def sot_forward(*a, **k):
+                    out, entries = symbolic_call(fn, [layer] + list(a), k)
+                    sink[:] = entries
+                    return out
+
+                layer.forward = sot_forward
+                try:
+                    return call_functional(layer, params, buffers, datas,
+                                           training=training)
+                finally:
+                    layer.forward = real_forward
+        else:
+            def pure(params, buffers, *datas):
+                wrapped = [Tensor(d) for d in datas]
+                from ..core import tape as tape_mod
+
+                with tape_mod.no_grad():
+                    result, entries = symbolic_call(
+                        fn, list(lead) + wrapped, {})
+                sink[:] = entries
+                unwrap = lambda x: (x._data if isinstance(x, Tensor)  # noqa: E731
+                                    else x)
+                return jax.tree_util.tree_map(
+                    unwrap, result,
+                    is_leaf=lambda x: isinstance(x, Tensor)), {}
+
+        # abstract trace: runs the interpreter (filling the guard sink)
+        # without executing anything on device — a GraphBreak surfaces
+        # here, before a compiled entry exists
+        jax.eval_shape(pure, params, buffers, *datas)
+        compiled = jax.jit(pure)
+        self._sot_cache.setdefault(sig, []).append((tuple(sink), compiled))
+        return compiled, None
+
+    def guard_entries(self, *args):
+        """The guard sets recorded for the given input signature (SOT
+        backend): list of guard-entry tuples, one per specialization."""
+        training = self._layer.training if self._layer is not None else False
+        sig = (_sig_of(args), training)
+        return [g for g, _ in self._sot_cache.get(sig, ())]
+
+    # -------------------------------------------------------------- calling
+
+    def _call_recorded(self, compiled, params, buffers, datas, args):
+        """Run the compiled program as ONE recorded tape op, so
+        `loss.backward()` flows into the layer's parameters and any
+        input Tensors — upstream's train-under-@to_static contract.
+        The whole program gets a single GradNode (jax.vjp over the jitted
+        callable), not per-op nodes."""
+        from ..core.dispatch import apply_callable
+
+        layer = self._layer
+        pobjs = ({n: p for n, p in layer.named_parameters()}
+                 if layer is not None else {})
+        pnames = [n for n in params.keys() if n in pobjs]
+        ptensors = [pobjs[n] for n in pnames]
+        in_tensors = [a if isinstance(a, Tensor) else Tensor(d)
+                      for a, d in zip(args, datas)]
+        const_params = {n: v for n, v in params.items() if n not in pobjs}
+        meta = {}
+
+        def fn(*xs):
+            p = dict(zip(pnames, xs[:len(pnames)]))
+            p.update(const_params)
+            outs, new_buffers = compiled(p, buffers,
+                                         *xs[len(pnames):])
+            leaves, td = jax.tree_util.tree_flatten(
+                (outs, new_buffers or {}))
+            meta["td"] = td
+            # a 1-tuple would register as a single-output op whose tape
+            # cotangent is a bare array — return the bare leaf instead
+            return leaves[0] if len(leaves) == 1 else tuple(leaves)
+
+        out = apply_callable(self.__name__, fn, *(ptensors + in_tensors))
+        out_leaves = list(out) if isinstance(out, tuple) else [out]
+        outs, new_buffers = jax.tree_util.tree_unflatten(
+            meta["td"], out_leaves)
+        if new_buffers:
+            new_buffers = {n: (b._data if isinstance(b, Tensor) else b)
+                           for n, b in new_buffers.items()}
+        return outs, new_buffers
+
     def __call__(self, *args, **kwargs):
         if kwargs:
             raise TypeError("to_static call supports positional args only")
@@ -232,22 +361,59 @@ class StaticFunction:
         training = self._layer.training if self._layer is not None else False
         sig = (_sig_of(args), training)
         if sig in self._eager_sigs:   # before any conversion/state walk
-            return self._run_eager(args)
+            # SOT: a graph break is often guard-set-specific (one config
+            # breaks, another captures fine) — only go eager if no cached
+            # specialization's guards pass
+            if self._backend != "sot":
+                return self._run_eager(args)
+            lead = self._sot_target()[1]
+            if self._sot_lookup(sig, list(lead) + list(args)) is None:
+                return self._run_eager(args)
         datas = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
                  for a in args]
         if self._layer is not None:
             params, buffers = extract_state(self._layer)
         else:
             params, buffers = {}, {}
-        compiled = self._compiled_for(args, sig)
+        from ..core import tape as tape_mod
+
+        record = tape_mod.grad_enabled() and (
+            any(not p.stop_gradient
+                for _, p in (self._layer.named_parameters()
+                             if self._layer is not None else ()))
+            or any(isinstance(a, Tensor) and not a.stop_gradient
+                   for a in args))
         try:
-            outs, new_buffers = compiled(params, buffers, *datas)
-        except _TRACE_LEAK_ERRORS as e:
+            if self._backend == "sot":
+                from .sot import GraphBreak
+
+                fn, lead = self._sot_target()
+                guard_args = list(lead) + list(args)
+                try:
+                    compiled, _ = self._sot_entry(
+                        sig, fn, lead, guard_args, params, buffers, datas)
+                except GraphBreak as e:
+                    raise GraphBreakError(
+                        f"SOT capture of {self.__name__!r} broke: {e}")
+                if record:
+                    outs, new_buffers = self._call_recorded(
+                        compiled, params, buffers, datas, args)
+                else:
+                    outs, new_buffers = compiled(params, buffers, *datas)
+            else:
+                compiled = self._compiled_for(args, sig)
+                if record:
+                    outs, new_buffers = self._call_recorded(
+                        compiled, params, buffers, datas, args)
+                else:
+                    outs, new_buffers = compiled(params, buffers, *datas)
+        except (_TRACE_LEAK_ERRORS + (GraphBreakError,)) as e:
             # upstream guard-system contract: graph break -> eager fallback
             # with a warning, not an exception (the GraphBreakError text
             # documents how to make the function capturable)
-            warnings.warn(str(_graph_break(self.__name__, e)),
-                          stacklevel=2)
+            msg = (str(e) if isinstance(e, GraphBreakError)
+                   else str(_graph_break(self.__name__, e)))
+            warnings.warn(msg, stacklevel=2)
             self._eager_sigs.add(sig)
             return self._run_eager(args)
         # write back mutated buffers (BN running stats under training)
@@ -285,11 +451,13 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         from ..nn import Layer
 
         if isinstance(fn, Layer):
-            sf = StaticFunction(fn, input_spec, build_strategy, full_graph)
+            sf = StaticFunction(fn, input_spec, build_strategy, full_graph,
+                                backend=backend)
             fn.forward_static = sf
             fn._static_function = sf
             return fn if kwargs.get("_return_layer") else sf
-        return StaticFunction(fn, input_spec, build_strategy, full_graph)
+        return StaticFunction(fn, input_spec, build_strategy, full_graph,
+                              backend=backend)
 
     if function is not None:
         return deco(function)

@@ -1,0 +1,12 @@
+"""paddle.jit SOT tier — symbolic bytecode capture with guards.
+
+Upstream: python/paddle/jit/sot/ (opcode translator + guard system;
+upstream layout, unverified — mount empty). Selected by
+`to_static(full_graph=False)` or `to_static(backend="sot")`; see
+`interpreter.py` for the capture contract.
+"""
+from .interpreter import (GraphBreak, SymbolicRunner, evaluate_guards,
+                          symbolic_call)
+
+__all__ = ["GraphBreak", "SymbolicRunner", "evaluate_guards",
+           "symbolic_call"]

@@ -1,0 +1,872 @@
+"""SOT — symbolic opcode translation (upstream: python/paddle/jit/sot/,
+the bytecode-capture tier of to_static; upstream layout, unverified —
+mount empty).
+
+Unlike the AST transform (`jit/dy2static.py`), which needs source text,
+this tier interprets the function's BYTECODE on live values at trace
+time. What that buys over the AST path:
+
+- works on closures, exec'd code, decorated functions — no source needed;
+- data-dependent `if` on a traced Tensor captures BOTH arms and merges
+  through `static.control_flow.cond` (lax.cond under trace) by forking
+  the interpreter: each arm interprets the *rest of the function* on a
+  copy of the frame, so no join-point analysis is required;
+- plain Python function calls are INLINED (recursively interpreted), so
+  a tensor-dependent branch inside a helper is captured too;
+- every Python-level value the capture depends on (scalar globals,
+  closure cells, `self.*` config attributes) is recorded as a GUARD;
+  `SOTFunction` re-checks guards per call and retraces on mismatch —
+  upstream's guard/specialization contract.
+
+TPU-first consequence: a function captured here is ONE XLA program; the
+guard system (not shape-polymorphism hacks) decides when a new program
+is needed.
+
+Unsupported constructs raise GraphBreak (caught by the caller, which
+falls back to eager or the AST tier): tensor-condition `while`
+(backward-jump fork), try/except/with, generators/async, starargs
+calls, attribute/subscript stores while forked (side effects must not
+run for an untaken arm).
+"""
+from __future__ import annotations
+
+import dis
+import operator
+import types
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+__all__ = ["GraphBreak", "SymbolicRunner", "symbolic_call"]
+
+
+class GraphBreak(Exception):
+    """Capture cannot continue; caller decides the fallback."""
+
+
+class _Null:
+    """CPython's NULL stack sentinel (PUSH_NULL / LOAD_ATTR method bit)."""
+
+    def __repr__(self):
+        return "<NULL>"
+
+
+class _Missing:
+    """Unbound-local sentinel (LOAD_FAST_AND_CLEAR on an unbound name)."""
+
+    def __repr__(self):
+        return "<MISSING>"
+
+
+NULL = _Null()
+MISSING = _Missing()
+
+_BINOPS = {
+    "+": operator.add, "-": operator.sub, "*": operator.mul,
+    "/": operator.truediv, "//": operator.floordiv, "%": operator.mod,
+    "**": operator.pow, "@": operator.matmul, "<<": operator.lshift,
+    ">>": operator.rshift, "&": operator.and_, "|": operator.or_,
+    "^": operator.xor,
+    "+=": operator.iadd, "-=": operator.isub, "*=": operator.imul,
+    "/=": operator.itruediv, "//=": operator.ifloordiv, "%=": operator.imod,
+    "**=": operator.ipow, "@=": operator.imatmul, "<<=": operator.ilshift,
+    ">>=": operator.irshift, "&=": operator.iand, "|=": operator.ior,
+    "^=": operator.ixor,
+}
+
+_CMPOPS = {
+    "<": operator.lt, "<=": operator.le, "==": operator.eq,
+    "!=": operator.ne, ">": operator.gt, ">=": operator.ge,
+}
+
+_GUARDABLE = (bool, int, float, str, bytes, type(None))
+
+
+def _is_tensorish(x) -> bool:
+    if hasattr(x, "_data"):
+        x = x._data
+    return isinstance(x, (jax.Array, jax.core.Tracer))
+
+
+def _raw(x):
+    return x._data if hasattr(x, "_data") else x
+
+
+class _Guards:
+    """Accumulates (accessor, value) pairs during capture — for EVERY
+    interpreted frame, inlined helpers included (a stale global in an
+    inlined helper is exactly as wrong as one in the root frame).
+
+    Accessor forms (self-contained: they hold the globals dict / cell
+    object directly, so inlined frames from other modules and exec'd
+    functions resolve correctly):
+      ("global", globals_dict, name) -> globals_dict[name] (or builtins)
+      ("cell", cell_object)          -> cell.cell_contents
+      ("argattr", i, (a1, a2..))     -> getattr chain off root arg i
+    Values are scalars compared by ==, or callables/modules compared by
+    identity (id).
+    """
+
+    def __init__(self):
+        self.entries: List[Tuple[tuple, Any]] = []
+        self._seen = set()
+
+    def _add(self, key, accessor, value):
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        if isinstance(value, _GUARDABLE):
+            self.entries.append((accessor, ("eq", value)))
+        elif callable(value) or isinstance(value, types.ModuleType):
+            self.entries.append((accessor, ("id", id(value))))
+        # other objects (tensors, containers): not guarded — tensor avals
+        # are covered by the signature, containers would over-specialize
+
+    def add_global(self, gdict: dict, name: str, value):
+        self._add(("g", id(gdict), name), ("global", gdict, name), value)
+
+    def add_cell(self, cell, value):
+        self._add(("c", id(cell)), ("cell", cell), value)
+
+    def add_argattr(self, i: int, attrs: tuple, value):
+        self._add(("a", i, attrs), ("argattr", i, attrs), value)
+
+
+def evaluate_guards(entries, args) -> bool:
+    """Re-evaluate recorded guards against a new call's state."""
+    for accessor, (kind, want) in entries:
+        try:
+            got = _resolve_accessor(accessor, args)
+        except Exception:  # noqa: BLE001 — a vanished attr fails the guard
+            return False
+        if kind == "eq":
+            if type(got) is not type(want) or got != want:
+                return False
+        elif id(got) != want:
+            return False
+    return True
+
+
+def _resolve_accessor(accessor, args):
+    if accessor[0] == "global":
+        _, gdict, name = accessor
+        if name in gdict:
+            return gdict[name]
+        import builtins
+
+        return getattr(builtins, name)
+    if accessor[0] == "cell":
+        return accessor[1].cell_contents
+    if accessor[0] == "argattr":
+        obj = args[accessor[1]]
+        for attr in accessor[2]:
+            obj = getattr(obj, attr)
+        return obj
+    raise KeyError(accessor)
+
+
+_MAX_INLINE_DEPTH = 8
+_MAX_FORK_DEPTH = 6
+
+#: library roots never inlined — their functions trace fine as-is and
+#: interpreting them would simulate half of jax bytecode-by-bytecode
+_NO_INLINE_PREFIXES = ("jax", "numpy", "paddle_tpu", "flax", "optax",
+                       "chex", "einops", "torch", "math", "functools",
+                       "itertools", "typing", "collections", "contextlib",
+                       "operator", "builtins", "inspect", "dataclasses")
+
+
+def _should_inline(fn) -> bool:
+    mod = getattr(fn, "__module__", None) or ""
+    root = mod.split(".", 1)[0]
+    return root not in _NO_INLINE_PREFIXES
+
+
+class SymbolicRunner:
+    """Interprets one function's bytecode on live (possibly traced) values.
+
+    One runner per capture; frames share the guard accumulator and the
+    fork/inline depth bookkeeping.
+    """
+
+    def __init__(self, root_fn):
+        self.root_fn = root_fn
+        self.guards = _Guards()
+        self.fork_depth = 0
+        # (code, offset) sites currently being forked: re-forking the same
+        # site means a tensor-condition loop re-entered its own test
+        self.active_forks: set = set()
+
+    # ------------------------------------------------------------- frames
+
+    def call_function(self, fn, args, kwargs, depth=0, provenance=None):
+        """Interpret `fn(*args, **kwargs)`; inline nested Python calls."""
+        if depth > _MAX_INLINE_DEPTH:
+            raise GraphBreak("inline depth exceeded")
+        code = fn.__code__
+        flags = code.co_flags
+        if flags & 0x20:  # generator/async
+            raise GraphBreak("generator or coroutine")
+        try:
+            import inspect
+
+            bound = inspect.signature(fn).bind(*args, **kwargs)
+            bound.apply_defaults()
+        except TypeError as e:
+            raise GraphBreak(f"cannot bind args: {e}")
+        local_vars: Dict[str, Any] = dict(bound.arguments)
+        # *args / **kwargs land as tuple/dict locals with the right names
+        frame = _Frame(self, fn, code, local_vars, depth,
+                       provenance or {})
+        return frame.run()
+
+
+class _Frame:
+    def __init__(self, runner: SymbolicRunner, fn, code, local_vars,
+                 depth: int, provenance: Dict[str, tuple]):
+        self.r = runner
+        self.fn = fn
+        self.code = code
+        self.depth = depth
+        self.stack: List[Any] = []
+        self.locals = dict(local_vars)
+        # provenance: local name -> ("argattr", i, (attrs...)) prefix used
+        # for guard paths on scalar attribute reads (self.training etc.)
+        self.prov: Dict[int, tuple] = {}
+        for i, name in enumerate(code.co_varnames[:code.co_argcount]):
+            if name in self.locals:
+                self.prov[id(self.locals[name])] = ("argattr", i, ())
+        # only the ROOT frame's args map onto guard accessors; inlined
+        # frames inherit the caller's provenance by object identity
+        if depth > 0:
+            self.prov = dict(provenance)
+        self.instrs = list(dis.get_instructions(code))
+        self.off2idx = {ins.offset: i for i, ins in enumerate(self.instrs)}
+        self.kwnames: Tuple[str, ...] = ()
+
+    # ----------------------------------------------------------- plumbing
+
+    def push(self, v):
+        self.stack.append(v)
+
+    def pop(self):
+        return self.stack.pop()
+
+    def popn(self, n):
+        if n == 0:
+            return []
+        vals = self.stack[-n:]
+        del self.stack[-n:]
+        return vals
+
+    def _cells(self):
+        """Map freevar/cellvar name -> cell object."""
+        cells = {}
+        free = self.code.co_freevars
+        if free and self.fn.__closure__ is not None:
+            for name, cell in zip(free, self.fn.__closure__):
+                cells[name] = cell
+        return cells
+
+    # ---------------------------------------------------------- execution
+
+    def run(self, start_idx: int = 0):
+        idx = start_idx
+        n = len(self.instrs)
+        steps = 0
+        while idx < n:
+            steps += 1
+            if steps > 200_000:
+                raise GraphBreak("instruction budget exceeded")
+            ins = self.instrs[idx]
+            op = ins.opname
+            handler = getattr(self, f"op_{op}", None)
+            if handler is None:
+                raise GraphBreak(f"unsupported opcode {op} "
+                                 f"(line {ins.positions.lineno})")
+            res = handler(ins)
+            if isinstance(res, _Return):
+                return res.value
+            idx = res if isinstance(res, int) else idx + 1
+        raise GraphBreak("fell off bytecode end")
+
+    def _jump_idx(self, ins) -> int:
+        return self.off2idx[ins.argval]
+
+    # --------------------------------------------------------- loads/stores
+
+    def op_RESUME(self, ins):
+        return None
+
+    def op_NOP(self, ins):
+        return None
+
+    def op_CACHE(self, ins):
+        return None
+
+    def op_PRECALL(self, ins):  # 3.11 leftover; harmless if present
+        return None
+
+    def op_LOAD_CONST(self, ins):
+        self.push(ins.argval)
+
+    def op_RETURN_CONST(self, ins):
+        return _Return(ins.argval)
+
+    def op_LOAD_FAST(self, ins):
+        try:
+            v = self.locals[ins.argval]
+        except KeyError:
+            raise GraphBreak(f"unbound local {ins.argval!r}")
+        if v is MISSING:
+            raise GraphBreak(f"unbound local {ins.argval!r}")
+        self.push(v)
+
+    op_LOAD_FAST_CHECK = op_LOAD_FAST
+
+    def op_LOAD_FAST_AND_CLEAR(self, ins):
+        v = self.locals.get(ins.argval, MISSING)
+        self.push(v)
+        self.locals[ins.argval] = MISSING
+
+    def op_STORE_FAST(self, ins):
+        v = self.pop()
+        if v is MISSING:
+            self.locals.pop(ins.argval, None)
+        else:
+            self.locals[ins.argval] = v
+
+    def op_DELETE_FAST(self, ins):
+        self.locals.pop(ins.argval, None)
+
+    def op_LOAD_GLOBAL(self, ins):
+        name = ins.argval
+        g = self.fn.__globals__
+        if name in g:
+            v = g[name]
+        else:
+            import builtins
+
+            try:
+                v = getattr(builtins, name)
+            except AttributeError:
+                raise GraphBreak(f"unresolved global {name!r}")
+        self.r.guards.add_global(g, name, v)
+        if ins.arg & 1:  # LOAD_GLOBAL with NULL push (3.12: NULL first)
+            self.push(NULL)
+        self.push(v)
+
+    def op_LOAD_DEREF(self, ins):
+        cells = self._cells()
+        name = ins.argval
+        if name in cells:
+            v = cells[name].cell_contents
+            self.r.guards.add_cell(cells[name], v)
+            self.push(v)
+            return
+        # cellvar written earlier in this frame (MAKE_CELL path)
+        if name in self.locals:
+            self.push(self.locals[name])
+            return
+        raise GraphBreak(f"unresolved deref {name!r}")
+
+    def op_STORE_DEREF(self, ins):
+        # cellvars of this frame back plain locals; writing a FREEVAR
+        # (enclosing scope) would leak state — break
+        if ins.argval in self.code.co_cellvars:
+            self.locals[ins.argval] = self.pop()
+        else:
+            raise GraphBreak("store to enclosing-scope cell")
+
+    def op_MAKE_CELL(self, ins):
+        return None  # cellvars are emulated as plain locals
+
+    def op_COPY_FREE_VARS(self, ins):
+        return None
+
+    def op_LOAD_ATTR(self, ins):
+        obj = self.pop()
+        name = ins.argval
+        try:
+            v = getattr(obj, name)
+        except AttributeError as e:
+            raise GraphBreak(f"attribute error during capture: {e}")
+        # guard scalar config reads reachable from the args (self.training)
+        pv = self.prov.get(id(obj))
+        if pv is not None:
+            attrs = pv[2] + (name,)
+            if isinstance(v, _GUARDABLE):
+                self.r.guards.add_argattr(pv[1], attrs, v)
+            else:
+                self.prov[id(v)] = ("argattr", pv[1], attrs)
+        if ins.arg & 1:
+            # method-call form: CALL pops the callable from the TOP of the
+            # (self_or_null, callable) pair; a bound attr with NULL below
+            # is semantically identical to CPython's (self, unbound) split
+            self.push(NULL)
+            self.push(v)
+        else:
+            self.push(v)
+
+    def op_STORE_ATTR(self, ins):
+        if self.r.fork_depth:
+            raise GraphBreak("attribute store inside a captured branch")
+        obj = self.pop()
+        val = self.pop()
+        setattr(obj, ins.argval, val)
+
+    def op_LOAD_METHOD(self, ins):  # pre-3.12 compat
+        obj = self.pop()
+        self.push(NULL)
+        self.push(getattr(obj, ins.argval))
+
+    # ------------------------------------------------------------ operators
+
+    def op_BINARY_OP(self, ins):
+        rhs = self.pop()
+        lhs = self.pop()
+        fn = _BINOPS.get(ins.argrepr)
+        if fn is None:
+            raise GraphBreak(f"binary op {ins.argrepr!r}")
+        self.push(fn(lhs, rhs))
+
+    def op_COMPARE_OP(self, ins):
+        rhs = self.pop()
+        lhs = self.pop()
+        sym = ins.argrepr.strip("bool()") or ins.argrepr
+        fn = _CMPOPS.get(sym)
+        if fn is None:
+            raise GraphBreak(f"compare op {ins.argrepr!r}")
+        self.push(fn(lhs, rhs))
+
+    def op_IS_OP(self, ins):
+        rhs = self.pop()
+        lhs = self.pop()
+        self.push((lhs is not rhs) if ins.arg else (lhs is rhs))
+
+    def op_CONTAINS_OP(self, ins):
+        container = self.pop()
+        item = self.pop()
+        if _is_tensorish(container) or _is_tensorish(item):
+            raise GraphBreak("tensor `in` during capture")
+        self.push((item not in container) if ins.arg
+                  else (item in container))
+
+    def op_UNARY_NEGATIVE(self, ins):
+        self.push(-self.pop())
+
+    def op_UNARY_NOT(self, ins):
+        v = self.pop()
+        if _is_tensorish(v):
+            import jax.numpy as jnp
+
+            self.push(jnp.logical_not(_raw(v)))
+        else:
+            self.push(not v)
+
+    def op_UNARY_INVERT(self, ins):
+        self.push(~self.pop())
+
+    def op_BINARY_SUBSCR(self, ins):
+        idx = self.pop()
+        obj = self.pop()
+        self.push(obj[idx])
+
+    def op_BINARY_SLICE(self, ins):
+        end = self.pop()
+        start = self.pop()
+        obj = self.pop()
+        self.push(obj[slice(start, end)])
+
+    def op_STORE_SUBSCR(self, ins):
+        if self.r.fork_depth:
+            raise GraphBreak("subscript store inside a captured branch")
+        idx = self.pop()
+        obj = self.pop()
+        val = self.pop()
+        obj[idx] = val
+
+    def op_BUILD_SLICE(self, ins):
+        parts = self.popn(ins.arg)
+        self.push(slice(*parts))
+
+    # ----------------------------------------------------------- containers
+
+    def op_BUILD_TUPLE(self, ins):
+        self.push(tuple(self.popn(ins.arg)))
+
+    def op_BUILD_LIST(self, ins):
+        self.push(list(self.popn(ins.arg)))
+
+    def op_BUILD_MAP(self, ins):
+        kv = self.popn(2 * ins.arg)
+        self.push({kv[i]: kv[i + 1] for i in range(0, len(kv), 2)})
+
+    def op_BUILD_CONST_KEY_MAP(self, ins):
+        keys = self.pop()
+        vals = self.popn(ins.arg)
+        self.push(dict(zip(keys, vals)))
+
+    def op_BUILD_STRING(self, ins):
+        self.push("".join(self.popn(ins.arg)))
+
+    def op_FORMAT_VALUE(self, ins):
+        # (conversion | has_spec) — enough for f-strings on scalars
+        have_spec = ins.arg & 0x04
+        spec = self.pop() if have_spec else ""
+        v = self.pop()
+        conv = ins.arg & 0x03
+        if conv == 1:
+            v = str(v)
+        elif conv == 2:
+            v = repr(v)
+        elif conv == 3:
+            v = ascii(v)
+        self.push(format(v, spec))
+
+    def op_LIST_APPEND(self, ins):
+        v = self.pop()
+        self.stack[-ins.arg].append(v)
+
+    def op_SET_ADD(self, ins):
+        v = self.pop()
+        self.stack[-ins.arg].add(v)
+
+    def op_MAP_ADD(self, ins):
+        v = self.pop()
+        k = self.pop()
+        self.stack[-ins.arg][k] = v
+
+    def op_LIST_EXTEND(self, ins):
+        it = self.pop()
+        self.stack[-ins.arg].extend(it)
+
+    def op_DICT_MERGE(self, ins):
+        d = self.pop()
+        self.stack[-ins.arg].update(d)
+
+    op_DICT_UPDATE = op_DICT_MERGE
+
+    def op_BUILD_SET(self, ins):
+        self.push(set(self.popn(ins.arg)))
+
+    def op_UNPACK_SEQUENCE(self, ins):
+        seq = self.pop()
+        if _is_tensorish(seq):
+            raise GraphBreak("tensor unpacking during capture")
+        items = list(seq)
+        if len(items) != ins.arg:
+            raise GraphBreak("unpack length mismatch")
+        for v in reversed(items):
+            self.push(v)
+
+    # ---------------------------------------------------------- stack admin
+
+    def op_POP_TOP(self, ins):
+        self.pop()
+
+    def op_PUSH_NULL(self, ins):
+        self.push(NULL)
+
+    def op_COPY(self, ins):
+        self.push(self.stack[-ins.arg])
+
+    def op_SWAP(self, ins):
+        self.stack[-1], self.stack[-ins.arg] = (self.stack[-ins.arg],
+                                                self.stack[-1])
+
+    # --------------------------------------------------------------- calls
+
+    def op_KW_NAMES(self, ins):
+        self.kwnames = ins.argval
+
+    def op_CALL(self, ins):
+        argc = ins.arg
+        kwnames, self.kwnames = self.kwnames, ()
+        args = self.popn(argc)
+        callable_ = self.pop()
+        self_or_null = self.pop()
+        if self_or_null is not NULL:
+            args = [self_or_null] + args
+        kwargs = {}
+        if kwnames:
+            n_kw = len(kwnames)
+            kwargs = dict(zip(kwnames, args[-n_kw:]))
+            args = args[:-n_kw]
+        self.push(self._do_call(callable_, args, kwargs))
+
+    def op_CALL_FUNCTION_EX(self, ins):
+        # conservative: starargs calls are rare in model code and the
+        # NULL-slot layout is version-fiddly
+        raise GraphBreak("CALL_FUNCTION_EX (starargs call)")
+
+    #: container-mutating bound methods that must not run inside a forked
+    #: branch arm: frames are copied shallowly, so mutating a pre-fork
+    #: container from one arm would leak into the other arm's capture
+    _MUTATORS = {"append", "extend", "insert", "remove", "clear", "update",
+                 "add", "discard", "setdefault", "popitem", "pop", "sort",
+                 "reverse", "__setitem__", "__delitem__", "append_",
+                 "add_", "update_"}
+
+    def _do_call(self, fn, args, kwargs):
+        if fn is MISSING or fn is NULL:
+            raise GraphBreak("call on NULL")
+        if (self.r.fork_depth
+                and getattr(fn, "__name__", None) in self._MUTATORS
+                and getattr(fn, "__self__", None) is not None
+                and isinstance(fn.__self__, (list, dict, set, bytearray))):
+            raise GraphBreak("container mutation inside a captured branch")
+        if isinstance(fn, types.FunctionType) and _should_inline(fn):
+            # inline plain USER Python functions so nested tensor branches
+            # are captured too (upstream SOT's inlining); library/framework
+            # functions are called directly — they are traceable as-is and
+            # inlining them would interpret half of jax per op
+            return self.r.call_function(fn, args, kwargs,
+                                        depth=self.depth + 1,
+                                        provenance=self.prov)
+        if (isinstance(fn, types.MethodType)
+                and isinstance(fn.__func__, types.FunctionType)
+                and _should_inline(fn.__func__)):
+            return self.r.call_function(fn.__func__,
+                                        [fn.__self__] + list(args), kwargs,
+                                        depth=self.depth + 1,
+                                        provenance=self.prov)
+        if fn is bool and args and _is_tensorish(args[0]):
+            raise GraphBreak("bool() on a traced tensor")
+        # builtins, Tensor methods, framework ops: call straight through
+        try:
+            return fn(*args, **kwargs)
+        except GraphBreak:
+            raise
+        except jax.errors.TracerBoolConversionError:
+            raise GraphBreak("tensor truthiness inside a C-level call")
+
+    def op_CALL_INTRINSIC_1(self, ins):
+        name = ins.argrepr
+        if name == "INTRINSIC_LIST_TO_TUPLE":
+            self.push(tuple(self.pop()))
+        elif name == "INTRINSIC_UNARY_POSITIVE":
+            self.push(+self.pop())
+        elif name == "INTRINSIC_STOPITERATION_ERROR":
+            pass
+        else:
+            raise GraphBreak(f"intrinsic {name}")
+
+    def op_GET_ITER(self, ins):
+        v = self.pop()
+        if _is_tensorish(v):
+            raise GraphBreak("iteration over a traced tensor")
+        self.push(iter(v))
+
+    def op_FOR_ITER(self, ins):
+        it = self.stack[-1]
+        try:
+            v = next(it)
+        except StopIteration:
+            self.push(MISSING)   # sentinel; END_FOR pops it + the iterator
+            return self._jump_idx(ins)
+        self.push(v)
+        return None
+
+    def op_END_FOR(self, ins):
+        self.pop()
+        self.pop()
+
+    def op_JUMP_BACKWARD(self, ins):
+        return self._jump_idx(ins)
+
+    op_JUMP_BACKWARD_NO_INTERRUPT = op_JUMP_BACKWARD
+
+    def op_JUMP_FORWARD(self, ins):
+        return self._jump_idx(ins)
+
+    def op_RETURN_VALUE(self, ins):
+        return _Return(self.pop())
+
+    # ------------------------------------------------------------- branches
+
+    def _branch(self, ins, jump_when: bool):
+        cond = self.pop()
+        raw = _raw(cond)
+        if not _is_tensorish(cond) or not isinstance(raw, jax.core.Tracer):
+            taken = bool(raw) is jump_when
+            return self._jump_idx(ins) if taken else None
+        # traced condition: fork the frame and capture both arms
+        tgt = self._jump_idx(ins)
+        cur = self.off2idx[ins.offset] + 1
+        if tgt <= self.off2idx[ins.offset]:
+            raise GraphBreak("tensor-dependent backward jump (while loop) "
+                             "— use the AST tier or lax.while_loop")
+        if self.r.fork_depth >= _MAX_FORK_DEPTH:
+            raise GraphBreak("branch fork depth exceeded")
+        site = (self.code, ins.offset)
+        if site in self.r.active_forks:
+            raise GraphBreak("tensor-dependent loop condition "
+                             "— use the AST tier or lax.while_loop")
+        idx_true, idx_false = (tgt, cur) if jump_when else (cur, tgt)
+        self.r.active_forks.add(site)
+        try:
+            return _Return(self._fork(raw, idx_true, idx_false))
+        finally:
+            self.r.active_forks.discard(site)
+
+    def _fork(self, pred, idx_true: int, idx_false: int):
+        """Capture both continuations and merge via lax.cond.
+
+        Each arm interprets the REST of the function on a copy of the
+        frame; returns are canonicalized to flat tuples of raw arrays
+        (Tensor leaves noted so the merged result restores their type;
+        Python scalars promote to 0-d arrays so the arms may disagree)."""
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        is_leaf = lambda x: isinstance(x, Tensor)  # noqa: E731
+        info: Dict[str, tuple] = {}
+
+        def arm(idx, tag):
+            def run_arm(_):
+                sub = _Frame(self.r, self.fn, self.code, {}, self.depth,
+                             self.prov)
+                sub.locals = dict(self.locals)
+                sub.stack = list(self.stack)
+                sub.prov = self.prov
+                out = sub.run(idx)
+                flat, td = jax.tree_util.tree_flatten(out, is_leaf=is_leaf)
+                meta, arrays = [], []
+                for leaf in flat:
+                    if isinstance(leaf, Tensor):
+                        meta.append("T")
+                        arrays.append(leaf._data)
+                    elif isinstance(leaf, (jax.Array, jax.core.Tracer)):
+                        meta.append("A")
+                        arrays.append(leaf)
+                    elif isinstance(leaf, (bool, int, float, complex)):
+                        meta.append("A")
+                        arrays.append(jnp.asarray(leaf))
+                    else:
+                        raise GraphBreak(
+                            f"branch returns non-array leaf {type(leaf)}")
+                info[tag] = (td, tuple(meta))
+                return tuple(arrays)
+
+            return run_arm
+
+        self.r.fork_depth += 1
+        try:
+            arrays = jax.lax.cond(pred != 0, arm(idx_true, "t"),
+                                  arm(idx_false, "f"), operand=None)
+        except GraphBreak:
+            raise
+        except (TypeError, ValueError) as e:
+            raise GraphBreak(f"branch arms do not merge: {e}")
+        finally:
+            self.r.fork_depth -= 1
+        if info["t"] != info["f"]:
+            raise GraphBreak("branch arms return different structures")
+        td, meta = info["t"]
+        leaves = [Tensor(a) if m == "T" else a
+                  for a, m in zip(arrays, meta)]
+        return jax.tree_util.tree_unflatten(td, leaves)
+
+    def op_POP_JUMP_IF_FALSE(self, ins):
+        return self._branch(ins, jump_when=False)
+
+    def op_POP_JUMP_IF_TRUE(self, ins):
+        return self._branch(ins, jump_when=True)
+
+    def op_POP_JUMP_IF_NONE(self, ins):
+        v = self.pop()
+        return self._jump_idx(ins) if v is None else None
+
+    def op_POP_JUMP_IF_NOT_NONE(self, ins):
+        v = self.pop()
+        return None if v is None else self._jump_idx(ins)
+
+    def _bool_shortcircuit(self, ins, jump_on_true: bool):
+        v = self.stack[-1]
+        if _is_tensorish(v):
+            raise GraphBreak("tensor in and/or short-circuit")
+        if bool(v) is jump_on_true:
+            return self._jump_idx(ins)
+        self.pop()
+        return None
+
+    def op_JUMP_IF_TRUE_OR_POP(self, ins):
+        return self._bool_shortcircuit(ins, True)
+
+    def op_JUMP_IF_FALSE_OR_POP(self, ins):
+        return self._bool_shortcircuit(ins, False)
+
+    def op_TO_BOOL(self, ins):  # 3.13 compat no-op (3.12 has no TO_BOOL)
+        return None
+
+    def op_MAKE_FUNCTION(self, ins):
+        # nested defs/lambdas: materialize a real function; calls inline it
+        code = None
+        flags = ins.arg
+        defaults = ()
+        closure = ()
+        kwdefaults = None
+        code = self.pop()
+        if flags & 0x08:
+            closure = self.pop()
+        if flags & 0x04:
+            self.pop()  # annotations — ignored
+        if flags & 0x02:
+            kwdefaults = self.pop()
+        if flags & 0x01:
+            defaults = tuple(self.pop())
+        fn = types.FunctionType(code, self.fn.__globals__,
+                                code.co_name, defaults, tuple(closure))
+        if kwdefaults:
+            fn.__kwdefaults__ = dict(kwdefaults)
+        self.push(fn)
+
+    def op_SET_FUNCTION_ATTRIBUTE(self, ins):  # 3.13-style MAKE_FUNCTION
+        fn = self.pop()
+        val = self.pop()
+        if ins.arg & 0x08:
+            fn = types.FunctionType(fn.__code__, fn.__globals__,
+                                    fn.__name__, fn.__defaults__,
+                                    tuple(val))
+        elif ins.arg & 0x01:
+            fn.__defaults__ = tuple(val)
+        elif ins.arg & 0x02:
+            fn.__kwdefaults__ = dict(val)
+        self.push(fn)
+
+    def op_LOAD_CLOSURE(self, ins):
+        # closure tuple entries for MAKE_FUNCTION: freevars resolve to the
+        # actual enclosing cell; cellvars to a fresh cell over the local
+        cells = self._cells()
+        name = ins.argval
+        if name in cells:
+            self.push(cells[name])
+        elif name in self.locals:
+            self.push(types.CellType(self.locals[name]))
+        else:
+            self.push(types.CellType())
+
+    def op_RAISE_VARARGS(self, ins):
+        args = self.popn(ins.arg)
+        if args and isinstance(args[0], BaseException) or (
+                args and isinstance(args[0], type)
+                and issubclass(args[0], BaseException)):
+            exc = args[0] if not isinstance(args[0], type) else args[0]()
+            raise exc
+        raise GraphBreak("bare raise")
+
+
+class _Return:
+    def __init__(self, value):
+        self.value = value
+
+
+def symbolic_call(fn, args, kwargs=None):
+    """Interpret fn(*args, **kwargs) symbolically.
+
+    Returns (result, guard_entries)."""
+    runner = SymbolicRunner(fn)
+    out = runner.call_function(fn, list(args), kwargs or {})
+    return out, runner.guards.entries

@@ -61,6 +61,7 @@ def bench_step_lowered():
         import bench
 
         cfg = ErnieConfig.ernie_base()
+        cfg.fused_mlm_loss = True   # the shipping bench config (r5)
         model = ErnieForPretraining(cfg)
         model.train()
         opt = paddle.optimizer.Adam(learning_rate=1e-4,
@@ -161,6 +162,7 @@ def test_bench_step_compiles_with_mosaic(monkeypatch):
     sh = jax.sharding.SingleDeviceSharding(dev)
 
     cfg = ErnieConfig.ernie_base()
+    cfg.fused_mlm_loss = True       # the shipping bench config (r5)
     model = ErnieForPretraining(cfg)
     model.train()
     opt = paddle.optimizer.Adam(learning_rate=1e-4,

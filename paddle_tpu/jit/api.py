@@ -254,12 +254,25 @@ class StaticFunction:
                 return compiled
         return None
 
+    #: per-signature respecialization budget: a guarded scalar that keeps
+    #: changing would otherwise recompile every call and grow the cache
+    #: without bound — past the cap the signature degrades to eager (the
+    #: cached entries still serve calls whose guards match)
+    _MAX_SPECIALIZATIONS = 8
+
     def _sot_entry(self, sig, fn, lead, guard_args, params, buffers, datas):
         """Find a cached guarded entry or capture a new one (an abstract
         eval_shape trace discovers the guard set without executing)."""
         compiled = self._sot_lookup(sig, guard_args)
         if compiled is not None:
             return compiled, None
+        from .sot import GraphBreak
+
+        if len(self._sot_cache.get(sig, ())) >= self._MAX_SPECIALIZATIONS:
+            raise GraphBreak(
+                f"{self._MAX_SPECIALIZATIONS} specializations for one "
+                "input signature — a guarded Python value changes every "
+                "call; keep it out of the captured region")
         # miss: capture now; the symbolic interpreter fills the guard sink
         from .sot import symbolic_call
 

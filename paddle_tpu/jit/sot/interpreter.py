@@ -243,6 +243,10 @@ class _Frame:
         self.instrs = list(dis.get_instructions(code))
         self.off2idx = {ins.offset: i for i, ins in enumerate(self.instrs)}
         self.kwnames: Tuple[str, ...] = ()
+        # REAL cell objects for this frame's cellvars: LOAD/STORE_DEREF and
+        # LOAD_CLOSURE all share them, so a nested function sees later
+        # rebindings exactly as CPython's cell semantics dictate
+        self.cellvars: Dict[str, types.CellType] = {}
 
     # ----------------------------------------------------------- plumbing
 
@@ -357,29 +361,39 @@ class _Frame:
         self.push(v)
 
     def op_LOAD_DEREF(self, ins):
-        cells = self._cells()
         name = ins.argval
+        if name in self.cellvars:
+            cell = self.cellvars[name]
+            try:
+                self.push(cell.cell_contents)
+            except ValueError:
+                raise GraphBreak(f"unbound cell {name!r}")
+            return
+        cells = self._cells()
         if name in cells:
             v = cells[name].cell_contents
             self.r.guards.add_cell(cells[name], v)
             self.push(v)
             return
-        # cellvar written earlier in this frame (MAKE_CELL path)
-        if name in self.locals:
-            self.push(self.locals[name])
-            return
         raise GraphBreak(f"unresolved deref {name!r}")
 
     def op_STORE_DEREF(self, ins):
-        # cellvars of this frame back plain locals; writing a FREEVAR
-        # (enclosing scope) would leak state — break
-        if ins.argval in self.code.co_cellvars:
-            self.locals[ins.argval] = self.pop()
+        name = ins.argval
+        if name in self.cellvars:
+            if self.r.fork_depth:
+                # the cell is shared with closures made pre-fork; writing
+                # it from one arm would leak into the other
+                raise GraphBreak("cell store inside a captured branch")
+            self.cellvars[name].cell_contents = self.pop()
         else:
             raise GraphBreak("store to enclosing-scope cell")
 
     def op_MAKE_CELL(self, ins):
-        return None  # cellvars are emulated as plain locals
+        name = ins.argval
+        if name in self.locals:  # parameter promoted to a cell
+            self.cellvars[name] = types.CellType(self.locals[name])
+        else:
+            self.cellvars[name] = types.CellType()
 
     def op_COPY_FREE_VARS(self, ins):
         return None
@@ -428,6 +442,12 @@ class _Frame:
         fn = _BINOPS.get(ins.argrepr)
         if fn is None:
             raise GraphBreak(f"binary op {ins.argrepr!r}")
+        if (self.r.fork_depth and ins.argrepr.endswith("=")
+                and isinstance(lhs, (list, dict, set, bytearray))):
+            # `acc += [..]` mutates the container in place; frames are
+            # copied shallowly, so the other arm would see the mutation
+            raise GraphBreak("in-place container op inside a captured "
+                             "branch")
         self.push(fn(lhs, rhs))
 
     def op_COMPARE_OP(self, ins):
@@ -731,6 +751,7 @@ class _Frame:
                 sub.locals = dict(self.locals)
                 sub.stack = list(self.stack)
                 sub.prov = self.prov
+                sub.cellvars = self.cellvars  # reads only: stores break
                 out = sub.run(idx)
                 flat, td = jax.tree_util.tree_flatten(out, is_leaf=is_leaf)
                 meta, arrays = [], []
@@ -837,16 +858,18 @@ class _Frame:
         self.push(fn)
 
     def op_LOAD_CLOSURE(self, ins):
-        # closure tuple entries for MAKE_FUNCTION: freevars resolve to the
-        # actual enclosing cell; cellvars to a fresh cell over the local
-        cells = self._cells()
+        # closure tuple entries for MAKE_FUNCTION: this frame's cellvars
+        # push the SHARED cell (so later STORE_DEREF rebindings are seen
+        # by the closure, as in CPython); freevars pass through
         name = ins.argval
+        if name in self.cellvars:
+            self.push(self.cellvars[name])
+            return
+        cells = self._cells()
         if name in cells:
             self.push(cells[name])
-        elif name in self.locals:
-            self.push(types.CellType(self.locals[name]))
         else:
-            self.push(types.CellType())
+            raise GraphBreak(f"unresolved closure cell {name!r}")
 
     def op_RAISE_VARARGS(self, ins):
         args = self.popn(ins.arg)

@@ -604,7 +604,10 @@ def _flash_attention_data(q, k, v, mask=None, seed=None, is_causal=False,
     block_k = _pick_block(sk, _BLOCK_K)
     sq_p = _round_up(sq, block_q)
     sk_p = _round_up(sk, block_k)
-    d_p = _round_up(d, 128)
+    # head_dim 64 lowers natively (Mosaic tiles a 64-lane block into a
+    # half-used vreg); padding it to 128 doubled the q/k/v HBM traffic
+    # and cost ~7 ms/step of pad+slice ops at ERNIE-base (r5 trace)
+    d_p = d if d in (64, 128, 256) else _round_up(d, 128)
 
     def to_bhsd(x, s_target):
         x = jnp.einsum("bshd->bhsd", x)

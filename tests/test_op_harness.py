@@ -1809,6 +1809,12 @@ CASES11 = [
         _reduce_np(np.maximum(x, 0) - x * l + np.log1p(np.exp(-np.abs(x))),
                    reduction), [LOGITS, LBL01], {}),
     ("cross_entropy", _cross_entropy_ref, [LOGITS, LBL_I], {}),
+    ("fused_linear_cross_entropy",
+     lambda x, w, bias=None, label=None, ignore_index=-100,
+            transpose_y=False, reduction="mean", chunk_size=2048:
+        _cross_entropy_ref(x @ w + bias, label, reduction=reduction),
+     [R.randn(4, 3).astype(np.float32), R.randn(3, 5).astype(np.float32),
+      R.randn(5).astype(np.float32), LBL_I], {"chunk_size": 3}),
     ("nll_loss", _nll_loss_ref,
      [np.log(_softmax_np(LOGITS)), LBL_I], {}),
     ("kl_div", lambda i, l, reduction="mean", log_target=False:

@@ -405,3 +405,55 @@ class TestSoundness:
         # the good specialization must still serve compiled (not eager)
         np.testing.assert_allclose(sf(t).numpy(), [2.0])
         assert len(sf.guard_entries(t)) == 1
+
+
+class TestSoundnessRound2:
+    """Second review pass (r5): iadd container leak, cell-snapshot
+    staleness, unbounded respecialization."""
+
+    def test_inplace_container_op_in_branch_breaks(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            acc = [1]
+            if x.sum() > 0:
+                acc += [2]
+                return x * len(acc)
+            return x * len(acc)
+
+        def run(xd):
+            with pytest.raises(GraphBreak, match="in-place container"):
+                symbolic_call(f, (xd,))
+            return jnp.zeros(())
+
+        jax.jit(run)(jnp.asarray([-1.0]))
+
+    def test_cell_rebinding_after_closure_creation(self):
+        # CPython cell semantics: the lambda sees the REBOUND value
+        def f(x):
+            m = 2.0
+            g = lambda v: v * m  # noqa: E731
+            m = 3.0
+            return g(x)
+
+        got, _ = symbolic_call(f, (4.0,))
+        assert got == f(4.0) == 12.0
+
+    def test_specialization_cap_degrades_to_eager(self):
+        ns = {"K": 0}
+        exec("def f(x):\n"
+             "    if x.sum() > 0:\n"
+             "        return x + K\n"
+             "    return x\n", ns)
+        sf = paddle.jit.to_static(ns["f"], full_graph=False)
+        t = _t(np.asarray([1.0], np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for k in range(12):   # guard churn past the cap
+                ns["K"] = k
+                np.testing.assert_allclose(sf(t).numpy(), [1.0 + k])
+        assert len(sf.guard_entries(t)) <= 8
+        # cached specializations still serve compiled when guards match
+        ns["K"] = 3
+        np.testing.assert_allclose(sf(t).numpy(), [4.0])

@@ -103,10 +103,70 @@ def _sds(shape, dtype, vma):
 
 # ---------------------------------------------------------------- forward
 
+def _qkv_layout(qt, kt, *, heads, block_q, block_k, kv_major, vma):
+    """Shared layout selection for the three flash kernels.
+
+    Returns (b, h, sq_p, sk_p, d_p, blk, q_spec, k_spec, sds_like) where
+    `blk` slices a grid block out of a q/k/v/do ref, `q_spec`/`k_spec`
+    are the BlockSpecs for row/col operands, and `sds_like(rows_p, dt)`
+    builds an output ShapeDtypeStruct in the active layout. `kv_major`
+    flips the grid's (qi, ki) order to (ki, qi) — the dkv kernel
+    accumulates over q, so its k index comes third."""
+    from jax.experimental import pallas as pl
+
+    packed = heads is not None
+    if packed:
+        b, sq_p, hd = qt.shape
+        h = heads
+        d_p = hd // h
+        sk_p = kt.shape[1]
+    else:
+        b, h, sq_p, d_p = qt.shape
+        sk_p = kt.shape[2]
+
+    def spec(block, pick):
+        # pick selects this operand's row coordinate from (third, fourth)
+        # grid ids; the other two grid ids are always (b, h)
+        if packed:
+            return pl.BlockSpec(
+                (1, block, d_p),
+                lambda b_, h_, i2, i3: (b_, pick(i2, i3), h_))
+        return pl.BlockSpec(
+            (1, 1, block, d_p),
+            lambda b_, h_, i2, i3: (b_, h_, pick(i2, i3), 0))
+
+    if kv_major:   # grid (b, h, ki, qi)
+        q_spec = spec(block_q, lambda ki, qi: qi)
+        k_spec = spec(block_k, lambda ki, qi: ki)
+    else:          # grid (b, h, qi, ki)
+        q_spec = spec(block_q, lambda qi, ki: qi)
+        k_spec = spec(block_k, lambda qi, ki: ki)
+
+    def sds_like(rows_p, dtype):
+        if packed:
+            return _sds((b, rows_p, h * d_p), dtype, vma)
+        return _sds((b, h, rows_p, d_p), dtype, vma)
+
+    blk = (lambda ref: ref[0]) if packed else (lambda ref: ref[0, 0])
+    return b, h, sq_p, sk_p, d_p, blk, q_spec, k_spec, sds_like
+
+
+def _blk_store(packed, ref, value):
+    if packed:
+        ref[0] = value
+    else:
+        ref[0, 0] = value
+
+
 def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
               mask_b_is_one, mask_h_is_one, mask_q_is_one, block_q, block_k,
-              dropout_p, interpret, offs=None, keep_neg_inf_lse=False, vma=None):
-    """qt/kt/vt: padded (b, h, S, D). Returns (out_padded, logsumexp).
+              dropout_p, interpret, offs=None, keep_neg_inf_lse=False,
+              vma=None, heads=None):
+    """qt/kt/vt: padded (b, h, S, D) — or, with `heads=h`, the PACKED
+    layout (b, S, h*D): the per-head slab is addressed by the BlockSpec
+    index map's h coordinate instead of a transposed axis, so the caller
+    never materializes a bshd->bhsd transpose (r5 trace: ~5 ms/step of
+    relayout at ERNIE-base). Returns (out_padded, logsumexp).
 
     `offs` (i32[2] in SMEM: global q-row / k-col offsets) generalizes causal
     masking to ring attention, where the q and k shards sit at different
@@ -116,8 +176,10 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, h, sq_p, d_p = qt.shape
-    sk_p = kt.shape[2]
+    packed = heads is not None
+    b, h, sq_p, sk_p, d_p, blk, q_spec, k_spec, sds_like = _qkv_layout(
+        qt, kt, heads=heads, block_q=block_q, block_k=block_k,
+        kv_major=False, vma=vma)
     n_q, n_k = sq_p // block_q, sk_p // block_k
     need_k_mask = sk_p != sk
     has_dropout = dropout_p > 0.0
@@ -148,7 +210,7 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
             # an fp32 contract on bf16 vectors, which Mosaic rejects
             # ("Bad lhs type" — caught by the AOT tier of test_hlo_perf)
             s = jax.lax.dot_general(
-                q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+                blk(q_ref), blk(k_ref), (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.DEFAULT) * scale
             if has_mask:
@@ -175,7 +237,7 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
             l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1,
                                                       keepdims=True)
             m_ref[...] = m_cur
-            vblk = v_ref[0, 0]
+            vblk = blk(v_ref)
             # attention dropout (upscale_in_train): drop unnormalized
             # weights in the value accumulation; the softmax denominator l
             # uses UNdropped p
@@ -206,7 +268,8 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
         @pl.when(ki == n_k - 1)
         def _done():
             l_fin = jnp.maximum(l_ref[...], 1e-30)
-            o_ref[0, 0] = (acc_ref[...] / l_fin).astype(o_ref.dtype)
+            _blk_store(packed, o_ref,
+                       (acc_ref[...] / l_fin).astype(o_ref.dtype))
             lse = m_ref[...][:, 0] + jnp.log(l_fin[:, 0])
             if not keep_neg_inf_lse:
                 lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
@@ -215,14 +278,7 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
             # so a flat (1,1,block_q) row block is not lowerable
             lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, block_q))
 
-    in_specs = [
-        pl.BlockSpec((1, 1, block_q, d_p),
-                     lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-        pl.BlockSpec((1, 1, block_k, d_p),
-                     lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-        pl.BlockSpec((1, 1, block_k, d_p),
-                     lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-    ]
+    in_specs = [q_spec, k_spec, k_spec]
     operands = [qt, kt, vt]
     if has_mask:
         in_specs.append(pl.BlockSpec(
@@ -243,13 +299,12 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
         grid=(b, h, n_q, n_k),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d_p),
-                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            q_spec,
             pl.BlockSpec((1, 1, 8, block_q),
                          lambda b_, h_, qi, ki: (b_, h_, 0, qi)),
         ],
         out_shape=[
-            _sds((b, h, sq_p, d_p), qt.dtype, vma),
+            sds_like(sq_p, qt.dtype),
             _sds((b, h, 8, sq_p), jnp.float32, vma),
         ],
         scratch_shapes=[
@@ -266,10 +321,12 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
 
 def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
                     is_causal, has_mask, need_k_mask, block_q, block_k,
-                    offs_ref=None):
+                    offs_ref=None, blk=None):
     """Shared backward recompute: p = exp(s - lse), masked like forward.
-    `offs_ref` carries the ring step's global (q, k) position offsets."""
-    s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+    `offs_ref` carries the ring step's global (q, k) position offsets.
+    `blk` slices a grid block out of a ref ([0] packed, [0, 0] bhsd)."""
+    blk = blk or (lambda ref: ref[0, 0])
+    s = jax.lax.dot_general(blk(q_ref), blk(k_ref),
                             (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.DEFAULT) * scale
@@ -294,12 +351,14 @@ def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
 def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                  is_causal, has_mask, mask_b_is_one, mask_h_is_one,
                  mask_q_is_one, block_q, block_k, dropout_p, want_dmask,
-                 interpret, offs=None, vma=None):
+                 interpret, offs=None, vma=None, heads=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, h, sq_p, d_p = qt.shape
-    sk_p = kt.shape[2]
+    packed = heads is not None
+    b, h, sq_p, sk_p, d_p, blk, q_spec, k_spec, sds_like = _qkv_layout(
+        qt, kt, heads=heads, block_q=block_q, block_k=block_k,
+        kv_major=False, vma=vma)
     n_q, n_k = sq_p // block_q, sk_p // block_k
     need_k_mask = sk_p != sk
     has_dropout = dropout_p > 0.0
@@ -331,8 +390,8 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                                 scale=scale, sk=sk, is_causal=is_causal,
                                 has_mask=has_mask, need_k_mask=need_k_mask,
                                 block_q=block_q, block_k=block_k,
-                                offs_ref=offs_ref)
-            dp = jax.lax.dot_general(do_ref[0, 0], v_ref[0, 0],
+                                offs_ref=offs_ref, blk=blk)
+            dp = jax.lax.dot_general(blk(do_ref), blk(v_ref),
                                      (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.DEFAULT)
@@ -348,7 +407,7 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                 # (h,qi,ki) blocks are each visited exactly once so a plain
                 # store is safe
                 dmask_ref[0, 0] = ds
-            kblk = k_ref[0, 0]
+            kblk = blk(k_ref)
             acc_ref[...] += jax.lax.dot_general(
                 ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -363,12 +422,8 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
 
         @pl.when(ki == n_k - 1)
         def _done():
-            dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+            _blk_store(packed, dq_ref, acc_ref[...].astype(dq_ref.dtype))
 
-    q_spec = pl.BlockSpec((1, 1, block_q, d_p),
-                          lambda b_, h_, qi, ki: (b_, h_, qi, 0))
-    k_spec = pl.BlockSpec((1, 1, block_k, d_p),
-                          lambda b_, h_, qi, ki: (b_, h_, ki, 0))
     row_spec = pl.BlockSpec((1, 1, 8, block_q),
                             lambda b_, h_, qi, ki: (b_, h_, 0, qi))
     score_spec = pl.BlockSpec((1, 1, block_q, block_k),
@@ -393,7 +448,7 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
     operands += [dot, lse, delta]
 
     out_specs = [q_spec]
-    out_shape = [_sds((b, h, sq_p, d_p), qt.dtype, vma)]
+    out_shape = [sds_like(sq_p, qt.dtype)]
     if want_dmask:
         out_specs.append(score_spec)
         out_shape.append(_sds((b, h, sq_p, sk_p), jnp.float32, vma))
@@ -413,12 +468,14 @@ def _bwd_dq_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
 def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                   is_causal, has_mask, mask_b_is_one, mask_h_is_one,
                   mask_q_is_one, block_q, block_k, dropout_p, interpret,
-                  offs=None, vma=None):
+                  offs=None, vma=None, heads=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, h, sq_p, d_p = qt.shape
-    sk_p = kt.shape[2]
+    packed = heads is not None
+    b, h, sq_p, sk_p, d_p, blk, q_spec, k_spec, sds_like = _qkv_layout(
+        qt, kt, heads=heads, block_q=block_q, block_k=block_k,
+        kv_major=True, vma=vma)
     n_q, n_k = sq_p // block_q, sk_p // block_k
     need_k_mask = sk_p != sk
     has_dropout = dropout_p > 0.0
@@ -446,8 +503,8 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                                 scale=scale, sk=sk, is_causal=is_causal,
                                 has_mask=has_mask, need_k_mask=need_k_mask,
                                 block_q=block_q, block_k=block_k,
-                                offs_ref=offs_ref)
-            doblk = do_ref[0, 0]
+                                offs_ref=offs_ref, blk=blk)
+            doblk = blk(do_ref)
             if has_dropout:
                 # seed args in (b, h, qi, ki) order — identical to fwd/dq
                 # even though this kernel's grid iterates (ki, qi)
@@ -461,14 +518,14 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
                 p_d.astype(doblk.dtype), doblk, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.DEFAULT)      # P_dropped^T @ dO
-            dp = jax.lax.dot_general(doblk, v_ref[0, 0],
+            dp = jax.lax.dot_general(doblk, blk(v_ref),
                                      (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.DEFAULT)
             if has_dropout:
                 dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
             ds = p * (dp - delta_ref[0, 0, 0][:, None])
-            qblk = q_ref[0, 0]
+            qblk = blk(q_ref)
             dk_acc[...] += jax.lax.dot_general(
                 ds.astype(qblk.dtype), qblk, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -483,13 +540,9 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
 
         @pl.when(qi == n_q - 1)
         def _done():
-            dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
-            dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+            _blk_store(packed, dk_ref, dk_acc[...].astype(dk_ref.dtype))
+            _blk_store(packed, dv_ref, dv_acc[...].astype(dv_ref.dtype))
 
-    q_spec = pl.BlockSpec((1, 1, block_q, d_p),
-                          lambda b_, h_, ki, qi: (b_, h_, qi, 0))
-    k_spec = pl.BlockSpec((1, 1, block_k, d_p),
-                          lambda b_, h_, ki, qi: (b_, h_, ki, 0))
     row_spec = pl.BlockSpec((1, 1, 8, block_q),
                             lambda b_, h_, ki, qi: (b_, h_, 0, qi))
     in_specs = [q_spec, k_spec, k_spec]
@@ -515,8 +568,7 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
         grid=(b, h, n_k, n_q),
         in_specs=in_specs,
         out_specs=[k_spec, k_spec],
-        out_shape=[_sds((b, h, sk_p, d_p), kt.dtype, vma),
-                   _sds((b, h, sk_p, d_p), vt.dtype, vma)],
+        out_shape=[sds_like(sk_p, kt.dtype), sds_like(sk_p, vt.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
                         pltpu.VMEM((block_k, d_p), jnp.float32)],
         interpret=interpret,
@@ -530,24 +582,27 @@ def _bwd_dkv_call(qt, kt, vt, mask, seed, dot, lse, delta, *, scale, sk,
 def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
                mask_h_is_one: bool, mask_q_is_one: bool, sk: int,
                real_d: int, mask_needs_grad: bool, dropout_p: float,
-               interpret: bool, vma=None):
+               interpret: bool, vma=None, heads=None):
     """custom_vjp'd padded-layout flash attention, specialized per config.
     `real_d` is the unpadded head dim — it sets the softmax scale. When
     `mask_needs_grad`, the dq kernel additionally emits d(mask)=ds blocks
     (O(s^2) fp32 — only materialized for trainable masks, e.g. learned
     position biases); otherwise the mask cotangent is zeros. With
     `dropout_p` > 0 a scalar `seed` rides along (SMEM) and the on-chip PRNG
-    regenerates the identical keep mask in forward and backward."""
+    regenerates the identical keep mask in forward and backward. With
+    `heads`, qt/kt/vt are in the PACKED (b, S, h*D) layout (see
+    _fwd_call)."""
     scale = 1.0 / math.sqrt(real_d)
+    s_axis = 1 if heads is not None else 2
 
     def _kw(qt, kt):
         return dict(scale=scale, sk=sk, is_causal=is_causal,
                     has_mask=has_mask, mask_b_is_one=mask_b_is_one,
                     mask_h_is_one=mask_h_is_one, mask_q_is_one=mask_q_is_one,
-                    block_q=min(_BLOCK_Q, qt.shape[2]),
-                    block_k=min(_BLOCK_K, kt.shape[2]),
+                    block_q=min(_BLOCK_Q, qt.shape[s_axis]),
+                    block_k=min(_BLOCK_K, kt.shape[s_axis]),
                     dropout_p=dropout_p,
-                    interpret=interpret, vma=vma)
+                    interpret=interpret, vma=vma, heads=heads)
 
     @jax.custom_vjp
     def f(qt, kt, vt, mask, seed):
@@ -560,8 +615,16 @@ def _flash_vjp(is_causal: bool, has_mask: bool, mask_b_is_one: bool,
 
     def bwd(res, dout):
         qt, kt, vt, mask, seed, out, lse = res
-        delta = jnp.sum(dout.astype(jnp.float32)
-                        * out.astype(jnp.float32), axis=-1)   # [b,h,S]
+        if heads is not None:
+            # packed (b, S, h*d): per-head delta then to (b, h, S)
+            b_, s_, hd_ = out.shape
+            delta = jnp.sum(
+                dout.astype(jnp.float32).reshape(b_, s_, heads, -1)
+                * out.astype(jnp.float32).reshape(b_, s_, heads, -1),
+                axis=-1).transpose(0, 2, 1)                   # [b,h,S]
+        else:
+            delta = jnp.sum(dout.astype(jnp.float32)
+                            * out.astype(jnp.float32), axis=-1)  # [b,h,S]
         # match lse's sublane-broadcast (b,h,8,S) layout (see _fwd_call)
         delta = jnp.broadcast_to(delta[:, :, None, :],
                                  (*delta.shape[:2], 8, delta.shape[-1]))
@@ -609,12 +672,25 @@ def _flash_attention_data(q, k, v, mask=None, seed=None, is_causal=False,
     # and cost ~7 ms/step of pad+slice ops at ERNIE-base (r5 trace)
     d_p = d if d in (64, 128, 256) else _round_up(d, 128)
 
-    def to_bhsd(x, s_target):
-        x = jnp.einsum("bshd->bhsd", x)
-        return jnp.pad(x, ((0, 0), (0, 0), (0, s_target - x.shape[2]),
-                           (0, d_p - d)))
+    # 128-multiple head dims take the PACKED (b, S, h*d) layout: a pure
+    # reshape (free) instead of a materialized bshd->bhsd transpose; the
+    # kernels address the head slab through the BlockSpec index map.
+    # Mosaic requires a block's lane dim be 128-divisible or equal to the
+    # array dim, so d=64 heads (block (1, bq, 64) over (b, S, h*64))
+    # cannot ride this path — they keep the transpose with d_p=d (no pad)
+    packed = d == d_p and d % 128 == 0 and h > 1
 
-    qt, kt, vt = to_bhsd(q, sq_p), to_bhsd(k, sk_p), to_bhsd(v, sk_p)
+    if packed:
+        def prep(x, s_target):
+            x = x.reshape(x.shape[0], x.shape[1], h * d)
+            return jnp.pad(x, ((0, 0), (0, s_target - x.shape[1]), (0, 0)))
+    else:
+        def prep(x, s_target):
+            x = jnp.einsum("bshd->bhsd", x)
+            return jnp.pad(x, ((0, 0), (0, 0), (0, s_target - x.shape[2]),
+                               (0, d_p - d)))
+
+    qt, kt, vt = prep(q, sq_p), prep(k, sk_p), prep(v, sk_p)
     mask_b_is_one = mask_h_is_one = mask_q_is_one = True
     if has_mask:
         # keep broadcast (size-1) batch/head/q dims at 1 — the BlockSpec
@@ -637,8 +713,10 @@ def _flash_attention_data(q, k, v, mask=None, seed=None, is_causal=False,
 
     f = _flash_vjp(is_causal, has_mask, mask_b_is_one, mask_h_is_one,
                    mask_q_is_one, sk, d, mask_needs_grad, float(dropout_p),
-                   interpret)
+                   interpret, heads=h if packed else None)
     out = f(qt, kt, vt, mask, seed.astype(jnp.int32).reshape((1,)))
+    if packed:
+        return out[:, :sq, :].reshape(b, sq, h, d)
     return jnp.einsum("bhsd->bshd", out[:, :, :sq, :d])
 
 

@@ -1813,8 +1813,12 @@ CASES11 = [
      lambda x, w, bias=None, label=None, ignore_index=-100,
             transpose_y=False, reduction="mean", chunk_size=2048:
         _cross_entropy_ref(x @ w + bias, label, reduction=reduction),
-     [R.randn(4, 3).astype(np.float32), R.randn(3, 5).astype(np.float32),
-      R.randn(5).astype(np.float32), LBL_I], {"chunk_size": 3}),
+     # private RNG: drawing from the shared R here would shift every
+     # later case's inputs (grid_sample's FD check broke exactly so)
+     [np.random.RandomState(77).randn(4, 3).astype(np.float32),
+      np.random.RandomState(78).randn(3, 5).astype(np.float32),
+      np.random.RandomState(79).randn(5).astype(np.float32), LBL_I],
+     {"chunk_size": 3}),
     ("nll_loss", _nll_loss_ref,
      [np.log(_softmax_np(LOGITS)), LBL_I], {}),
     ("kl_div", lambda i, l, reduction="mean", log_target=False:

@@ -12,3 +12,6 @@ from .gpt import (  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel,
 )
+from .t5 import (  # noqa: F401
+    T5Config, T5ForConditionalGeneration, T5Model,
+)

@@ -27,12 +27,14 @@ from ..nn import functional as F
 __all__ = ["generate", "attend_with_cache", "init_caches"]
 
 
-def attend_with_cache(q, k, v, cache, start_pos, rep):
+def attend_with_cache(q, k, v, cache, start_pos, rep, bias=None):
     """Write this block's K/V into the cache at `start_pos`, then attend q
     over the full (masked) cache.
 
     q: Tensor (b, s, heads, hd); k/v: Tensor (b, s, kv_heads, hd);
-    cache: (k_cache, v_cache) raw jnp arrays (b, max_len, kv_heads, hd).
+    cache: (k_cache, v_cache) raw jnp arrays (b, max_len, kv_heads, hd);
+    bias: optional additive (1, heads, s, max_len) attention bias (T5's
+    relative position bias), folded into the visibility mask.
     Returns (ctx Tensor (b, s, heads, hd), new_cache).
     """
     kc, vc = cache
@@ -51,6 +53,9 @@ def attend_with_cache(q, k, v, cache, start_pos, rep):
     pos_q = start + jnp.arange(s, dtype=jnp.int32)
     allowed = jnp.arange(max_len, dtype=jnp.int32)[None, :] <= pos_q[:, None]
     mask = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)[None, None]
+    if bias is not None:
+        bias_d = bias._data if hasattr(bias, "_data") else bias
+        mask = mask + bias_d.astype(jnp.float32)
     ctx = F.scaled_dot_product_attention(
         q, Tensor(kf), Tensor(vf), attn_mask=Tensor(mask), is_causal=False)
     return ctx, (kc, vc)

@@ -1,0 +1,348 @@
+"""T5-family encoder-decoder (ref: the PaddleNLP t5 modeling family —
+upstream lives in the PaddleNLP ecosystem; layout unverified — mount
+empty).
+
+The missing seq2seq model family: RMS layer norm (T5's no-mean, no-bias
+variant), bucketed relative position bias shared from the first layer of
+each stack, bias-free linears, ReLU or gated-GELU FFN, cross-attention
+over encoder states, tied embeddings with the d_model**-0.5 logit scale.
+
+TPU notes: attention rides F.scaled_dot_product_attention (Pallas flash
+on chip). T5 omits the 1/sqrt(d) attention scale — queries are
+pre-multiplied by sqrt(d_kv) to cancel the kernel's scale instead of
+forking the kernel. The relative position bias enters as a trainable
+additive (1, heads, q, k) mask, exercising the flash kernel's
+mask-gradient (dmask) path in training. Cross-attention K/V for
+generation are computed once per prompt; only self-attention uses the
+growing KV cache.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64                    # per-head dim (not d_model/heads!)
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: Optional[int] = None
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"   # or "gated-gelu" (t5.1.1)
+    tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0
+    pad_token_id: int = 0
+
+    @classmethod
+    def t5_small(cls):
+        return cls()
+
+    @classmethod
+    def t5_base(cls):
+        return cls(d_model=768, d_ff=3072, num_layers=12, num_heads=12)
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=256, d_model=32, d_kv=8, d_ff=64,
+                   num_layers=2, num_heads=4,
+                   relative_attention_num_buckets=8,
+                   relative_attention_max_distance=16)
+
+
+def _relative_position_bucket(relative_position, bidirectional, num_buckets,
+                              max_distance):
+    """T5's log-bucketed relative positions (jnp, trace-safe)."""
+    rp = relative_position
+    bucket = jnp.zeros_like(rp)
+    if bidirectional:
+        num_buckets //= 2
+        bucket = bucket + (rp > 0).astype(jnp.int32) * num_buckets
+        rp = jnp.abs(rp)
+    else:
+        rp = -jnp.minimum(rp, 0)
+    max_exact = num_buckets // 2
+    is_small = rp < max_exact
+    # log-spaced buckets for distant positions
+    rp_large = max_exact + (
+        jnp.log(jnp.maximum(rp, 1).astype(jnp.float32) / max_exact)
+        / math.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    rp_large = jnp.minimum(rp_large, num_buckets - 1)
+    return bucket + jnp.where(is_small, rp, rp_large)
+
+
+class T5Attention(nn.Layer):
+    def __init__(self, cfg: T5Config, has_relative_bias=False, causal=False):
+        super().__init__()
+        self.cfg = cfg
+        self.causal = causal
+        self.num_heads = cfg.num_heads
+        self.d_kv = cfg.d_kv
+        inner = cfg.num_heads * cfg.d_kv
+        self.q = nn.Linear(cfg.d_model, inner, bias_attr=False)
+        self.k = nn.Linear(cfg.d_model, inner, bias_attr=False)
+        self.v = nn.Linear(cfg.d_model, inner, bias_attr=False)
+        self.o = nn.Linear(inner, cfg.d_model, bias_attr=False)
+        self.has_relative_bias = has_relative_bias
+        if has_relative_bias:
+            self.relative_attention_bias = nn.Embedding(
+                cfg.relative_attention_num_buckets, cfg.num_heads)
+
+    def compute_bias(self, q_len, k_len, q_offset=0):
+        """(1, heads, q_len, k_len) trainable additive position bias."""
+        cfg = self.cfg
+        ctx = jnp.arange(q_len, dtype=jnp.int32)[:, None] + q_offset
+        mem = jnp.arange(k_len, dtype=jnp.int32)[None, :]
+        buckets = _relative_position_bucket(
+            mem - ctx, bidirectional=not self.causal,
+            num_buckets=cfg.relative_attention_num_buckets,
+            max_distance=cfg.relative_attention_max_distance)
+        vals = self.relative_attention_bias(Tensor(buckets))   # (q, k, h)
+        return vals.transpose([2, 0, 1]).unsqueeze(0)
+
+    def project_kv(self, src):
+        """Project K/V once for a fixed source (cross-attention during
+        generation: the encoder states never change, so neither do
+        these)."""
+        sk = src.shape[1]
+        b = src.shape[0]
+        k = self.k(src).reshape([b, sk, self.num_heads, self.d_kv])
+        v = self.v(src).reshape([b, sk, self.num_heads, self.d_kv])
+        return k, v
+
+    def forward(self, x, kv=None, kv_proj=None, position_bias=None,
+                cache=None, start_pos=0):
+        """kv: encoder states for cross-attention (self-attn when None);
+        kv_proj: pre-projected (k, v) from project_kv (overrides kv).
+        cache: (k_cache, v_cache) for decode — self-attention only."""
+        b, s = x.shape[0], x.shape[1]
+        # T5 uses UNscaled dot-product attention; sdpa divides by
+        # sqrt(d_kv), so pre-scale q to cancel it
+        q = (self.q(x) * math.sqrt(self.d_kv)).reshape(
+            [b, s, self.num_heads, self.d_kv])
+        if kv_proj is not None:
+            k, v = kv_proj
+        else:
+            k, v = self.project_kv(x if kv is None else kv)
+        if cache is not None:
+            from .generation import attend_with_cache
+
+            max_len = cache[0].shape[1]
+            if position_bias is None and self.has_relative_bias:
+                position_bias = self.compute_bias(s, max_len,
+                                                  q_offset=start_pos)
+            ctx, new_cache = attend_with_cache(q, k, v, cache, start_pos,
+                                               1, bias=position_bias)
+            out = self.o(ctx.reshape([b, s, self.num_heads * self.d_kv]))
+            return out, position_bias, new_cache
+        if position_bias is None and self.has_relative_bias:
+            position_bias = self.compute_bias(s, k.shape[1])
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=position_bias, is_causal=self.causal,
+            dropout_p=self.cfg.dropout_rate if self.training else 0.0)
+        out = self.o(ctx.reshape([b, s, self.num_heads * self.d_kv]))
+        return out, position_bias, None
+
+
+class T5LayerFF(nn.Layer):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.gated = cfg.feed_forward_proj == "gated-gelu"
+        if self.gated:
+            self.wi_0 = nn.Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+            self.wi_1 = nn.Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+        else:
+            self.wi = nn.Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+        self.wo = nn.Linear(cfg.d_ff, cfg.d_model, bias_attr=False)
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+
+    def forward(self, x):
+        if self.gated:
+            h = F.gelu(self.wi_0(x)) * self.wi_1(x)
+        else:
+            h = F.relu(self.wi(x))
+        return self.wo(self.dropout(h))
+
+
+class T5EncoderLayer(nn.Layer):
+    def __init__(self, cfg: T5Config, has_relative_bias=False):
+        super().__init__()
+        self.ln1 = nn.RMSNorm(cfg.d_model, epsilon=cfg.layer_norm_epsilon)
+        self.attn = T5Attention(cfg, has_relative_bias, causal=False)
+        self.ln2 = nn.RMSNorm(cfg.d_model, epsilon=cfg.layer_norm_epsilon)
+        self.ff = T5LayerFF(cfg)
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+
+    def forward(self, x, position_bias=None):
+        a, position_bias, _ = self.attn(self.ln1(x),
+                                        position_bias=position_bias)
+        x = x + self.dropout(a)
+        return x + self.dropout(self.ff(self.ln2(x))), position_bias
+
+
+class T5DecoderLayer(nn.Layer):
+    def __init__(self, cfg: T5Config, has_relative_bias=False):
+        super().__init__()
+        eps = cfg.layer_norm_epsilon
+        self.ln1 = nn.RMSNorm(cfg.d_model, epsilon=eps)
+        self.self_attn = T5Attention(cfg, has_relative_bias, causal=True)
+        self.ln2 = nn.RMSNorm(cfg.d_model, epsilon=eps)
+        self.cross_attn = T5Attention(cfg, False, causal=False)
+        self.ln3 = nn.RMSNorm(cfg.d_model, epsilon=eps)
+        self.ff = T5LayerFF(cfg)
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+
+    def forward(self, x, enc, self_bias=None, cache=None, start_pos=0,
+                cross_kv=None):
+        a, self_bias, new_cache = self.self_attn(
+            self.ln1(x), position_bias=self_bias, cache=cache,
+            start_pos=start_pos)
+        x = x + self.dropout(a)
+        c, _, _ = self.cross_attn(self.ln2(x), kv=enc, kv_proj=cross_kv)
+        x = x + self.dropout(c)
+        return (x + self.dropout(self.ff(self.ln3(x))), self_bias,
+                new_cache)
+
+
+class T5Model(nn.Layer):
+    def __init__(self, cfg: Optional[T5Config] = None):
+        super().__init__()
+        self.config = cfg = cfg or T5Config()
+        n_dec = cfg.num_decoder_layers or cfg.num_layers
+        self.shared = nn.Embedding(cfg.vocab_size, cfg.d_model)
+        self.encoder_layers = nn.LayerList(
+            [T5EncoderLayer(cfg, has_relative_bias=(i == 0))
+             for i in range(cfg.num_layers)])
+        self.encoder_norm = nn.RMSNorm(cfg.d_model,
+                                       epsilon=cfg.layer_norm_epsilon)
+        self.decoder_layers = nn.LayerList(
+            [T5DecoderLayer(cfg, has_relative_bias=(i == 0))
+             for i in range(n_dec)])
+        self.decoder_norm = nn.RMSNorm(cfg.d_model,
+                                       epsilon=cfg.layer_norm_epsilon)
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+        from .ernie import _init_transformer_weights
+
+        _init_transformer_weights(self, 0.02)
+
+    def encode(self, input_ids):
+        x = self.dropout(self.shared(input_ids))
+        bias = None
+        for layer in self.encoder_layers:
+            x, bias = layer(x, position_bias=bias)
+        return self.encoder_norm(x)
+
+    def decode(self, decoder_input_ids, enc, caches=None, start_pos=0,
+               cross_kvs=None):
+        x = self.dropout(self.shared(decoder_input_ids))
+        bias = None
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.decoder_layers):
+            cache = caches[i] if caches is not None else None
+            x, bias, nc = layer(
+                x, enc, self_bias=bias, cache=cache, start_pos=start_pos,
+                cross_kv=cross_kvs[i] if cross_kvs is not None else None)
+            if new_caches is not None:
+                new_caches.append(nc)
+        x = self.decoder_norm(x)
+        if new_caches is not None:
+            return x, new_caches
+        return x
+
+    def forward(self, input_ids, decoder_input_ids):
+        return self.decode(decoder_input_ids, self.encode(input_ids))
+
+
+class T5ForConditionalGeneration(nn.Layer):
+    def __init__(self, cfg: Optional[T5Config] = None):
+        super().__init__()
+        self.t5 = T5Model(cfg)
+        self.config = cfg = self.t5.config
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.d_model, cfg.vocab_size,
+                                     bias_attr=False)
+
+    def _logits(self, h):
+        cfg = self.config
+        if cfg.tie_word_embeddings:
+            # tied head: scale by d_model**-0.5 (T5's rescaled logits)
+            return (h * (cfg.d_model ** -0.5)).matmul(
+                self.t5.shared.weight, transpose_y=True)
+        return self.lm_head(h)
+
+    def forward(self, input_ids, decoder_input_ids):
+        return self._logits(self.t5(input_ids, decoder_input_ids))
+
+    def loss(self, logits, labels, ignore_index=-100):
+        vocab = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, vocab]),
+                               labels.reshape([-1]),
+                               ignore_index=ignore_index)
+
+    def shift_right(self, labels):
+        """Decoder inputs: labels shifted right with the start token."""
+        import numpy as np
+
+        lab = labels.numpy() if hasattr(labels, "numpy") else np.asarray(
+            labels)
+        out = np.full_like(lab, self.config.pad_token_id)
+        out[:, 0] = self.config.decoder_start_token_id
+        out[:, 1:] = lab[:, :-1]
+        out[out == -100] = self.config.pad_token_id
+        return Tensor(jnp.asarray(out))
+
+    def generate(self, input_ids, max_new_tokens=32,
+                 eos_token_id: Optional[int] = None, cache_dtype=None):
+        """Greedy seq2seq decoding: one encoder pass, cross-attention K/V
+        projected ONCE per prompt, then token-by-token decode with
+        per-layer self-attention KV caches."""
+        ids = input_ids if isinstance(input_ids, Tensor) else Tensor(
+            jnp.asarray(input_ids))
+        b = ids.shape[0]
+        cfg = self.config
+        was_training = self.training
+        self.eval()
+        try:
+            enc = self.t5.encode(ids)
+            cross_kvs = [layer.cross_attn.project_kv(enc)
+                         for layer in self.t5.decoder_layers]
+            max_len = max_new_tokens
+            dt = cache_dtype or jnp.float32
+            caches = [
+                (jnp.zeros((b, max_len, cfg.num_heads, cfg.d_kv), dt),
+                 jnp.zeros((b, max_len, cfg.num_heads, cfg.d_kv), dt))
+                for _ in self.t5.decoder_layers]
+            cur = jnp.full((b, 1), cfg.decoder_start_token_id, jnp.int32)
+            outs = []
+            finished = jnp.zeros((b,), bool)
+            for step in range(max_new_tokens):
+                h, caches = self.t5.decode(Tensor(cur), enc,
+                                           caches=caches, start_pos=step,
+                                           cross_kvs=cross_kvs)
+                logits = self._logits(h)._data[:, -1]
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                outs.append(nxt)
+                cur = nxt[:, None]
+                if eos_token_id is not None and bool(jnp.all(finished)):
+                    break
+        finally:
+            if was_training:
+                self.train()
+        return Tensor(jnp.stack(outs, axis=1))

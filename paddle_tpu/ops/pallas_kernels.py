@@ -1067,7 +1067,9 @@ def ring_flash_attention_pallas(q, k, v, axis_name: str, causal=False,
     block_k = _pick_block(s, _BLOCK_K)
     block = max(block_q, block_k)
     S = _round_up(s, block)
-    d_p = _round_up(d, 128)
+    # 64/128/256 head dims lower natively (same Mosaic rule as the
+    # flash entry point) — no pad-to-128 HBM traffic
+    d_p = d if d in (64, 128, 256) else _round_up(d, 128)
 
     def padp(x):
         return jnp.pad(x, ((0, 0), (0, 0), (0, S - s), (0, d_p - d)))
@@ -1089,7 +1091,9 @@ def _fwd_flash_for_ulysses(q, k, v, scale, causal, axis_name, interpret):
         raise ValueError("pallas ulysses path supports the default scale")
     block = max(_pick_block(s, _BLOCK_Q), _pick_block(s, _BLOCK_K))
     S = _round_up(s, block)
-    d_p = _round_up(d, 128)
+    # 64/128/256 head dims lower natively (same Mosaic rule as the
+    # flash entry point) — no pad-to-128 HBM traffic
+    d_p = d if d in (64, 128, 256) else _round_up(d, 128)
 
     def padp(x):
         return jnp.pad(x, ((0, 0), (0, 0), (0, S - s), (0, d_p - d)))

@@ -113,6 +113,16 @@ def test_all_matmuls_bf16(bench_step_lowered):
     assert set(combos) == {("bf16", "bf16")}, dict(combos)
 
 
+def test_no_materialized_logits(bench_step_lowered):
+    """The fused-CE head (r5) must keep the f32 (batch*seq, vocab) logits
+    out of the step — only per-chunk blocks may exist. Its reappearance
+    costs ~10 ms/step of copies and ~2.4 GB live (PERF_NOTES r5)."""
+    txt, _ = bench_step_lowered
+    n_rows = BATCH * SEQ
+    assert not re.search(r"tensor<%dx18000xf32>" % n_rows, txt)
+    assert not re.search(r"tensor<%dx512x18000x(f32|bf16)>" % BATCH, txt)
+
+
 def test_state_buffers_donated(bench_step_lowered):
     """params/buffers/opt_state are donated (donate_argnums=(0,1,2)); the
     lowered module records each aliased input as tf.aliasing_output. Without

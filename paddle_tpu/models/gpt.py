@@ -25,6 +25,9 @@ class GPTConfig:
     hidden_dropout_prob: float = 0.1
     attention_probs_dropout_prob: float = 0.1
     layer_norm_eps: float = 1e-5
+    # LM head via fused_linear_cross_entropy when labels ride into
+    # forward: the (b*s, vocab) f32 logits never materialize
+    fused_lm_loss: bool = False
 
     @classmethod
     def gpt3_1p3b(cls):
@@ -235,11 +238,22 @@ class GPTForCausalLM(nn.Layer):
         self.gpt = GPTModel(cfg)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                start_pos=0):
+                start_pos=0, labels=None):
         if caches is None:
             h = self.gpt(input_ids, position_ids)
+            if labels is not None and self.gpt.config.fused_lm_loss:
+                # shifted causal CE fused with the tied head projection
+                from .. import incubate
+
+                hidden = h.shape[-1]
+                return incubate.nn.functional.fused_linear_cross_entropy(
+                    h[:, :-1].reshape([-1, hidden]), self.gpt.wte.weight,
+                    None, labels[:, 1:].reshape([-1]), transpose_y=True)
             # tied LM head: one [h, vocab] matmul
-            return h.matmul(self.gpt.wte.weight, transpose_y=True)
+            logits = h.matmul(self.gpt.wte.weight, transpose_y=True)
+            if labels is not None:
+                return self.loss(logits, labels)
+            return logits
         h, new_caches = self.gpt(input_ids, position_ids, caches, start_pos)
         return h.matmul(self.gpt.wte.weight, transpose_y=True), new_caches
 

@@ -28,6 +28,9 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
+    # LM head via fused_linear_cross_entropy when labels ride into
+    # forward: the (b*s, vocab) f32 logits never materialize
+    fused_lm_loss: bool = False
 
     @classmethod
     def llama7b(cls):
@@ -160,9 +163,21 @@ class LlamaForCausalLM(nn.Layer):
         self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                  bias_attr=False)
 
-    def forward(self, input_ids, caches=None, start_pos=0):
+    def forward(self, input_ids, caches=None, start_pos=0, labels=None):
         if caches is None:
-            return self.lm_head(self.llama(input_ids))
+            h = self.llama(input_ids)
+            if labels is not None and self.llama.config.fused_lm_loss:
+                # shifted causal CE fused with the head projection
+                from .. import incubate
+
+                hidden = h.shape[-1]
+                return incubate.nn.functional.fused_linear_cross_entropy(
+                    h[:, :-1].reshape([-1, hidden]), self.lm_head.weight,
+                    None, labels[:, 1:].reshape([-1]), transpose_y=False)
+            logits = self.lm_head(h)
+            if labels is not None:
+                return self.loss(logits, labels)
+            return logits
         h, new_caches = self.llama(input_ids, caches, start_pos)
         return self.lm_head(h), new_caches
 

@@ -101,3 +101,38 @@ def dataclass_asdict(cfg):
     import dataclasses
 
     return dataclasses.asdict(cfg)
+
+
+class TestFusedLMLoss:
+    """forward(ids, labels=...) with fused_lm_loss: the chunked CE head
+    must match the logits-path loss for LLaMA and GPT (r5)."""
+
+    def test_llama_fused_matches_logits_path(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny()
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 12)))
+        ref = model.loss(model(ids), ids)
+        plain = model(ids, labels=ids)          # flag off: logits path
+        cfg.fused_lm_loss = True
+        fused = model(ids, labels=ids)
+        np.testing.assert_allclose(plain.numpy(), ref.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(fused.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_gpt_fused_matches_logits_path(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig.tiny()
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_probs_dropout_prob = 0.0
+        paddle.seed(1)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        rng = np.random.RandomState(1)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 10)))
+        ref = model.loss(model(ids), ids)
+        cfg.fused_lm_loss = True
+        fused = model(ids, labels=ids)
+        np.testing.assert_allclose(fused.numpy(), ref.numpy(), rtol=1e-5)

@@ -357,4 +357,4 @@ def test_yaml_is_the_single_source_of_truth():
 
     assert set(OPS) == set(GENERATED), (
         sorted(set(OPS) ^ set(GENERATED)))
-    assert len(OPS) == 393
+    assert len(OPS) == 397

@@ -30,11 +30,14 @@ back to EAGER with a warning instead of raising):
   early-return If machinery. `range` with TRACED endpoints lowers to one
   carried `lax.while_loop`; a Python iterable with a traced break
   condition latches the flag and masks subsequent iterations.
+- (v3) `continue` inside a converted `for` rewrites to an early
+  `return (False, *carried)` — ends the iteration without latching the
+  done-flag, so traced continue conditions stay one XLA program.
 
 Skipped (left as-is): branches that store to attributes/subscripts (side
 effects must not run for the untaken branch at trace time), loops
-containing continue/return, `for` with non-name targets or for-else,
-lambdas. Every converted/skipped site is recorded with its reason in the
+containing `return`, `while` containing break/continue, `for` with
+non-name targets or for-else, lambdas. Every converted/skipped site is recorded with its reason in the
 function's `__dy2static_report__` (surfaced by
 `StaticFunction.conversion_report()`), so a user can SEE what stayed
 eager instead of silently losing the one-XLA-program property
@@ -396,17 +399,26 @@ def _names_tuple(names: List[str], ctx) -> ast.expr:
 
 class _BreakToReturn(ast.NodeTransformer):
     """Rewrites this loop level's `break` into `return (True, *carried)`
-    — the early-return If machinery then converts it into the carried
-    done-flag. Nested loops/functions own their breaks: not descended."""
+    and `continue` into `return (False, *carried)` — the body closure
+    returns (done, *carried) per iteration, so breaking latches the
+    carried done-flag while continuing just ends the iteration early;
+    both ride the early-return If machinery. Nested loops/functions own
+    their break/continue: not descended."""
 
     def __init__(self, carried: List[str]):
         self._carried = carried
 
-    def visit_Break(self, node):
+    def _ret(self, done: bool):
         return ast.Return(value=ast.Tuple(
-            elts=[ast.Constant(value=True)]
+            elts=[ast.Constant(value=done)]
             + [ast.Name(id=c, ctx=ast.Load()) for c in self._carried],
             ctx=ast.Load()))
+
+    def visit_Break(self, node):
+        return self._ret(True)
+
+    def visit_Continue(self, node):
+        return self._ret(False)
 
     def _stop(self, node):
         return node
@@ -616,8 +628,9 @@ class _Dy2Static(ast.NodeTransformer):
             return skip("non-name loop target")
         if _has_side_stores(st.body):
             return skip("attribute/subscript store in body")
-        if _has_nonlocal_flow(st.body, include_break=False):
-            return skip("continue/return in body")
+        if _has_nonlocal_flow(st.body, include_break=False,
+                              include_continue=False):
+            return skip("return in body")
         target = st.target.id
         assigned = _stored_names(st.body)
         carried = [n for n in assigned
@@ -628,10 +641,10 @@ class _Dy2Static(ast.NodeTransformer):
         if not carried:
             return skip("no carried loop variables")
 
-        has_break = _has_nonlocal_flow(st.body, include_return=False,
-                                       include_continue=False)
+        has_break_or_continue = _has_nonlocal_flow(st.body,
+                                                   include_return=False)
         body_stmts = [_copy(s) for s in st.body]
-        if has_break:
+        if has_break_or_continue:
             rewriter = _BreakToReturn(carried)
             body_stmts = [ast.fix_missing_locations(rewriter.visit(s))
                           for s in body_stmts]

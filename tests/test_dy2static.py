@@ -391,3 +391,67 @@ class TestForTransform:
 def _rebuild_net(cls):
     paddle.seed(3)
     return cls()
+
+
+class TestForContinue:
+    """v3: `continue` inside a converted for rewrites to an early
+    (False, *carried) return — the iteration ends without latching the
+    break flag, and a traced continue condition stays one program."""
+
+    def test_continue_matches_eager(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(6):
+                if i % 2 == 1:        # python-valued continue
+                    continue
+                s = s + x * float(i)
+            return s
+
+        e, st = _both(f, _t([1.0, 2.0]))
+        np.testing.assert_allclose(e, st)
+
+    def test_tensor_continue_is_one_program(self):
+        def f(x, t):
+            s = x * 0.0
+            for i in range(5):
+                if (x + i).sum() > t.sum():  # traced continue condition
+                    continue
+                s = s + x
+            return s
+
+        sf = paddle.jit.to_static(f)
+        for thresh, want in ((100.0, 5.0), (2.5, 2.0), (-1.0, 0.0)):
+            got = float(np.asarray(sf(_t([1.0]), _t([thresh])).numpy())[0])
+            assert got == want, (thresh, got, want)
+        assert len(sf._cache) == 1
+        assert not sf._eager_sigs, "for+continue fell back to eager"
+
+    def test_continue_and_break_combined(self):
+        def f(x, stop):
+            s = x * 0.0
+            for i in range(8):
+                if i == 1:
+                    continue
+                s = s + x
+                if s.sum() > stop.sum():
+                    break
+            return s
+
+        for thresh in (2.5, 100.0):
+            e, st = _both(f, _t([1.0]), _t([thresh]))
+            np.testing.assert_allclose(e, st)
+
+    def test_report_notes_conversion(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(3):
+                if i == 0:
+                    continue
+                s = s + x
+            return s
+
+        sf = paddle.jit.to_static(f)
+        sf(_t([1.0]))
+        rep = sf.conversion_report()
+        assert any(kind == "for" and "converted" in status
+                   for kind, _, status in rep)

@@ -284,7 +284,26 @@ class T5ForConditionalGeneration(nn.Layer):
                 self.t5.shared.weight, transpose_y=True)
         return self.lm_head(h)
 
-    def forward(self, input_ids, decoder_input_ids):
+    def forward(self, input_ids, decoder_input_ids=None, caches=None,
+                start_pos=0, enc=None, cross_kvs=None):
+        """Three call shapes (mirroring the decoder-only families'
+        cache-aware forward, so jitted generation can drive everything
+        through the one functional entry point):
+        - (input_ids, decoder_input_ids): training/eval logits;
+        - (input_ids) with decoder_input_ids None: encoder-only — returns
+          (encoder_states, per-layer cross-attention (k, v) projections);
+        - decode step: pass decoder_input_ids + enc/cross_kvs/caches —
+          returns (logits, new_caches)."""
+        if decoder_input_ids is None:
+            enc = self.t5.encode(input_ids)
+            cross = tuple(layer.cross_attn.project_kv(enc)
+                          for layer in self.t5.decoder_layers)
+            return enc, cross
+        if caches is not None:
+            h, new_caches = self.t5.decode(
+                decoder_input_ids, enc, caches=caches, start_pos=start_pos,
+                cross_kvs=cross_kvs)
+            return self._logits(h), new_caches
         return self._logits(self.t5(input_ids, decoder_input_ids))
 
     def loss(self, logits, labels, ignore_index=-100):
@@ -307,40 +326,74 @@ class T5ForConditionalGeneration(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32,
                  eos_token_id: Optional[int] = None, cache_dtype=None):
-        """Greedy seq2seq decoding: one encoder pass, cross-attention K/V
-        projected ONCE per prompt, then token-by-token decode with
-        per-layer self-attention KV caches."""
-        ids = input_ids if isinstance(input_ids, Tensor) else Tensor(
-            jnp.asarray(input_ids))
-        b = ids.shape[0]
+        """Greedy seq2seq decoding: ONE jitted encoder pass (cross-
+        attention K/V projected once per prompt), then a memoized jitted
+        decode step per token — per-layer self-attention KV caches
+        donated step to step, eos mask on device (polled every 8
+        steps)."""
+        import jax
+
+        from ..jit.functional import call_functional, extract_state
+
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        b, src_len = ids.shape
         cfg = self.config
         was_training = self.training
         self.eval()
         try:
-            enc = self.t5.encode(ids)
-            cross_kvs = [layer.cross_attn.project_kv(enc)
-                         for layer in self.t5.decoder_layers]
-            max_len = max_new_tokens
+            params, buffers = extract_state(self)
             dt = cache_dtype or jnp.float32
             caches = [
-                (jnp.zeros((b, max_len, cfg.num_heads, cfg.d_kv), dt),
-                 jnp.zeros((b, max_len, cfg.num_heads, cfg.d_kv), dt))
+                (jnp.zeros((b, max_new_tokens, cfg.num_heads, cfg.d_kv),
+                           dt),
+                 jnp.zeros((b, max_new_tokens, cfg.num_heads, cfg.d_kv),
+                           dt))
                 for _ in self.t5.decoder_layers]
-            cur = jnp.full((b, 1), cfg.decoder_start_token_id, jnp.int32)
-            outs = []
+
+            cache_key = (b, src_len, max_new_tokens,
+                         jnp.dtype(dt).name, eos_token_id)
+            jit_cache = self.__dict__.setdefault("_t5_gen_jit_cache", {})
+            if cache_key not in jit_cache:
+                def encode(params, buffers, ids):
+                    (enc, cross), _ = call_functional(
+                        self, params, buffers, (Tensor(ids),),
+                        training=False)
+                    return enc, cross
+
+                def decode(params, buffers, token, caches, pos, enc,
+                           cross, finished):
+                    (logits, new_caches), _ = call_functional(
+                        self, params, buffers,
+                        (None, Tensor(token[:, None])),
+                        kwargs={"caches": caches, "start_pos": pos,
+                                "enc": Tensor(enc),
+                                "cross_kvs": [(Tensor(k), Tensor(v))
+                                              for k, v in cross]},
+                        training=False)
+                    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(
+                        jnp.int32)
+                    if eos_token_id is not None:
+                        nxt = jnp.where(finished, eos_token_id, nxt)
+                        finished = finished | (nxt == eos_token_id)
+                    return nxt, new_caches, finished
+
+                jit_cache[cache_key] = (jax.jit(encode),
+                                        jax.jit(decode,
+                                                donate_argnums=(3,)))
+            encode_j, decode_j = jit_cache[cache_key]
+
+            enc, cross = encode_j(params, buffers, ids)
+            cur = jnp.full((b,), cfg.decoder_start_token_id, jnp.int32)
             finished = jnp.zeros((b,), bool)
+            outs = []
             for step in range(max_new_tokens):
-                h, caches = self.t5.decode(Tensor(cur), enc,
-                                           caches=caches, start_pos=step,
-                                           cross_kvs=cross_kvs)
-                logits = self._logits(h)._data[:, -1]
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                if eos_token_id is not None:
-                    nxt = jnp.where(finished, eos_token_id, nxt)
-                    finished = finished | (nxt == eos_token_id)
-                outs.append(nxt)
-                cur = nxt[:, None]
-                if eos_token_id is not None and bool(jnp.all(finished)):
+                cur, caches, finished = decode_j(
+                    params, buffers, cur, caches, jnp.int32(step), enc,
+                    cross, finished)
+                outs.append(cur)
+                if (eos_token_id is not None and step % 8 == 7
+                        and bool(jnp.all(finished))):
                     break
         finally:
             if was_training:

@@ -166,3 +166,19 @@ class TestT5Generate:
         hits = np.where(out[0] == 1)[0]
         if hits.size:                      # everything after eos is eos
             assert (out[0, hits[0]:] == 1).all()
+
+
+def test_generate_jit_cache_memoized():
+    """Repeated generate() with the same shape must reuse the jitted
+    encode/decode pair (no per-call recompile) and give identical greedy
+    output."""
+    paddle.seed(7)
+    model = T5ForConditionalGeneration(_tiny())
+    model.eval()
+    src = paddle.to_tensor(
+        np.random.RandomState(7).randint(0, 256, (1, 5)))
+    a = model.generate(src, max_new_tokens=4).numpy()
+    assert len(model._t5_gen_jit_cache) == 1
+    b = model.generate(src, max_new_tokens=4).numpy()
+    assert len(model._t5_gen_jit_cache) == 1   # memoized, not re-jitted
+    np.testing.assert_array_equal(a, b)

@@ -201,6 +201,53 @@ def test_bench_step_compiles_with_mosaic(monkeypatch):
 
 
 @aot
+def test_bench_step_batch64_fits_hbm(monkeypatch):
+    """The fused-CE head's memory win must hold: the PLAIN (no remat)
+    batch-64 step compiles within the 16 GB v5e budget (15.74 GB at
+    r5 — the sweep's best-throughput config depends on this)."""
+    _patch_tpu_gates(monkeypatch)
+    from jax.experimental import topologies
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.functional import extract_state
+    from paddle_tpu.models import ErnieConfig, ErnieForPretraining
+    import bench
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+    sh = jax.sharding.SingleDeviceSharding(topo.devices[0])
+
+    cfg = ErnieConfig.ernie_base()
+    cfg.fused_mlm_loss = True
+    model = ErnieForPretraining(cfg)
+    model.train()
+    opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                parameters=model.parameters())
+    params, buffers = extract_state(model)
+    opt_state = opt.functional_state(params)
+
+    def absify(t):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+            t)
+
+    jitted = jax.jit(bench.make_train_step(model, opt),
+                     donate_argnums=(0, 1, 2))
+    scalar = lambda dt: jax.ShapeDtypeStruct((), dt, sharding=sh)  # noqa:E731
+    data = jax.ShapeDtypeStruct((64, SEQ), jnp.int32, sharding=sh)
+    compiled = jitted.lower(
+        absify(params), absify(buffers), absify(opt_state),
+        scalar(jnp.float32), scalar(jnp.int32),
+        scalar(jax.random.key(0).dtype), data, data).compile()
+    mem = compiled.memory_analysis()
+    hbm = (mem.argument_size_in_bytes + mem.temp_size_in_bytes
+           + mem.generated_code_size_in_bytes
+           - mem.alias_size_in_bytes + mem.output_size_in_bytes)
+    assert hbm < 16e9, (f"plain batch-64 fused step needs "
+                        f"{hbm/1e9:.2f} GB > 16 GB")
+
+
+@aot
 def test_zero2_step_emits_reduce_scatter():
     """ZeRO-2 through the PRODUCT hapi step on an 8-chip v5e topology: the
     TPU pipeline must turn the grad all-reduce + shard-slice into

@@ -325,6 +325,30 @@ class StaticFunction:
         sig = (_sig_of(args), training)
         return [g for g, _ in self._sot_cache.get(sig, ())]
 
+    def capture_report(self):
+        """SOT-tier visibility (the dy2static conversion_report analog):
+        per input signature, how many guarded specializations captured
+        and any graph-break reason that sent it eager. A user can SEE
+        whether they kept the one-XLA-program property."""
+        if self._backend != "sot":
+            return None
+        breaks = getattr(self, "_sot_break_reasons", {})
+        report = []
+        for sig, entries in self._sot_cache.items():
+            # a sig can both hold captured specializations and have broken
+            # once under another guard set — report ONE row with both facts
+            status = ("captured" if sig not in breaks
+                      else f"captured; one guard set went eager: "
+                           f"{breaks[sig]}")
+            report.append({"signature": sig,
+                           "specializations": len(entries),
+                           "status": status})
+        for sig, reason in breaks.items():
+            if sig not in self._sot_cache:
+                report.append({"signature": sig, "specializations": 0,
+                               "status": f"eager: {reason}"})
+        return report
+
     # -------------------------------------------------------------- calling
 
     def _call_recorded(self, compiled, params, buffers, datas, args):
@@ -428,6 +452,9 @@ class StaticFunction:
                    else str(_graph_break(self.__name__, e)))
             warnings.warn(msg, stacklevel=2)
             self._eager_sigs.add(sig)
+            if self._backend == "sot":
+                self.__dict__.setdefault("_sot_break_reasons", {})[sig] = \
+                    msg.split(": ", 1)[-1][:200]
             return self._run_eager(args)
         # write back mutated buffers (BN running stats under training)
         if new_buffers:

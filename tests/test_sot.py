@@ -457,3 +457,30 @@ class TestSoundnessRound2:
         # cached specializations still serve compiled when guards match
         ns["K"] = 3
         np.testing.assert_allclose(sf(t).numpy(), [4.0])
+
+
+def test_capture_report():
+    """capture_report(): specializations visible per signature; breaks
+    carry their reason (the dy2static conversion_report analog)."""
+    @paddle.jit.to_static(full_graph=False)
+    def good(x):
+        if x.sum() > 0:
+            return x * 2
+        return x
+
+    good(_t(np.asarray([1.0], np.float32)))
+    rep = good.capture_report()
+    assert any(r["status"] == "captured" and r["specializations"] == 1
+               for r in rep)
+
+    @paddle.jit.to_static(full_graph=False)
+    def bad(x):
+        while x.sum() > 0:
+            x = x - 1
+        return x
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bad(_t(np.asarray([1.0], np.float32)))
+    rep = bad.capture_report()
+    assert any(r["status"].startswith("eager:") for r in rep)

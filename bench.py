@@ -71,13 +71,72 @@ def _peak_flops(device) -> float | None:
 
 
 def _write_partial(obj: dict) -> None:
-    """Persist the best-so-far result so a later wedge still leaves signal."""
+    """Persist the best-so-far result so a later wedge still leaves signal.
+    Every write carries the phase ledger, so even a value-less partial
+    tells the supervisor how far the child got."""
+    if "error" not in obj:
+        _PHASE_STATE["best"] = obj
+    obj.setdefault("detail", {})["phases_completed"] = \
+        list(_PHASE_STATE["completed"])
     try:
         with open(PARTIAL_PATH, "w") as f:
             json.dump(obj, f)
             f.write("\n")
     except OSError:
         pass
+
+
+# ------------------------------------------------------- per-phase watchdog
+#
+# Round-5 wedge postmortem: the run died under the driver's external
+# `timeout` (rc=124) with parsed: null — no JSON, no partial, no culprit
+# phase. The global watchdog below still backstops the whole child; this
+# tracker additionally re-arms a PER-PHASE timer at every phase boundary,
+# and on fire records a partial JSON naming the completed phases and the
+# wedged one, emits the same in the error line, and hard-exits — so the
+# tail always says WHERE it died, and the supervisor inherits whatever
+# phases did complete.
+
+_PHASE_STATE: dict = {"current": "start", "completed": [], "timer": None,
+                      "best": None}
+
+
+def _enter_phase(name: str, budget: float | None = None) -> None:
+    import threading
+
+    st = _PHASE_STATE
+    if st["current"] != "start":
+        st["completed"].append(st["current"])
+    st["current"] = name
+    if st["timer"] is not None:
+        st["timer"].cancel()
+    if budget is None:
+        budget = float(os.environ.get("BENCH_PHASE_WATCHDOG_SECS", "700"))
+    t = threading.Timer(budget, _phase_wedged, (name, budget))
+    t.daemon = True
+    t.start()
+    st["timer"] = t
+
+
+def _phase_wedged(name: str, budget: float) -> None:
+    st = _PHASE_STATE
+    msg = (f"phase watchdog: {name!r} exceeded {budget:.0f}s "
+           f"(completed: {','.join(st['completed']) or 'none'})")
+    _log(msg)
+    base = dict(st["best"]) if st["best"] else _error_json(msg)
+    base.setdefault("detail", {})["wedged_phase"] = name
+    base["detail"]["phases_completed"] = list(st["completed"])
+    try:
+        with open(PARTIAL_PATH, "w") as f:
+            json.dump(base, f)
+            f.write("\n")
+    except OSError:
+        pass
+    err = _error_json(msg)
+    err["detail"] = {"wedged_phase": name,
+                     "phases_completed": list(st["completed"])}
+    _emit(err)
+    os._exit(3)
 
 
 # --------------------------------------------------------------------------
@@ -161,11 +220,23 @@ def _run_gates(on_tpu: bool) -> dict:
         assert float(np.max(np.abs(np.asarray(o, np.float32)))) == 0.0
         assert bool(np.all(np.isneginf(np.asarray(lse))))
 
+    def paged_decode():
+        # the serving engine's ragged paged-attention decode kernel
+        from paddle_tpu.serving import attention as satt
+
+        kvh, hd, ps, pages, maxp, bb = 4, 128, 16, 16, 4, 4
+        kp = jnp.asarray(rng.randn(kvh, pages, ps, hd), jnp.bfloat16)
+        qq = jnp.asarray(rng.randn(bb, 1, 8, hd), jnp.bfloat16)
+        pt = jnp.asarray(rng.randint(1, pages, (bb, maxp)), jnp.int32)
+        pos = jnp.asarray([3, 17, 33, 60], jnp.int32)
+        np.asarray(satt._paged_decode_pallas(qq, kp, kp, pt, pos))
+
     gate("flash_fwd", flash_fwd)
     gate("flash_bwd", flash_bwd)
     gate("flash_dropout", flash_dropout)
     gate("fused_norms", norms)
     gate("ring_step", ring_step)
+    gate("paged_decode", paged_decode)
     return gates
 
 
@@ -224,6 +295,15 @@ def _run_aot_gates() -> dict:
 
     gates: dict[str, str] = {"mode": "aot-compile (no chip; real v5e "
                              "compiler via libtpu topology)"}
+    # without these, libtpu burns minutes querying the (absent) GCP
+    # metadata server — 30 curl retries per variable — before topologies
+    # works; safe here because this path only runs with no chip attached
+    for k, v in (("TPU_SKIP_MDS_QUERY", "true"),
+                 ("TPU_ACCELERATOR_TYPE", "v5litepod-4"),
+                 ("TPU_WORKER_ID", "0"),
+                 ("TPU_WORKER_HOSTNAMES", "localhost")):
+        os.environ.setdefault(k, v)
+
     def topo_devices():
         from jax.experimental import topologies
         topo = topologies.get_topology_desc(platform="tpu",
@@ -294,6 +374,15 @@ def _run_aot_gates() -> dict:
     gate("ring_step", ring_step, abs_((1, 4, 256, 128), jnp.bfloat16),
          abs_((1, 1, 1, 1), jnp.float32), seed)
 
+    from paddle_tpu.serving import attention as satt
+
+    gate("paged_decode",
+         lambda qq, kp, pt, pos: satt._paged_decode_pallas(qq, kp, kp, pt,
+                                                           pos),
+         abs_((4, 1, 8, 128), jnp.bfloat16),
+         abs_((4, 16, 16, 128), jnp.bfloat16),
+         abs_((4, 4), jnp.int32), abs_((4,), jnp.int32))
+
     pk._on_tpu = orig
     return gates
 
@@ -303,6 +392,7 @@ def bench_child() -> None:
     # head, ~4-6 min each through the relay) + measurement; the per-phase
     # bench_partial.json still rescues a mid-run wedge
     _start_watchdog(float(os.environ.get("BENCH_WATCHDOG_SECS", "1250")))
+    _enter_phase("init")
     _log("phase=init: importing jax")
     import jax
 
@@ -325,13 +415,16 @@ def bench_child() -> None:
 
     # tiny compile first: verifies the backend can compile+run at all before
     # we sink 20-40s into the big StableHLO program
+    _enter_phase("smoke", 300.0)
     x = jnp.ones((128, 128), jnp.bfloat16)
     y = jax.jit(lambda a: (a @ a).sum())(x)
     float(np.asarray(y))
     _log("phase=smoke: tiny matmul compiled and ran")
 
     # Pallas lowering gates next: cheap compiles, maximal hardware signal
+    _enter_phase("gates")
     gates = _run_gates(on_tpu)
+    _enter_phase("build")
 
     if on_tpu:
         cfg = ErnieConfig.ernie_base()  # ERNIE-1.0: L12 H768 A12 vocab 18k
@@ -465,6 +558,7 @@ def bench_child() -> None:
         }
 
     # --- phase: quick MFU at the round-2 reference config -----------------
+    _enter_phase("quick")
     run_steps(2, ids, labels, sync_each=True)  # compile + warm
     dt_q, loss_q = run_steps(5, ids, labels)
     tps_q = batch * seq * 5 / dt_q
@@ -474,6 +568,7 @@ def bench_child() -> None:
          f"(mfu={best['detail']['mfu']:.3f})")
 
     # --- phase: batch micro-sweep (TPU only, no explicit override) --------
+    _enter_phase("sweep", 1000.0)
     sweep_detail = {str(batch): round(tps_q, 1)}
     best_r = False
     if will_sweep:
@@ -504,6 +599,7 @@ def bench_child() -> None:
         ids, labels = data_for(batch)
 
     # --- phase: final measurement with profiler trace ---------------------
+    _enter_phase("final")
     final_step = remat_step() if best_r else jitted
     run_steps(warmup, ids, labels, sync_each=True, step_fn=final_step)
     _log(f"phase=warmup: {warmup} steps done (batch={batch})")
@@ -550,6 +646,7 @@ def _run_child(extra_env: dict, timeout: float) -> str | None:
     except subprocess.TimeoutExpired:
         _log(f"attempt timed out after {timeout}s")
         return None
+    last_err = None
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -559,7 +656,11 @@ def _run_child(extra_env: dict, timeout: float) -> str | None:
                 continue
             if parsed.get("metric") == METRIC and "error" not in parsed:
                 return line
-    _log(f"attempt failed rc={proc.returncode}")
+            if last_err is None and parsed.get("error"):
+                last_err = parsed["error"]
+    # the wedged phase name (per-phase watchdog) surfaces in the tail here
+    _log(f"attempt failed rc={proc.returncode}"
+         + (f": {last_err[:300]}" if last_err else ""))
     return None
 
 
@@ -623,7 +724,15 @@ def main() -> None:
         _emit(parsed)
         return
 
-    _emit(_error_json("all attempts failed (tpu x2, cpu x1)"))
+    err = _error_json("all attempts failed (tpu x2, cpu x1)")
+    try:  # even a value-less partial names the phases reached before wedging
+        with open(PARTIAL_PATH) as f:
+            detail = json.load(f).get("detail", {})
+        err["detail"] = {k: detail[k] for k in
+                         ("phases_completed", "wedged_phase") if k in detail}
+    except (OSError, json.JSONDecodeError):
+        pass
+    _emit(err)
 
 
 if __name__ == "__main__":

@@ -50,7 +50,7 @@ _SUBPACKAGES = [
     "nn", "optimizer", "io", "metric", "vision", "amp", "static", "jit",
     "distributed", "device", "profiler", "incubate", "sparse", "framework",
     "hapi", "text", "audio", "distribution", "quantization", "utils",
-    "inference", "linalg", "fft", "signal", "hub", "onnx",
+    "inference", "linalg", "fft", "signal", "hub", "onnx", "serving",
 ]
 import importlib as _importlib
 

@@ -104,7 +104,11 @@ class _Guards:
       ("cell", cell_object)          -> cell.cell_contents
       ("argattr", i, (a1, a2..))     -> getattr chain off root arg i
     Values are scalars compared by ==, or callables/modules compared by
-    identity (id).
+    identity (`is` against the stored object itself — NOT a recorded
+    id(): the captured object can be garbage-collected and its address
+    reused by a different callable, which would silently revalidate a
+    stale specialization; holding the reference pins the object and makes
+    the comparison exact).
     """
 
     def __init__(self):
@@ -118,7 +122,7 @@ class _Guards:
         if isinstance(value, _GUARDABLE):
             self.entries.append((accessor, ("eq", value)))
         elif callable(value) or isinstance(value, types.ModuleType):
-            self.entries.append((accessor, ("id", id(value))))
+            self.entries.append((accessor, ("is", value)))
         # other objects (tensors, containers): not guarded — tensor avals
         # are covered by the signature, containers would over-specialize
 
@@ -142,7 +146,7 @@ def evaluate_guards(entries, args) -> bool:
         if kind == "eq":
             if type(got) is not type(want) or got != want:
                 return False
-        elif id(got) != want:
+        elif got is not want:
             return False
     return True
 

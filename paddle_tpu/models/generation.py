@@ -32,11 +32,18 @@ def attend_with_cache(q, k, v, cache, start_pos, rep, bias=None):
     over the full (masked) cache.
 
     q: Tensor (b, s, heads, hd); k/v: Tensor (b, s, kv_heads, hd);
-    cache: (k_cache, v_cache) raw jnp arrays (b, max_len, kv_heads, hd);
-    bias: optional additive (1, heads, s, max_len) attention bias (T5's
-    relative position bias), folded into the visibility mask.
+    cache: (k_cache, v_cache) raw jnp arrays (b, max_len, kv_heads, hd),
+    OR a serving.PagedLayerCache — then the write/attend runs on the paged
+    pool (ragged per-row positions, `start_pos` may be a (b,) vector) and
+    every attention module here serves the continuous-batching engine
+    unmodified; bias: optional additive (1, heads, s, max_len) attention
+    bias (T5's relative position bias), folded into the visibility mask.
     Returns (ctx Tensor (b, s, heads, hd), new_cache).
     """
+    if hasattr(cache, "page_table"):
+        from ..serving.attention import paged_attend
+
+        return paged_attend(q, k, v, cache, start_pos, rep, bias=bias)
     kc, vc = cache
     kd = k._data.astype(kc.dtype)
     vd = v._data.astype(vc.dtype)

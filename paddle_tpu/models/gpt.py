@@ -150,8 +150,15 @@ class GPTModel(nn.Layer):
             if caches is None:
                 position_ids = arange(s, dtype="int64").unsqueeze(0)
             else:  # decode offset may be traced: static arange + add
-                position_ids = Tensor(
-                    (jnp.arange(s, dtype=jnp.int32) + start_pos)[None])
+                sp = jnp.asarray(
+                    start_pos._data if hasattr(start_pos, "_data")
+                    else start_pos, jnp.int32)
+                if sp.ndim >= 1:  # ragged serving batch: per-row offsets
+                    position_ids = Tensor(
+                        sp[:, None] + jnp.arange(s, dtype=jnp.int32)[None])
+                else:
+                    position_ids = Tensor(
+                        (jnp.arange(s, dtype=jnp.int32) + sp)[None])
         x = self.dropout(self.wte(input_ids) + self.wpe(position_ids))
         if caches is None:
             for blk in self.blocks:

@@ -519,7 +519,12 @@ def _flce_bwd(ignore_index, transpose_y, chunk, res, g):
     xs, ls = _flce_chunks(x2, lbl, ignore_index, chunk)
     n_chunks = xs.shape[0]
     pad = n_chunks * chunk - n
-    lse_s = jnp.pad(lse, (0, pad)).reshape(n_chunks, chunk)
+    # padded rows carry lse=+inf so p = exp(logits - lse) is exactly 0:
+    # with a 0 pad, a padded row whose recomputed logits overflow exp()
+    # yields p=inf, and inf * (g*valid == 0) = NaN poisoning the dw/db
+    # scan accumulators (ragged final chunk, advisor round-5 finding)
+    lse_s = jnp.pad(lse, (0, pad),
+                    constant_values=jnp.inf).reshape(n_chunks, chunk)
     g_s = jnp.pad(g.astype(jnp.float32), (0, pad)).reshape(n_chunks, chunk)
     bf = b.astype(jnp.float32)
 

@@ -229,10 +229,13 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
                 s = jnp.where(cols < sk, s, -jnp.inf)
             m_prev = m_ref[...]
             m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-            # fully-masked rows keep m=-inf; clamp so exp(-inf--inf) != nan
-            m_safe = jnp.where(jnp.isfinite(m_cur), m_cur, 0.0)
-            p = jnp.exp(jnp.where(jnp.isfinite(s), s - m_safe, -jnp.inf))
-            alpha = jnp.where(jnp.isfinite(m_prev),
+            # fully-masked rows keep m=-inf; clamp so exp(-inf--inf) != nan.
+            # In-kernel values are finite or -inf by construction, and the
+            # is_finite primitive has no Mosaic lowering on this jax — the
+            # != -inf test is the same guard and compiles
+            m_safe = jnp.where(m_cur != -jnp.inf, m_cur, 0.0)
+            p = jnp.exp(jnp.where(s != -jnp.inf, s - m_safe, -jnp.inf))
+            alpha = jnp.where(m_prev != -jnp.inf,
                               jnp.exp(m_prev - m_safe), 0.0)
             l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1,
                                                       keepdims=True)
@@ -272,7 +275,7 @@ def _fwd_call(qt, kt, vt, mask, seed, *, scale, sk, is_causal, has_mask,
                        (acc_ref[...] / l_fin).astype(o_ref.dtype))
             lse = m_ref[...][:, 0] + jnp.log(l_fin[:, 0])
             if not keep_neg_inf_lse:
-                lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
+                lse = jnp.where(lse != -jnp.inf, lse, 0.0)
             # lse rows live in a (8, block_q) tile (sublane-broadcast) —
             # Mosaic requires the last two block dims be (8,128)-aligned,
             # so a flat (1,1,block_q) row block is not lowerable
@@ -344,7 +347,7 @@ def _recompute_p_ds(q_ref, k_ref, m_in_ref, lse_blk, qi, ki, *, scale, sk,
             s = jnp.where(rows >= cols, s, -jnp.inf)
     if need_k_mask:
         s = jnp.where(cols < sk, s, -jnp.inf)
-    p = jnp.exp(jnp.where(jnp.isfinite(s), s - lse_blk, -jnp.inf))
+    p = jnp.exp(jnp.where(s != -jnp.inf, s - lse_blk, -jnp.inf))
     return p
 
 

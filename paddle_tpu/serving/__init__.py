@@ -1,0 +1,37 @@
+"""paddle_tpu.serving — continuous-batching LLM serving over a paged KV
+cache.
+
+The static-cache `models.generation.generate` runs ONE request at fixed
+shape; this package multiplexes an arbitrary request stream onto the same
+decoder models (LLaMA, GPT) with:
+
+- `kv_cache`: fixed-size KV pages over one preallocated per-layer pool
+  (free-list allocator, per-sequence page tables, reserved null page);
+- `attention`: ragged paged attention — jnp reference path everywhere,
+  Pallas kernel (scalar-prefetched page table, BlockSpec page gather) on
+  TPU;
+- `scheduler`: iteration-level continuous batching — admission by
+  free-page budget, prefill/decode interleaving into a bounded set of
+  fixed-shape jitted steps, preempt-and-requeue on pool exhaustion;
+- `engine`: `ServingEngine.add_request/step/stream/run` plus per-request
+  latency/throughput counters exported through paddle_tpu.profiler.
+
+See README.md "paddle_tpu.serving" for knobs and parity notes.
+"""
+from .attention import (  # noqa: F401
+    paged_attend, paged_decode_attention, paged_decode_available,
+)
+from .engine import ServingEngine  # noqa: F401
+from .kv_cache import (  # noqa: F401
+    BlockAllocator, NULL_PAGE, PagedKVCache, PagedLayerCache, pages_for,
+)
+from .scheduler import (  # noqa: F401
+    Request, SamplingParams, ScheduleDecision, Scheduler,
+)
+
+__all__ = [
+    "ServingEngine", "PagedKVCache", "PagedLayerCache", "BlockAllocator",
+    "Scheduler", "ScheduleDecision", "Request", "SamplingParams",
+    "paged_attend", "paged_decode_attention", "paged_decode_available",
+    "pages_for", "NULL_PAGE",
+]

@@ -1,0 +1,296 @@
+"""Ragged paged attention over the flat page pool.
+
+The op behind `attend_with_cache` when the cache is a `PagedLayerCache`:
+write this step's K/V into the pool at each row's own position, then
+attend each query over exactly its sequence's pages (rows sit at DIFFERENT
+positions — the batch is ragged, Ragged Paged Attention's setting).
+
+Two paths, mirroring ops/pallas_kernels.py's selection policy:
+- a pure-jnp reference path (gather pages via the page table, mask by
+  per-row length, reuse F.scaled_dot_product_attention) — numerically the
+  twin of the static-cache `attend_with_cache`, runs everywhere;
+- a Pallas decode kernel gated on backend: grid (batch, kv_head, page),
+  the page table rides in SMEM via scalar prefetch and the BlockSpec index
+  map gathers one (page_size, head_dim) K/V tile per step straight from
+  the pool (no host-side gather), online-softmax accumulation in VMEM.
+
+Both steps stay inside ONE jitted call per decode (T3's single-dispatch
+rule, arxiv 2401.16677): the write, the gather and the softmax never
+bounce logits or pages to the host.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .kv_cache import PagedLayerCache
+
+__all__ = ["paged_attend", "paged_decode_attention",
+           "paged_decode_available", "KERNEL_MODE"]
+
+# "auto": Pallas kernel on TPU, jnp reference elsewhere; "off": always the
+# reference; "interpret": run the Pallas kernel in interpret mode (hermetic
+# CPU testing of the kernel itself — slow, test-only)
+KERNEL_MODE = "auto"
+
+
+def _on_tpu() -> bool:
+    from ..ops.pallas_kernels import _on_tpu as on_tpu
+
+    return on_tpu()
+
+
+def paged_decode_available(page_size: int, head_dim: int) -> bool:
+    """Shape gates for the Pallas decode kernel: page rows must tile the
+    8-sublane axis, head_dim anything pad-able to 128 lanes."""
+    return page_size % 8 == 0 and 8 <= head_dim <= 256
+
+
+def _positions(start_pos, b: int, s: int) -> jnp.ndarray:
+    """(b, s) int32 global positions for this step's tokens. `start_pos`
+    is a scalar (uniform prefill) or a (b,) vector (ragged decode)."""
+    start = start_pos._data if hasattr(start_pos, "_data") else start_pos
+    start = jnp.asarray(start, jnp.int32)
+    offs = jnp.arange(s, dtype=jnp.int32)
+    if start.ndim == 0:
+        return jnp.broadcast_to(start + offs, (b, s))
+    return start[:, None] + offs[None, :]
+
+
+def _write_pages(pool, vals, entries, slots):
+    """Scatter (b*s, kvh, hd) token K/V rows into the (kvh, P, ps, hd)
+    pool at (entries, slots). Rows mapped to the null page collide there
+    harmlessly — nothing reads page 0 through a real page table."""
+    flat = jnp.transpose(vals, (1, 0, 2))            # (kvh, b*s, hd)
+    return pool.at[:, entries, slots].set(flat)
+
+
+def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
+                 bias=None):
+    """The paged twin of `attend_with_cache`: write K/V into the pool,
+    attend q over the page table. Returns (ctx Tensor, new cache view).
+
+    q: Tensor (b, s, heads, hd); k/v: Tensor (b, s, kv_heads, hd);
+    start_pos: scalar (prefill, whole batch at offset 0) or (b,) int32
+    (decode, one token per row at its own position); bias: optional
+    additive (1, heads, s, L) attention bias, cropped/zero-padded on its
+    key axis to this step's key length.
+    """
+    kp, vp = cache.k_pool, cache.v_pool
+    page_table = cache.page_table
+    ps = cache.page_size
+    b, s = q.shape[0], q.shape[1]
+    max_pages = page_table.shape[1]
+
+    kd = (k._data if hasattr(k, "_data") else k).astype(kp.dtype)
+    vd = (v._data if hasattr(v, "_data") else v).astype(vp.dtype)
+    pos = _positions(start_pos, b, s)                # (b, s)
+    page_idx = jnp.clip(pos // ps, 0, max_pages - 1)
+    entries = jnp.take_along_axis(page_table, page_idx, axis=1)
+    slots = pos % ps
+    kp = _write_pages(kp, kd.reshape(b * s, *kd.shape[2:]),
+                      entries.reshape(-1), slots.reshape(-1))
+    vp = _write_pages(vp, vd.reshape(b * s, *vd.shape[2:]),
+                      entries.reshape(-1), slots.reshape(-1))
+    new_cache = PagedLayerCache(kp, vp, page_table)
+
+    if s == 1:
+        ctx = paged_decode_attention(q, new_cache, pos[:, 0], rep,
+                                     bias=bias)
+    else:
+        ctx = _prefill_attention(q, kd, vd, pos, rep, bias=bias)
+    return ctx, new_cache
+
+
+def _expand_kv(x, rep):
+    return jnp.repeat(x, rep, axis=2) if rep > 1 else x
+
+
+def _crop_bias(bias, length: int) -> jnp.ndarray:
+    """Additive bias (1, heads, s, L) -> (1, heads, s, length): crop or
+    zero-pad the key axis (the paged step's key extent is maxP*page_size,
+    not the bias builder's max_len)."""
+    bias_d = bias._data if hasattr(bias, "_data") else bias
+    have = bias_d.shape[-1]
+    if have >= length:
+        return bias_d[..., :length]
+    return jnp.pad(bias_d, ((0, 0),) * (bias_d.ndim - 1)
+                   + ((0, length - have),))
+
+
+def _prefill_attention(q, kd, vd, pos, rep, bias=None):
+    """Prefill attends over this step's own K/V block (the sequence starts
+    at position 0, so the block IS the cache) — same mask arithmetic as
+    the static-cache path for exact parity."""
+    from ..nn import functional as F
+
+    s = kd.shape[1]
+    kf = _expand_kv(kd, rep)
+    vf = _expand_kv(vd, rep)
+    # query at global pos[i, r] sees keys at pos[i, c] <= pos[i, r]; with
+    # a shared offset this is plain causal, kept per-row for generality
+    allowed = pos[:, None, :] <= pos[:, :, None]          # (b, s, s)
+    mask = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)[:, None]
+    if bias is not None:
+        mask = mask + _crop_bias(bias, s).astype(jnp.float32)
+    return F.scaled_dot_product_attention(
+        q, Tensor(kf), Tensor(vf), attn_mask=Tensor(mask), is_causal=False)
+
+
+def paged_decode_attention(q, cache: PagedLayerCache, pos, rep,
+                           bias=None):
+    """One-token-per-row ragged attention over the page pool.
+
+    q: Tensor (b, 1, heads, hd); pos: (b,) int32 — each row's token
+    position (its key length minus one). Returns ctx Tensor (b, 1, heads,
+    hd).
+    """
+    hd = q.shape[-1]
+    use_kernel = (KERNEL_MODE != "off" and bias is None
+                  and paged_decode_available(cache.page_size, hd)
+                  and (KERNEL_MODE == "interpret" or _on_tpu()))
+    if use_kernel:
+        qd = q._data if hasattr(q, "_data") else q
+        out = _paged_decode_pallas(qd, cache.k_pool, cache.v_pool,
+                                   cache.page_table, pos,
+                                   interpret=KERNEL_MODE == "interpret")
+        return Tensor(out)
+    return _paged_decode_reference(q, cache, pos, rep, bias)
+
+
+def _paged_decode_reference(q, cache, pos, rep, bias=None):
+    """Gather the sequence's pages into a contiguous (b, L, kvh, hd) view
+    and run the reference sdpa with a per-row length mask — bit-for-bit
+    the static cache computation, with the pool's exact-zero padded
+    columns masked to the same -1e9 floor."""
+    from ..nn import functional as F
+
+    kp, vp, page_table = cache.k_pool, cache.v_pool, cache.page_table
+    b = page_table.shape[0]
+    ps = cache.page_size
+    length = page_table.shape[1] * ps
+    # (kvh, b, maxP, ps, hd) -> (b, L, kvh, hd)
+    def gather(pool):
+        g = pool[:, page_table]
+        kvh, _, mp, _, hd = g.shape
+        return jnp.transpose(g, (1, 2, 3, 0, 4)).reshape(
+            b, mp * ps, kvh, hd)
+
+    kf = _expand_kv(gather(kp), rep)
+    vf = _expand_kv(gather(vp), rep)
+    allowed = jnp.arange(length, dtype=jnp.int32)[None, :] <= pos[:, None]
+    mask = jnp.where(allowed, 0.0, -1e9).astype(
+        jnp.float32)[:, None, None, :]                    # (b, 1, 1, L)
+    if bias is not None:
+        mask = mask + _crop_bias(bias, length).astype(jnp.float32)
+    return F.scaled_dot_product_attention(
+        q, Tensor(kf), Tensor(vf), attn_mask=Tensor(mask), is_causal=False)
+
+
+# ------------------------------------------------------- Pallas decode path
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, ps, scale, n_pages):
+    """Grid (batch, kv_head, page): one (page_size, head_dim) K/V tile per
+    step, gathered by the BlockSpec index map from the scalar-prefetched
+    page table; online softmax in fp32 VMEM scratch (flash structure).
+    Pages wholly past the row's position are skipped splash-style."""
+    from jax.experimental import pallas as pl
+
+    b_ = pl.program_id(0)
+    pi = pl.program_id(2)
+    pos = pos_ref[b_]
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        # (G, ps) scores: the q group rides the MXU in the input dtype
+        s = jax.lax.dot_general(
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
+        cols = pi * ps + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols <= pos, s, -jnp.inf)
+        m_prev = m_ref[...]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # no jnp.isfinite (its primitive has no Mosaic lowering on some
+        # jax versions): m_safe only needs the all-masked guard, and
+        # exp(-inf - finite) is already an exact 0 for masked columns
+        # and never-seen rows alike
+        m_safe = jnp.where(m_cur == -jnp.inf, 0.0, m_cur)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.exp(m_prev - m_safe)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = m_cur
+        vblk = v_ref[0, 0]
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+
+    pl.when(pi * ps <= pos)(_compute)
+
+    @pl.when(pi == n_pages - 1)
+    def _done():
+        l_fin = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l_fin).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pool, v_pool, page_table, pos,
+                         interpret=False):
+    """q: (b, 1, heads, hd); pools: (kvh, P, ps, hd); page_table: (b,
+    maxP) i32; pos: (b,) i32. Returns (b, 1, heads, hd)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, _, heads, hd = q.shape
+    kvh, _, ps, _ = k_pool.shape
+    rep = heads // kvh
+    max_pages = page_table.shape[1]
+    scale = 1.0 / (hd ** 0.5)
+
+    d_p = _round_up(hd, 128)
+    g_p = _round_up(rep, 8)
+    # (b, kvh, G, hd): q head h*rep + g attends kv head h — matches the
+    # repeat(axis=2) expansion of the reference path
+    qg = q.reshape(b, kvh, rep, hd)
+    qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_p - rep), (0, d_p - hd)))
+    kp = jnp.pad(k_pool, ((0, 0), (0, 0), (0, 0), (0, d_p - hd)))
+    vp = jnp.pad(v_pool, ((0, 0), (0, 0), (0, 0), (0, d_p - hd)))
+
+    q_spec = pl.BlockSpec((1, 1, g_p, d_p),
+                          lambda b_, h_, pi, pt, ps_: (b_, h_, 0, 0))
+    kv_spec = pl.BlockSpec((1, 1, ps, d_p),
+                           lambda b_, h_, pi, pt, ps_: (h_, pt[b_, pi],
+                                                        0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kvh, max_pages),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((g_p, d_p), jnp.float32),
+            pltpu.VMEM((g_p, 1), jnp.float32),
+            pltpu.VMEM((g_p, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, ps=ps, scale=scale,
+                          n_pages=max_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g_p, d_p), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), pos.astype(jnp.int32), qg, kp, vp)
+    return out[:, :, :rep, :hd].reshape(b, 1, heads, hd)

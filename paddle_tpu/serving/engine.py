@@ -1,0 +1,358 @@
+"""ServingEngine: continuous-batching generation over a paged KV cache.
+
+Multiplexes an arbitrary request stream onto a decoder model with a
+BOUNDED set of compiled programs (T3's rule: every hot-loop step is one
+jitted dispatch):
+
+- one prefill executable per prompt bucket (prompt padded up to the
+  bucket; one request per prefill step);
+- ONE decode executable: a fixed (max_batch_size,) token batch where each
+  row carries its own position and page table row (the ragged paged
+  attention path), padding rows aimed at the null page;
+- one sampler executable per batch shape (temperature/top-k/top-p ride as
+  traced per-row arrays, so mixed sampling params never recompile).
+
+The engine talks to any decoder model that follows the
+`forward(input_ids, caches=..., start_pos=...)` cache protocol of
+models/generation.py (LLaMA, GPT); the per-layer cache objects it passes
+are `PagedLayerCache` views, which `attend_with_cache` dispatches to the
+ragged paged attention op.
+
+Per-request latency/throughput counters are recorded through
+paddle_tpu.profiler (RecordEvent spans "serving.prefill"/"serving.decode"
+line up in profiler traces) and summarized by `stats()`.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.functional import call_functional, extract_state
+from ..profiler import RecordEvent
+from .kv_cache import PagedKVCache, PagedLayerCache, pages_for
+from .scheduler import Request, SamplingParams, Scheduler
+
+__all__ = ["ServingEngine"]
+
+
+def _default_buckets(max_seq_len: int) -> Tuple[int, ...]:
+    """Power-of-two prompt buckets up to max_seq_len (always included):
+    a handful of prefill compilations covers every prompt length."""
+    buckets = []
+    b = 16
+    while b < max_seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_seq_len)
+    return tuple(buckets)
+
+
+def _sample_batch(logits, keys, temps, top_ks, top_ps):
+    """Per-row sampling with TRACED knobs (the batch mixes requests with
+    different sampling params). Mirrors generation._sample row-wise:
+    greedy where temperature == 0, else temperature -> top-k -> top-p ->
+    categorical."""
+    vocab = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    t_safe = jnp.where(temps > 0.0, temps, 1.0)
+    scaled = logits / t_safe[:, None]
+    # top-k as a rank threshold (top_k <= 0 disables by keeping all V)
+    k_eff = jnp.where(top_ks > 0, jnp.minimum(top_ks, vocab), vocab)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # top-p over the top-k-masked distribution (generation._sample order)
+    sorted_m = jnp.sort(masked, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_m, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.minimum(
+        jnp.sum(cum < top_ps[:, None], axis=-1, keepdims=True), vocab - 1)
+    cutoff = jnp.take_along_axis(sorted_m, cutoff_idx, axis=-1)
+    masked = jnp.where(masked < cutoff, -jnp.inf, masked)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked)
+    return jnp.where(temps == 0.0, greedy, sampled)
+
+
+class ServingEngine:
+    def __init__(self, model, *, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 max_batch_size: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 cache_dtype=jnp.float32):
+        from ..models.generation import _config_of
+
+        self.model = model
+        model.eval()
+        cfg = _config_of(model)
+        self.page_size = page_size
+        self.max_batch_size = max_batch_size
+        self.max_seq_len = max_seq_len or cfg.max_position_embeddings
+        self.max_pages_per_seq = pages_for(self.max_seq_len, page_size)
+        if num_pages is None:
+            # worst case every slot runs a full-length sequence, +1 null
+            num_pages = max_batch_size * self.max_pages_per_seq + 1
+        self.cache = PagedKVCache.for_model(model, num_pages, page_size,
+                                            cache_dtype)
+        self.scheduler = Scheduler(self.cache.allocator, page_size,
+                                   max_batch_size, self.max_pages_per_seq)
+        self.prefill_buckets = tuple(sorted(
+            prefill_buckets or _default_buckets(self.max_seq_len)))
+        if self.prefill_buckets[-1] < self.max_seq_len:
+            raise ValueError("prefill_buckets must cover max_seq_len "
+                             "(preempted requests re-prefill at their "
+                             "full current length)")
+        self.params, self.buffers = extract_state(model)
+        self.requests: Dict[int, Request] = {}
+        self._keys: Dict[int, jax.Array] = {}
+        # jitted steps are memoized ON THE MODEL (generation.py's trick):
+        # the closures only capture `model`, so engines over the same model
+        # — restarts, tests, multiple pools — share compiled executables,
+        # and jax retraces per aval set exactly when shapes differ
+        self._jit_cache: Dict[object, object] = model.__dict__.setdefault(
+            "_serving_jit_cache", {})
+        # this engine's distinct per-family input avals == its jit cache
+        # misses (the shared caches' _cache_size would count OTHER
+        # engines' shapes too); compile_counts() reports these
+        self._exec_shapes: Dict[str, set] = {
+            "prefill": set(), "decode": set(), "sample": set()}
+        self._stats = {"prefill_steps": 0, "decode_steps": 0,
+                       "tokens_generated": 0, "prefill_time_s": 0.0,
+                       "decode_time_s": 0.0, "preemptions": 0}
+
+    # ----------------------------------------------------------- request API
+    def add_request(self, prompt_ids, max_new_tokens: int = 32,
+                    temperature: float = 0.0, top_k: int = 0,
+                    top_p: float = 1.0, seed: Optional[int] = None,
+                    eos_token_id: Optional[int] = None) -> int:
+        """Queue one prompt; returns a request id. Non-blocking — the
+        request runs as `step()`/`stream()` turn the crank."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      sampling=SamplingParams(temperature, top_k, top_p,
+                                              seed),
+                      eos_token_id=eos_token_id)
+        self.requests[req.request_id] = req
+        if seed is None:
+            seed = int(np.random.randint(0, 2 ** 31 - 1))
+        self._keys[req.request_id] = jax.random.key(seed)
+        self.scheduler.add(req)
+        return req.request_id
+
+    def output(self, request_id: int) -> List[int]:
+        """prompt + generated tokens so far. For a preempted request the
+        prompt absorbs already-generated tokens, so this is always the
+        full sequence."""
+        req = self.requests[request_id]
+        return list(req.prompt) + list(req.generated)
+
+    # ---------------------------------------------------------------- steps
+    def step(self) -> List[Tuple[int, int]]:
+        """One scheduler decision + one jitted model step. Returns the
+        (request_id, token) pairs emitted this step."""
+        decision = self.scheduler.schedule()
+        if decision.kind == "prefill":
+            return self._prefill(decision.prefill)
+        if decision.kind == "decode":
+            return self._decode(decision.decode)
+        return []
+
+    def stream(self):
+        """Generator of (request_id, token, done) events until every
+        queued request completes."""
+        while self.scheduler.has_work():
+            for rid, tok in self.step():
+                yield rid, tok, self.requests[rid].status == "finished"
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain all queued requests; returns request_id -> full tokens."""
+        for _ in self.stream():
+            pass
+        return {rid: self.output(rid) for rid in self.requests}
+
+    # -------------------------------------------------------------- prefill
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket")
+
+    def _prefill_jit(self, bucket: int):
+        key = ("prefill", bucket)
+        if key not in self._jit_cache:
+            model = self.model
+
+            def prefill(params, buffers, ids, pools, page_table, last_idx):
+                views = [PagedLayerCache(kp, vp, page_table)
+                         for kp, vp in pools]
+                (logits, new_views), _ = call_functional(
+                    model, params, buffers, (Tensor(ids),),
+                    kwargs={"caches": views, "start_pos": 0},
+                    training=False)
+                last = jax.lax.dynamic_slice_in_dim(
+                    logits, last_idx, 1, axis=1)[:, 0]
+                return last, [(v.k_pool, v.v_pool) for v in new_views]
+
+            self._jit_cache[key] = jax.jit(prefill, donate_argnums=(3,))
+        return self._jit_cache[key]
+
+    def _sample_jit(self):
+        if "sample" not in self._jit_cache:
+            self._jit_cache["sample"] = jax.jit(_sample_batch)
+        return self._jit_cache["sample"]
+
+    def _next_key(self, rid: int) -> jax.Array:
+        key, sub = jax.random.split(self._keys[rid])
+        self._keys[rid] = key
+        return sub
+
+    def _sample_rows(self, logits, reqs: Sequence[Request]) -> np.ndarray:
+        """Sample one token per row; rows beyond len(reqs) are padding."""
+        b = logits.shape[0]
+        temps = np.zeros((b,), np.float32)
+        top_ks = np.zeros((b,), np.int32)
+        top_ps = np.ones((b,), np.float32)
+        keys = []
+        for i, req in enumerate(reqs):
+            sp = req.sampling
+            temps[i] = sp.temperature
+            top_ks[i] = sp.top_k
+            top_ps[i] = sp.top_p
+            keys.append(self._next_key(req.request_id))
+        for _ in range(b - len(reqs)):
+            keys.append(jax.random.key(0))
+        self._exec_shapes["sample"].add(tuple(logits.shape))
+        toks = self._sample_jit()(
+            logits, jnp.stack(keys), jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps))
+        return np.asarray(toks)
+
+    def _emit(self, req: Request, token: int, now: float
+              ) -> Tuple[int, int]:
+        req.generated.append(token)
+        self._stats["tokens_generated"] += 1
+        if req.first_token_t is None:
+            req.first_token_t = now
+        if req.is_done():
+            req.finish_t = now
+            self.scheduler.finish(req)
+        return (req.request_id, token)
+
+    def _prefill(self, req: Request) -> List[Tuple[int, int]]:
+        bucket = self._bucket_for(len(req.prompt))
+        self._exec_shapes["prefill"].add(
+            (bucket, self.cache.num_pages, self.max_pages_per_seq))
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :len(req.prompt)] = req.prompt
+        page_table = self.cache.page_table_array([req.pages],
+                                                 self.max_pages_per_seq)
+        t0 = time.perf_counter()
+        with RecordEvent("serving.prefill"):
+            last_logits, pools = self._prefill_jit(bucket)(
+                self.params, self.buffers, jnp.asarray(ids),
+                self.cache.pools, page_table,
+                jnp.int32(len(req.prompt) - 1))
+            self.cache.pools = pools
+            token = int(self._sample_rows(last_logits, [req])[0])
+        now = time.perf_counter()
+        self._stats["prefill_steps"] += 1
+        self._stats["prefill_time_s"] += now - t0
+        return [self._emit(req, token, now)]
+
+    # --------------------------------------------------------------- decode
+    def _decode_jit(self):
+        if "decode" not in self._jit_cache:
+            model = self.model
+
+            def decode(params, buffers, tokens, pools, page_tables,
+                       positions):
+                views = [PagedLayerCache(kp, vp, page_tables)
+                         for kp, vp in pools]
+                (logits, new_views), _ = call_functional(
+                    model, params, buffers, (Tensor(tokens[:, None]),),
+                    kwargs={"caches": views, "start_pos": positions},
+                    training=False)
+                return logits[:, 0], [(v.k_pool, v.v_pool)
+                                      for v in new_views]
+
+            self._jit_cache["decode"] = jax.jit(decode, donate_argnums=(3,))
+        return self._jit_cache["decode"]
+
+    def _decode(self, reqs: Sequence[Request]) -> List[Tuple[int, int]]:
+        b = self.max_batch_size
+        self._exec_shapes["decode"].add(
+            (b, self.cache.num_pages, self.max_pages_per_seq))
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        page_lists: List[Sequence[int]] = [()] * b
+        for i, req in enumerate(reqs):
+            last = (req.generated[-1] if req.generated
+                    else req.prompt[-1])
+            tokens[i] = last
+            # the input token's K/V lands at its own position; the step
+            # predicts the token after it
+            positions[i] = req.num_tokens - 1
+            page_lists[i] = req.pages
+        page_tables = self.cache.page_table_array(page_lists,
+                                                  self.max_pages_per_seq)
+        t0 = time.perf_counter()
+        with RecordEvent("serving.decode"):
+            logits, pools = self._decode_jit()(
+                self.params, self.buffers, jnp.asarray(tokens),
+                self.cache.pools, page_tables, jnp.asarray(positions))
+            self.cache.pools = pools
+            toks = self._sample_rows(logits, reqs)
+        now = time.perf_counter()
+        self._stats["decode_steps"] += 1
+        self._stats["decode_time_s"] += now - t0
+        return [self._emit(req, int(toks[i]), now)
+                for i, req in enumerate(reqs)]
+
+    # -------------------------------------------------------------- metrics
+    def stats(self) -> Dict[str, object]:
+        s = dict(self._stats)
+        s["preemptions"] = sum(r.preemptions
+                               for r in self.requests.values())
+        dt = s["decode_time_s"]
+        s["decode_tokens_per_s"] = (
+            s["tokens_generated"] / dt if dt > 0 else 0.0)
+        s["num_requests"] = len(self.requests)
+        s["num_finished"] = sum(r.status == "finished"
+                                for r in self.requests.values())
+        s["free_pages"] = self.cache.allocator.num_free
+        per_req = {}
+        for rid, req in self.requests.items():
+            per_req[rid] = {
+                "ttft_s": (req.first_token_t - req.arrival_t
+                           if req.first_token_t else None),
+                "latency_s": (req.finish_t - req.arrival_t
+                              if req.finish_t else None),
+                "tokens": len(req.generated),
+                "preemptions": req.preemptions,
+            }
+        s["requests"] = per_req
+        return s
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Distinct executables THIS engine's step stream needs, i.e. its
+        jit-cache miss count per family (prefill buckets, decode, sampler
+        shapes) — the serving tests assert these stay bounded. Counted
+        from the engine's own input avals because the underlying compiled
+        caches are deliberately shared across engines on the same model."""
+        counts = {name: len(shapes)
+                  for name, shapes in self._exec_shapes.items()}
+        counts["total"] = sum(counts.values())
+        return counts

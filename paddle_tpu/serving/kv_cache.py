@@ -1,0 +1,168 @@
+"""Paged KV cache: fixed-size pages over one preallocated per-layer pool.
+
+The static-cache generator (models/generation.py) gives every request a
+private (b, max_len, kv_heads, head_dim) buffer — memory scales with the
+WORST-CASE length of every live request, which is what kills concurrent
+serving. Here the cache is one flat pool of `num_pages` pages of
+`page_size` tokens per layer (Ragged Paged Attention's layout, arxiv
+2604.15464); a sequence owns a list of page ids (its page table) and pages
+return to a free list the moment the request finishes, so memory scales
+with TOKENS ACTUALLY RESIDENT.
+
+Page 0 is reserved as the null page: fixed-shape jitted steps pad the
+batch with inactive rows, and those rows need somewhere harmless to write
+their K/V. Nothing ever reads page 0 through a real page table.
+
+Host/device split: the allocator and per-request page lists live on the
+host (tiny, O(pages) ints); the pools are jax arrays threaded through the
+jitted step (donated, so XLA updates them in place); the (B, max_pages)
+page-table array handed to each step is rebuilt from the host lists —
+copy-on-extend, a few hundred bytes per step.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BlockAllocator", "PagedKVCache", "PagedLayerCache",
+           "NULL_PAGE", "pages_for"]
+
+NULL_PAGE = 0
+
+
+def pages_for(num_tokens: int, page_size: int) -> int:
+    """Pages needed to hold `num_tokens` tokens."""
+    return -(-num_tokens // page_size)
+
+
+class BlockAllocator:
+    """Free-list page allocator. Page ids are ints in [1, num_pages);
+    page 0 is the reserved null page and is never handed out."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        # LIFO keeps recently-freed (cache-warm) pages in rotation
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._used: set[int] = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return len(self._used)
+
+    def alloc(self) -> Optional[int]:
+        """One free page id, or None when the pool is exhausted."""
+        if not self._free:
+            return None
+        page = self._free.pop()
+        self._used.add(page)
+        return page
+
+    def alloc_n(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing batch alloc (request admission)."""
+        if len(self._free) < n:
+            return None
+        return [self.alloc() for _ in range(n)]
+
+    def free(self, page: int) -> None:
+        if page == NULL_PAGE:
+            raise ValueError("page 0 is the reserved null page")
+        if page not in self._used:
+            raise ValueError(f"double free or unknown page {page}")
+        self._used.remove(page)
+        self._free.append(page)
+
+    def free_all(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            self.free(p)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedLayerCache:
+    """One layer's view of the pool, handed to the model's attention in
+    place of the static (k_cache, v_cache) pair. `attend_with_cache`
+    dispatches on this type (duck-typed by `page_table`), so LLaMA/GPT/T5
+    attention modules ride the paged path unmodified.
+
+    k_pool/v_pool: (kv_heads, num_pages, page_size, head_dim) — kv-head
+                   major so the Pallas decode kernel's BlockSpec can gather
+                   one (page_size, head_dim) tile per grid step without a
+                   per-step pool transpose
+    page_table:    (B, max_pages) int32 — logical page j of row i lives in
+                   physical page page_table[i, j] (0 = null page padding)
+    """
+
+    k_pool: jnp.ndarray
+    v_pool: jnp.ndarray
+    page_table: jnp.ndarray
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pool.shape[2]
+
+    def tree_flatten(self):
+        return (self.k_pool, self.v_pool, self.page_table), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+class PagedKVCache:
+    """The per-layer pools plus the allocator. Pools are plain jax arrays
+    so the engine can thread (and donate) them through jitted steps."""
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_kv_heads: int, head_dim: int, dtype=jnp.float32):
+        self.num_layers = num_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        shape = (num_kv_heads, num_pages, page_size, head_dim)
+        self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                      for _ in range(num_layers)]
+        self.allocator = BlockAllocator(num_pages)
+
+    @classmethod
+    def for_model(cls, model, num_pages: int, page_size: int,
+                  dtype=jnp.float32) -> "PagedKVCache":
+        from ..models.generation import _config_of
+
+        cfg = _config_of(model)
+        kv_heads = getattr(cfg, "num_key_value_heads",
+                           cfg.num_attention_heads)
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        return cls(cfg.num_hidden_layers, num_pages, page_size, kv_heads,
+                   head_dim, dtype)
+
+    def page_table_array(self, page_lists: Sequence[Sequence[int]],
+                         max_pages: int) -> jnp.ndarray:
+        """(B, max_pages) int32 device page table from host page lists,
+        padded with the null page."""
+        import numpy as np
+
+        out = np.zeros((len(page_lists), max_pages), np.int32)
+        for i, pages in enumerate(page_lists):
+            if len(pages) > max_pages:
+                raise ValueError(f"sequence holds {len(pages)} pages > "
+                                 f"max_pages {max_pages}")
+            out[i, :len(pages)] = pages
+        return jnp.asarray(out)
+
+    def layer_views(self, page_table: jnp.ndarray) -> List[PagedLayerCache]:
+        """Per-layer PagedLayerCache list in the shape the models expect
+        for their `caches` argument."""
+        return [PagedLayerCache(kp, vp, page_table)
+                for kp, vp in self.pools]
+
+    def update(self, new_views: Sequence[PagedLayerCache]) -> None:
+        """Adopt the pools a jitted step returned (the step's new_caches)."""
+        self.pools = [(v.k_pool, v.v_pool) for v in new_views]

@@ -1,0 +1,200 @@
+"""Continuous-batching scheduler (Orca-style iteration-level scheduling).
+
+Every engine step is ONE fixed-shape jitted call; the scheduler's job is
+to decide which call. Policy:
+
+- admission by free-page budget: a waiting request is admitted only when
+  the pool can hold its whole prompt plus the first generated token —
+  admitted requests get their prompt pages up front, so a prefill can
+  never fail mid-flight;
+- prefill priority, one request per step: a newly admitted request is
+  prefilled alone (padded to the smallest prompt bucket), keeping the
+  compiled-program set to one prefill executable per bucket;
+- decode batches every running request into the fixed (max_batch_size)
+  decode step — rows beyond the running set are padding aimed at the
+  null page;
+- copy-on-extend: before a decode step, each running request crossing a
+  page boundary gets a fresh page appended to its page table; when the
+  pool is exhausted the YOUNGEST running request is preempted — its pages
+  return to the free list and it re-queues (front) with prompt+generated
+  tokens, to be re-prefilled when pages free up. Eviction therefore costs
+  recompute, never correctness.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from .kv_cache import BlockAllocator, pages_for
+
+__all__ = ["Request", "SamplingParams", "Scheduler", "ScheduleDecision"]
+
+_REQUEST_IDS = itertools.count()
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 0.0            # 0.0 = greedy
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its serving-side bookkeeping."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    sampling: SamplingParams
+    eos_token_id: Optional[int] = None
+    request_id: int = dataclasses.field(
+        default_factory=lambda: next(_REQUEST_IDS))
+
+    # scheduler state
+    status: str = "waiting"             # waiting | running | finished
+    generated: List[int] = dataclasses.field(default_factory=list)
+    pages: List[int] = dataclasses.field(default_factory=list)
+    preemptions: int = 0
+
+    # metrics (perf_counter timestamps, filled by the engine)
+    arrival_t: float = dataclasses.field(default_factory=time.perf_counter)
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+
+    @property
+    def num_tokens(self) -> int:
+        """Tokens resident in the cache once prefilled + decoded so far."""
+        return len(self.prompt) + len(self.generated)
+
+    @property
+    def next_pos(self) -> int:
+        """Position the next decode token will occupy."""
+        return self.num_tokens
+
+    def is_done(self) -> bool:
+        if len(self.generated) >= self.max_new_tokens:
+            return True
+        return (self.eos_token_id is not None and self.generated
+                and self.generated[-1] == self.eos_token_id)
+
+
+@dataclasses.dataclass
+class ScheduleDecision:
+    kind: str                            # "prefill" | "decode" | "idle"
+    prefill: Optional[Request] = None
+    decode: Sequence[Request] = ()
+
+
+class Scheduler:
+    def __init__(self, allocator: BlockAllocator, page_size: int,
+                 max_batch_size: int, max_pages_per_seq: int):
+        self.allocator = allocator
+        self.page_size = page_size
+        self.max_batch_size = max_batch_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self.waiting: List[Request] = []
+        self.running: List[Request] = []
+
+    # ------------------------------------------------------------ lifecycle
+    def add(self, req: Request) -> None:
+        need = pages_for(len(req.prompt) + req.max_new_tokens,
+                         self.page_size)
+        if need > self.max_pages_per_seq:
+            raise ValueError(
+                f"request needs {need} pages > max_pages_per_seq "
+                f"{self.max_pages_per_seq}; raise max_seq_len/page budget")
+        self.waiting.append(req)
+
+    def finish(self, req: Request) -> None:
+        """Release a completed request's pages back to the pool."""
+        req.status = "finished"
+        self.allocator.free_all(req.pages)
+        req.pages = []
+        if req in self.running:
+            self.running.remove(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ------------------------------------------------------------- policy
+    def _admission_pages(self, req: Request) -> int:
+        # prompt + the first generated token: prefill writes the prompt,
+        # and the very next decode step must have a slot to land on
+        return pages_for(len(req.prompt) + 1, self.page_size)
+
+    def _try_admit(self) -> Optional[Request]:
+        if not self.waiting or len(self.running) >= self.max_batch_size:
+            return None
+        req = self.waiting[0]
+        pages = self.allocator.alloc_n(self._admission_pages(req))
+        if pages is None:
+            return None                  # backpressure: pool exhausted
+        self.waiting.pop(0)
+        req.pages = pages
+        req.status = "running"
+        self.running.append(req)
+        return req
+
+    def _preempt(self, victim: Request) -> None:
+        """Evict a running request and requeue it at the FRONT of the
+        waiting queue with its generated tokens folded into the prompt
+        (re-prefill resumes it bit-exactly — prefill and decode share the
+        cache numerics)."""
+        self.running.remove(victim)
+        self.allocator.free_all(victim.pages)
+        victim.pages = []
+        victim.prompt = victim.prompt + victim.generated
+        victim.max_new_tokens -= len(victim.generated)
+        victim.generated = []
+        victim.status = "waiting"
+        victim.preemptions += 1
+        self.waiting.insert(0, victim)
+
+    def _ensure_decode_pages(self) -> None:
+        """Copy-on-extend: every running request whose next token crosses
+        a page boundary gets a new page. On pool exhaustion the YOUNGEST
+        running request is preempted (FCFS priority — running order is
+        admission order), including the requester itself when it is the
+        youngest."""
+        for req in list(self.running):
+            if req not in self.running:   # preempted by an older peer
+                continue
+            # the step writes the input token at position num_tokens - 1,
+            # so the table must cover num_tokens resident tokens
+            while pages_for(req.num_tokens, self.page_size) > \
+                    len(req.pages):
+                page = self.allocator.alloc()
+                if page is not None:
+                    req.pages.append(page)
+                    continue
+                victim = self.running[-1]
+                if victim is req and len(self.running) == 1:
+                    raise RuntimeError(
+                        "KV page pool too small for a single request: "
+                        f"request {req.request_id} at position "
+                        f"{req.next_pos} with {self.allocator.num_pages} "
+                        "pages total")
+                self._preempt(victim)
+                if victim is req:         # self-preempted: sit this one out
+                    break
+
+    def schedule(self) -> ScheduleDecision:
+        admitted = self._try_admit()
+        if admitted is not None:
+            return ScheduleDecision(kind="prefill", prefill=admitted)
+        if self.running:
+            self._ensure_decode_pages()
+            batch = self.running[:self.max_batch_size]
+            return ScheduleDecision(kind="decode", decode=list(batch))
+        if self.waiting:
+            # nothing running and the head request cannot be admitted:
+            # the pool cannot ever satisfy it
+            req = self.waiting[0]
+            raise RuntimeError(
+                f"request {req.request_id} needs "
+                f"{self._admission_pages(req)} pages but the pool has "
+                f"{self.allocator.num_pages - 1} allocatable in total")
+        return ScheduleDecision(kind="idle")

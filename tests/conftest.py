@@ -30,6 +30,13 @@ if _plat != "axon":
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    # the tier-1 fast lane runs `-m 'not slow'`; anything that compiles
+    # beyond a module's core executable set carries this marker
+    config.addinivalue_line(
+        "markers", "slow: heavy test excluded from the tier-1 fast lane")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

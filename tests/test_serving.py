@@ -1,0 +1,473 @@
+"""paddle_tpu.serving: block-allocator invariants, paged-attention parity
+vs the static-cache `attend_with_cache`, continuous batching with staggered
+arrivals token-identical to sequential `generate`, admission backpressure /
+preemption, and BOUNDED compilation counts (asserted via the jit caches'
+miss counts — each `_cache_size` entry is one cache miss -> one compiled
+executable).
+
+Fast-lane tests compile only the prefill-bucket + decode + sampler set (a
+single tiny model reused module-wide); anything beyond that — the second
+model family, the multi-bucket sweep — is `slow`.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models import (
+    GPTConfig, GPTForCausalLM, LlamaConfig, LlamaForCausalLM,
+)
+from paddle_tpu.models.generation import attend_with_cache
+from paddle_tpu.serving import (
+    BlockAllocator, NULL_PAGE, PagedKVCache, PagedLayerCache, Request,
+    SamplingParams, Scheduler, ServingEngine, pages_for,
+)
+from paddle_tpu.serving import attention as satt
+
+
+@functools.lru_cache(maxsize=None)
+def _llama():
+    paddle.seed(1234)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+@functools.lru_cache(maxsize=None)
+def _gpt():
+    paddle.seed(1234)
+    m = GPTForCausalLM(GPTConfig.tiny())
+    m.eval()
+    return m
+
+
+def _sequential_reference(model, prompts, max_new_tokens):
+    """Per-request greedy `generate`, the engine's parity oracle."""
+    return [list(model.generate(paddle.to_tensor(np.asarray(p)[None]),
+                                max_new_tokens=max_new_tokens,
+                                temperature=0.0).numpy()[0])
+            for p in prompts]
+
+
+# ---------------------------------------------------------------- allocator
+
+class TestBlockAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = BlockAllocator(8)
+        assert a.num_free == 7           # page 0 reserved
+        pages = [a.alloc() for _ in range(7)]
+        assert sorted(pages) == list(range(1, 8))
+        assert a.alloc() is None         # exhausted
+        for p in pages:
+            a.free(p)
+        assert a.num_free == 7 and a.num_used == 0
+
+    def test_double_free_raises(self):
+        a = BlockAllocator(4)
+        p = a.alloc()
+        a.free(p)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(p)
+
+    def test_null_page_is_never_handed_out_and_unfreeable(self):
+        a = BlockAllocator(4)
+        assert NULL_PAGE not in [a.alloc() for _ in range(3)]
+        with pytest.raises(ValueError, match="null page"):
+            a.free(NULL_PAGE)
+
+    def test_alloc_n_all_or_nothing(self):
+        a = BlockAllocator(4)
+        assert a.alloc_n(4) is None      # only 3 allocatable
+        assert a.num_free == 3           # failed batch leaks nothing
+        got = a.alloc_n(3)
+        assert len(got) == 3 and a.num_free == 0
+
+    def test_pages_for(self):
+        assert pages_for(1, 8) == 1
+        assert pages_for(8, 8) == 1
+        assert pages_for(9, 8) == 2
+        assert pages_for(17, 8) == 3
+
+
+# ------------------------------------------------- paged-attention parity
+
+def _static_vs_paged(rng, *, heads, kv_heads, hd, prompt_len, decode_steps,
+                     page_size, bias=None):
+    """Drive attend_with_cache down BOTH cache layouts on the same data:
+    a static (1, max_len, kvh, hd) cache per request vs one ragged paged
+    batch, and return (static ctx rows, paged ctx) per step."""
+    b = len(prompt_len)
+    max_pages = max(pages_for(n + decode_steps, page_size)
+                    for n in prompt_len)
+    max_len = max_pages * page_size
+    rep = heads // kv_heads
+
+    def rand(*shape):
+        return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    # one paged pool shared by all rows; page tables disjoint per row
+    pool = PagedKVCache(1, b * max_pages + 1, page_size, kv_heads, hd)
+    alloc = pool.allocator
+    tables = [[alloc.alloc() for _ in range(max_pages)] for _ in range(b)]
+    pt = pool.page_table_array(tables, max_pages)
+
+    statics = [(jnp.zeros((1, max_len, kv_heads, hd)),
+                jnp.zeros((1, max_len, kv_heads, hd))) for _ in range(b)]
+    outs = []
+
+    # prefill: each request alone on the static path (its true ragged
+    # length), all together on the paged path padded to the max bucket
+    s = max(prompt_len)
+    q, k, v = rand(b, s, heads, hd), rand(b, s, kv_heads, hd), \
+        rand(b, s, kv_heads, hd)
+    paged_view = pool.layer_views(pt)[0]
+    static_rows = []
+    for i in range(b):
+        n = prompt_len[i]
+        ctx, statics[i] = attend_with_cache(
+            Tensor(q[i:i + 1, :n]), Tensor(k[i:i + 1, :n]),
+            Tensor(v[i:i + 1, :n]), statics[i], 0, rep, bias=bias)
+        static_rows.append(ctx.numpy()[0])
+    ctx_p, paged_view = attend_with_cache(
+        Tensor(q), Tensor(k), Tensor(v), paged_view, 0, rep, bias=bias)
+    outs.append((static_rows, [ctx_p.numpy()[i, :prompt_len[i]]
+                               for i in range(b)]))
+
+    # ragged decode: every row at its OWN position in one paged call
+    pos = np.asarray(prompt_len, np.int32)
+    for _ in range(decode_steps):
+        q1, k1, v1 = rand(b, 1, heads, hd), rand(b, 1, kv_heads, hd), \
+            rand(b, 1, kv_heads, hd)
+        static_rows = []
+        for i in range(b):
+            ctx, statics[i] = attend_with_cache(
+                Tensor(q1[i:i + 1]), Tensor(k1[i:i + 1]),
+                Tensor(v1[i:i + 1]), statics[i], int(pos[i]), rep,
+                bias=bias)
+            static_rows.append(ctx.numpy()[0])
+        ctx_p, paged_view = attend_with_cache(
+            Tensor(q1), Tensor(k1), Tensor(v1), paged_view,
+            jnp.asarray(pos), rep, bias=bias)
+        outs.append((static_rows, [ctx_p.numpy()[i] for i in range(b)]))
+        pos = pos + 1
+    return outs
+
+
+class TestPagedAttentionParity:
+    def test_ragged_batch_matches_static_per_request(self, rng):
+        """Mixed prompt lengths: one ragged paged batch computes exactly
+        what b independent static-cache requests compute."""
+        steps = _static_vs_paged(rng, heads=4, kv_heads=4, hd=8,
+                                 prompt_len=[5, 9, 3], decode_steps=3,
+                                 page_size=4)
+        for static_rows, paged_rows in steps:
+            for srow, prow in zip(static_rows, paged_rows):
+                np.testing.assert_allclose(prow, srow, atol=1e-5)
+
+    def test_gqa_parity(self, rng):
+        steps = _static_vs_paged(rng, heads=4, kv_heads=2, hd=8,
+                                 prompt_len=[6, 4], decode_steps=2,
+                                 page_size=4)
+        for static_rows, paged_rows in steps:
+            for srow, prow in zip(static_rows, paged_rows):
+                np.testing.assert_allclose(prow, srow, atol=1e-5)
+
+    def test_additive_bias_parity(self, rng):
+        """T5's relative-position bias rides the mask on both paths; the
+        paged path crops/pads it to its own key extent."""
+        ps, n, steps = 4, 6, 2
+        max_len = pages_for(n + steps, ps) * ps
+        bias = Tensor(jnp.asarray(
+            rng.standard_normal((1, 4, 1, max_len)) * 0.1, jnp.float32))
+        out = _static_vs_paged(rng, heads=4, kv_heads=4, hd=8,
+                               prompt_len=[n], decode_steps=steps,
+                               page_size=ps, bias=bias)
+        # bias shape (1, h, 1, L) only broadcasts over single-token steps
+        for static_rows, paged_rows in out[1:]:
+            np.testing.assert_allclose(paged_rows[0], static_rows[0],
+                                       atol=1e-5)
+
+    def test_pallas_kernel_interpret_matches_reference(self, rng):
+        """The Pallas decode kernel (interpret mode, hermetic on CPU) is
+        numerically the jnp reference gather."""
+        kvh, hd, ps, P, maxp, b, heads = 2, 32, 8, 10, 3, 4, 4
+        kp = jnp.asarray(rng.standard_normal((kvh, P, ps, hd)), jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((kvh, P, ps, hd)), jnp.float32)
+        pt = jnp.asarray(rng.integers(1, P, (b, maxp)), jnp.int32)
+        pos = jnp.asarray([3, 7, 14, 21], jnp.int32)
+        q = Tensor(jnp.asarray(rng.standard_normal((b, 1, heads, hd)),
+                               jnp.float32))
+        cache = PagedLayerCache(kp, vp, pt)
+        ref = satt._paged_decode_reference(q, cache, pos, heads // kvh)
+        out = satt._paged_decode_pallas(q._data, kp, vp, pt, pos,
+                                        interpret=True)
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), atol=1e-5)
+
+    def test_kernel_shape_gates(self):
+        assert satt.paged_decode_available(16, 128)
+        assert not satt.paged_decode_available(7, 128)   # ragged sublanes
+        assert not satt.paged_decode_available(16, 4)    # hd too small
+
+
+# -------------------------------------------------- continuous batching
+
+class TestContinuousBatching:
+    def test_staggered_arrivals_match_sequential_generate(self):
+        """THE acceptance gate: 4 concurrently-scheduled requests with
+        mixed prompt lengths and staggered arrivals produce tokens
+        identical to per-request sequential `generate`, and the engine
+        compiles a bounded executable set (asserted, not eyeballed)."""
+        model = _llama()
+        rng = np.random.RandomState(0)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)) for n in (5, 11, 3, 8)]
+        refs = _sequential_reference(model, prompts, max_new_tokens=6)
+
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        # staggered arrivals: two up front, the rest mid-flight
+        rids = [eng.add_request(p, max_new_tokens=6, temperature=0.0)
+                for p in prompts[:2]]
+        for _ in range(3):
+            eng.step()
+        rids.append(eng.add_request(prompts[2], max_new_tokens=6,
+                                    temperature=0.0))
+        eng.step()
+        rids.append(eng.add_request(prompts[3], max_new_tokens=6,
+                                    temperature=0.0))
+        outs = eng.run()
+
+        for rid, ref in zip(rids, refs):
+            assert outs[rid] == ref, f"request {rid} diverged"
+
+        # bounded compilation: every prompt fits the 16-bucket -> ONE
+        # prefill executable, ONE decode executable, and the sampler
+        # compiles at most two shapes (prefill b=1, decode b=max_batch)
+        counts = eng.compile_counts()
+        assert counts["prefill"] == 1, counts
+        assert counts["decode"] == 1, counts
+        assert counts["sample"] <= 2, counts
+        assert counts["total"] <= 4, counts
+
+        # metrics populated for every request
+        stats = eng.stats()
+        assert stats["num_finished"] == 4
+        assert stats["tokens_generated"] == 24
+        for rid in rids:
+            per = stats["requests"][rid]
+            assert per["ttft_s"] is not None and per["ttft_s"] >= 0
+            assert per["latency_s"] is not None
+            assert per["tokens"] == 6
+
+    def test_request_validation(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        with pytest.raises(ValueError, match="empty"):
+            eng.add_request([])
+        with pytest.raises(ValueError, match="max_seq_len"):
+            eng.add_request([1] * 30, max_new_tokens=10)
+
+
+# ------------------------------------------- backpressure and preemption
+
+class TestBackpressure:
+    def test_admission_deferred_until_pages_free(self):
+        """Pool holds ~one request: the second arrival must WAIT (not
+        fail), then complete with identical tokens once pages free up."""
+        model = _llama()
+        rng = np.random.RandomState(1)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)) for n in (9, 7)]
+        refs = _sequential_reference(model, prompts, max_new_tokens=5)
+
+        # 3 usable pages x page_size 8 = 24 slots; request 0 needs
+        # ceil((9+5)/8)=2 pages resident -> request 1 (2 pages) cannot
+        # coexist with it plus slack, forcing deferred admission
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            num_pages=4)
+        rids = [eng.add_request(p, max_new_tokens=5, temperature=0.0)
+                for p in prompts]
+        saw_waiting_while_running = False
+        while eng.scheduler.has_work():
+            eng.step()
+            r0, r1 = (eng.requests[r] for r in rids)
+            if r0.status == "running" and r1.status == "waiting":
+                saw_waiting_while_running = True
+        outs = {r: eng.output(r) for r in rids}
+        assert saw_waiting_while_running
+        for rid, ref in zip(rids, refs):
+            assert outs[rid] == ref
+        # pool fully reclaimed: no leaked or double-freed pages
+        assert eng.cache.allocator.num_used == 0
+        assert eng.cache.allocator.num_free == eng.cache.num_pages - 1
+
+    def test_single_request_larger_than_pool_raises(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            num_pages=2)      # 1 usable page = 8 slots
+        eng.add_request([1] * 12, max_new_tokens=4, temperature=0.0)
+        with pytest.raises(RuntimeError, match="pages"):
+            eng.run()
+
+    def test_scheduler_defers_admission_while_pool_busy(self):
+        alloc = BlockAllocator(6)                        # 5 usable pages
+        sched = Scheduler(alloc, page_size=4, max_batch_size=2,
+                          max_pages_per_seq=8)
+        first = Request(prompt=[1] * 12, max_new_tokens=4,
+                        sampling=SamplingParams())       # admission: 4
+        second = Request(prompt=[2] * 9, max_new_tokens=2,
+                         sampling=SamplingParams())      # admission: 3
+        sched.add(first)
+        sched.add(second)
+        d = sched.schedule()
+        assert d.kind == "prefill" and d.prefill is first
+        free_before = alloc.num_free                     # 1 left
+        d2 = sched.schedule()                            # cannot admit
+        assert d2.kind == "decode" and second.status == "waiting"
+        assert alloc.num_free == free_before             # nothing leaked
+        sched.finish(first)
+        d3 = sched.schedule()
+        assert d3.kind == "prefill" and d3.prefill is second
+
+
+# ----------------------------------------------------- sampling knobs
+
+class TestServingSampling:
+    def test_mixed_sampling_params_do_not_recompile(self):
+        """temperature/top-k/top-p ride as traced arrays: a batch mixing
+        greedy and sampled requests adds NO sampler executables."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        eng.add_request([1, 2, 3], max_new_tokens=4, temperature=0.0)
+        eng.add_request([4, 5], max_new_tokens=4, temperature=0.9,
+                        top_k=5, seed=11)
+        eng.add_request([6], max_new_tokens=4, temperature=0.7,
+                        top_p=0.8, seed=12)
+        eng.run()
+        assert eng.compile_counts()["sample"] <= 2
+
+
+# ------------------------------------------------------------ slow lane
+
+@pytest.mark.slow
+class TestServingSlow:
+    """Everything here compiles beyond the fast lane's prefill-bucket +
+    decode set (second model family, multi-bucket sweep, extra engine
+    pool shapes / sequential-generate reference shapes)."""
+
+    def test_stream_yields_done_flags(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        rid = eng.add_request([1, 2, 3], max_new_tokens=4, temperature=0.0)
+        events = list(eng.stream())
+        assert [e[0] for e in events] == [rid] * 4
+        assert [e[2] for e in events] == [False] * 3 + [True]
+
+    def test_eos_finishes_early_and_frees_pages(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        # eos == the greedy first token => request finishes at length 1
+        ref = _sequential_reference(model, [[7, 8, 9]], 1)[0]
+        eos = ref[-1]
+        rid = eng.add_request([7, 8, 9], max_new_tokens=8, temperature=0.0,
+                              eos_token_id=eos)
+        outs = eng.run()
+        assert outs[rid] == ref
+        assert eng.cache.allocator.num_used == 0
+
+    def test_preemption_requeues_and_stays_token_identical(self):
+        """Pool too small for all requests' full lengths: the youngest
+        running request is evicted, re-prefilled later, and still emits
+        exactly the sequential tokens (recompute, never corruption)."""
+        model = _llama()
+        rng = np.random.RandomState(3)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)) for n in (10, 8, 12)]
+        refs = _sequential_reference(model, prompts, max_new_tokens=8)
+
+        eng = ServingEngine(model, page_size=8, max_batch_size=3,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            num_pages=8)
+        rids = [eng.add_request(p, max_new_tokens=8, temperature=0.0)
+                for p in prompts]
+        outs = eng.run()
+        assert eng.stats()["preemptions"] >= 1
+        for rid, ref in zip(rids, refs):
+            assert outs[rid] == ref
+        assert eng.cache.allocator.num_used == 0
+
+    def test_seeded_requests_reproducible_across_engines(self):
+        model = _llama()
+
+        def run_once():
+            eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                                max_seq_len=32, prefill_buckets=(16, 32))
+            rid = eng.add_request([3, 1, 4, 1, 5], max_new_tokens=6,
+                                  temperature=0.8, top_k=7, seed=42)
+            return eng.run()[rid]
+
+        assert run_once() == run_once()
+
+    def test_gpt_engine_parity(self):
+        """GPT rides the same engine: absolute position embeddings take
+        the ragged (b,) start_pos path in models/gpt.py."""
+        model = _gpt()
+        rng = np.random.RandomState(5)
+        vocab = GPTConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)) for n in (4, 9, 6, 2)]
+        refs = _sequential_reference(model, prompts, max_new_tokens=5)
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        rids = [eng.add_request(p, max_new_tokens=5, temperature=0.0)
+                for p in prompts]
+        outs = eng.run()
+        for rid, ref in zip(rids, refs):
+            assert outs[rid] == ref
+
+    def test_multiple_prefill_buckets_stay_bounded(self):
+        """Prompts spanning several buckets: prefill executables == the
+        number of DISTINCT buckets used, decode still == 1."""
+        model = _llama()
+        rng = np.random.RandomState(7)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)) for n in (3, 14, 20, 6)]
+        refs = _sequential_reference(model, prompts, max_new_tokens=4)
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=32, prefill_buckets=(8, 16, 32))
+        rids = [eng.add_request(p, max_new_tokens=4, temperature=0.0)
+                for p in prompts]
+        outs = eng.run()
+        for rid, ref in zip(rids, refs):
+            assert outs[rid] == ref
+        counts = eng.compile_counts()
+        assert counts["prefill"] == 3    # buckets 8, 16, 32 all touched
+        assert counts["decode"] == 1
+
+    def test_compile_events_via_jax_monitoring(self):
+        """Secondary compile-count signal straight from jax.monitoring:
+        steady-state decode fires ZERO compile events after warmup."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=64, prefill_buckets=(16, 64))
+        eng.add_request([1, 2, 3, 4], max_new_tokens=24, temperature=0.0)
+        for _ in range(6):
+            eng.step()                   # prefill + warm decode steps
+        events = []
+        jax.monitoring.register_event_listener(
+            lambda name, **kw: events.append(name))
+        try:
+            eng.run()                    # 18+ more pure decode steps
+        finally:
+            jax.monitoring.clear_event_listeners()
+        compiles = [e for e in events if "compile" in e]
+        assert not compiles, compiles

@@ -240,6 +240,37 @@ def _run_gates(on_tpu: bool) -> dict:
     return gates
 
 
+def _run_serving_prefix(on_tpu: bool) -> dict:
+    """Shared-system-prompt serving phase: ttft with the prefix cache on
+    vs off plus hit rate (benchmarks/generation_bench.py's phase, reused
+    here so the driver bench reports cache efficacy alongside MFU).
+    Non-fatal: a failure is recorded, not raised."""
+    import importlib.util
+
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "generation_bench",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "benchmarks", "generation_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        out = mod.serving_prefix_phase(model, cfg, on_tpu)
+        _log(f"phase=serving_prefix: ttft {out['ttft_cache_off_ms']}ms -> "
+             f"{out['ttft_cache_on_ms']}ms (hit rate {out['hit_rate']})")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_prefix: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def make_train_step(model, opt):
     """The bench train step (fwd + MLM loss + grad + Adam, bf16 autocast).
 
@@ -424,6 +455,10 @@ def bench_child() -> None:
     # Pallas lowering gates next: cheap compiles, maximal hardware signal
     _enter_phase("gates")
     gates = _run_gates(on_tpu)
+
+    # serving prefix-cache phase: tiny model, bounded budget, non-fatal
+    _enter_phase("serving_prefix", 400.0)
+    serving_prefix = _run_serving_prefix(on_tpu)
     _enter_phase("build")
 
     if on_tpu:
@@ -554,6 +589,7 @@ def bench_child() -> None:
                 "final_loss": loss,
                 "phase": phase,
                 "gates": gates,
+                "serving_prefix": serving_prefix,
             },
         }
 

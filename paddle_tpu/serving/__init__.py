@@ -13,6 +13,10 @@ decoder models (LLaMA, GPT) with:
 - `scheduler`: iteration-level continuous batching — admission by
   free-page budget, prefill/decode interleaving into a bounded set of
   fixed-shape jitted steps, preempt-and-requeue on pool exhaustion;
+- `prefix_cache`: automatic prefix caching — a radix tree over full-page
+  token chunks maps shared prompt prefixes to refcounted KV pages, so a
+  request whose prompt starts with a cached prefix prefills only its
+  suffix (`ServingEngine(enable_prefix_caching=True)`);
 - `engine`: `ServingEngine.add_request/step/stream/run` plus per-request
   latency/throughput counters exported through paddle_tpu.profiler.
 
@@ -25,12 +29,14 @@ from .engine import ServingEngine  # noqa: F401
 from .kv_cache import (  # noqa: F401
     BlockAllocator, NULL_PAGE, PagedKVCache, PagedLayerCache, pages_for,
 )
+from .prefix_cache import PrefixCache, PrefixNode  # noqa: F401
 from .scheduler import (  # noqa: F401
     Request, SamplingParams, ScheduleDecision, Scheduler,
 )
 
 __all__ = [
     "ServingEngine", "PagedKVCache", "PagedLayerCache", "BlockAllocator",
+    "PrefixCache", "PrefixNode",
     "Scheduler", "ScheduleDecision", "Request", "SamplingParams",
     "paged_attend", "paged_decode_attention", "paged_decode_available",
     "pages_for", "NULL_PAGE",

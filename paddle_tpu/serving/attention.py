@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from .kv_cache import PagedLayerCache
+from .kv_cache import NULL_PAGE, PagedLayerCache
 
 __all__ = ["paged_attend", "paged_decode_attention",
            "paged_decode_available", "KERNEL_MODE"]
@@ -88,8 +88,14 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
     kd = (k._data if hasattr(k, "_data") else k).astype(kp.dtype)
     vd = (v._data if hasattr(v, "_data") else v).astype(vp.dtype)
     pos = _positions(start_pos, b, s)                # (b, s)
-    page_idx = jnp.clip(pos // ps, 0, max_pages - 1)
-    entries = jnp.take_along_axis(page_table, page_idx, axis=1)
+    page_idx = pos // ps
+    entries = jnp.take_along_axis(
+        page_table, jnp.clip(page_idx, 0, max_pages - 1), axis=1)
+    # padding rows whose position overflows the table (suffix prefill:
+    # offset + bucket may exceed max_pages * page_size) must land in the
+    # null page — clipping the index instead would alias them onto the
+    # sequence's REAL last page and corrupt it
+    entries = jnp.where(page_idx >= max_pages, NULL_PAGE, entries)
     slots = pos % ps
     kp = _write_pages(kp, kd.reshape(b * s, *kd.shape[2:]),
                       entries.reshape(-1), slots.reshape(-1))
@@ -97,11 +103,17 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
                       entries.reshape(-1), slots.reshape(-1))
     new_cache = PagedLayerCache(kp, vp, page_table)
 
+    raw_start = start_pos._data if hasattr(start_pos, "_data") else start_pos
+    static_zero = isinstance(raw_start, int) and raw_start == 0
     if s == 1:
         ctx = paged_decode_attention(q, new_cache, pos[:, 0], rep,
                                      bias=bias)
-    else:
+    elif static_zero:
         ctx = _prefill_attention(q, kd, vd, pos, rep, bias=bias)
+    else:
+        # suffix prefill from a cached prefix: earlier K/V lives only in
+        # the pool's shared pages, so attend over the page table
+        ctx = _prefill_attention_paged(q, new_cache, pos, rep, bias=bias)
     return ctx, new_cache
 
 
@@ -136,6 +148,41 @@ def _prefill_attention(q, kd, vd, pos, rep, bias=None):
     mask = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)[:, None]
     if bias is not None:
         mask = mask + _crop_bias(bias, s).astype(jnp.float32)
+    return F.scaled_dot_product_attention(
+        q, Tensor(kf), Tensor(vf), attn_mask=Tensor(mask), is_causal=False)
+
+
+def _prefill_attention_paged(q, cache: PagedLayerCache, pos, rep,
+                             bias=None):
+    """Multi-token prefill at a NONZERO offset (prefix-cache hit): the
+    queries' earlier keys are cached pages written by another request, so
+    gather the whole sequence through the page table — the pool already
+    holds this step's suffix K/V — and mask causally by global position.
+    Reference path (jnp gather + sdpa), the s>1 twin of
+    `_paged_decode_reference`; the Pallas kernel stays decode-only."""
+    from ..nn import functional as F
+
+    kp, vp, page_table = cache.k_pool, cache.v_pool, cache.page_table
+    b = page_table.shape[0]
+    ps = cache.page_size
+    length = page_table.shape[1] * ps
+
+    def gather(pool):
+        g = pool[:, page_table]                  # (kvh, b, maxP, ps, hd)
+        kvh, _, mp, _, hd = g.shape
+        return jnp.transpose(g, (1, 2, 3, 0, 4)).reshape(
+            b, mp * ps, kvh, hd)
+
+    kf = _expand_kv(gather(kp), rep)
+    vf = _expand_kv(gather(vp), rep)
+    # query at global pos[i, r] sees pool column j iff j <= pos[i, r];
+    # pool padding (null page, beyond-length slots) masks to the same
+    # -1e9 floor as the reference decode path
+    allowed = (jnp.arange(length, dtype=jnp.int32)[None, None, :]
+               <= pos[:, :, None])                       # (b, s, L)
+    mask = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)[:, None]
+    if bias is not None:
+        mask = mask + _crop_bias(bias, length).astype(jnp.float32)
     return F.scaled_dot_product_attention(
         q, Tensor(kf), Tensor(vf), attn_mask=Tensor(mask), is_causal=False)
 

@@ -5,7 +5,10 @@ BOUNDED set of compiled programs (T3's rule: every hot-loop step is one
 jitted dispatch):
 
 - one prefill executable per prompt bucket (prompt padded up to the
-  bucket; one request per prefill step);
+  bucket; one request per prefill step) — plus, when
+  `enable_prefix_caching=True`, ONE offset-aware variant per bucket that
+  prefills only the suffix left uncovered by the radix prefix cache
+  (shared pages ride in through the page table, see prefix_cache.py);
 - ONE decode executable: a fixed (max_batch_size,) token batch where each
   row carries its own position and page table row (the ragged paged
   attention path), padding rows aimed at the null page;
@@ -35,6 +38,7 @@ from ..core.tensor import Tensor
 from ..jit.functional import call_functional, extract_state
 from ..profiler import RecordEvent
 from .kv_cache import PagedKVCache, PagedLayerCache, pages_for
+from .prefix_cache import PrefixCache
 from .scheduler import Request, SamplingParams, Scheduler
 
 __all__ = ["ServingEngine"]
@@ -85,7 +89,8 @@ class ServingEngine:
                  max_batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 cache_dtype=jnp.float32):
+                 cache_dtype=jnp.float32,
+                 enable_prefix_caching: bool = False):
         from ..models.generation import _config_of
 
         self.model = model
@@ -100,8 +105,15 @@ class ServingEngine:
             num_pages = max_batch_size * self.max_pages_per_seq + 1
         self.cache = PagedKVCache.for_model(model, num_pages, page_size,
                                             cache_dtype)
+        # automatic prefix caching (full-page granularity, LRU eviction):
+        # finished/prefilled prompts leave their full pages in a radix
+        # tree; a later prompt sharing a page-aligned prefix reuses them
+        # and prefills only its suffix
+        self.prefix_cache = (PrefixCache(self.cache.allocator, page_size)
+                             if enable_prefix_caching else None)
         self.scheduler = Scheduler(self.cache.allocator, page_size,
-                                   max_batch_size, self.max_pages_per_seq)
+                                   max_batch_size, self.max_pages_per_seq,
+                                   prefix_cache=self.prefix_cache)
         self.prefill_buckets = tuple(sorted(
             prefill_buckets or _default_buckets(self.max_seq_len)))
         if self.prefill_buckets[-1] < self.max_seq_len:
@@ -121,7 +133,8 @@ class ServingEngine:
         # misses (the shared caches' _cache_size would count OTHER
         # engines' shapes too); compile_counts() reports these
         self._exec_shapes: Dict[str, set] = {
-            "prefill": set(), "decode": set(), "sample": set()}
+            "prefill": set(), "prefill_offset": set(), "decode": set(),
+            "sample": set()}
         self._stats = {"prefill_steps": 0, "decode_steps": 0,
                        "tokens_generated": 0, "prefill_time_s": 0.0,
                        "decode_time_s": 0.0, "preemptions": 0}
@@ -209,6 +222,31 @@ class ServingEngine:
             self._jit_cache[key] = jax.jit(prefill, donate_argnums=(3,))
         return self._jit_cache[key]
 
+    def _prefill_offset_jit(self, bucket: int):
+        """The offset-aware prefill variant (prefix-cache hits): same
+        bucket shapes, but start_pos is a TRACED scalar — the suffix
+        tokens sit at positions offset..offset+bucket-1 and attend over
+        the cached prefix pages through the page table. One extra
+        executable per bucket, shared by every hit length."""
+        key = ("prefill_offset", bucket)
+        if key not in self._jit_cache:
+            model = self.model
+
+            def prefill(params, buffers, ids, pools, page_table, last_idx,
+                        offset):
+                views = [PagedLayerCache(kp, vp, page_table)
+                         for kp, vp in pools]
+                (logits, new_views), _ = call_functional(
+                    model, params, buffers, (Tensor(ids),),
+                    kwargs={"caches": views, "start_pos": offset},
+                    training=False)
+                last = jax.lax.dynamic_slice_in_dim(
+                    logits, last_idx, 1, axis=1)[:, 0]
+                return last, [(v.k_pool, v.v_pool) for v in new_views]
+
+            self._jit_cache[key] = jax.jit(prefill, donate_argnums=(3,))
+        return self._jit_cache[key]
+
     def _sample_jit(self):
         if "sample" not in self._jit_cache:
             self._jit_cache["sample"] = jax.jit(_sample_batch)
@@ -252,21 +290,38 @@ class ServingEngine:
         return (req.request_id, token)
 
     def _prefill(self, req: Request) -> List[Tuple[int, int]]:
-        bucket = self._bucket_for(len(req.prompt))
-        self._exec_shapes["prefill"].add(
+        # prefix-cache hit: only the uncached suffix runs through the
+        # model (bucketed on the SUFFIX length, so a long shared prompt
+        # with a short question prefills in the smallest bucket)
+        n_cached = req.cached_tokens
+        suffix = req.prompt[n_cached:]
+        bucket = self._bucket_for(len(suffix))
+        family = "prefill_offset" if n_cached else "prefill"
+        self._exec_shapes[family].add(
             (bucket, self.cache.num_pages, self.max_pages_per_seq))
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :len(req.prompt)] = req.prompt
+        ids[0, :len(suffix)] = suffix
         page_table = self.cache.page_table_array([req.pages],
                                                  self.max_pages_per_seq)
         t0 = time.perf_counter()
         with RecordEvent("serving.prefill"):
-            last_logits, pools = self._prefill_jit(bucket)(
-                self.params, self.buffers, jnp.asarray(ids),
-                self.cache.pools, page_table,
-                jnp.int32(len(req.prompt) - 1))
+            if n_cached:
+                last_logits, pools = self._prefill_offset_jit(bucket)(
+                    self.params, self.buffers, jnp.asarray(ids),
+                    self.cache.pools, page_table,
+                    jnp.int32(len(suffix) - 1), jnp.int32(n_cached))
+            else:
+                last_logits, pools = self._prefill_jit(bucket)(
+                    self.params, self.buffers, jnp.asarray(ids),
+                    self.cache.pools, page_table,
+                    jnp.int32(len(suffix) - 1))
             self.cache.pools = pools
             token = int(self._sample_rows(last_logits, [req])[0])
+        if self.prefix_cache is not None:
+            # register the prompt's full pages for future reuse (the
+            # partial last page never enters the tree); in-flight
+            # requests can hit them immediately
+            self.prefix_cache.insert(req.prompt, req.pages)
         now = time.perf_counter()
         self._stats["prefill_steps"] += 1
         self._stats["prefill_time_s"] += now - t0
@@ -333,6 +388,8 @@ class ServingEngine:
         s["num_finished"] = sum(r.status == "finished"
                                 for r in self.requests.values())
         s["free_pages"] = self.cache.allocator.num_free
+        if self.prefix_cache is not None:
+            s["prefix_cache"] = self.prefix_cache.stats()
         per_req = {}
         for rid, req in self.requests.items():
             per_req[rid] = {

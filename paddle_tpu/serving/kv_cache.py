@@ -39,8 +39,17 @@ def pages_for(num_tokens: int, page_size: int) -> int:
 
 
 class BlockAllocator:
-    """Free-list page allocator. Page ids are ints in [1, num_pages);
-    page 0 is the reserved null page and is never handed out."""
+    """Refcounted free-list page allocator. Page ids are ints in
+    [1, num_pages); page 0 is the reserved null page and is never handed
+    out.
+
+    A freshly alloc'd page carries ONE reference (its allocator). The
+    prefix cache `acquire`s extra references when a page enters the radix
+    tree or another sequence's page table, so one physical page can sit in
+    many page tables at once; `free` drops one reference and the page only
+    returns to the free list when the count hits zero. Without a prefix
+    cache every page stays at refcount 1 and alloc/free behave exactly as
+    the plain free list did."""
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
@@ -48,7 +57,7 @@ class BlockAllocator:
         self.num_pages = num_pages
         # LIFO keeps recently-freed (cache-warm) pages in rotation
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._used: set[int] = set()
+        self._refs: dict[int, int] = {}
 
     @property
     def num_free(self) -> int:
@@ -56,14 +65,19 @@ class BlockAllocator:
 
     @property
     def num_used(self) -> int:
-        return len(self._used)
+        return len(self._refs)
+
+    def ref_count(self, page: int) -> int:
+        """Live references on `page` (0 = free)."""
+        return self._refs.get(page, 0)
 
     def alloc(self) -> Optional[int]:
-        """One free page id, or None when the pool is exhausted."""
+        """One free page id (refcount 1), or None when the pool is
+        exhausted."""
         if not self._free:
             return None
         page = self._free.pop()
-        self._used.add(page)
+        self._refs[page] = 1
         return page
 
     def alloc_n(self, n: int) -> Optional[List[int]]:
@@ -72,13 +86,26 @@ class BlockAllocator:
             return None
         return [self.alloc() for _ in range(n)]
 
-    def free(self, page: int) -> None:
+    def acquire(self, page: int) -> None:
+        """Add one reference to an allocated page (prefix-cache sharing:
+        the page is entering another page table or the radix tree)."""
         if page == NULL_PAGE:
             raise ValueError("page 0 is the reserved null page")
-        if page not in self._used:
+        if page not in self._refs:
+            raise ValueError(f"acquire of free/unknown page {page}")
+        self._refs[page] += 1
+
+    def free(self, page: int) -> None:
+        """Drop one reference; the page returns to the free list only when
+        no references remain."""
+        if page == NULL_PAGE:
+            raise ValueError("page 0 is the reserved null page")
+        if page not in self._refs:
             raise ValueError(f"double free or unknown page {page}")
-        self._used.remove(page)
-        self._free.append(page)
+        self._refs[page] -= 1
+        if self._refs[page] == 0:
+            del self._refs[page]
+            self._free.append(page)
 
     def free_all(self, pages: Sequence[int]) -> None:
         for p in pages:

@@ -1,0 +1,173 @@
+"""Automatic prefix caching: radix-tree KV reuse over the paged pool.
+
+Shared-prompt traffic (one system prompt or few-shot preamble in front of
+thousands of requests) re-prefills the same tokens again and again; with
+the paged layout the fix is nearly free, because the page table is already
+an indirection layer — a cached prefix is just a list of page ids that
+several sequences' tables point at (Ragged Paged Attention's observation,
+arxiv 2604.15464; same design as vLLM's automatic prefix caching and
+SGLang's RadixAttention).
+
+Structure: a radix tree keyed on FULL-PAGE token chunks. Each node owns
+exactly one KV page whose `page_size` tokens are the node's chunk; the
+path from the root to a node spells the token prefix whose K/V those
+pages hold. Only full pages ever enter the tree — a partial last page is
+never shared (the next request simply re-prefills it into a fresh page,
+copy-on-write by fresh allocation), so no kernel or attention change is
+needed for correctness.
+
+Sharing is by reference count (BlockAllocator.acquire/free): the tree
+holds one reference per cached page, every sequence whose table contains
+the page holds another, and the page returns to the free list only when
+the last holder drops it. Eviction is LRU over refcount-1 leaves — pages
+no live sequence references — so a hot prefix pinned by running requests
+can never be evicted out from under them.
+
+Invariants (tests/test_serving.py asserts these):
+- `match` caps at len(tokens)-1 so a fully-cached prompt still prefills
+  its final token (the engine needs that token's logits to sample);
+- every page `match` returns carries a reference owned by the caller,
+  released through the ordinary allocator `free` path;
+- `evict`/`flush` only ever free refcount-1 pages (tree-only references);
+- cached-page content is immutable in practice: suffix prefills and
+  decode steps only write positions >= the cached offset, which land in
+  privately-allocated pages (full-page alignment guarantees it).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..profiler import RecordEvent
+from .kv_cache import BlockAllocator
+
+__all__ = ["PrefixCache", "PrefixNode"]
+
+Chunk = Tuple[int, ...]
+
+
+@dataclasses.dataclass
+class PrefixNode:
+    """One cached page: `chunk` is the page_size token ids whose K/V the
+    page holds; the root is a sentinel with page None."""
+
+    chunk: Chunk
+    page: Optional[int]
+    parent: Optional["PrefixNode"]
+    children: Dict[Chunk, "PrefixNode"] = dataclasses.field(
+        default_factory=dict)
+    last_used: int = 0
+
+
+class PrefixCache:
+    def __init__(self, allocator: BlockAllocator, page_size: int):
+        self.allocator = allocator
+        self.page_size = page_size
+        self._root = PrefixNode(chunk=(), page=None, parent=None)
+        self._tick = 0
+        self._num_pages = 0
+        self._stats = {"lookups": 0, "hit_tokens": 0, "miss_tokens": 0,
+                       "evictions": 0}
+
+    # ------------------------------------------------------------- lookup
+    def match(self, tokens: Sequence[int]) -> List[int]:
+        """Longest cached full-page prefix of `tokens`, as page ids in
+        prefix order. Acquires ONE reference per returned page — the
+        caller owns them exactly like alloc'd pages and releases them
+        through `allocator.free`. Capped at len(tokens)-1 tokens so a
+        fully-cached prompt still has a suffix to prefill."""
+        self._tick += 1
+        with RecordEvent("serving.prefix_cache.lookup"):
+            max_chunks = (len(tokens) - 1) // self.page_size
+            node = self._root
+            pages: List[int] = []
+            for i in range(max_chunks):
+                chunk = tuple(tokens[i * self.page_size:
+                                     (i + 1) * self.page_size])
+                child = node.children.get(chunk)
+                if child is None:
+                    break
+                child.last_used = self._tick
+                self.allocator.acquire(child.page)
+                pages.append(child.page)
+                node = child
+            return pages
+
+    def record(self, total_tokens: int, hit_tokens: int) -> None:
+        """Count one committed lookup (called on successful admission, so
+        a deferred-and-retried request isn't double counted)."""
+        self._stats["lookups"] += 1
+        self._stats["hit_tokens"] += hit_tokens
+        self._stats["miss_tokens"] += total_tokens - hit_tokens
+
+    # ------------------------------------------------------------- insert
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Register a just-prefilled request's FULL prompt pages (pages[i]
+        holds tokens[i*ps:(i+1)*ps]); the partial last page never enters.
+        New nodes acquire a tree-owned reference on their page; a chunk
+        already cached keeps its incumbent page (the request's duplicate
+        stays private and is freed with the request). Returns the number
+        of pages newly registered."""
+        self._tick += 1
+        node = self._root
+        added = 0
+        n_full = min(len(tokens) // self.page_size, len(pages))
+        for i in range(n_full):
+            chunk = tuple(tokens[i * self.page_size:
+                                 (i + 1) * self.page_size])
+            child = node.children.get(chunk)
+            if child is None:
+                child = PrefixNode(chunk=chunk, page=pages[i], parent=node)
+                self.allocator.acquire(pages[i])
+                node.children[chunk] = child
+                self._num_pages += 1
+                added += 1
+            child.last_used = self._tick
+            node = child
+        return added
+
+    # ----------------------------------------------------------- eviction
+    def _evictable_leaves(self) -> List[PrefixNode]:
+        out, stack = [], list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif self.allocator.ref_count(n.page) == 1:
+                out.append(n)          # only the tree references this page
+        return out
+
+    def evict(self, n: int) -> int:
+        """Free up to `n` pages, LRU leaves first (a parent only becomes
+        evictable once its children are gone, so lookups never dangle).
+        Pages referenced by any live sequence are never touched. Returns
+        the number of pages actually freed."""
+        freed = 0
+        while freed < n:
+            leaves = self._evictable_leaves()
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda nd: nd.last_used)
+            del victim.parent.children[victim.chunk]
+            self.allocator.free(victim.page)
+            self._num_pages -= 1
+            self._stats["evictions"] += 1
+            freed += 1
+        return freed
+
+    def flush(self) -> int:
+        """Evict every page no live sequence references (end-of-run leak
+        checks; a still-shared prefix survives)."""
+        return self.evict(self._num_pages)
+
+    # ------------------------------------------------------------ metrics
+    @property
+    def cached_pages(self) -> int:
+        return self._num_pages
+
+    def stats(self) -> Dict[str, object]:
+        s = dict(self._stats)
+        seen = s["hit_tokens"] + s["miss_tokens"]
+        s["hit_rate"] = s["hit_tokens"] / seen if seen else 0.0
+        s["cached_pages"] = self._num_pages
+        return s

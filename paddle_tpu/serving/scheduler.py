@@ -18,7 +18,13 @@ to decide which call. Policy:
   pool is exhausted the YOUNGEST running request is preempted — its pages
   return to the free list and it re-queues (front) with prompt+generated
   tokens, to be re-prefilled when pages free up. Eviction therefore costs
-  recompute, never correctness.
+  recompute, never correctness;
+- prefix caching (optional): admission first asks the PrefixCache for the
+  longest cached full-page prefix of the prompt and charges the pool only
+  for the UNCACHED suffix; release paths go through the refcounted
+  allocator, so shared pages outlive any one request, and on pool
+  pressure unreferenced cached pages are evicted before anyone is
+  preempted.
 """
 from __future__ import annotations
 
@@ -58,6 +64,10 @@ class Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     pages: List[int] = dataclasses.field(default_factory=list)
     preemptions: int = 0
+    # prompt tokens whose K/V came from the prefix cache (page-aligned);
+    # prefill starts at this offset. pages[:cached_tokens // page_size]
+    # are shared — the request holds a reference, never writes them
+    cached_tokens: int = 0
 
     # metrics (perf_counter timestamps, filled by the engine)
     arrival_t: float = dataclasses.field(default_factory=time.perf_counter)
@@ -90,11 +100,13 @@ class ScheduleDecision:
 
 class Scheduler:
     def __init__(self, allocator: BlockAllocator, page_size: int,
-                 max_batch_size: int, max_pages_per_seq: int):
+                 max_batch_size: int, max_pages_per_seq: int,
+                 prefix_cache=None):
         self.allocator = allocator
         self.page_size = page_size
         self.max_batch_size = max_batch_size
         self.max_pages_per_seq = max_pages_per_seq
+        self.prefix_cache = prefix_cache
         self.waiting: List[Request] = []
         self.running: List[Request] = []
 
@@ -109,7 +121,8 @@ class Scheduler:
         self.waiting.append(req)
 
     def finish(self, req: Request) -> None:
-        """Release a completed request's pages back to the pool."""
+        """Drop a completed request's page references; a page returns to
+        the pool once no other sequence (and no cached prefix) holds it."""
         req.status = "finished"
         self.allocator.free_all(req.pages)
         req.pages = []
@@ -122,18 +135,57 @@ class Scheduler:
     # ------------------------------------------------------------- policy
     def _admission_pages(self, req: Request) -> int:
         # prompt + the first generated token: prefill writes the prompt,
-        # and the very next decode step must have a slot to land on
+        # and the very next decode step must have a slot to land on.
+        # This is EXACTLY what the first post-prefill _ensure_decode_pages
+        # requires (pages_for(num_tokens) with num_tokens = prompt + 1),
+        # including the exact-fill case len(prompt) % page_size == 0 where
+        # the +1 rolls into a fresh page; page 0 (null) is outside the
+        # allocator, so no off-by-one hides there either.
+        # tests/test_serving.py::TestAdmissionPageAccounting pins this.
         return pages_for(len(req.prompt) + 1, self.page_size)
+
+    def _alloc_n(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing alloc that reclaims unreferenced prefix-cache
+        pages before reporting exhaustion."""
+        pages = self.allocator.alloc_n(n)
+        if pages is None and self.prefix_cache is not None:
+            self.prefix_cache.evict(n - self.allocator.num_free)
+            pages = self.allocator.alloc_n(n)
+        return pages
+
+    def _alloc_one(self) -> Optional[int]:
+        page = self.allocator.alloc()
+        if page is None and self.prefix_cache is not None \
+                and self.prefix_cache.evict(1):
+            page = self.allocator.alloc()
+        return page
 
     def _try_admit(self) -> Optional[Request]:
         if not self.waiting or len(self.running) >= self.max_batch_size:
             return None
         req = self.waiting[0]
-        pages = self.allocator.alloc_n(self._admission_pages(req))
+        cached: List[int] = []
+        if self.prefix_cache is not None:
+            # longest cached full-page prefix; the pool is charged only
+            # for the uncached suffix (match acquires one ref per page)
+            cached = self.prefix_cache.match(req.prompt)
+        pages = self._alloc_n(self._admission_pages(req) - len(cached))
         if pages is None:
-            return None                  # backpressure: pool exhausted
+            # pool exhausted. Drop the match refs FIRST — holding them
+            # pins exactly the pages whose eviction could let this
+            # request (or an older peer) through — then retry once
+            # cache-free before reporting backpressure.
+            self.allocator.free_all(cached)
+            if cached:
+                cached = []
+                pages = self._alloc_n(self._admission_pages(req))
+            if pages is None:
+                return None
         self.waiting.pop(0)
-        req.pages = pages
+        req.pages = cached + pages
+        req.cached_tokens = len(cached) * self.page_size
+        if self.prefix_cache is not None:
+            self.prefix_cache.record(len(req.prompt), req.cached_tokens)
         req.status = "running"
         self.running.append(req)
         return req
@@ -142,10 +194,12 @@ class Scheduler:
         """Evict a running request and requeue it at the FRONT of the
         waiting queue with its generated tokens folded into the prompt
         (re-prefill resumes it bit-exactly — prefill and decode share the
-        cache numerics)."""
+        cache numerics). Shared prefix pages only lose the victim's
+        reference; survivors and the prefix cache keep theirs."""
         self.running.remove(victim)
         self.allocator.free_all(victim.pages)
         victim.pages = []
+        victim.cached_tokens = 0
         victim.prompt = victim.prompt + victim.generated
         victim.max_new_tokens -= len(victim.generated)
         victim.generated = []
@@ -166,7 +220,7 @@ class Scheduler:
             # so the table must cover num_tokens resident tokens
             while pages_for(req.num_tokens, self.page_size) > \
                     len(req.pages):
-                page = self.allocator.alloc()
+                page = self._alloc_one()
                 if page is not None:
                     req.pages.append(page)
                     continue

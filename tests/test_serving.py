@@ -1,9 +1,12 @@
-"""paddle_tpu.serving: block-allocator invariants, paged-attention parity
-vs the static-cache `attend_with_cache`, continuous batching with staggered
-arrivals token-identical to sequential `generate`, admission backpressure /
-preemption, and BOUNDED compilation counts (asserted via the jit caches'
-miss counts — each `_cache_size` entry is one cache miss -> one compiled
-executable).
+"""paddle_tpu.serving: block-allocator invariants (incl. refcounted page
+sharing), paged-attention parity vs the static-cache `attend_with_cache`,
+continuous batching with staggered arrivals token-identical to sequential
+`generate`, admission backpressure / preemption, automatic prefix caching
+(radix-tree hits token-identical to cold runs, LRU eviction, shared-page
+preemption safety), and BOUNDED compilation counts (asserted via the jit
+caches' miss counts — each `_cache_size` entry is one cache miss -> one
+compiled executable; the prefix cache may add at most one offset-aware
+prefill executable per bucket).
 
 Fast-lane tests compile only the prefill-bucket + decode + sampler set (a
 single tiny model reused module-wide); anything beyond that — the second
@@ -23,8 +26,8 @@ from paddle_tpu.models import (
 )
 from paddle_tpu.models.generation import attend_with_cache
 from paddle_tpu.serving import (
-    BlockAllocator, NULL_PAGE, PagedKVCache, PagedLayerCache, Request,
-    SamplingParams, Scheduler, ServingEngine, pages_for,
+    BlockAllocator, NULL_PAGE, PagedKVCache, PagedLayerCache, PrefixCache,
+    Request, SamplingParams, Scheduler, ServingEngine, pages_for,
 )
 from paddle_tpu.serving import attention as satt
 
@@ -91,6 +94,298 @@ class TestBlockAllocator:
         assert pages_for(8, 8) == 1
         assert pages_for(9, 8) == 2
         assert pages_for(17, 8) == 3
+
+
+# -------------------------------------------------- refcounted allocator
+
+class TestBlockAllocatorRefcounts:
+    def test_acquire_defers_free_until_last_release(self):
+        a = BlockAllocator(4)
+        p = a.alloc()
+        assert a.ref_count(p) == 1
+        a.acquire(p)
+        a.acquire(p)
+        assert a.ref_count(p) == 3
+        a.free(p)
+        a.free(p)
+        assert a.ref_count(p) == 1 and a.num_used == 1
+        free_before = a.num_free
+        a.free(p)                        # last holder: page really frees
+        assert a.ref_count(p) == 0
+        assert a.num_free == free_before + 1 and a.num_used == 0
+
+    def test_release_past_zero_raises(self):
+        a = BlockAllocator(4)
+        p = a.alloc()
+        a.acquire(p)
+        a.free(p)
+        a.free(p)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(p)
+
+    def test_acquire_free_or_null_page_raises(self):
+        a = BlockAllocator(4)
+        with pytest.raises(ValueError, match="null page"):
+            a.acquire(NULL_PAGE)
+        with pytest.raises(ValueError, match="free/unknown"):
+            a.acquire(2)                 # never alloc'd
+
+    def test_shared_page_survives_one_owner(self):
+        """Two 'sequences' hold the same page; freeing one table leaves
+        the page resident for the other."""
+        a = BlockAllocator(8)
+        shared = a.alloc()
+        a.acquire(shared)                # second sequence's table
+        own = a.alloc()
+        a.free_all([shared, own])        # first sequence finishes
+        assert a.ref_count(shared) == 1  # survivor still holds it
+        assert a.ref_count(own) == 0
+
+
+# ------------------------------------------------------- prefix cache
+
+class TestPrefixCache:
+    """Host-side radix-tree invariants (no model, no jit)."""
+
+    def _cache(self, num_pages=16, ps=4):
+        a = BlockAllocator(num_pages)
+        return a, PrefixCache(a, ps)
+
+    def test_match_miss_then_insert_then_hit(self):
+        a, pc = self._cache()
+        toks = list(range(11))           # 2 full pages + 3 spare @ ps=4
+        assert pc.match(toks) == []
+        pages = a.alloc_n(3)
+        pc.insert(toks, pages)           # registers pages[0:2] only
+        assert pc.cached_pages == 2
+        got = pc.match(toks)
+        assert got == pages[:2]
+        # match acquired one ref per page on top of owner + tree
+        assert a.ref_count(pages[0]) == 3
+        assert a.ref_count(pages[2]) == 1   # partial page never cached
+
+    def test_match_caps_below_full_prompt(self):
+        """A fully-cached page-aligned prompt still leaves its last token
+        uncached — the engine needs that token's logits to sample."""
+        a, pc = self._cache(ps=4)
+        toks = list(range(8))            # exactly 2 pages
+        pages = a.alloc_n(2)
+        pc.insert(toks, pages)
+        assert pc.cached_pages == 2
+        assert pc.match(toks) == pages[:1]   # cap: (8-1)//4 = 1 chunk
+
+    def test_eviction_frees_only_unreferenced_lru_leaves(self):
+        a, pc = self._cache(ps=4)
+        hot = list(range(8))
+        cold = [90, 91, 92, 93, 94]
+        hot_pages, cold_pages = a.alloc_n(2), a.alloc_n(2)
+        pc.insert(hot, hot_pages)
+        pc.insert(cold, cold_pages)          # registers cold_pages[0] only
+        held = pc.match(hot)                 # live sequence pins hot[0]
+        assert held == hot_pages[:1]
+        a.free_all(hot_pages + cold_pages)   # original owners finish
+        assert pc.evict(10) == 2             # hot leaf + cold leaf only
+        assert a.ref_count(cold_pages[0]) == 0   # tree-only ref: freed
+        assert a.ref_count(hot_pages[1]) == 0
+        assert a.ref_count(hot_pages[0]) == 2    # pinned by match: kept
+        assert pc.cached_pages == 1
+        a.free_all(held)
+        assert pc.flush() == 1               # now evictable
+        assert pc.cached_pages == 0 and a.num_used == 0
+
+    def test_lru_order(self):
+        a, pc = self._cache(ps=2)
+        p1, p2 = [a.alloc()], [a.alloc()]
+        pc.insert([1, 2], p1)
+        pc.insert([3, 4], p2)
+        a.free(p1[0])
+        a.free(p2[0])                    # owners gone, tree-only refs
+        a.free_all(pc.match([1, 2, 99]))  # touch the first prefix
+        assert pc.evict(1) == 1
+        assert a.ref_count(p2[0]) == 0   # LRU victim was the untouched one
+        assert a.ref_count(p1[0]) == 1
+
+    def test_duplicate_insert_keeps_incumbent(self):
+        a, pc = self._cache(ps=4)
+        toks = list(range(5))
+        first, second = a.alloc_n(2), a.alloc_n(2)
+        assert pc.insert(toks, first) == 1
+        assert pc.insert(toks, second) == 0      # chunk already cached
+        assert pc.match(toks) == first[:1]
+        assert a.ref_count(second[0]) == 1       # duplicate stays private
+
+    def test_stats_counters(self):
+        a, pc = self._cache(ps=4)
+        toks = list(range(9))
+        pc.insert(toks, a.alloc_n(3))
+        pc.record(9, 0)
+        pc.record(9, 8)
+        s = pc.stats()
+        assert s["hit_tokens"] == 8 and s["miss_tokens"] == 10
+        assert s["lookups"] == 2 and s["cached_pages"] == 2
+        assert abs(s["hit_rate"] - 8 / 18) < 1e-9
+
+
+# ------------------------------------------- admission page accounting
+
+class TestAdmissionPageAccounting:
+    """ISSUE 2 satellite audit: `_admission_pages` (prompt + 1 token) must
+    equal what the first post-prefill `_ensure_decode_pages` demands
+    (pages_for(num_tokens) with num_tokens = prompt + 1). The audit found
+    the two CONSISTENT — including the exact-fill case where the +1 rolls
+    into a fresh page and the null-page convention (page 0 lives outside
+    the allocator, so free counts need no adjustment). These tests pin
+    that equivalence so a refactor can't silently reintroduce the
+    off-by-one."""
+
+    @pytest.mark.parametrize("prompt_len", [7, 8, 9, 15, 16, 17])
+    def test_admission_matches_first_decode_demand(self, prompt_len):
+        sched = Scheduler(BlockAllocator(64), page_size=8,
+                          max_batch_size=2, max_pages_per_seq=8)
+        req = Request(prompt=[1] * prompt_len, max_new_tokens=4,
+                      sampling=SamplingParams())
+        sched.add(req)
+        assert sched.schedule().kind == "prefill"
+        admitted = len(req.pages)
+        assert admitted == sched._admission_pages(req)
+        req.generated.append(0)          # the token prefill emitted
+        free_before = sched.allocator.num_free
+        sched._ensure_decode_pages()     # first decode's page demand
+        assert sched.allocator.num_free == free_before, \
+            "admission under-charged: first decode had to allocate"
+        assert len(req.pages) == pages_for(prompt_len + 1, 8)
+
+    @pytest.mark.slow            # compiles a fresh pool-shape executable set
+    def test_exact_fill_prompt_end_to_end(self):
+        """Prompt exactly fills its last page: prefill + first decode must
+        not wedge or leak, and tokens match sequential generate."""
+        model = _llama()
+        rng = np.random.RandomState(11)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompt = rng.randint(0, vocab, (16,))    # 2 pages @ page_size 8
+        ref = _sequential_reference(model, [prompt], 4)[0]
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        rid = eng.add_request(prompt, max_new_tokens=4, temperature=0.0)
+        assert eng.run()[rid] == ref
+        assert eng.cache.allocator.num_used == 0
+
+
+# ------------------------------------------------- prefix caching engine
+
+def _shared_prefix_prompts(rng, vocab, prefix_pages, page_size, tails):
+    shared = rng.randint(0, vocab, (prefix_pages * page_size,)).tolist()
+    return [shared + rng.randint(0, vocab, (t,)).tolist() for t in tails]
+
+
+class TestPrefixCaching:
+    def test_shared_prefix_hits_and_stays_token_identical(self):
+        """THE acceptance gate: two requests share a 2-page prefix; the
+        second's prefill touches only its suffix (hit tokens == both
+        shared pages), outputs are token-identical to the cache-off
+        engine, and the pool drains to zero after an eviction flush.
+        Also the CI guard: enabling the cache adds at most ONE new
+        prefill executable per touched bucket."""
+        model = _llama()
+        rng = np.random.RandomState(21)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = _shared_prefix_prompts(rng, vocab, prefix_pages=2,
+                                         page_size=8, tails=[4, 6])
+
+        def run(flag):
+            eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                                max_seq_len=32, prefill_buckets=(16, 32),
+                                enable_prefix_caching=flag)
+            rids = [eng.add_request(p, max_new_tokens=5, temperature=0.0)
+                    for p in prompts]
+            outs = eng.run()
+            return eng, [outs[r] for r in rids]
+
+        eng_off, outs_off = run(False)
+        eng_on, outs_on = run(True)
+        assert outs_on == outs_off       # token-identical with cache on
+
+        pcs = eng_on.stats()["prefix_cache"]
+        assert pcs["hit_tokens"] >= 8 * 2        # both shared pages reused
+        assert pcs["miss_tokens"] < sum(len(p) for p in prompts)
+        assert 0.0 < pcs["hit_rate"] < 1.0
+        assert pcs["cached_pages"] > 0
+
+        # CI satellite: at most one NEW prefill executable per bucket
+        on, off = eng_on.compile_counts(), eng_off.compile_counts()
+        assert on["prefill_offset"] <= len({16, 32})
+        assert on["prefill"] <= off["prefill"]
+        assert on["decode"] == 1 and on["sample"] <= 2
+
+        # zero leaked pages once the cache lets go
+        assert eng_on.prefix_cache.flush() == pcs["cached_pages"]
+        assert eng_on.cache.allocator.num_used == 0
+        assert eng_on.cache.allocator.num_free == eng_on.cache.num_pages - 1
+
+    @pytest.mark.slow            # extra offset-bucket compile on this pool
+    def test_cache_hit_byte_identical_to_cold(self):
+        """Same prompt twice on one engine: the second run is a cache hit
+        (suffix-only prefill) yet emits byte-identical tokens."""
+        model = _llama()
+        rng = np.random.RandomState(22)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompt = rng.randint(0, vocab, (19,)).tolist()
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            enable_prefix_caching=True)
+        r_cold = eng.add_request(prompt, max_new_tokens=6, temperature=0.0)
+        eng.run()
+        r_hit = eng.add_request(prompt, max_new_tokens=6, temperature=0.0)
+        outs = eng.run()
+        assert outs[r_hit] == outs[r_cold]
+        st = eng.stats()["prefix_cache"]
+        assert st["hit_tokens"] == 16    # 2 full pages of the 19 tokens
+        ref = _sequential_reference(model, [prompt], 6)[0]
+        assert outs[r_hit] == ref
+
+    @pytest.mark.slow            # small-pool shapes compile beyond fast set
+    def test_preemption_while_shared_keeps_survivor_intact(self):
+        """Pool pressure preempts the youngest of two prefix-sharing
+        requests: the victim's release must only drop ITS references —
+        the survivor keeps decoding on the shared pages and both end
+        token-identical to sequential generate."""
+        model = _llama()
+        rng = np.random.RandomState(23)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = _shared_prefix_prompts(rng, vocab, prefix_pages=2,
+                                         page_size=8, tails=[2, 3, 5])
+        refs = _sequential_reference(model, prompts, max_new_tokens=8)
+        # 7 usable pages: the 2 shared + one private page per request fit,
+        # but copy-on-extend during decode runs the pool dry — the
+        # youngest sharer must be preempted (shared pages are pinned by
+        # the tree + survivors, so eviction cannot save it)
+        eng = ServingEngine(model, page_size=8, max_batch_size=3,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            num_pages=8, enable_prefix_caching=True)
+        rids = [eng.add_request(p, max_new_tokens=8, temperature=0.0)
+                for p in prompts]
+        outs = eng.run()
+        assert eng.stats()["preemptions"] >= 1
+        for rid, ref in zip(rids, refs):
+            assert outs[rid] == ref
+        eng.prefix_cache.flush()
+        assert eng.cache.allocator.num_used == 0
+
+    def test_stats_section_shape(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            enable_prefix_caching=True)
+        eng.add_request([1, 2, 3], max_new_tokens=2, temperature=0.0)
+        eng.run()
+        st = eng.stats()
+        assert set(st["prefix_cache"]) >= {
+            "hit_tokens", "miss_tokens", "hit_rate", "cached_pages",
+            "evictions", "lookups"}
+        # cache off: no section (semantics unchanged from PR 1)
+        eng_off = ServingEngine(model, page_size=8, max_batch_size=2,
+                                max_seq_len=32, prefill_buckets=(16, 32))
+        assert "prefix_cache" not in eng_off.stats()
 
 
 # ------------------------------------------------- paged-attention parity
@@ -211,6 +506,31 @@ class TestPagedAttentionParity:
         assert satt.paged_decode_available(16, 128)
         assert not satt.paged_decode_available(7, 128)   # ragged sublanes
         assert not satt.paged_decode_available(16, 4)    # hd too small
+
+    def test_overflow_positions_write_null_page_not_last_page(self, rng):
+        """Null-page convention regression (found by the prefix-cache
+        stress test): a suffix prefill's padding positions can exceed
+        max_pages * page_size; those writes must land in the reserved
+        null page. Clipping the PAGE INDEX instead aliases them onto the
+        sequence's real last page and corrupts resident K/V."""
+        ps, max_pages, hd = 4, 2, 8
+        pool = PagedKVCache(1, 4, ps, 1, hd)
+        pages = [pool.allocator.alloc() for _ in range(max_pages)]
+        pt = pool.page_table_array([pages], max_pages)
+        view = pool.layer_views(pt)[0]
+
+        def rand(*shape):
+            return Tensor(jnp.asarray(rng.standard_normal(shape),
+                                      jnp.float32))
+
+        # offset 4, block of 8: positions 4..11, but capacity is 8 —
+        # positions 8..11 are table overflow (padding rows)
+        q, k, v = rand(1, 8, 1, hd), rand(1, 8, 1, hd), rand(1, 8, 1, hd)
+        _, new_view = satt.paged_attend(q, k, v, view, jnp.int32(4), 1)
+        got = np.asarray(new_view.k_pool[0, pages[1]])   # positions 4..7
+        np.testing.assert_array_equal(got, np.asarray(k._data[0, :4, 0]))
+        # and the overflow really went to page 0, not nowhere
+        assert np.any(np.asarray(new_view.k_pool[0, NULL_PAGE]) != 0)
 
 
 # -------------------------------------------------- continuous batching
@@ -452,6 +772,60 @@ class TestServingSlow:
         counts = eng.compile_counts()
         assert counts["prefill"] == 3    # buckets 8, 16, 32 all touched
         assert counts["decode"] == 1
+
+    def test_gpt_prefix_caching_parity(self):
+        """GPT rides the offset prefill too: wpe positions come from the
+        traced scalar start_pos (models/gpt.py's sp.ndim == 0 branch)."""
+        model = _gpt()
+        rng = np.random.RandomState(13)
+        vocab = GPTConfig.tiny().vocab_size
+        prompts = _shared_prefix_prompts(rng, vocab, prefix_pages=2,
+                                         page_size=8, tails=[3, 7])
+        refs = _sequential_reference(model, prompts, max_new_tokens=5)
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            enable_prefix_caching=True)
+        rids = [eng.add_request(p, max_new_tokens=5, temperature=0.0)
+                for p in prompts]
+        outs = eng.run()
+        for rid, ref in zip(rids, refs):
+            assert outs[rid] == ref
+        assert eng.stats()["prefix_cache"]["hit_tokens"] >= 16
+
+    def test_large_pool_eviction_stress(self):
+        """Eviction stress: a stream of requests with rotating shared
+        prefixes through a pool too small to cache them all. The LRU
+        evictor must recycle cold prefixes (evictions > 0), every request
+        must stay token-identical to sequential generate, and the pool
+        must drain to zero after the final flush."""
+        model = _llama()
+        rng = np.random.RandomState(17)
+        vocab = LlamaConfig.tiny().vocab_size
+        families = [rng.randint(0, vocab, (16,)).tolist()
+                    for _ in range(3)]   # 3 distinct 2-page prefixes
+        prompts = [fam + rng.randint(0, vocab, (2 + i,)).tolist()
+                   for i, fam in enumerate(families * 3)]
+        refs = _sequential_reference(model, prompts, max_new_tokens=4)
+        # 9 usable pages; three cached 2-page families plus a running
+        # request's private pages overflow the pool, forcing the LRU
+        # evictor to recycle cold prefixes mid-stream
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            num_pages=10, enable_prefix_caching=True)
+        outs = {}
+        for burst in range(3):           # arrival bursts: 3 requests each
+            rids = [eng.add_request(p, max_new_tokens=4, temperature=0.0)
+                    for p in prompts[burst * 3:(burst + 1) * 3]]
+            outs.update(eng.run())
+        flat_rids = sorted(outs)
+        for rid, ref in zip(flat_rids, refs):
+            assert outs[rid] == ref, f"request {rid} diverged"
+        st = eng.stats()["prefix_cache"]
+        assert st["evictions"] > 0, st
+        assert st["hit_tokens"] > 0, st
+        eng.prefix_cache.flush()
+        assert eng.cache.allocator.num_used == 0
+        assert eng.cache.allocator.num_free == eng.cache.num_pages - 1
 
     def test_compile_events_via_jax_monitoring(self):
         """Secondary compile-count signal straight from jax.monitoring:

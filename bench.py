@@ -240,34 +240,63 @@ def _run_gates(on_tpu: bool) -> dict:
     return gates
 
 
+def _gen_bench_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "generation_bench",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "benchmarks", "generation_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tiny_serving_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
 def _run_serving_prefix(on_tpu: bool) -> dict:
     """Shared-system-prompt serving phase: ttft with the prefix cache on
     vs off plus hit rate (benchmarks/generation_bench.py's phase, reused
     here so the driver bench reports cache efficacy alongside MFU).
     Non-fatal: a failure is recorded, not raised."""
-    import importlib.util
-
     try:
-        spec = importlib.util.spec_from_file_location(
-            "generation_bench",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "benchmarks", "generation_bench.py"))
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-
-        import paddle_tpu as paddle
-        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-
-        paddle.seed(0)
-        cfg = LlamaConfig.tiny()
-        model = LlamaForCausalLM(cfg)
-        model.eval()
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
         out = mod.serving_prefix_phase(model, cfg, on_tpu)
         _log(f"phase=serving_prefix: ttft {out['ttft_cache_off_ms']}ms -> "
              f"{out['ttft_cache_on_ms']}ms (hit rate {out['hit_rate']})")
         return out
     except Exception as e:  # noqa: BLE001 — bench must degrade, not die
         _log(f"phase=serving_prefix: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
+def _run_serving_decode(on_tpu: bool) -> dict:
+    """Decode-horizon serving phase: steady-state scheduled decode
+    tokens/s and host syncs per token at horizon 1 vs 8 (the fused
+    decode+sample block + async overlap). Non-fatal like the phases
+    around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_decode_phase(model, cfg, on_tpu)
+        _log(f"phase=serving_decode: "
+             f"{out['horizon_1']['decode_tokens_per_s']} tok/s @h1 -> "
+             f"{out['horizon_8']['decode_tokens_per_s']} tok/s @h8 "
+             f"(syncs/token {out['horizon_1']['syncs_per_token']} -> "
+             f"{out['horizon_8']['syncs_per_token']})")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_decode: FAIL {type(e).__name__}: {e}")
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
@@ -459,6 +488,10 @@ def bench_child() -> None:
     # serving prefix-cache phase: tiny model, bounded budget, non-fatal
     _enter_phase("serving_prefix", 400.0)
     serving_prefix = _run_serving_prefix(on_tpu)
+
+    # decode-horizon serving phase: same tiny model budget, non-fatal
+    _enter_phase("serving_decode", 400.0)
+    serving_decode = _run_serving_decode(on_tpu)
     _enter_phase("build")
 
     if on_tpu:
@@ -590,6 +623,7 @@ def bench_child() -> None:
                 "phase": phase,
                 "gates": gates,
                 "serving_prefix": serving_prefix,
+                "serving_decode": serving_decode,
             },
         }
 

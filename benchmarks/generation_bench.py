@@ -4,8 +4,10 @@ Measures tokens/sec for LLaMA-tiny (CPU smoke) or a larger LLaMA config on
 TPU, separating prefill latency from steady-state decode; then a serving
 phase drives `ServingEngine` on a shared-system-prompt workload and
 reports mean ttft with the prefix cache on vs off (plus the hit rate), so
-one run shows what radix KV reuse buys on prefill-bound traffic. Run
-directly:
+one run shows what radix KV reuse buys on prefill-bound traffic; finally
+a serving_decode phase measures steady-state scheduled decode tokens/s
+and host-sync counts at decode_horizon 1 vs 8 (the fused multi-token
+decode block + async host/device overlap). Run directly:
 
     python benchmarks/generation_bench.py [--cpu]
 
@@ -74,7 +76,8 @@ def main():
                    "batch": batch, "prompt": prompt, "new_tokens": new,
                    "decode_ms_per_token": round(decode_s_per_tok * 1000, 2),
                    "prefill_ms": round(prefill_s * 1000, 2),
-                   "serving_prefix": serving_prefix_phase(m, cfg, on_tpu)},
+                   "serving_prefix": serving_prefix_phase(m, cfg, on_tpu),
+                   "serving_decode": serving_decode_phase(m, cfg, on_tpu)},
     }))
 
 
@@ -129,6 +132,61 @@ def serving_prefix_phase(model, cfg, on_tpu):
         "wall_on_ms": round(wall_on * 1000, 2),
         "hit_rate": round(pc["hit_rate"], 4) if pc else None,
         "evictions": pc["evictions"] if pc else None,
+    }
+
+
+def serving_decode_phase(model, cfg, on_tpu):
+    """Steady-state SCHEDULED decode at decode_horizon 1 vs 8: a full
+    batch of concurrent requests, wall-clocked over the decode-dominated
+    region (tiny prompts, long generations). Reports decode tokens/s,
+    host syncs, and syncs per generated token — the horizon should cut
+    syncs/token to ~1/8 and raise throughput."""
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.RandomState(7)
+    page_size = 16 if on_tpu else 8
+    max_seq = min(cfg.max_position_embeddings, 512 if on_tpu else 128)
+    n_req = 4
+    new_tokens = 96 if on_tpu else 48
+    prompts = [rng.randint(0, cfg.vocab_size, (12,)).tolist()
+               for _ in range(n_req)]
+
+    def run(h):
+        eng = ServingEngine(model, page_size=page_size,
+                            max_batch_size=n_req, max_seq_len=max_seq,
+                            decode_horizon=h)
+        for p in prompts:            # warm wave: compiles + cache warmup
+            eng.add_request(p, max_new_tokens=new_tokens)
+        eng.run()
+        syncs0 = eng.stats()["host_syncs"]
+        toks0 = eng.stats()["tokens_generated"]
+        t0 = time.perf_counter()
+        for p in prompts:            # measured wave: steady state
+            eng.add_request(p, max_new_tokens=new_tokens)
+        eng.run()
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        syncs = st["host_syncs"] - syncs0
+        toks = st["tokens_generated"] - toks0
+        return {"decode_tokens_per_s": round(toks / wall, 1),
+                "wall_ms": round(wall * 1000, 2),
+                "host_syncs": syncs,
+                "syncs_per_token": round(syncs / toks, 4),
+                "tokens": toks}
+
+    h1, h8 = run(1), run(8)
+    return {
+        "requests": n_req, "new_tokens": new_tokens,
+        "horizon_1": h1, "horizon_8": h8,
+        "decode_speedup": round(
+            h8["decode_tokens_per_s"] / max(h1["decode_tokens_per_s"],
+                                            1e-9), 2),
+        "sync_reduction": round(
+            h1["syncs_per_token"] / max(h8["syncs_per_token"], 1e-9), 2),
     }
 
 

@@ -18,16 +18,21 @@ decoder models (LLaMA, GPT) with:
   request whose prompt starts with a cached prefix prefills only its
   suffix (`ServingEngine(enable_prefix_caching=True)`);
 - `engine`: `ServingEngine.add_request/step/stream/run` plus per-request
-  latency/throughput counters exported through paddle_tpu.profiler.
+  latency/throughput counters exported through paddle_tpu.profiler. The
+  decode hot path runs a fused decode+sample block of `decode_horizon`
+  steps per jitted dispatch (device PRNG/EOS state, async host/device
+  overlap), syncing the host once per block instead of once per token.
 
 See README.md "paddle_tpu.serving" for knobs and parity notes.
 """
 from .attention import (  # noqa: F401
-    paged_attend, paged_decode_attention, paged_decode_available,
+    advance_positions, paged_attend, paged_decode_attention,
+    paged_decode_available,
 )
-from .engine import ServingEngine  # noqa: F401
+from .engine import PAD_TOKEN, ServingEngine  # noqa: F401
 from .kv_cache import (  # noqa: F401
-    BlockAllocator, NULL_PAGE, PagedKVCache, PagedLayerCache, pages_for,
+    BlockAllocator, NULL_PAGE, PagedKVCache, PagedLayerCache,
+    overflow_position, pages_for,
 )
 from .prefix_cache import PrefixCache, PrefixNode  # noqa: F401
 from .scheduler import (  # noqa: F401
@@ -39,5 +44,6 @@ __all__ = [
     "PrefixCache", "PrefixNode",
     "Scheduler", "ScheduleDecision", "Request", "SamplingParams",
     "paged_attend", "paged_decode_attention", "paged_decode_available",
-    "pages_for", "NULL_PAGE",
+    "advance_positions", "pages_for", "overflow_position",
+    "NULL_PAGE", "PAD_TOKEN",
 ]

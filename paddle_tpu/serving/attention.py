@@ -26,10 +26,10 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from .kv_cache import NULL_PAGE, PagedLayerCache
+from .kv_cache import NULL_PAGE, PagedLayerCache, overflow_position
 
 __all__ = ["paged_attend", "paged_decode_attention",
-           "paged_decode_available", "KERNEL_MODE"]
+           "paged_decode_available", "advance_positions", "KERNEL_MODE"]
 
 # "auto": Pallas kernel on TPU, jnp reference elsewhere; "off": always the
 # reference; "interpret": run the Pallas kernel in interpret mode (hermetic
@@ -47,6 +47,20 @@ def paged_decode_available(page_size: int, head_dim: int) -> bool:
     """Shape gates for the Pallas decode kernel: page rows must tile the
     8-sublane axis, head_dim anything pad-able to 128 lanes."""
     return page_size % 8 == 0 and 8 <= head_dim <= 256
+
+
+def advance_positions(positions, live, max_pages: int,
+                      page_size: int) -> jnp.ndarray:
+    """Device-side position advance for the multi-step decode horizon:
+    live rows step to the next token position; dead rows (EOS emitted,
+    budget exhausted, batch padding) park at the table-overflow position,
+    which `paged_attend` routes to the null page — so a fused decode
+    block never needs a host decision to stop a finished row's writes.
+
+    positions: (b,) int32 current write positions; live: (b,) bool.
+    """
+    park = jnp.int32(overflow_position(max_pages, page_size))
+    return jnp.where(live, positions + jnp.int32(1), park)
 
 
 def _positions(start_pos, b: int, s: int) -> jnp.ndarray:

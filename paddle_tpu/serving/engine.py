@@ -8,12 +8,24 @@ jitted dispatch):
   bucket; one request per prefill step) — plus, when
   `enable_prefix_caching=True`, ONE offset-aware variant per bucket that
   prefills only the suffix left uncovered by the radix prefix cache
-  (shared pages ride in through the page table, see prefix_cache.py);
-- ONE decode executable: a fixed (max_batch_size,) token batch where each
-  row carries its own position and page table row (the ragged paged
-  attention path), padding rows aimed at the null page;
-- one sampler executable per batch shape (temperature/top-k/top-p ride as
-  traced per-row arrays, so mixed sampling params never recompile).
+  (shared pages ride in through the page table, see prefix_cache.py).
+  Sampling is fused into the prefill executable (per-row PRNG key state
+  rides in as device key data);
+- ONE fused decode+sample executable per decode horizon: a
+  `decode_horizon=N` block runs N decode iterations inside one jitted
+  `lax.scan` — model step, sampling (traced per-row temperature/top-k/
+  top-p, device PRNG key state), EOS/budget masking, and position
+  advance through the page table all on device — and returns an (b, N)
+  token block. Rows that finish mid-block emit PAD and park their write
+  position at the table-overflow slot (routed to the null page), so the
+  host syncs ONCE per N tokens instead of once per token;
+- async host/device overlap: the engine dispatches block k+1 (inputs
+  taken straight from block k's device-resident carries) BEFORE pulling
+  block k's tokens to the host, so Python bookkeeping and scheduling
+  run while the device computes. The scheduler reserves each block's
+  pages up front (`_ensure_decode_pages` with in-flight upper bounds)
+  and drains the pipeline before any preemption, keeping emitted
+  streams token-identical to `decode_horizon=1`.
 
 The engine talks to any decoder model that follows the
 `forward(input_ids, caches=..., start_pos=...)` cache protocol of
@@ -22,8 +34,10 @@ are `PagedLayerCache` views, which `attend_with_cache` dispatches to the
 ragged paged attention op.
 
 Per-request latency/throughput counters are recorded through
-paddle_tpu.profiler (RecordEvent spans "serving.prefill"/"serving.decode"
-line up in profiler traces) and summarized by `stats()`.
+paddle_tpu.profiler (RecordEvent spans "serving.prefill" /
+"serving.decode_block" / "serving.host_drain" line up in profiler
+traces) and summarized by `stats()` — `host_syncs` and
+`tokens_per_sync` make the decode-horizon batching visible.
 """
 from __future__ import annotations
 
@@ -37,11 +51,17 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..jit.functional import call_functional, extract_state
 from ..profiler import RecordEvent
-from .kv_cache import PagedKVCache, PagedLayerCache, pages_for
+from .attention import advance_positions
+from .kv_cache import (PagedKVCache, PagedLayerCache, overflow_position,
+                       pages_for)
 from .prefix_cache import PrefixCache
 from .scheduler import Request, SamplingParams, Scheduler
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "PAD_TOKEN"]
+
+# emitted by dead rows inside a decode block (finished / padding); the
+# host drain trims each row at its first PAD
+PAD_TOKEN = -1
 
 
 def _default_buckets(max_seq_len: int) -> Tuple[int, ...]:
@@ -83,6 +103,15 @@ def _sample_batch(logits, keys, temps, top_ks, top_ps):
     return jnp.where(temps == 0.0, greedy, sampled)
 
 
+def _split_rows(key_data):
+    """One split per row, entirely on device: key_data (b, 2) uint32 ->
+    (new key_data, sample keys). Bit-identical to the host-side
+    `jax.random.split` chain the pre-horizon sampler ran per token."""
+    keys = jax.random.wrap_key_data(key_data)
+    pair = jax.vmap(jax.random.split)(keys)
+    return jax.random.key_data(pair[:, 0]), pair[:, 1]
+
+
 class ServingEngine:
     def __init__(self, model, *, page_size: int = 16,
                  num_pages: Optional[int] = None,
@@ -90,7 +119,8 @@ class ServingEngine:
                  max_seq_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  cache_dtype=jnp.float32,
-                 enable_prefix_caching: bool = False):
+                 enable_prefix_caching: bool = False,
+                 decode_horizon: int = 8):
         from ..models.generation import _config_of
 
         self.model = model
@@ -100,6 +130,9 @@ class ServingEngine:
         self.max_batch_size = max_batch_size
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
         self.max_pages_per_seq = pages_for(self.max_seq_len, page_size)
+        self.decode_horizon = int(decode_horizon)
+        if self.decode_horizon < 1:
+            raise ValueError("decode_horizon must be >= 1")
         if num_pages is None:
             # worst case every slot runs a full-length sequence, +1 null
             num_pages = max_batch_size * self.max_pages_per_seq + 1
@@ -113,7 +146,9 @@ class ServingEngine:
                              if enable_prefix_caching else None)
         self.scheduler = Scheduler(self.cache.allocator, page_size,
                                    max_batch_size, self.max_pages_per_seq,
-                                   prefix_cache=self.prefix_cache)
+                                   prefix_cache=self.prefix_cache,
+                                   decode_horizon=self.decode_horizon,
+                                   drain_hook=self._drain_for_scheduler)
         self.prefill_buckets = tuple(sorted(
             prefill_buckets or _default_buckets(self.max_seq_len)))
         if self.prefill_buckets[-1] < self.max_seq_len:
@@ -122,7 +157,17 @@ class ServingEngine:
                              "full current length)")
         self.params, self.buffers = extract_state(model)
         self.requests: Dict[int, Request] = {}
-        self._keys: Dict[int, jax.Array] = {}
+        # per-request PRNG state as raw (2,) uint32 key data, resident on
+        # device — sampling never splits keys on the host
+        self._key_state: Dict[int, jax.Array] = {}
+        # the dispatched-but-undrained decode block (async overlap depth
+        # 1): emitted tokens + the device carries the next chained block
+        # consumes without any host round-trip
+        self._pending: Optional[dict] = None
+        # events produced when the scheduler's drain_hook fires inside
+        # schedule(); step() returns them ahead of its own
+        self._spill: List[Tuple[int, int]] = []
+        self._last_drain_t = 0.0
         # jitted steps are memoized ON THE MODEL (generation.py's trick):
         # the closures only capture `model`, so engines over the same model
         # — restarts, tests, multiple pools — share compiled executables,
@@ -131,13 +176,16 @@ class ServingEngine:
             "_serving_jit_cache", {})
         # this engine's distinct per-family input avals == its jit cache
         # misses (the shared caches' _cache_size would count OTHER
-        # engines' shapes too); compile_counts() reports these
+        # engines' shapes too); compile_counts() reports these. "sample"
+        # stays for compatibility: sampling is fused into prefill/decode,
+        # so it counts the (now extinct) standalone sampler dispatches
         self._exec_shapes: Dict[str, set] = {
             "prefill": set(), "prefill_offset": set(), "decode": set(),
             "sample": set()}
         self._stats = {"prefill_steps": 0, "decode_steps": 0,
                        "tokens_generated": 0, "prefill_time_s": 0.0,
-                       "decode_time_s": 0.0, "preemptions": 0}
+                       "decode_time_s": 0.0, "preemptions": 0,
+                       "host_syncs": 0}
 
     # ----------------------------------------------------------- request API
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
@@ -145,7 +193,9 @@ class ServingEngine:
                     top_p: float = 1.0, seed: Optional[int] = None,
                     eos_token_id: Optional[int] = None) -> int:
         """Queue one prompt; returns a request id. Non-blocking — the
-        request runs as `step()`/`stream()` turn the crank."""
+        request runs as `step()`/`stream()` turn the crank. ALL
+        validation happens up front: a rejected request leaves no trace
+        (no page allocation, no engine/scheduler registration)."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -154,15 +204,25 @@ class ServingEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len "
                 f"{self.max_seq_len}")
+        if len(prompt) > self.prefill_buckets[-1]:
+            # belt over the constructor's buckets-cover-max_seq_len check:
+            # admitting this request would allocate pages and then blow up
+            # in _bucket_for mid-prefill, leaking them
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest "
+                f"prefill bucket {self.prefill_buckets[-1]}")
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       sampling=SamplingParams(temperature, top_k, top_p,
                                               seed),
                       eos_token_id=eos_token_id)
+        # scheduler.add validates the page budget and may raise — only
+        # register the request with the engine once it is accepted
+        self.scheduler.add(req)
         self.requests[req.request_id] = req
         if seed is None:
             seed = int(np.random.randint(0, 2 ** 31 - 1))
-        self._keys[req.request_id] = jax.random.key(seed)
-        self.scheduler.add(req)
+        self._key_state[req.request_id] = jax.random.key_data(
+            jax.random.key(seed))
         return req.request_id
 
     def output(self, request_id: int) -> List[int]:
@@ -174,21 +234,29 @@ class ServingEngine:
 
     # ---------------------------------------------------------------- steps
     def step(self) -> List[Tuple[int, int]]:
-        """One scheduler decision + one jitted model step. Returns the
-        (request_id, token) pairs emitted this step."""
-        decision = self.scheduler.schedule()
+        """One scheduler decision + (at most) one jitted dispatch.
+        Returns the (request_id, token) pairs that reached the host this
+        step — with a decode horizon and async overlap, a decode block's
+        tokens surface one step AFTER its dispatch (the drain overlaps
+        the next block's device time)."""
+        decision = self.scheduler.schedule()   # drain_hook may spill here
+        spilled, self._spill = self._spill, []
         if decision.kind == "prefill":
-            return self._prefill(decision.prefill)
+            return spilled + self._prefill(decision.prefill)
         if decision.kind == "decode":
-            return self._decode(decision.decode)
-        return []
+            return spilled + self._decode(decision.decode)
+        return spilled + self._drain_pending()
 
     def stream(self):
         """Generator of (request_id, token, done) events until every
         queued request completes."""
-        while self.scheduler.has_work():
-            for rid, tok in self.step():
-                yield rid, tok, self.requests[rid].status == "finished"
+        while self.scheduler.has_work() or self._pending is not None:
+            events = (self.step() if self.scheduler.has_work()
+                      else self._drain_pending())
+            for i, (rid, tok) in enumerate(events):
+                done = (self.requests[rid].status == "finished"
+                        and all(r != rid for r, _ in events[i + 1:]))
+                yield rid, tok, done
 
     def run(self) -> Dict[int, List[int]]:
         """Drain all queued requests; returns request_id -> full tokens."""
@@ -208,7 +276,8 @@ class ServingEngine:
         if key not in self._jit_cache:
             model = self.model
 
-            def prefill(params, buffers, ids, pools, page_table, last_idx):
+            def prefill(params, buffers, ids, pools, page_table, last_idx,
+                        key_data, temps, top_ks, top_ps):
                 views = [PagedLayerCache(kp, vp, page_table)
                          for kp, vp in pools]
                 (logits, new_views), _ = call_functional(
@@ -217,7 +286,10 @@ class ServingEngine:
                     training=False)
                 last = jax.lax.dynamic_slice_in_dim(
                     logits, last_idx, 1, axis=1)[:, 0]
-                return last, [(v.k_pool, v.v_pool) for v in new_views]
+                key_data, subs = _split_rows(key_data)
+                tok = _sample_batch(last, subs, temps, top_ks, top_ps)
+                return (tok.astype(jnp.int32), key_data,
+                        [(v.k_pool, v.v_pool) for v in new_views])
 
             self._jit_cache[key] = jax.jit(prefill, donate_argnums=(3,))
         return self._jit_cache[key]
@@ -233,7 +305,7 @@ class ServingEngine:
             model = self.model
 
             def prefill(params, buffers, ids, pools, page_table, last_idx,
-                        offset):
+                        offset, key_data, temps, top_ks, top_ps):
                 views = [PagedLayerCache(kp, vp, page_table)
                          for kp, vp in pools]
                 (logits, new_views), _ = call_functional(
@@ -242,41 +314,13 @@ class ServingEngine:
                     training=False)
                 last = jax.lax.dynamic_slice_in_dim(
                     logits, last_idx, 1, axis=1)[:, 0]
-                return last, [(v.k_pool, v.v_pool) for v in new_views]
+                key_data, subs = _split_rows(key_data)
+                tok = _sample_batch(last, subs, temps, top_ks, top_ps)
+                return (tok.astype(jnp.int32), key_data,
+                        [(v.k_pool, v.v_pool) for v in new_views])
 
             self._jit_cache[key] = jax.jit(prefill, donate_argnums=(3,))
         return self._jit_cache[key]
-
-    def _sample_jit(self):
-        if "sample" not in self._jit_cache:
-            self._jit_cache["sample"] = jax.jit(_sample_batch)
-        return self._jit_cache["sample"]
-
-    def _next_key(self, rid: int) -> jax.Array:
-        key, sub = jax.random.split(self._keys[rid])
-        self._keys[rid] = key
-        return sub
-
-    def _sample_rows(self, logits, reqs: Sequence[Request]) -> np.ndarray:
-        """Sample one token per row; rows beyond len(reqs) are padding."""
-        b = logits.shape[0]
-        temps = np.zeros((b,), np.float32)
-        top_ks = np.zeros((b,), np.int32)
-        top_ps = np.ones((b,), np.float32)
-        keys = []
-        for i, req in enumerate(reqs):
-            sp = req.sampling
-            temps[i] = sp.temperature
-            top_ks[i] = sp.top_k
-            top_ps[i] = sp.top_p
-            keys.append(self._next_key(req.request_id))
-        for _ in range(b - len(reqs)):
-            keys.append(jax.random.key(0))
-        self._exec_shapes["sample"].add(tuple(logits.shape))
-        toks = self._sample_jit()(
-            logits, jnp.stack(keys), jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps))
-        return np.asarray(toks)
 
     def _emit(self, req: Request, token: int, now: float
               ) -> Tuple[int, int]:
@@ -303,20 +347,28 @@ class ServingEngine:
         ids[0, :len(suffix)] = suffix
         page_table = self.cache.page_table_array([req.pages],
                                                  self.max_pages_per_seq)
+        sp = req.sampling
+        knobs = (jnp.asarray([sp.temperature], jnp.float32),
+                 jnp.asarray([sp.top_k], jnp.int32),
+                 jnp.asarray([sp.top_p], jnp.float32))
+        key_data = self._key_state[req.request_id][None]
         t0 = time.perf_counter()
         with RecordEvent("serving.prefill"):
             if n_cached:
-                last_logits, pools = self._prefill_offset_jit(bucket)(
+                tok, new_kd, pools = self._prefill_offset_jit(bucket)(
                     self.params, self.buffers, jnp.asarray(ids),
                     self.cache.pools, page_table,
-                    jnp.int32(len(suffix) - 1), jnp.int32(n_cached))
+                    jnp.int32(len(suffix) - 1), jnp.int32(n_cached),
+                    key_data, *knobs)
             else:
-                last_logits, pools = self._prefill_jit(bucket)(
+                tok, new_kd, pools = self._prefill_jit(bucket)(
                     self.params, self.buffers, jnp.asarray(ids),
                     self.cache.pools, page_table,
-                    jnp.int32(len(suffix) - 1))
+                    jnp.int32(len(suffix) - 1), key_data, *knobs)
             self.cache.pools = pools
-            token = int(self._sample_rows(last_logits, [req])[0])
+            self._key_state[req.request_id] = new_kd[0]
+            token = int(np.asarray(tok)[0])
+        self._stats["host_syncs"] += 1
         if self.prefix_cache is not None:
             # register the prompt's full pages for future reuse (the
             # partial last page never enters the tree); in-flight
@@ -328,53 +380,187 @@ class ServingEngine:
         return [self._emit(req, token, now)]
 
     # --------------------------------------------------------------- decode
-    def _decode_jit(self):
-        if "decode" not in self._jit_cache:
+    def _decode_block_jit(self, horizon: int):
+        """ONE fused decode+sample executable per horizon: N model steps
+        + sampling + EOS/budget masking + position advance inside one
+        jitted lax.scan. Returns the (b, N) emitted block plus the
+        device carries (tokens/positions/keys/budgets) the next chained
+        block consumes without a host round-trip."""
+        key = ("decode", horizon)
+        if key not in self._jit_cache:
             model = self.model
+            page_size = self.page_size
 
-            def decode(params, buffers, tokens, pools, page_tables,
-                       positions):
-                views = [PagedLayerCache(kp, vp, page_tables)
-                         for kp, vp in pools]
-                (logits, new_views), _ = call_functional(
-                    model, params, buffers, (Tensor(tokens[:, None]),),
-                    kwargs={"caches": views, "start_pos": positions},
-                    training=False)
-                return logits[:, 0], [(v.k_pool, v.v_pool)
-                                      for v in new_views]
+            def decode_block(params, buffers, tokens, pools, page_tables,
+                             positions, key_data, temps, top_ks, top_ps,
+                             eos_ids, remaining):
+                max_pages = page_tables.shape[1]
 
-            self._jit_cache["decode"] = jax.jit(decode, donate_argnums=(3,))
-        return self._jit_cache["decode"]
+                def body(carry, _):
+                    tokens, pools, positions, key_data, remaining = carry
+                    views = [PagedLayerCache(kp, vp, page_tables)
+                             for kp, vp in pools]
+                    (logits, new_views), _ = call_functional(
+                        model, params, buffers, (Tensor(tokens[:, None]),),
+                        kwargs={"caches": views, "start_pos": positions},
+                        training=False)
+                    pools = [(v.k_pool, v.v_pool) for v in new_views]
+                    key_data, subs = _split_rows(key_data)
+                    nxt = _sample_batch(logits[:, 0], subs, temps,
+                                        top_ks, top_ps).astype(jnp.int32)
+                    alive = remaining > 0
+                    hit_eos = alive & (eos_ids >= 0) & (nxt == eos_ids)
+                    emit = jnp.where(alive, nxt, jnp.int32(PAD_TOKEN))
+                    remaining = jnp.where(alive, remaining - 1, remaining)
+                    remaining = jnp.where(hit_eos, jnp.int32(0), remaining)
+                    tokens = jnp.where(alive, nxt, tokens)
+                    positions = advance_positions(
+                        positions, remaining > 0, max_pages, page_size)
+                    return (tokens, pools, positions, key_data,
+                            remaining), emit
+
+                carry = (tokens, pools, positions, key_data, remaining)
+                (tokens, pools, positions, key_data, remaining), emitted = \
+                    jax.lax.scan(body, carry, None, length=horizon)
+                return (jnp.transpose(emitted), pools, tokens, positions,
+                        key_data, remaining)
+
+            self._jit_cache[key] = jax.jit(decode_block,
+                                           donate_argnums=(3,))
+        return self._jit_cache[key]
 
     def _decode(self, reqs: Sequence[Request]) -> List[Tuple[int, int]]:
-        b = self.max_batch_size
+        reqs = [r for r in reqs if r.status == "running"]
+        if not reqs:
+            return self._drain_pending()
+        b, h = self.max_batch_size, self.decode_horizon
+        rids = tuple(r.request_id for r in reqs)
+        events_prev: List[Tuple[int, int]] = []
+        prev = self._pending
+        if prev is not None and prev["rids"] != rids:
+            # batch composition changed (admission/finish/preemption):
+            # the chained carries no longer line up — sync and go fresh
+            events_prev = self._drain_pending()
+            reqs = [r for r in reqs if r.status == "running"]
+            if not reqs:
+                return events_prev
+            rids = tuple(r.request_id for r in reqs)
+            prev = None
         self._exec_shapes["decode"].add(
-            (b, self.cache.num_pages, self.max_pages_per_seq))
-        tokens = np.zeros((b,), np.int32)
-        positions = np.zeros((b,), np.int32)
+            (b, h, self.cache.num_pages, self.max_pages_per_seq))
         page_lists: List[Sequence[int]] = [()] * b
         for i, req in enumerate(reqs):
-            last = (req.generated[-1] if req.generated
-                    else req.prompt[-1])
-            tokens[i] = last
-            # the input token's K/V lands at its own position; the step
-            # predicts the token after it
-            positions[i] = req.num_tokens - 1
             page_lists[i] = req.pages
         page_tables = self.cache.page_table_array(page_lists,
                                                   self.max_pages_per_seq)
+        if prev is None:
+            # fresh block: inputs from (drained, accurate) host state
+            park = overflow_position(self.max_pages_per_seq,
+                                     self.page_size)
+            tokens = np.zeros((b,), np.int32)
+            positions = np.full((b,), park, np.int32)
+            remaining = np.zeros((b,), np.int32)
+            temps = np.zeros((b,), np.float32)
+            top_ks = np.zeros((b,), np.int32)
+            top_ps = np.ones((b,), np.float32)
+            eos_ids = np.full((b,), PAD_TOKEN, np.int32)
+            kds = []
+            for i, req in enumerate(reqs):
+                tokens[i] = (req.generated[-1] if req.generated
+                             else req.prompt[-1])
+                # the input token's K/V lands at its own position; the
+                # step predicts the token after it
+                positions[i] = req.num_tokens - 1
+                remaining[i] = req.max_new_tokens - len(req.generated)
+                sp = req.sampling
+                temps[i], top_ks[i], top_ps[i] = (sp.temperature,
+                                                  sp.top_k, sp.top_p)
+                if req.eos_token_id is not None:
+                    eos_ids[i] = req.eos_token_id
+                kds.append(self._key_state[req.request_id])
+            kds.extend([jnp.zeros((2,), jnp.uint32)] * (b - len(reqs)))
+            knobs = (jnp.asarray(temps), jnp.asarray(top_ks),
+                     jnp.asarray(top_ps), jnp.asarray(eos_ids))
+            tokens = jnp.asarray(tokens)
+            positions = jnp.asarray(positions)
+            remaining = jnp.asarray(remaining)
+            key_data = jnp.stack(kds)
+        else:
+            # chained block: consume the pending block's device carries —
+            # no host sync anywhere on this path
+            tokens, positions = prev["tokens"], prev["positions"]
+            key_data, remaining = prev["key_data"], prev["remaining"]
+            knobs = prev["knobs"]
+        # in-flight accounting: the block may add up to min(h, budget)
+        # tokens per row before the host sees them; _ensure_decode_pages
+        # reserves against this bound before the NEXT block
+        incr = []
+        for req in reqs:
+            cap = req.max_new_tokens - len(req.generated) - req.inflight
+            n = max(min(h, cap), 0)
+            req.inflight += n
+            incr.append(n)
         t0 = time.perf_counter()
-        with RecordEvent("serving.decode"):
-            logits, pools = self._decode_jit()(
-                self.params, self.buffers, jnp.asarray(tokens),
-                self.cache.pools, page_tables, jnp.asarray(positions))
+        with RecordEvent("serving.decode_block"):
+            emitted, pools, tokens, positions, key_data, remaining = \
+                self._decode_block_jit(h)(
+                    self.params, self.buffers, tokens, self.cache.pools,
+                    page_tables, positions, key_data, *knobs, remaining)
             self.cache.pools = pools
-            toks = self._sample_rows(logits, reqs)
-        now = time.perf_counter()
         self._stats["decode_steps"] += 1
-        self._stats["decode_time_s"] += now - t0
-        return [self._emit(req, int(toks[i]), now)
-                for i, req in enumerate(reqs)]
+        self._pending = {
+            "rids": rids, "reqs": list(reqs), "incr": incr,
+            "emitted": emitted, "tokens": tokens, "positions": positions,
+            "key_data": key_data, "remaining": remaining, "knobs": knobs,
+            "t0": t0,
+        }
+        if prev is not None:
+            # async overlap: block k+1 is dispatched and running; pulling
+            # block k's tokens now costs (at most) the device time block
+            # k+1 is already spending
+            return events_prev + self._drain_record(prev)
+        return events_prev
+
+    # ---------------------------------------------------------------- drain
+    def _drain_for_scheduler(self) -> None:
+        """Scheduler drain_hook: the emitted events surface through
+        step()'s spill queue so callers still see every token."""
+        self._spill.extend(self._drain_pending())
+
+    def _drain_pending(self) -> List[Tuple[int, int]]:
+        rec, self._pending = self._pending, None
+        if rec is None:
+            return []
+        return self._drain_record(rec)
+
+    def _drain_record(self, rec: dict) -> List[Tuple[int, int]]:
+        """THE host sync: pull one block's (b, N) token buffer, append
+        per-request tokens trimmed at EOS/budget (device already masked
+        past-the-end steps to PAD), finish requests, refresh per-request
+        key state from the block's device carries."""
+        with RecordEvent("serving.host_drain"):
+            toks = np.asarray(jax.device_get(rec["emitted"]))
+        self._stats["host_syncs"] += 1
+        now = time.perf_counter()
+        kd = rec["key_data"]
+        events: List[Tuple[int, int]] = []
+        for i, req in enumerate(rec["reqs"]):
+            req.inflight = max(req.inflight - rec["incr"][i], 0)
+            self._key_state[req.request_id] = kd[i]
+            if req.status != "running":
+                continue
+            for t in toks[i]:
+                t = int(t)
+                if t == PAD_TOKEN:
+                    break
+                events.append(self._emit(req, t, now))
+                if req.status != "running":
+                    break
+        # decode wall time without double-counting overlapped block spans
+        start = max(rec["t0"], self._last_drain_t)
+        self._stats["decode_time_s"] += max(now - start, 0.0)
+        self._last_drain_t = now
+        return events
 
     # -------------------------------------------------------------- metrics
     def stats(self) -> Dict[str, object]:
@@ -384,6 +570,10 @@ class ServingEngine:
         dt = s["decode_time_s"]
         s["decode_tokens_per_s"] = (
             s["tokens_generated"] / dt if dt > 0 else 0.0)
+        s["decode_horizon"] = self.decode_horizon
+        s["tokens_per_sync"] = (
+            s["tokens_generated"] / s["host_syncs"]
+            if s["host_syncs"] else 0.0)
         s["num_requests"] = len(self.requests)
         s["num_finished"] = sum(r.status == "finished"
                                 for r in self.requests.values())
@@ -405,10 +595,11 @@ class ServingEngine:
 
     def compile_counts(self) -> Dict[str, int]:
         """Distinct executables THIS engine's step stream needs, i.e. its
-        jit-cache miss count per family (prefill buckets, decode, sampler
-        shapes) — the serving tests assert these stay bounded. Counted
-        from the engine's own input avals because the underlying compiled
-        caches are deliberately shared across engines on the same model."""
+        jit-cache miss count per family (prefill buckets, one fused
+        decode+sample block per horizon) — the serving tests assert these
+        stay bounded. Counted from the engine's own input avals because
+        the underlying compiled caches are deliberately shared across
+        engines on the same model."""
         counts = {name: len(shapes)
                   for name, shapes in self._exec_shapes.items()}
         counts["total"] = sum(counts.values())

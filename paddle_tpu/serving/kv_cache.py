@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PagedLayerCache",
-           "NULL_PAGE", "pages_for"]
+           "NULL_PAGE", "pages_for", "overflow_position"]
 
 NULL_PAGE = 0
 
@@ -36,6 +36,15 @@ NULL_PAGE = 0
 def pages_for(num_tokens: int, page_size: int) -> int:
     """Pages needed to hold `num_tokens` tokens."""
     return -(-num_tokens // page_size)
+
+
+def overflow_position(max_pages: int, page_size: int) -> int:
+    """First position past a (max_pages,)-table's capacity. `paged_attend`
+    routes K/V writes at or beyond it to the reserved null page, so this
+    doubles as the parking slot for rows that must stop writing real
+    pages: padding rows of a fixed-shape batch, and decode-horizon rows
+    that hit EOS or their token budget mid-block."""
+    return max_pages * page_size
 
 
 class BlockAllocator:

@@ -19,6 +19,15 @@ to decide which call. Policy:
   return to the free list and it re-queues (front) with prompt+generated
   tokens, to be re-prefilled when pages free up. Eviction therefore costs
   recompute, never correctness;
+- decode horizon (`decode_horizon=N`): the engine runs N decode
+  iterations per jitted block, so page demand is per BLOCK, not per
+  token — admission reserves the first block's pages up front and
+  `_ensure_decode_pages` tops every running request up to its next
+  block's worst case (`num_tokens + inflight` undrained upper bound),
+  so no allocation is ever needed mid-block. With the engine's async
+  overlap one block may be in flight undrained; before preempting
+  anyone the scheduler calls `drain_hook` so a victim's already-sampled
+  tokens are folded into its prompt instead of lost;
 - prefix caching (optional): admission first asks the PrefixCache for the
   longest cached full-page prefix of the prompt and charges the pool only
   for the UNCACHED suffix; release paths go through the refcounted
@@ -68,6 +77,10 @@ class Request:
     # prefill starts at this offset. pages[:cached_tokens // page_size]
     # are shared — the request holds a reference, never writes them
     cached_tokens: int = 0
+    # upper bound on tokens sampled by a dispatched-but-undrained decode
+    # block (the engine's async overlap): page demand must cover them,
+    # and host state (generated/num_tokens) lags behind by this much
+    inflight: int = 0
 
     # metrics (perf_counter timestamps, filled by the engine)
     arrival_t: float = dataclasses.field(default_factory=time.perf_counter)
@@ -101,12 +114,19 @@ class ScheduleDecision:
 class Scheduler:
     def __init__(self, allocator: BlockAllocator, page_size: int,
                  max_batch_size: int, max_pages_per_seq: int,
-                 prefix_cache=None):
+                 prefix_cache=None, decode_horizon: int = 1,
+                 drain_hook=None):
         self.allocator = allocator
         self.page_size = page_size
         self.max_batch_size = max_batch_size
         self.max_pages_per_seq = max_pages_per_seq
         self.prefix_cache = prefix_cache
+        self.decode_horizon = max(int(decode_horizon), 1)
+        # called once per _ensure_decode_pages on pool exhaustion, before
+        # any preemption: the engine drains its in-flight decode block so
+        # (a) device-finished requests release their pages and (b) a
+        # preemption victim's undrained tokens reach host state first
+        self.drain_hook = drain_hook
         self.waiting: List[Request] = []
         self.running: List[Request] = []
 
@@ -134,15 +154,32 @@ class Scheduler:
 
     # ------------------------------------------------------------- policy
     def _admission_pages(self, req: Request) -> int:
-        # prompt + the first generated token: prefill writes the prompt,
-        # and the very next decode step must have a slot to land on.
-        # This is EXACTLY what the first post-prefill _ensure_decode_pages
-        # requires (pages_for(num_tokens) with num_tokens = prompt + 1),
-        # including the exact-fill case len(prompt) % page_size == 0 where
-        # the +1 rolls into a fresh page; page 0 (null) is outside the
-        # allocator, so no off-by-one hides there either.
+        # prompt + the first decode BLOCK: prefill writes the prompt, and
+        # the first block of `decode_horizon` fused steps writes K/V at
+        # positions prompt .. prompt + min(horizon, max_new-1) - 1, so it
+        # must have slots to land on without mid-block allocation. At
+        # horizon 1 this reduces to the classic prompt + 1 (including the
+        # exact-fill case len(prompt) % page_size == 0 where the +1 rolls
+        # into a fresh page; page 0 (null) is outside the allocator, so
+        # no off-by-one hides there either).
         # tests/test_serving.py::TestAdmissionPageAccounting pins this.
-        return pages_for(len(req.prompt) + 1, self.page_size)
+        first_block = max(1, min(self.decode_horizon,
+                                 req.max_new_tokens - 1))
+        return pages_for(len(req.prompt) + first_block, self.page_size)
+
+    def _block_pages(self, req: Request) -> int:
+        """Pages the NEXT decode block needs resident for `req`: host
+        state (`num_tokens`) plus the undrained in-flight upper bound,
+        advanced by one more block of writes — the block's last sampled
+        token never gets K/V written inside it, hence the -1. Never
+        shrinks below pages_for(num_tokens), and self-caps at the
+        request's lifetime maximum because `rem` runs dry."""
+        assumed = req.num_tokens + req.inflight
+        rem = max(req.max_new_tokens - len(req.generated) - req.inflight,
+                  0)
+        want = max(assumed - 1 + min(self.decode_horizon, rem),
+                   req.num_tokens)
+        return pages_for(want, self.page_size)
 
     def _alloc_n(self, n: int) -> Optional[List[int]]:
         """All-or-nothing alloc that reclaims unreferenced prefix-cache
@@ -200,6 +237,7 @@ class Scheduler:
         self.allocator.free_all(victim.pages)
         victim.pages = []
         victim.cached_tokens = 0
+        victim.inflight = 0     # drain_hook ran first: nothing undrained
         victim.prompt = victim.prompt + victim.generated
         victim.max_new_tokens -= len(victim.generated)
         victim.generated = []
@@ -208,21 +246,28 @@ class Scheduler:
         self.waiting.insert(0, victim)
 
     def _ensure_decode_pages(self) -> None:
-        """Copy-on-extend: every running request whose next token crosses
-        a page boundary gets a new page. On pool exhaustion the YOUNGEST
-        running request is preempted (FCFS priority — running order is
+        """Copy-on-extend, one decode BLOCK at a time: every running
+        request is topped up to its next block's worst-case page demand
+        (`_block_pages`), so the fused multi-step block never allocates
+        mid-flight. On pool exhaustion, first drain the engine's pending
+        block once (may finish requests and free pages; also makes any
+        preemption victim's host state accurate), then preempt the
+        YOUNGEST running request (FCFS priority — running order is
         admission order), including the requester itself when it is the
         youngest."""
+        drained = False
         for req in list(self.running):
             if req not in self.running:   # preempted by an older peer
                 continue
-            # the step writes the input token at position num_tokens - 1,
-            # so the table must cover num_tokens resident tokens
-            while pages_for(req.num_tokens, self.page_size) > \
-                    len(req.pages):
+            while req in self.running and \
+                    self._block_pages(req) > len(req.pages):
                 page = self._alloc_one()
                 if page is not None:
                     req.pages.append(page)
+                    continue
+                if self.drain_hook is not None and not drained:
+                    drained = True
+                    self.drain_hook()     # may finish reqs / free pages
                     continue
                 victim = self.running[-1]
                 if victim is req and len(self.running) == 1:

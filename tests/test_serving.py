@@ -1,12 +1,16 @@
 """paddle_tpu.serving: block-allocator invariants (incl. refcounted page
 sharing), paged-attention parity vs the static-cache `attend_with_cache`,
 continuous batching with staggered arrivals token-identical to sequential
-`generate`, admission backpressure / preemption, automatic prefix caching
-(radix-tree hits token-identical to cold runs, LRU eviction, shared-page
-preemption safety), and BOUNDED compilation counts (asserted via the jit
-caches' miss counts — each `_cache_size` entry is one cache miss -> one
-compiled executable; the prefix cache may add at most one offset-aware
-prefill executable per bucket).
+`generate`, the multi-token decode horizon (fused decode+sample blocks at
+horizon 1/4/8 token-identical to each other, to horizon 1, and to
+`generate`; host syncs ~1/horizon; block page reservation; preemption
+with blocks in flight), admission backpressure / preemption, automatic
+prefix caching (radix-tree hits token-identical to cold runs, LRU
+eviction, shared-page preemption safety), and BOUNDED compilation counts
+(asserted via the jit caches' miss counts — each `_cache_size` entry is
+one cache miss -> one compiled executable; the prefix cache may add at
+most one offset-aware prefill executable per bucket, and each decode
+horizon gets exactly one fused decode+sample executable).
 
 Fast-lane tests compile only the prefill-bucket + decode + sampler set (a
 single tiny model reused module-wide); anything beyond that — the second
@@ -358,10 +362,15 @@ class TestPrefixCaching:
         # 7 usable pages: the 2 shared + one private page per request fit,
         # but copy-on-extend during decode runs the pool dry — the
         # youngest sharer must be preempted (shared pages are pinned by
-        # the tree + survivors, so eviction cannot save it)
+        # the tree + survivors, so eviction cannot save it).
+        # decode_horizon=1 pins the CLASSIC per-token reservation path:
+        # at the default horizon, admission reserves the whole block and
+        # this pool simply defers the youngest instead of preempting
+        # (TestDecodeHorizon covers preemption while a block is in flight)
         eng = ServingEngine(model, page_size=8, max_batch_size=3,
                             max_seq_len=32, prefill_buckets=(16, 32),
-                            num_pages=8, enable_prefix_caching=True)
+                            num_pages=8, enable_prefix_caching=True,
+                            decode_horizon=1)
         rids = [eng.add_request(p, max_new_tokens=8, temperature=0.0)
                 for p in prompts]
         outs = eng.run()
@@ -675,6 +684,213 @@ class TestServingSampling:
         assert eng.compile_counts()["sample"] <= 2
 
 
+# ------------------------------------------------------- decode horizon
+
+class TestDecodeHorizon:
+    """Multi-token decode horizon: fused decode+sample blocks must be
+    token-identical to horizon-1 and to sequential `generate`, reserve
+    their pages up front, and cut host syncs to ~1/horizon."""
+
+    def _staggered_run(self, model, prompts, h, max_new=6):
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            decode_horizon=h)
+        rids = [eng.add_request(p, max_new_tokens=max_new,
+                                temperature=0.0) for p in prompts[:2]]
+        for _ in range(3):
+            eng.step()
+        for p in prompts[2:]:
+            rids.append(eng.add_request(p, max_new_tokens=max_new,
+                                        temperature=0.0))
+            eng.step()
+        outs = eng.run()
+        return eng, [outs[r] for r in rids]
+
+    def test_horizon_matrix_token_parity(self):
+        """THE acceptance gate: horizons 1/4/8 under staggered arrivals
+        all emit exactly the sequential-generate tokens (and therefore
+        match each other), with ONE fused decode executable each and no
+        standalone sampler dispatch."""
+        model = _llama()
+        rng = np.random.RandomState(31)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)) for n in (5, 11, 3, 8)]
+        refs = _sequential_reference(model, prompts, max_new_tokens=6)
+        outs_by_h = {}
+        for h in (1, 4, 8):
+            eng, outs = self._staggered_run(model, prompts, h)
+            assert outs == refs, f"horizon {h} diverged from generate"
+            outs_by_h[h] = outs
+            counts = eng.compile_counts()
+            assert counts["decode"] == 1, counts
+            assert counts["sample"] == 0, counts   # sampling is fused
+            assert eng.cache.allocator.num_used == 0
+        assert outs_by_h[1] == outs_by_h[4] == outs_by_h[8]
+
+    def test_eos_mid_block_trims_and_frees(self):
+        """EOS landing mid-horizon: the device mask pads the rest of the
+        block, the host trims at the EOS token, and the result matches
+        both sequential generate and a horizon-1 engine."""
+        model = _llama()
+        prompt = [7, 8, 9]
+        ref = _sequential_reference(model, [prompt], 8)[0]
+        gen = ref[len(prompt):]
+        eos = gen[2]                     # third generated token
+        assert eos not in gen[:2]        # really lands MID-block
+        expect = list(prompt) + gen[:3]
+
+        def run(h):
+            eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                                max_seq_len=32, prefill_buckets=(16, 32),
+                                decode_horizon=h)
+            rid = eng.add_request(prompt, max_new_tokens=8,
+                                  temperature=0.0, eos_token_id=eos)
+            outs = eng.run()
+            assert eng.cache.allocator.num_used == 0
+            return outs[rid]
+
+        assert run(8) == expect
+        assert run(1) == expect
+
+    def test_host_syncs_drop_with_horizon(self):
+        """stats() observability: host_syncs ~ prefills + ceil(tokens/
+        horizon) blocks, so tokens_per_sync grows with the horizon."""
+        model = _llama()
+        prompt = [3, 1, 4, 1, 5]
+
+        def run(h):
+            eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                                max_seq_len=64, prefill_buckets=(16, 64),
+                                decode_horizon=h)
+            eng.add_request(prompt, max_new_tokens=24, temperature=0.0)
+            eng.run()
+            return eng.stats()
+
+        s1, s8 = run(1), run(8)
+        assert s1["tokens_generated"] == s8["tokens_generated"] == 24
+        # horizon 1: one sync per token (+1 prefill, ±pipeline edges)
+        assert s1["host_syncs"] >= 24
+        # horizon 8: 23 decode tokens in ceil(23/8)=3 blocks (+1 tail
+        # flush block at the pipeline edge) + 1 prefill sync
+        assert s8["host_syncs"] <= 6
+        assert s8["tokens_per_sync"] > 3.0 > s1["tokens_per_sync"]
+        assert s8["decode_horizon"] == 8
+
+    def test_admission_reserves_first_block(self):
+        """Scheduler accounting: admission covers the whole first decode
+        block, so _ensure_decode_pages allocates NOTHING before it (the
+        horizon generalization of TestAdmissionPageAccounting)."""
+        for h, prompt_len, max_new in [(4, 7, 12), (4, 8, 12), (8, 9, 3),
+                                       (8, 16, 20), (1, 7, 4)]:
+            sched = Scheduler(BlockAllocator(64), page_size=8,
+                              max_batch_size=2, max_pages_per_seq=8,
+                              decode_horizon=h)
+            req = Request(prompt=[1] * prompt_len, max_new_tokens=max_new,
+                          sampling=SamplingParams())
+            sched.add(req)
+            assert sched.schedule().kind == "prefill"
+            assert len(req.pages) == pages_for(
+                prompt_len + max(1, min(h, max_new - 1)), 8)
+            req.generated.append(0)      # the token prefill emitted
+            free_before = sched.allocator.num_free
+            sched._ensure_decode_pages()
+            assert sched.allocator.num_free == free_before, \
+                f"h={h}: admission under-charged the first block"
+
+    def test_block_demand_caps_at_request_lifetime(self):
+        """_block_pages never asks for pages past prompt+max_new-1 (the
+        block's own last token never gets K/V written), so a short
+        request near its budget stops growing its table."""
+        sched = Scheduler(BlockAllocator(64), page_size=8,
+                          max_batch_size=1, max_pages_per_seq=8,
+                          decode_horizon=8)
+        req = Request(prompt=[1] * 9, max_new_tokens=4,
+                      sampling=SamplingParams())
+        req.status = "running"
+        req.generated = [5]
+        assert sched._block_pages(req) == pages_for(9 + 4 - 1, 8)
+        req.generated = [5, 6, 7]        # one token of budget left
+        assert sched._block_pages(req) == pages_for(9 + 4 - 1, 8)
+
+    def test_one_executable_per_horizon_across_waves(self):
+        """Compile-count guard: serving two separate request waves (and
+        re-chaining fresh pipelines each time) still uses ONE fused
+        decode executable for the engine's (batch-shape, horizon)."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            decode_horizon=4)
+        rng = np.random.RandomState(37)
+        vocab = LlamaConfig.tiny().vocab_size
+        for wave in range(2):
+            for n in (4, 9):
+                eng.add_request(rng.randint(0, vocab, (n,)),
+                                max_new_tokens=5, temperature=0.0)
+            eng.run()
+        counts = eng.compile_counts()
+        assert counts["decode"] == 1, counts
+        assert counts["sample"] == 0, counts
+
+    def test_seeded_sampling_device_keys_match_host_chain(self):
+        """The fused sampler's device-side key evolution reproduces the
+        pre-horizon host chain: one split per generated token, starting
+        from jax.random.key(seed) — asserted via cross-engine
+        reproducibility at horizon 1 vs 8 while requests are alive."""
+        model = _llama()
+
+        def run(h):
+            eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                                max_seq_len=32, prefill_buckets=(16, 32),
+                                decode_horizon=h)
+            rid = eng.add_request([3, 1, 4, 1, 5], max_new_tokens=6,
+                                  temperature=0.8, top_k=7, seed=42)
+            return eng.run()[rid]
+
+        assert run(1) == run(8) == run(1)
+
+
+# ------------------------------------------------ add_request validation
+
+class TestAddRequestRejection:
+    def test_rejected_prompt_leaks_nothing(self):
+        """Regression: a prompt the engine can never prefill must be
+        rejected AT add_request — before pages, scheduler entries, or
+        engine registration exist — not mid-_prefill after admission."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        free_before = eng.cache.allocator.num_free
+        n_reqs = len(eng.requests)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            eng.add_request([1] * 40, max_new_tokens=4)
+        # the largest-bucket guard fires even if the bucket/max_seq_len
+        # invariant is sidestepped (e.g. a harness mutating the buckets)
+        eng.prefill_buckets = (16,)
+        with pytest.raises(ValueError, match="largest"):
+            eng.add_request([1] * 20, max_new_tokens=4)
+        assert eng.cache.allocator.num_free == free_before
+        assert len(eng.requests) == n_reqs
+        assert not eng.scheduler.waiting
+        # and the engine still serves normally afterwards
+        eng.prefill_buckets = (16, 32)
+        rid = eng.add_request([1, 2, 3], max_new_tokens=2)
+        outs = eng.run()
+        assert len(outs[rid]) == 5
+        assert eng.cache.allocator.num_used == 0
+
+    def test_over_budget_request_not_registered(self):
+        """scheduler.add's page-budget rejection happens before the
+        engine registers the request (no orphan entries in requests/key
+        state)."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        eng.max_seq_len = 64             # sidestep the length check so
+        with pytest.raises(ValueError, match="max_pages_per_seq"):
+            eng.add_request([1] * 30, max_new_tokens=30)
+        assert not eng.requests and not eng.scheduler.waiting
+
+
 # ------------------------------------------------------------ slow lane
 
 @pytest.mark.slow
@@ -715,9 +931,12 @@ class TestServingSlow:
         prompts = [rng.randint(0, vocab, (n,)) for n in (10, 8, 12)]
         refs = _sequential_reference(model, prompts, max_new_tokens=8)
 
+        # decode_horizon=1: the classic single-token reservation path —
+        # at the default horizon this pool defers admission instead of
+        # preempting (TestDecodeHorizon covers the in-horizon variant)
         eng = ServingEngine(model, page_size=8, max_batch_size=3,
                             max_seq_len=32, prefill_buckets=(16, 32),
-                            num_pages=8)
+                            num_pages=8, decode_horizon=1)
         rids = [eng.add_request(p, max_new_tokens=8, temperature=0.0)
                 for p in prompts]
         outs = eng.run()
@@ -725,6 +944,59 @@ class TestServingSlow:
         for rid, ref in zip(rids, refs):
             assert outs[rid] == ref
         assert eng.cache.allocator.num_used == 0
+
+    def test_preemption_while_in_horizon_token_identical(self):
+        """Preemption with decode blocks IN FLIGHT: the pool admits all
+        three requests but cannot hold their full lifetimes, so
+        copy-on-extend exhausts it mid-stream while an undrained block
+        is pending. The scheduler's drain_hook must land those tokens
+        before the victim requeues — output stays token-identical to
+        sequential generate (nothing sampled is ever lost)."""
+        model = _llama()
+        rng = np.random.RandomState(41)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)) for n in (10, 8, 12)]
+        refs = _sequential_reference(model, prompts, max_new_tokens=12)
+        # h=4 < max_new-1: admission reserves only the first block
+        # (2 pages each -> all admitted into 7), later blocks extend to
+        # 3 pages each (9 > 7) -> someone must be preempted mid-flight
+        eng = ServingEngine(model, page_size=8, max_batch_size=3,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            num_pages=8, decode_horizon=4)
+        rids = [eng.add_request(p, max_new_tokens=12, temperature=0.0)
+                for p in prompts]
+        outs = eng.run()
+        assert eng.stats()["preemptions"] >= 1
+        for rid, ref in zip(rids, refs):
+            assert outs[rid] == ref
+        assert eng.cache.allocator.num_used == 0
+
+    def test_horizon_matrix_under_preemption_and_eos(self):
+        """Heavy corner of the parity matrix: staggered arrivals + a
+        small pool (preemption) + EOS mid-block, horizons 1/4/8 all
+        token-identical to each other."""
+        model = _llama()
+        rng = np.random.RandomState(43)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)) for n in (9, 7, 11)]
+        ref = _sequential_reference(model, [prompts[0]], 12)[0]
+        eos = ref[9 + 5]                 # lands mid-block at h=4/8
+
+        def run(h):
+            eng = ServingEngine(model, page_size=8, max_batch_size=3,
+                                max_seq_len=32, prefill_buckets=(16, 32),
+                                num_pages=8, decode_horizon=h)
+            rids = [eng.add_request(prompts[0], max_new_tokens=12,
+                                    temperature=0.0, eos_token_id=eos)]
+            eng.step()
+            for p in prompts[1:]:
+                rids.append(eng.add_request(p, max_new_tokens=12,
+                                            temperature=0.0))
+            outs = eng.run()
+            assert eng.cache.allocator.num_used == 0
+            return [outs[r] for r in rids]
+
+        assert run(1) == run(4) == run(8)
 
     def test_seeded_requests_reproducible_across_engines(self):
         model = _llama()

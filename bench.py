@@ -240,6 +240,19 @@ def _run_gates(on_tpu: bool) -> dict:
     return gates
 
 
+def _obs_snapshot() -> dict:
+    """Process-global observability registry snapshot (trace-time paged
+    attention dispatch counts etc.) for the bench JSON — the per-engine
+    serving metrics ride inside the serving_prefix/serving_decode phase
+    payloads already."""
+    try:
+        from paddle_tpu.observability import global_registry
+
+        return global_registry().snapshot()
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+
 def _gen_bench_module():
     import importlib.util
 
@@ -624,6 +637,7 @@ def bench_child() -> None:
                 "gates": gates,
                 "serving_prefix": serving_prefix,
                 "serving_decode": serving_decode,
+                "observability": _obs_snapshot(),
             },
         }
 

@@ -81,6 +81,19 @@ def main():
     }))
 
 
+def _metrics_blob(eng):
+    """Observability payload embedded in bench JSON: the latency
+    percentile view plus the full registry snapshot (sparse histogram
+    buckets keep it small), so BENCH_*.json files carry p50/p95/p99 and
+    utilization next to the throughput numbers and
+    `observability.registry_from_snapshot` can rebuild live histograms
+    from an old bench file."""
+    blob = {"latency": eng.stats()["latency"]}
+    if eng.metrics is not None:
+        blob["snapshot"] = eng.metrics.snapshot()
+    return blob
+
+
 def serving_prefix_phase(model, cfg, on_tpu):
     """Shared-system-prompt serving: N requests sharing one long prefix,
     mean ttft of the FOLLOWER requests (the first request is the cold
@@ -118,11 +131,12 @@ def serving_prefix_phase(model, cfg, on_tpu):
         stats = eng.stats()
         ttfts = [stats["requests"][r]["ttft_s"] for r in rids]
         return (sum(ttfts) / len(ttfts), time.perf_counter() - t0,
-                stats.get("prefix_cache"))
+                stats.get("prefix_cache"), eng)
 
-    ttft_off, wall_off, _ = run(False)
-    ttft_on, wall_on, pc = run(True)
+    ttft_off, wall_off, _, _ = run(False)
+    ttft_on, wall_on, pc, eng_on = run(True)
     return {
+        "metrics": _metrics_blob(eng_on),
         "shared_prompt_tokens": len(shared),
         "requests": n_requests - 1,
         "ttft_cache_off_ms": round(ttft_off * 1000, 2),
@@ -172,16 +186,21 @@ def serving_decode_phase(model, cfg, on_tpu):
         st = eng.stats()
         syncs = st["host_syncs"] - syncs0
         toks = st["tokens_generated"] - toks0
-        return {"decode_tokens_per_s": round(toks / wall, 1),
-                "wall_ms": round(wall * 1000, 2),
-                "host_syncs": syncs,
-                "syncs_per_token": round(syncs / toks, 4),
-                "tokens": toks}
+        lat = st["latency"]
+        return ({"decode_tokens_per_s": round(toks / wall, 1),
+                 "wall_ms": round(wall * 1000, 2),
+                 "host_syncs": syncs,
+                 "syncs_per_token": round(syncs / toks, 4),
+                 "tokens": toks,
+                 "inter_token_ms": {
+                     p: round(lat["inter_token"][p] * 1000, 3)
+                     for p in ("p50", "p95", "p99")}}, eng)
 
-    h1, h8 = run(1), run(8)
+    (h1, _), (h8, eng8) = run(1), run(8)
     return {
         "requests": n_req, "new_tokens": new_tokens,
         "horizon_1": h1, "horizon_8": h8,
+        "metrics": _metrics_blob(eng8),
         "decode_speedup": round(
             h8["decode_tokens_per_s"] / max(h1["decode_tokens_per_s"],
                                             1e-9), 2),

@@ -51,6 +51,7 @@ _SUBPACKAGES = [
     "distributed", "device", "profiler", "incubate", "sparse", "framework",
     "hapi", "text", "audio", "distribution", "quantization", "utils",
     "inference", "linalg", "fft", "signal", "hub", "onnx", "serving",
+    "observability",
 ]
 import importlib as _importlib
 

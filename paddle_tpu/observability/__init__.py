@@ -1,0 +1,47 @@
+"""paddle_tpu.observability — unified metrics + request-lifecycle
+telemetry (TPU-native extension; no upstream paddle counterpart).
+
+Three pieces:
+
+- `metrics`: `MetricsRegistry` of `Counter`/`Gauge`/`Histogram`
+  (fixed-log-bucket, p50/p95/p99 estimation) — the single source of
+  truth behind `ServingEngine.stats()`; near-zero cost disabled, bounded
+  cost enabled;
+- `export`: Prometheus text exposition + JSON snapshot round-trip;
+- `lifecycle`: `LifecycleTracker` — per-request spans
+  (`serving.request[<rid>].<stage>`) folded into the
+  paddle_tpu.profiler chrome-trace host tracer.
+
+`global_registry()` is the process-wide registry for library-level
+signals (e.g. trace-time paged-attention dispatch counts); each
+ServingEngine keeps its OWN registry by default so per-engine stats
+never mix.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .export import registry_from_snapshot, to_prometheus  # noqa: F401
+from .lifecycle import LifecycleTracker  # noqa: F401
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "LifecycleTracker", "to_prometheus", "registry_from_snapshot",
+    "global_registry",
+]
+
+_GLOBAL: Optional[MetricsRegistry] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_registry() -> MetricsRegistry:
+    """Lazily-created process-wide registry (library-level counters that
+    have no owning engine)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = MetricsRegistry()
+    return _GLOBAL

@@ -21,6 +21,7 @@ from typing import Callable, Iterable, Optional
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "add_host_span",
     "make_scheduler", "export_chrome_tracing", "export_protobuf",
     "load_profiler_result", "SortedKeys", "SummaryView",
 ]
@@ -108,6 +109,21 @@ class _HostTracer:
 
 
 _HOST_TRACER = _HostTracer()
+
+
+def add_host_span(name: str, start: float, end: float, tid=None,
+                  event_type: str = "UserDefined") -> None:
+    """Record an already-completed host span with explicit perf_counter
+    timestamps into the armed profiler window (no-op when no window is
+    armed). The observability LifecycleTracker folds per-request serving
+    lifecycle spans into chrome-trace exports through this, alongside
+    RecordEvent spans (the native tracer's drain is calibrated onto the
+    same perf_counter timeline, so the two sinks merge cleanly)."""
+    if not _HOST_TRACER.armed:
+        return
+    _HOST_TRACER.add(_HostEvent(
+        name, float(start), float(end),
+        tid if tid is not None else threading.get_ident(), event_type))
 
 
 class RecordEvent:

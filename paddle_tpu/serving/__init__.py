@@ -29,7 +29,7 @@ from .attention import (  # noqa: F401
     advance_positions, paged_attend, paged_decode_attention,
     paged_decode_available,
 )
-from .engine import PAD_TOKEN, ServingEngine  # noqa: F401
+from .engine import PAD_TOKEN, ServingEngine, ServingObs  # noqa: F401
 from .kv_cache import (  # noqa: F401
     BlockAllocator, NULL_PAGE, PagedKVCache, PagedLayerCache,
     overflow_position, pages_for,
@@ -40,7 +40,8 @@ from .scheduler import (  # noqa: F401
 )
 
 __all__ = [
-    "ServingEngine", "PagedKVCache", "PagedLayerCache", "BlockAllocator",
+    "ServingEngine", "ServingObs",
+    "PagedKVCache", "PagedLayerCache", "BlockAllocator",
     "PrefixCache", "PrefixNode",
     "Scheduler", "ScheduleDecision", "Request", "SamplingParams",
     "paged_attend", "paged_decode_attention", "paged_decode_available",

@@ -43,6 +43,22 @@ def _on_tpu() -> bool:
     return on_tpu()
 
 
+def _count_dispatch(path: str) -> None:
+    """Trace-time dispatch accounting: which paged-attention path a
+    jitted step compiled against (Pallas kernel / interpret / jnp
+    reference / prefill variants). This runs only while a step is being
+    TRACED — steady-state dispatches replay the compiled program and pay
+    nothing — so the process-global observability registry ends up with
+    one count per (executable, layer), a cheap cross-check that TPU runs
+    really lowered the kernel path."""
+    from ..observability import global_registry
+
+    global_registry().counter(
+        "serving_attention_dispatch_total",
+        "trace-time paged-attention path selections",
+        labels={"path": path}).inc()
+
+
 def paged_decode_available(page_size: int, head_dim: int) -> bool:
     """Shape gates for the Pallas decode kernel: page rows must tile the
     8-sublane axis, head_dim anything pad-able to 128 lanes."""
@@ -123,10 +139,12 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
         ctx = paged_decode_attention(q, new_cache, pos[:, 0], rep,
                                      bias=bias)
     elif static_zero:
+        _count_dispatch("prefill")
         ctx = _prefill_attention(q, kd, vd, pos, rep, bias=bias)
     else:
         # suffix prefill from a cached prefix: earlier K/V lives only in
         # the pool's shared pages, so attend over the page table
+        _count_dispatch("prefill_paged")
         ctx = _prefill_attention_paged(q, new_cache, pos, rep, bias=bias)
     return ctx, new_cache
 
@@ -214,11 +232,14 @@ def paged_decode_attention(q, cache: PagedLayerCache, pos, rep,
                   and paged_decode_available(cache.page_size, hd)
                   and (KERNEL_MODE == "interpret" or _on_tpu()))
     if use_kernel:
+        _count_dispatch("decode_pallas_interpret"
+                        if KERNEL_MODE == "interpret" else "decode_pallas")
         qd = q._data if hasattr(q, "_data") else q
         out = _paged_decode_pallas(qd, cache.k_pool, cache.v_pool,
                                    cache.page_table, pos,
                                    interpret=KERNEL_MODE == "interpret")
         return Tensor(out)
+    _count_dispatch("decode_reference")
     return _paged_decode_reference(q, cache, pos, rep, bias)
 
 

@@ -33,11 +33,17 @@ models/generation.py (LLaMA, GPT); the per-layer cache objects it passes
 are `PagedLayerCache` views, which `attend_with_cache` dispatches to the
 ragged paged attention op.
 
-Per-request latency/throughput counters are recorded through
-paddle_tpu.profiler (RecordEvent spans "serving.prefill" /
-"serving.decode_block" / "serving.host_drain" line up in profiler
-traces) and summarized by `stats()` — `host_syncs` and
-`tokens_per_sync` make the decode-horizon batching visible.
+Observability (ISSUE 4): every counter lives in ONE
+paddle_tpu.observability MetricsRegistry per engine — `stats()` and
+`compile_counts()` are thin views over it, `ServingObs` resolves all
+handles once at construction so the hot path never looks anything up,
+and `enable_metrics=False` removes even that (a None check per site).
+On top of the batch-level RecordEvent spans ("serving.prefill" /
+"serving.decode_block" / "serving.host_drain"), a LifecycleTracker
+emits per-request spans (`serving.request[<rid>].<stage>` for
+enqueued/admitted/prefill/first_token/decode_block/preempted/requeued/
+finished) into the profiler's chrome-trace host tracer, and TTFT /
+inter-token latency histograms back `stats()["latency"]`'s p50/p95/p99.
 """
 from __future__ import annotations
 
@@ -50,6 +56,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..jit.functional import call_functional, extract_state
+from ..observability import Histogram, LifecycleTracker, MetricsRegistry
 from ..profiler import RecordEvent
 from .attention import advance_positions
 from .kv_cache import (PagedKVCache, PagedLayerCache, overflow_position,
@@ -57,7 +64,7 @@ from .kv_cache import (PagedKVCache, PagedLayerCache, overflow_position,
 from .prefix_cache import PrefixCache
 from .scheduler import Request, SamplingParams, Scheduler
 
-__all__ = ["ServingEngine", "PAD_TOKEN"]
+__all__ = ["ServingEngine", "ServingObs", "PAD_TOKEN"]
 
 # emitted by dead rows inside a decode block (finished / padding); the
 # host drain trims each row at its first PAD
@@ -112,6 +119,84 @@ def _split_rows(key_data):
     return jax.random.key_data(pair[:, 0]), pair[:, 1]
 
 
+class ServingObs:
+    """Every observability handle the serving hot path touches, resolved
+    ONCE against the engine's MetricsRegistry (metric name lookups never
+    run per step), plus the per-request LifecycleTracker. The scheduler
+    receives this same object and calls the small hooks below at queue
+    transitions; with `enable_metrics=False` the engine passes None
+    everywhere and the hot path does literally no metrics work
+    (tests/test_serving.py pins that with a raise-on-touch guard)."""
+
+    FAMILIES = ("prefill", "prefill_offset", "decode", "sample")
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.lifecycle = LifecycleTracker()
+        c, g, h = registry.counter, registry.gauge, registry.histogram
+        self.prefill_steps = c("serving_prefill_steps_total",
+                               "prefill dispatches")
+        self.decode_steps = c("serving_decode_steps_total",
+                              "fused decode-block dispatches")
+        self.tokens = c("serving_tokens_generated_total",
+                        "tokens emitted to the host")
+        self.host_syncs = c("serving_host_syncs_total",
+                            "device->host sync points")
+        self.preemptions = c("serving_preemptions_total",
+                             "requests preempted and requeued")
+        self.prefill_seconds = c("serving_prefill_seconds_total",
+                                 "wall time in prefill dispatch+sync")
+        self.decode_seconds = c(
+            "serving_decode_seconds_total",
+            "decode wall time (async-overlap deduplicated)")
+        self.compile_miss = {
+            fam: c("serving_jit_compile_misses_total",
+                   "distinct executables per step family "
+                   "(this engine's jit-cache misses)",
+                   labels={"family": fam})
+            for fam in self.FAMILIES}
+        self.ttft = h("serving_ttft_seconds",
+                      "request arrival to first token on the host")
+        self.inter_token = h(
+            "serving_inter_token_seconds",
+            "per-token gap between host-visible emissions (a decode "
+            "block's gap is spread evenly over its tokens)")
+        self.queue_waiting = g("serving_queue_depth",
+                               "scheduler queue depth",
+                               labels={"state": "waiting"})
+        self.queue_running = g("serving_queue_depth",
+                               "scheduler queue depth",
+                               labels={"state": "running"})
+        self.free_pages = g("serving_kv_free_pages",
+                            "allocatable KV pages right now")
+        self.kv_util = g("serving_kv_page_utilization",
+                         "fraction of allocatable KV pages in use")
+
+    # --------------------------------------------------- scheduler hooks
+    def enqueued(self, req) -> None:
+        self.lifecycle.point(req.request_id, "enqueued", req.arrival_t)
+
+    def admitted(self, req) -> None:
+        self.lifecycle.point(req.request_id, "admitted")
+
+    def preempted(self, req) -> None:
+        self.preemptions.inc()
+        now = time.perf_counter()
+        self.lifecycle.point(req.request_id, "preempted", now)
+        self.lifecycle.point(req.request_id, "requeued", now)
+
+    def finished(self, req) -> None:
+        self.lifecycle.point(req.request_id, "finished", req.finish_t)
+
+    def sample_queues(self, waiting: int, running: int, allocator) -> None:
+        self.queue_waiting.set(waiting)
+        self.queue_running.set(running)
+        free = allocator.num_free
+        total = allocator.num_pages - 1          # page 0 never allocates
+        self.free_pages.set(free)
+        self.kv_util.set(1.0 - free / total if total else 0.0)
+
+
 class ServingEngine:
     def __init__(self, model, *, page_size: int = 16,
                  num_pages: Optional[int] = None,
@@ -120,7 +205,9 @@ class ServingEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  cache_dtype=jnp.float32,
                  enable_prefix_caching: bool = False,
-                 decode_horizon: int = 8):
+                 decode_horizon: int = 8,
+                 enable_metrics: bool = True,
+                 metrics: Optional[MetricsRegistry] = None):
         from ..models.generation import _config_of
 
         self.model = model
@@ -138,17 +225,30 @@ class ServingEngine:
             num_pages = max_batch_size * self.max_pages_per_seq + 1
         self.cache = PagedKVCache.for_model(model, num_pages, page_size,
                                             cache_dtype)
+        # observability: ONE registry per engine is the single source of
+        # truth behind stats()/compile_counts() and the exporters. Pass
+        # `metrics=` to aggregate several engines into a shared registry,
+        # or `enable_metrics=False` to strip every metrics/lifecycle call
+        # off the hot path (stats() then returns the same shape zeroed).
+        self.metrics = metrics if metrics is not None else (
+            MetricsRegistry() if enable_metrics else None)
+        self._obs = (ServingObs(self.metrics)
+                     if self.metrics is not None else None)
+        if self.metrics is not None:
+            self.cache.allocator.bind_metrics(self.metrics)
         # automatic prefix caching (full-page granularity, LRU eviction):
         # finished/prefilled prompts leave their full pages in a radix
         # tree; a later prompt sharing a page-aligned prefix reuses them
         # and prefills only its suffix
-        self.prefix_cache = (PrefixCache(self.cache.allocator, page_size)
+        self.prefix_cache = (PrefixCache(self.cache.allocator, page_size,
+                                         metrics=self.metrics)
                              if enable_prefix_caching else None)
         self.scheduler = Scheduler(self.cache.allocator, page_size,
                                    max_batch_size, self.max_pages_per_seq,
                                    prefix_cache=self.prefix_cache,
                                    decode_horizon=self.decode_horizon,
-                                   drain_hook=self._drain_for_scheduler)
+                                   drain_hook=self._drain_for_scheduler,
+                                   obs=self._obs)
         self.prefill_buckets = tuple(sorted(
             prefill_buckets or _default_buckets(self.max_seq_len)))
         if self.prefill_buckets[-1] < self.max_seq_len:
@@ -182,10 +282,6 @@ class ServingEngine:
         self._exec_shapes: Dict[str, set] = {
             "prefill": set(), "prefill_offset": set(), "decode": set(),
             "sample": set()}
-        self._stats = {"prefill_steps": 0, "decode_steps": 0,
-                       "tokens_generated": 0, "prefill_time_s": 0.0,
-                       "decode_time_s": 0.0, "preemptions": 0,
-                       "host_syncs": 0}
 
     # ----------------------------------------------------------- request API
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
@@ -264,6 +360,16 @@ class ServingEngine:
             pass
         return {rid: self.output(rid) for rid in self.requests}
 
+    def _note_exec(self, family: str, aval) -> None:
+        """Record one step family's input aval; a NEW aval is a jit-cache
+        miss, counted into the registry's compile-miss counter (the set
+        stays the dedup structure, the registry holds the count)."""
+        shapes = self._exec_shapes[family]
+        if aval not in shapes:
+            shapes.add(aval)
+            if self._obs is not None:
+                self._obs.compile_miss[family].inc()
+
     # -------------------------------------------------------------- prefill
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -325,12 +431,18 @@ class ServingEngine:
     def _emit(self, req: Request, token: int, now: float
               ) -> Tuple[int, int]:
         req.generated.append(token)
-        self._stats["tokens_generated"] += 1
+        o = self._obs
+        if o is not None:
+            o.tokens.inc()
         if req.first_token_t is None:
             req.first_token_t = now
+            if o is not None:
+                o.ttft.observe(max(now - req.arrival_t, 0.0))
+                o.lifecycle.point(req.request_id, "first_token", now)
+        req.last_token_t = now
         if req.is_done():
             req.finish_t = now
-            self.scheduler.finish(req)
+            self.scheduler.finish(req)   # obs.finished fires in there
         return (req.request_id, token)
 
     def _prefill(self, req: Request) -> List[Tuple[int, int]]:
@@ -341,8 +453,8 @@ class ServingEngine:
         suffix = req.prompt[n_cached:]
         bucket = self._bucket_for(len(suffix))
         family = "prefill_offset" if n_cached else "prefill"
-        self._exec_shapes[family].add(
-            (bucket, self.cache.num_pages, self.max_pages_per_seq))
+        self._note_exec(
+            family, (bucket, self.cache.num_pages, self.max_pages_per_seq))
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :len(suffix)] = suffix
         page_table = self.cache.page_table_array([req.pages],
@@ -368,16 +480,25 @@ class ServingEngine:
             self.cache.pools = pools
             self._key_state[req.request_id] = new_kd[0]
             token = int(np.asarray(tok)[0])
-        self._stats["host_syncs"] += 1
         if self.prefix_cache is not None:
             # register the prompt's full pages for future reuse (the
             # partial last page never enters the tree); in-flight
             # requests can hit them immediately
             self.prefix_cache.insert(req.prompt, req.pages)
         now = time.perf_counter()
-        self._stats["prefill_steps"] += 1
-        self._stats["prefill_time_s"] += now - t0
-        return [self._emit(req, token, now)]
+        o = self._obs
+        prev_t = req.last_token_t            # set => this is a re-prefill
+        if o is not None:
+            o.prefill_steps.inc()
+            o.host_syncs.inc()
+            o.prefill_seconds.inc(now - t0)
+            o.lifecycle.span(req.request_id, "prefill", t0, now)
+        events = [self._emit(req, token, now)]
+        if o is not None and prev_t is not None:
+            # requeued request: the gap since its last pre-preemption
+            # token is honest inter-token latency
+            o.inter_token.observe(max(now - prev_t, 0.0))
+        return events
 
     # --------------------------------------------------------------- decode
     def _decode_block_jit(self, horizon: int):
@@ -446,8 +567,8 @@ class ServingEngine:
                 return events_prev
             rids = tuple(r.request_id for r in reqs)
             prev = None
-        self._exec_shapes["decode"].add(
-            (b, h, self.cache.num_pages, self.max_pages_per_seq))
+        self._note_exec(
+            "decode", (b, h, self.cache.num_pages, self.max_pages_per_seq))
         page_lists: List[Sequence[int]] = [()] * b
         for i, req in enumerate(reqs):
             page_lists[i] = req.pages
@@ -507,7 +628,8 @@ class ServingEngine:
                     self.params, self.buffers, tokens, self.cache.pools,
                     page_tables, positions, key_data, *knobs, remaining)
             self.cache.pools = pools
-        self._stats["decode_steps"] += 1
+        if self._obs is not None:
+            self._obs.decode_steps.inc()
         self._pending = {
             "rids": rids, "reqs": list(reqs), "incr": incr,
             "emitted": emitted, "tokens": tokens, "positions": positions,
@@ -538,9 +660,11 @@ class ServingEngine:
         per-request tokens trimmed at EOS/budget (device already masked
         past-the-end steps to PAD), finish requests, refresh per-request
         key state from the block's device carries."""
+        o = self._obs
         with RecordEvent("serving.host_drain"):
             toks = np.asarray(jax.device_get(rec["emitted"]))
-        self._stats["host_syncs"] += 1
+        if o is not None:
+            o.host_syncs.inc()
         now = time.perf_counter()
         kd = rec["key_data"]
         events: List[Tuple[int, int]] = []
@@ -549,6 +673,8 @@ class ServingEngine:
             self._key_state[req.request_id] = kd[i]
             if req.status != "running":
                 continue
+            prev_t = req.last_token_t
+            k0 = len(events)
             for t in toks[i]:
                 t = int(t)
                 if t == PAD_TOKEN:
@@ -556,17 +682,55 @@ class ServingEngine:
                 events.append(self._emit(req, t, now))
                 if req.status != "running":
                     break
+            k = len(events) - k0
+            if o is not None and k:
+                # one lifecycle span per request per drained block
+                # (profiler-only: per-token volume must not grow the
+                # tracker's retained event lists)
+                o.lifecycle.span(req.request_id, "decode_block",
+                                 rec["t0"], now, retain=False)
+                if prev_t is not None:
+                    # the block lands as a burst: spread its host-visible
+                    # gap evenly over the k tokens it carried
+                    per_tok = max(now - prev_t, 0.0) / k
+                    for _ in range(k):
+                        o.inter_token.observe(per_tok)
         # decode wall time without double-counting overlapped block spans
         start = max(rec["t0"], self._last_drain_t)
-        self._stats["decode_time_s"] += max(now - start, 0.0)
+        if o is not None:
+            o.decode_seconds.inc(max(now - start, 0.0))
         self._last_drain_t = now
         return events
 
     # -------------------------------------------------------------- metrics
     def stats(self) -> Dict[str, object]:
-        s = dict(self._stats)
-        s["preemptions"] = sum(r.preemptions
-                               for r in self.requests.values())
+        """Aggregate serving metrics — a THIN VIEW over the metrics
+        registry (the single source of truth; the engine keeps no
+        parallel hand-maintained counters). All pre-observability keys
+        are preserved; the `latency` section adds p50/p95/p99 TTFT and
+        inter-token seconds straight from the registry histograms. With
+        `enable_metrics=False` the same shape comes back zeroed (only
+        request-derived fields are populated)."""
+        o = self._obs
+        if o is not None:
+            s = {
+                "prefill_steps": int(o.prefill_steps.value),
+                "decode_steps": int(o.decode_steps.value),
+                "tokens_generated": int(o.tokens.value),
+                "prefill_time_s": float(o.prefill_seconds.value),
+                "decode_time_s": float(o.decode_seconds.value),
+                "preemptions": int(o.preemptions.value),
+                "host_syncs": int(o.host_syncs.value),
+            }
+        else:
+            s = {
+                "prefill_steps": 0, "decode_steps": 0,
+                "tokens_generated": 0, "prefill_time_s": 0.0,
+                "decode_time_s": 0.0,
+                "preemptions": sum(r.preemptions
+                                   for r in self.requests.values()),
+                "host_syncs": 0,
+            }
         dt = s["decode_time_s"]
         s["decode_tokens_per_s"] = (
             s["tokens_generated"] / dt if dt > 0 else 0.0)
@@ -578,6 +742,12 @@ class ServingEngine:
         s["num_finished"] = sum(r.status == "finished"
                                 for r in self.requests.values())
         s["free_pages"] = self.cache.allocator.num_free
+        s["latency"] = {
+            "ttft": (o.ttft.summary() if o is not None
+                     else Histogram.empty_summary()),
+            "inter_token": (o.inter_token.summary() if o is not None
+                            else Histogram.empty_summary()),
+        }
         if self.prefix_cache is not None:
             s["prefix_cache"] = self.prefix_cache.stats()
         per_req = {}
@@ -599,8 +769,14 @@ class ServingEngine:
         decode+sample block per horizon) — the serving tests assert these
         stay bounded. Counted from the engine's own input avals because
         the underlying compiled caches are deliberately shared across
-        engines on the same model."""
-        counts = {name: len(shapes)
-                  for name, shapes in self._exec_shapes.items()}
+        engines on the same model; with metrics on, the counts are read
+        from the registry's `serving_jit_compile_misses_total{family=}`
+        counters (kept in lockstep by `_note_exec`)."""
+        if self._obs is not None:
+            counts = {fam: int(c.value)
+                      for fam, c in self._obs.compile_miss.items()}
+        else:
+            counts = {name: len(shapes)
+                      for name, shapes in self._exec_shapes.items()}
         counts["total"] = sum(counts.values())
         return counts

@@ -67,6 +67,25 @@ class BlockAllocator:
         # LIFO keeps recently-freed (cache-warm) pages in rotation
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._refs: dict[int, int] = {}
+        # observability counters (bind_metrics); unbound allocators pay a
+        # single None check per page event
+        self._m_alloc = None
+        self._m_recycle = None
+        self._m_share = None
+
+    def bind_metrics(self, registry) -> None:
+        """Attach page-lifecycle counters from an observability
+        MetricsRegistry (the engine binds its own registry here, so
+        alloc/recycle/share rates land next to the serving metrics).
+        Handles are resolved once — no registry lookups on page ops."""
+        self._m_alloc = registry.counter(
+            "serving_kv_page_allocs_total", "pages handed out")
+        self._m_recycle = registry.counter(
+            "serving_kv_page_recycles_total",
+            "pages returned to the free list (last reference dropped)")
+        self._m_share = registry.counter(
+            "serving_kv_page_shares_total",
+            "extra references acquired on shared pages")
 
     @property
     def num_free(self) -> int:
@@ -87,6 +106,8 @@ class BlockAllocator:
             return None
         page = self._free.pop()
         self._refs[page] = 1
+        if self._m_alloc is not None:
+            self._m_alloc.inc()
         return page
 
     def alloc_n(self, n: int) -> Optional[List[int]]:
@@ -103,6 +124,8 @@ class BlockAllocator:
         if page not in self._refs:
             raise ValueError(f"acquire of free/unknown page {page}")
         self._refs[page] += 1
+        if self._m_share is not None:
+            self._m_share.inc()
 
     def free(self, page: int) -> None:
         """Drop one reference; the page returns to the free list only when
@@ -115,6 +138,8 @@ class BlockAllocator:
         if self._refs[page] == 0:
             del self._refs[page]
             self._free.append(page)
+            if self._m_recycle is not None:
+                self._m_recycle.inc()
 
     def free_all(self, pages: Sequence[int]) -> None:
         for p in pages:

@@ -38,6 +38,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..observability import MetricsRegistry
 from ..profiler import RecordEvent
 from .kv_cache import BlockAllocator
 
@@ -60,14 +61,32 @@ class PrefixNode:
 
 
 class PrefixCache:
-    def __init__(self, allocator: BlockAllocator, page_size: int):
+    def __init__(self, allocator: BlockAllocator, page_size: int,
+                 metrics: Optional[MetricsRegistry] = None):
         self.allocator = allocator
         self.page_size = page_size
         self._root = PrefixNode(chunk=(), page=None, parent=None)
         self._tick = 0
         self._num_pages = 0
-        self._stats = {"lookups": 0, "hit_tokens": 0, "miss_tokens": 0,
-                       "evictions": 0}
+        # hit/miss/eviction accounting lives in the observability
+        # registry (the engine's, so serving stats share one source of
+        # truth); standalone caches get a private registry so `stats()`
+        # still works — there is no parallel hand-kept dict either way
+        reg = metrics if metrics is not None else MetricsRegistry()
+        self._m_lookups = reg.counter(
+            "serving_prefix_lookups_total", "committed prefix lookups")
+        self._m_hit = reg.counter(
+            "serving_prefix_hit_tokens_total",
+            "prompt tokens served from cached pages")
+        self._m_miss = reg.counter(
+            "serving_prefix_miss_tokens_total",
+            "prompt tokens prefilled fresh")
+        self._m_evict = reg.counter(
+            "serving_prefix_evictions_total",
+            "cached pages reclaimed by LRU eviction")
+        self._m_pages = reg.gauge(
+            "serving_prefix_cached_pages",
+            "pages resident in the radix tree")
 
     # ------------------------------------------------------------- lookup
     def match(self, tokens: Sequence[int]) -> List[int]:
@@ -96,9 +115,9 @@ class PrefixCache:
     def record(self, total_tokens: int, hit_tokens: int) -> None:
         """Count one committed lookup (called on successful admission, so
         a deferred-and-retried request isn't double counted)."""
-        self._stats["lookups"] += 1
-        self._stats["hit_tokens"] += hit_tokens
-        self._stats["miss_tokens"] += total_tokens - hit_tokens
+        self._m_lookups.inc()
+        self._m_hit.inc(hit_tokens)
+        self._m_miss.inc(total_tokens - hit_tokens)
 
     # ------------------------------------------------------------- insert
     def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
@@ -124,6 +143,8 @@ class PrefixCache:
                 added += 1
             child.last_used = self._tick
             node = child
+        if added:
+            self._m_pages.set(self._num_pages)
         return added
 
     # ----------------------------------------------------------- eviction
@@ -151,8 +172,10 @@ class PrefixCache:
             del victim.parent.children[victim.chunk]
             self.allocator.free(victim.page)
             self._num_pages -= 1
-            self._stats["evictions"] += 1
+            self._m_evict.inc()
             freed += 1
+        if freed:
+            self._m_pages.set(self._num_pages)
         return freed
 
     def flush(self) -> int:
@@ -166,7 +189,11 @@ class PrefixCache:
         return self._num_pages
 
     def stats(self) -> Dict[str, object]:
-        s = dict(self._stats)
+        """Thin view over the registry counters (same keys as ever)."""
+        s = {"lookups": int(self._m_lookups.value),
+             "hit_tokens": int(self._m_hit.value),
+             "miss_tokens": int(self._m_miss.value),
+             "evictions": int(self._m_evict.value)}
         seen = s["hit_tokens"] + s["miss_tokens"]
         s["hit_rate"] = s["hit_tokens"] / seen if seen else 0.0
         s["cached_pages"] = self._num_pages

@@ -86,6 +86,10 @@ class Request:
     arrival_t: float = dataclasses.field(default_factory=time.perf_counter)
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
+    # host-visible time of the most recent emitted token (feeds the
+    # inter-token latency histogram; survives preemption so the requeue
+    # gap shows up honestly)
+    last_token_t: Optional[float] = None
 
     @property
     def num_tokens(self) -> int:
@@ -115,7 +119,7 @@ class Scheduler:
     def __init__(self, allocator: BlockAllocator, page_size: int,
                  max_batch_size: int, max_pages_per_seq: int,
                  prefix_cache=None, decode_horizon: int = 1,
-                 drain_hook=None):
+                 drain_hook=None, obs=None):
         self.allocator = allocator
         self.page_size = page_size
         self.max_batch_size = max_batch_size
@@ -127,6 +131,10 @@ class Scheduler:
         # (a) device-finished requests release their pages and (b) a
         # preemption victim's undrained tokens reach host state first
         self.drain_hook = drain_hook
+        # observability hooks (the engine's ServingObs: lifecycle points
+        # for enqueue/admit/preempt/finish, preemption counter, per-step
+        # queue-depth + page-pool gauges). None = zero metrics work.
+        self.obs = obs
         self.waiting: List[Request] = []
         self.running: List[Request] = []
 
@@ -139,6 +147,8 @@ class Scheduler:
                 f"request needs {need} pages > max_pages_per_seq "
                 f"{self.max_pages_per_seq}; raise max_seq_len/page budget")
         self.waiting.append(req)
+        if self.obs is not None:
+            self.obs.enqueued(req)
 
     def finish(self, req: Request) -> None:
         """Drop a completed request's page references; a page returns to
@@ -148,6 +158,8 @@ class Scheduler:
         req.pages = []
         if req in self.running:
             self.running.remove(req)
+        if self.obs is not None:
+            self.obs.finished(req)
 
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
@@ -225,6 +237,8 @@ class Scheduler:
             self.prefix_cache.record(len(req.prompt), req.cached_tokens)
         req.status = "running"
         self.running.append(req)
+        if self.obs is not None:
+            self.obs.admitted(req)
         return req
 
     def _preempt(self, victim: Request) -> None:
@@ -244,6 +258,8 @@ class Scheduler:
         victim.status = "waiting"
         victim.preemptions += 1
         self.waiting.insert(0, victim)
+        if self.obs is not None:
+            self.obs.preempted(victim)
 
     def _ensure_decode_pages(self) -> None:
         """Copy-on-extend, one decode BLOCK at a time: every running
@@ -281,6 +297,10 @@ class Scheduler:
                     break
 
     def schedule(self) -> ScheduleDecision:
+        if self.obs is not None:
+            # queue-depth + page-pool gauges, sampled once per step
+            self.obs.sample_queues(len(self.waiting), len(self.running),
+                                   self.allocator)
         admitted = self._try_admit()
         if admitted is not None:
             return ScheduleDecision(kind="prefill", prefill=admitted)

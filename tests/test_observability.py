@@ -1,0 +1,360 @@
+"""paddle_tpu.observability: registry get-or-create semantics, log-bucket
+histogram percentile accuracy on known distributions, Prometheus text
+exposition (parsed, not eyeballed), JSON snapshot round-trip, the
+request-lifecycle tracker folding spans into profiler chrome-trace
+exports, and the tools/trace_summary.py CLI over a synthetic trace.
+
+Engine-level observability (stats() as a registry view, lifecycle under
+preemption, the metrics-disabled overhead guard) lives in
+tests/test_serving.py next to the serving fixtures. Everything here is
+model-free and jit-free; only the large-sample distribution sweep is
+`slow`.
+"""
+import importlib.util
+import json
+import math
+import os
+import re
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import (
+    Counter, Gauge, Histogram, LifecycleTracker, MetricsRegistry,
+    global_registry, registry_from_snapshot, to_prometheus,
+)
+
+
+# ------------------------------------------------------ counters / gauges
+
+class TestCountersAndGauges:
+    def test_counter_monotonic(self):
+        c = Counter("tokens_total")
+        c.inc()
+        c.inc(5)
+        assert c.value == 6 and isinstance(c.value, int)
+        with pytest.raises(ValueError, match="only go up"):
+            c.inc(-1)
+
+    def test_counter_float_accumulation(self):
+        c = Counter("seconds_total")
+        c.inc(0.25)
+        c.inc(0.5)
+        assert abs(c.value - 0.75) < 1e-12
+
+    def test_gauge_set_inc_dec(self):
+        g = Gauge("queue_depth")
+        g.set(4)
+        g.inc()
+        g.dec(2)
+        assert g.value == 3
+
+
+# --------------------------------------------------------------- registry
+
+class TestRegistry:
+    def test_get_or_create_returns_same_object(self):
+        r = MetricsRegistry()
+        a = r.counter("x_total", "help")
+        b = r.counter("x_total")
+        assert a is b and len(r) == 1
+
+    def test_labels_create_distinct_series(self):
+        r = MetricsRegistry()
+        a = r.gauge("depth", labels={"state": "waiting"})
+        b = r.gauge("depth", labels={"state": "running"})
+        assert a is not b and len(r) == 2
+        assert r.get("depth", {"state": "waiting"}) is a
+        assert r.get("depth") is None        # unlabelled series not created
+
+    def test_kind_conflict_raises(self):
+        r = MetricsRegistry()
+        r.counter("x_total")
+        with pytest.raises(TypeError, match="already registered"):
+            r.gauge("x_total")
+
+    def test_name_validation(self):
+        r = MetricsRegistry()
+        with pytest.raises(ValueError, match="invalid metric name"):
+            r.counter("bad-name")
+        with pytest.raises(ValueError, match="invalid label name"):
+            r.counter("ok_total", labels={"bad-label": "v"})
+
+    def test_global_registry_is_a_singleton(self):
+        assert global_registry() is global_registry()
+
+
+# -------------------------------------------------------------- histogram
+
+class TestHistogram:
+    def test_bucket_edges_and_overflow(self):
+        h = Histogram("lat", lo=1.0, hi=16.0, growth=2.0)   # 4 buckets
+        assert h.num_buckets == 4
+        for v in (0.0, -3.0, 0.5):       # underflow incl. zero/negative
+            h.observe(v)
+        h.observe(1.0)                   # first real bucket [1, 2)
+        h.observe(15.9)                  # last real bucket [8, 16)
+        h.observe(16.0)                  # overflow
+        h.observe(1e9)
+        assert h._counts[0] == 3
+        assert h._counts[1] == 1
+        assert h._counts[h.num_buckets] == 1
+        assert h._counts[-1] == 2
+        assert h.count == 7
+
+    def test_nan_dropped(self):
+        h = Histogram("lat")
+        h.observe(float("nan"))
+        assert h.count == 0
+
+    def test_empty_percentiles_and_summary(self):
+        h = Histogram("lat")
+        assert h.percentile(50) == 0.0
+        assert h.summary() == Histogram.empty_summary()
+        assert h.summary()["p99"] == 0.0
+
+    def test_point_mass_reports_exactly(self):
+        """min/max clamping makes a constant stream report its exact
+        value at every percentile, despite ~19%-wide buckets."""
+        h = Histogram("lat")
+        for _ in range(100):
+            h.observe(0.125)
+        for q in (1, 50, 99, 100):
+            assert h.percentile(q) == 0.125
+        s = h.summary()
+        assert s["count"] == 100 and abs(s["mean"] - 0.125) < 1e-12
+
+    def test_log_uniform_percentiles_within_bucket_error(self):
+        """Geometric interpolation is exact for log-uniform data up to
+        bucket quantization: p50/p95/p99 within the bucket growth factor
+        of numpy's exact percentiles."""
+        rng = np.random.default_rng(7)
+        vals = np.exp(rng.uniform(np.log(1e-3), np.log(10.0), 4000))
+        h = Histogram("lat", lo=1e-5, hi=600.0)
+        for v in vals:
+            h.observe(float(v))
+        for q in (50, 95, 99):
+            est, exact = h.percentile(q), float(np.percentile(vals, q))
+            assert abs(est - exact) / exact < h.growth - 1.0 + 0.02, \
+                f"p{q}: {est} vs exact {exact}"
+
+    @pytest.mark.slow            # distribution-heavy: 200k-sample sweeps
+    def test_percentile_accuracy_on_known_distributions(self):
+        """Exponential and lognormal at 200k samples: relative error
+        bounded by one bucket ratio at the default growth, and by ~9%
+        with a finer growth=2**0.125 histogram."""
+        rng = np.random.default_rng(123)
+        dists = {
+            "exponential": rng.exponential(0.05, 200_000),
+            "lognormal": rng.lognormal(-3.0, 1.0, 200_000),
+        }
+        for growth, tol in ((2 ** 0.25, 0.20), (2 ** 0.125, 0.095)):
+            for name, vals in dists.items():
+                h = Histogram("lat", lo=1e-6, hi=600.0, growth=growth)
+                for v in vals:
+                    h.observe(float(v))
+                for q in (50, 95, 99):
+                    est = h.percentile(q)
+                    exact = float(np.percentile(vals, q))
+                    rel = abs(est - exact) / exact
+                    assert rel < tol, \
+                        f"{name} p{q} growth={growth}: rel err {rel:.3f}"
+
+    def test_bounded_memory(self):
+        """Bucket count is fixed by (lo, hi, growth), never by the number
+        of observations."""
+        h = Histogram("lat")
+        n_buckets = len(h._counts)
+        for v in np.linspace(1e-6, 700, 10_000):
+            h.observe(float(v))
+        assert len(h._counts) == n_buckets
+        assert sum(h._counts) == h.count == 10_000
+
+
+# -------------------------------------------------------------- exporters
+
+def _sample_registry():
+    r = MetricsRegistry()
+    r.counter("serving_tokens_generated_total", "tokens").inc(42)
+    r.counter("serving_jit_compile_misses_total", "misses",
+              labels={"family": "prefill"}).inc(2)
+    r.gauge("serving_queue_depth", "depth",
+            labels={"state": "waiting"}).set(3)
+    h = r.histogram("serving_ttft_seconds", "ttft")
+    for v in (0.001, 0.002, 0.004, 0.1, 2.0):
+        h.observe(v)
+    return r
+
+
+# one sample line: name{labels}? value  (value may be +Inf/-Inf/float/int)
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r' (\+Inf|-Inf|-?[0-9.]+(e[+-]?[0-9]+)?)$')
+
+
+class TestPrometheusExport:
+    def test_text_parses_line_by_line(self):
+        text = to_prometheus(_sample_registry())
+        assert text.endswith("\n")
+        types = {}
+        for line in text.strip().split("\n"):
+            if line.startswith("# TYPE"):
+                _, _, name, kind = line.split(" ")
+                types[name] = kind
+            elif not line.startswith("#"):
+                assert _PROM_LINE.match(line), f"unparseable: {line!r}"
+        assert types["serving_tokens_generated_total"] == "counter"
+        assert types["serving_queue_depth"] == "gauge"
+        assert types["serving_ttft_seconds"] == "histogram"
+
+    def test_histogram_exposition_is_cumulative_and_consistent(self):
+        text = to_prometheus(_sample_registry())
+        buckets = []
+        for line in text.split("\n"):
+            if line.startswith("serving_ttft_seconds_bucket"):
+                buckets.append(int(line.rsplit(" ", 1)[1]))
+        assert buckets == sorted(buckets)            # cumulative
+        assert buckets[-1] == 5                      # +Inf == count
+        assert "serving_ttft_seconds_count 5" in text
+        m = re.search(r"serving_ttft_seconds_sum ([0-9.e+-]+)", text)
+        assert m and abs(float(m.group(1)) - 2.107) < 1e-9
+        assert 'le="+Inf"' in text
+
+    def test_one_type_line_per_name_across_label_series(self):
+        r = MetricsRegistry()
+        r.gauge("depth", labels={"state": "waiting"}).set(1)
+        r.gauge("depth", labels={"state": "running"}).set(2)
+        text = to_prometheus(r)
+        assert text.count("# TYPE depth gauge") == 1
+        assert 'depth{state="running"} 2' in text
+        assert 'depth{state="waiting"} 1' in text
+
+    def test_label_escaping(self):
+        r = MetricsRegistry()
+        r.counter("c_total", labels={"path": 'a"b\\c'}).inc()
+        text = to_prometheus(r)
+        assert r'path="a\"b\\c"' in text
+
+
+class TestJsonSnapshot:
+    def test_snapshot_roundtrips_through_json(self):
+        reg = _sample_registry()
+        snap = reg.snapshot()
+        wire = json.dumps(snap)                      # must be JSON-able
+        rebuilt = registry_from_snapshot(json.loads(wire))
+        assert rebuilt.snapshot() == snap
+        # rebuilt histograms are LIVE: percentiles still work
+        h = rebuilt.get("serving_ttft_seconds")
+        orig = reg.get("serving_ttft_seconds")
+        assert h.count == 5
+        assert h.percentile(50) == orig.percentile(50)
+        assert rebuilt.get("serving_tokens_generated_total").value == 42
+
+    def test_empty_registry_roundtrip(self):
+        snap = MetricsRegistry().snapshot()
+        assert snap == {"metrics": []}
+        assert registry_from_snapshot(snap).snapshot() == snap
+
+
+# ------------------------------------------------------ lifecycle tracker
+
+class TestLifecycleTracker:
+    def test_retention_order_and_stages(self):
+        lt = LifecycleTracker()
+        lt.point(3, "enqueued", t=1.0)
+        lt.point(3, "admitted", t=2.0)
+        lt.span(3, "prefill", 2.0, 2.5)
+        lt.span(3, "decode_block", 2.5, 3.0, retain=False)
+        lt.point(3, "finished", t=3.0)
+        assert lt.stages(3) == ["enqueued", "admitted", "prefill",
+                                "finished"]
+        assert lt.events(3)[2] == ("prefill", 2.0, 2.5)
+        assert lt.request_ids() == [3]
+        assert "prefill" in lt.timeline(3)
+
+    def test_retention_is_bounded(self):
+        lt = LifecycleTracker(max_events_per_request=4)
+        for i in range(10):
+            lt.point(1, f"s{i}", t=float(i))
+        assert len(lt.events(1)) == 4
+        assert lt.dropped == 6
+
+    def test_spans_fold_into_profiler_chrome_trace(self, tmp_path):
+        from paddle_tpu import profiler as P
+
+        lt = LifecycleTracker()
+        prof = P.Profiler(timer_only=True,
+                          on_trace_ready=P.export_chrome_tracing(
+                              str(tmp_path)))
+        prof.start()
+        lt.point(7, "enqueued")
+        lt.span(7, "prefill", 10.0, 10.5)
+        prof.stop()
+        files = list(tmp_path.glob("*.json"))
+        assert files
+        with open(files[0]) as f:
+            names = [e["name"] for e in json.load(f)["traceEvents"]]
+        assert "serving.request[7].enqueued" in names
+        assert "serving.request[7].prefill" in names
+
+    def test_unarmed_spans_stay_out_of_profiler_but_are_retained(self):
+        from paddle_tpu.profiler import _HOST_TRACER
+
+        lt = LifecycleTracker()
+        before = len(_HOST_TRACER.events)
+        lt.point(9, "enqueued")
+        assert len(_HOST_TRACER.events) == before    # no armed window
+        assert lt.stages(9) == ["enqueued"]
+
+
+# ---------------------------------------------------------- trace summary
+
+def _trace_summary_mod():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("trace_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_SYNTH_EVENTS = [
+    {"name": "step", "ph": "X", "ts": 0, "dur": 100, "pid": 1, "tid": 1},
+    {"name": "child", "ph": "X", "ts": 10, "dur": 30, "pid": 1, "tid": 1},
+    {"name": "grandchild", "ph": "X", "ts": 12, "dur": 5, "pid": 1,
+     "tid": 1},
+    {"name": "serving.request[3].prefill", "ph": "X", "ts": 5, "dur": 20,
+     "pid": 1, "tid": 2},
+    {"name": "serving.request[3].first_token", "ph": "X", "ts": 25,
+     "dur": 0, "pid": 1, "tid": 2},
+    {"name": "serving.request[4].prefill", "ph": "X", "ts": 30, "dur": 10,
+     "pid": 1, "tid": 2},
+    {"name": "meta", "ph": "M", "pid": 1, "tid": 1},     # ignored
+]
+
+
+class TestTraceSummary:
+    def test_span_stats_total_and_self_time(self):
+        ts = _trace_summary_mod()
+        stats = ts.span_stats(list(map(dict, _SYNTH_EVENTS)))
+        assert stats["step"]["total"] == 100
+        assert stats["step"]["self"] == 70           # minus child's 30
+        assert stats["child"]["self"] == 25          # minus grandchild's 5
+        assert stats["grandchild"]["self"] == 5
+        assert "meta" not in stats
+
+    def test_request_timelines_group_and_order(self):
+        ts = _trace_summary_mod()
+        tl = ts.request_timelines(list(map(dict, _SYNTH_EVENTS)))
+        assert sorted(tl) == [3, 4]
+        assert [s for s, _, _ in tl[3]] == ["prefill", "first_token"]
+
+    def test_cli_end_to_end(self, tmp_path, capsys):
+        ts = _trace_summary_mod()
+        path = tmp_path / "trace.json"
+        path.write_text(json.dumps({"traceEvents": _SYNTH_EVENTS}))
+        assert ts.main([str(path), "--requests", "--top", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "step" in out
+        assert "request 3:" in out and "first_token" in out

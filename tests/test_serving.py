@@ -849,6 +849,205 @@ class TestDecodeHorizon:
         assert run(1) == run(8) == run(1)
 
 
+# ---------------------------------------------------- observability wiring
+
+class TestServingObservability:
+    """ISSUE 4: stats()/compile_counts() are thin views over ONE metrics
+    registry, per-request lifecycle spans land in chrome-trace exports,
+    and a metrics-disabled engine does literally no registry work on the
+    hot path. Engines here reuse the module model + fast-lane shapes, so
+    no new executables compile."""
+
+    def _run_two(self, **kw):
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=32, prefill_buckets=(16, 32), **kw)
+        rids = [eng.add_request([1, 2, 3], max_new_tokens=4,
+                                temperature=0.0),
+                eng.add_request([4, 5, 6, 7], max_new_tokens=4,
+                                temperature=0.0)]
+        eng.run()
+        return eng, rids
+
+    def test_stats_is_registry_view_and_backward_compatible(self):
+        eng, rids = self._run_two()
+        st = eng.stats()
+        # every pre-observability key survives the refactor (pin)
+        assert set(st) >= {
+            "prefill_steps", "decode_steps", "tokens_generated",
+            "prefill_time_s", "decode_time_s", "preemptions",
+            "host_syncs", "decode_tokens_per_s", "decode_horizon",
+            "tokens_per_sync", "num_requests", "num_finished",
+            "free_pages", "requests", "latency"}
+        assert st["tokens_generated"] == 8 and st["prefill_steps"] == 2
+        assert st["num_finished"] == 2 and st["host_syncs"] >= 3
+        # the registry IS the source: same counter, same number
+        reg = eng.metrics
+        assert reg.get("serving_tokens_generated_total").value == 8
+        assert reg.get("serving_host_syncs_total").value == \
+            st["host_syncs"]
+        assert reg.get("serving_queue_depth",
+                       {"state": "running"}) is not None
+        assert reg.get("serving_kv_free_pages").value >= 0
+        # allocator page counters balanced after a full drain
+        allocs = reg.get("serving_kv_page_allocs_total").value
+        recycles = reg.get("serving_kv_page_recycles_total").value
+        assert allocs == recycles > 0
+
+    def test_latency_percentiles_from_histograms(self):
+        eng, rids = self._run_two()
+        lat = eng.stats()["latency"]
+        for section in ("ttft", "inter_token"):
+            for key in ("count", "mean", "p50", "p95", "p99"):
+                assert key in lat[section], (section, key)
+        assert lat["ttft"]["count"] == 2
+        assert lat["ttft"]["p50"] > 0.0
+        assert lat["ttft"]["p50"] <= lat["ttft"]["p95"] \
+            <= lat["ttft"]["p99"]
+        # inter-token: every token after each request's first
+        assert lat["inter_token"]["count"] == 8 - 2
+        # percentile view matches per-request ttft ground truth
+        ttfts = [eng.stats()["requests"][r]["ttft_s"] for r in rids]
+        assert lat["ttft"]["p99"] <= max(ttfts) * 1.01 + 1e-9
+
+    def test_compile_counts_read_from_registry(self):
+        eng, _ = self._run_two()
+        counts = eng.compile_counts()
+        reg_counts = {
+            fam: eng.metrics.get("serving_jit_compile_misses_total",
+                                 {"family": fam}).value
+            for fam in ("prefill", "prefill_offset", "decode", "sample")}
+        assert counts["prefill"] == reg_counts["prefill"] == 1
+        assert counts["decode"] == reg_counts["decode"] == 1
+        assert counts["sample"] == reg_counts["sample"] == 0
+        # dedup sets and registry counters stay in lockstep
+        assert {f: len(s) for f, s in eng._exec_shapes.items()} == \
+            reg_counts
+
+    def test_exporters_over_a_live_engine_registry(self):
+        import json as _json
+
+        from paddle_tpu.observability import (registry_from_snapshot,
+                                              to_prometheus)
+
+        eng, _ = self._run_two()
+        text = to_prometheus(eng.metrics)
+        assert "# TYPE serving_ttft_seconds histogram" in text
+        assert "serving_ttft_seconds_count 2" in text
+        assert "serving_tokens_generated_total 8" in text
+        snap = eng.metrics.snapshot()
+        rebuilt = registry_from_snapshot(_json.loads(_json.dumps(snap)))
+        assert rebuilt.snapshot() == snap
+        assert rebuilt.get("serving_ttft_seconds").percentile(50) > 0
+
+    def test_chrome_trace_contains_request_lifecycle_spans(self,
+                                                           tmp_path):
+        import json as _json
+
+        from paddle_tpu import profiler as prof_mod
+
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=32, prefill_buckets=(16, 32))
+        prof = prof_mod.Profiler(
+            timer_only=True,
+            on_trace_ready=prof_mod.export_chrome_tracing(str(tmp_path)))
+        prof.start()
+        rid = eng.add_request([1, 2, 3, 4], max_new_tokens=4,
+                              temperature=0.0)
+        eng.run()
+        prof.stop()
+        files = list(tmp_path.glob("*.json"))
+        assert files
+        with open(files[0]) as f:
+            names = {e["name"] for e in _json.load(f)["traceEvents"]}
+        for stage in ("enqueued", "admitted", "prefill", "first_token",
+                      "decode_block", "finished"):
+            assert f"serving.request[{rid}].{stage}" in names, stage
+        # batch-level RecordEvent spans share the same timeline
+        assert "serving.prefill" in names
+        assert "serving.host_drain" in names
+
+    def test_scheduler_lifecycle_ordering_under_preemption(self):
+        """Span ordering pin, jit-free: a preempted request's lifecycle
+        reads enqueued < admitted < preempted < requeued < admitted
+        (re-admission), and the registry preemption counter matches."""
+        from paddle_tpu.observability import MetricsRegistry
+        from paddle_tpu.serving import ServingObs
+
+        obs = ServingObs(MetricsRegistry())
+        alloc = BlockAllocator(6)                    # 5 usable pages
+        sched = Scheduler(alloc, page_size=4, max_batch_size=2,
+                          max_pages_per_seq=8, obs=obs)
+        a = Request(prompt=[1] * 8, max_new_tokens=8,
+                    sampling=SamplingParams())       # admission: 3 pages
+        b = Request(prompt=[2] * 4, max_new_tokens=8,
+                    sampling=SamplingParams())       # admission: 2 pages
+        sched.add(a)
+        sched.add(b)
+        assert sched.schedule().prefill is a
+        assert sched.schedule().prefill is b         # pool now full
+        a.generated = [0] * 5                        # a needs a 4th page
+        b.generated = [0] * 2                        # b fits its 2 pages
+        d = sched.schedule()                         # preempts youngest: b
+        assert d.kind == "decode" and d.decode == [a]
+        assert b.status == "waiting" and b.preemptions == 1
+        assert obs.preemptions.value == 1
+        assert obs.lifecycle.stages(b.request_id) == [
+            "enqueued", "admitted", "preempted", "requeued"]
+        sched.finish(a)                              # frees a's pages
+        assert sched.schedule().prefill is b         # b re-admitted
+        stages = obs.lifecycle.stages(b.request_id)
+        assert stages == ["enqueued", "admitted", "preempted",
+                          "requeued", "admitted"]
+        assert obs.lifecycle.stages(a.request_id)[-1] == "finished"
+        # timestamps are monotone in emission order
+        times = [t0 for _, t0, _ in obs.lifecycle.events(b.request_id)]
+        assert times == sorted(times)
+
+    def test_metrics_disabled_hot_path_does_no_registry_work(
+            self, monkeypatch):
+        """THE overhead guard: with enable_metrics=False the engine holds
+        no registry at all, and a steady-state serving step touches no
+        metric object — pinned by making every metric entry point raise
+        and running a full request through the warm engine."""
+        import paddle_tpu.observability.metrics as obsm
+
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            enable_metrics=False)
+        assert eng.metrics is None and eng._obs is None
+        assert eng.scheduler.obs is None
+        assert eng.cache.allocator._m_alloc is None
+        # warm first: tracing MAY legitimately count trace-time dispatch
+        # selections in the global registry
+        eng.add_request([9, 8, 7], max_new_tokens=3, temperature=0.0)
+        eng.run()
+
+        def boom(*a, **kw):
+            raise AssertionError("metrics work on a disabled hot path")
+
+        for cls, meth in [(obsm.MetricsRegistry, "counter"),
+                          (obsm.MetricsRegistry, "gauge"),
+                          (obsm.MetricsRegistry, "histogram"),
+                          (obsm.Counter, "inc"),
+                          (obsm.Gauge, "set"), (obsm.Gauge, "inc"),
+                          (obsm.Histogram, "observe")]:
+            monkeypatch.setattr(cls, meth, boom)
+        rid = eng.add_request([1, 2, 3], max_new_tokens=4,
+                              temperature=0.0)
+        outs = eng.run()
+        assert len(outs[rid]) == 7
+        # stats() still returns the full (zeroed) shape without touching
+        # any metric object
+        st = eng.stats()
+        assert st["tokens_generated"] == 0
+        assert st["latency"]["ttft"]["count"] == 0
+        assert st["num_finished"] == 2
+        assert eng.compile_counts()["decode"] == 1   # set-based fallback
+
+
 # ------------------------------------------------ add_request validation
 
 class TestAddRequestRejection:
@@ -997,6 +1196,47 @@ class TestServingSlow:
             return [outs[r] for r in rids]
 
         assert run(1) == run(4) == run(8)
+
+    def test_request_lifecycle_spans_under_engine_preemption(self):
+        """End-to-end lifecycle ordering with real preemption: the
+        victim's retained spans read enqueued -> admitted -> prefill ->
+        first_token -> preempted -> requeued -> admitted -> prefill
+        (re-prefill) -> ... -> finished, the TTFT histogram counts each
+        request ONCE (preemption never re-observes first tokens), and
+        the registry preemption counter agrees with stats()."""
+        model = _llama()
+        rng = np.random.RandomState(3)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)) for n in (10, 8, 12)]
+        eng = ServingEngine(model, page_size=8, max_batch_size=3,
+                            max_seq_len=32, prefill_buckets=(16, 32),
+                            num_pages=8, decode_horizon=1)
+        rids = [eng.add_request(p, max_new_tokens=8, temperature=0.0)
+                for p in prompts]
+        eng.run()
+        st = eng.stats()
+        assert st["preemptions"] >= 1
+        lc = eng._obs.lifecycle
+        victims = [r for r in rids if "preempted" in lc.stages(r)]
+        assert victims
+        for rid in rids:
+            stages = lc.stages(rid)
+            assert stages[0] == "enqueued" and stages[-1] == "finished"
+            assert stages.index("admitted") < stages.index("prefill") \
+                < stages.index("first_token")
+            times = [t0 for _, t0, _ in lc.events(rid)]
+            assert times == sorted(times)
+        for rid in victims:
+            stages = lc.stages(rid)
+            i_pre = stages.index("preempted")
+            assert stages.index("first_token") < i_pre
+            assert stages[i_pre + 1] == "requeued"
+            # re-admission re-prefills: both stages appear again later
+            assert "admitted" in stages[i_pre:], stages
+            assert stages.count("prefill") >= 2
+        assert st["latency"]["ttft"]["count"] == len(rids)
+        assert eng.metrics.get("serving_preemptions_total").value == \
+            st["preemptions"]
 
     def test_seeded_requests_reproducible_across_engines(self):
         model = _llama()

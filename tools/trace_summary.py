@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Summarize a chrome trace exported by paddle_tpu.profiler.
+
+Two views over a `*.pt.trace.json` (or any chrome://tracing JSON):
+
+- top spans by TOTAL and SELF time (self = duration minus the time
+  covered by spans nested inside it on the same pid/tid — host spans
+  from RecordEvent/add_host_span nest properly, so "serving.prefill"
+  minus its children is genuine prefill host time);
+- per-request serving lifecycle timelines (`--requests`): the
+  observability LifecycleTracker names every span
+  `serving.request[<rid>].<stage>`, so the timeline of
+  enqueued -> admitted -> prefill -> first_token -> decode_block* ->
+  preempted/requeued -> finished reconstructs straight from the file.
+
+Usage:
+    python tools/trace_summary.py TRACE.json [--top N] [--requests]
+
+Standalone on purpose (json/argparse only): point it at a trace from any
+machine without installing the framework.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Dict, List, Tuple
+
+REQUEST_RE = re.compile(r"^serving\.request\[(\d+)\]\.(.+)$")
+
+
+def load_trace(path: str) -> List[dict]:
+    """traceEvents from either the object form ({"traceEvents": [...]})
+    or the bare-array chrome trace form."""
+    with open(path) as f:
+        obj = json.load(f)
+    return obj["traceEvents"] if isinstance(obj, dict) else obj
+
+
+def _complete_events(events: List[dict]) -> List[dict]:
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def span_stats(events: List[dict]) -> Dict[str, Dict[str, float]]:
+    """Per-name {count, total, self} in trace time units (µs for
+    profiler exports). Self time subtracts child spans nested on the
+    same (pid, tid); chrome complete events on one thread nest properly
+    by construction."""
+    stats: Dict[str, Dict[str, float]] = {}
+    by_thread: Dict[Tuple, List[dict]] = {}
+    for e in _complete_events(events):
+        by_thread.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    for evs in by_thread.values():
+        # parents before children: earlier start first, longer span first
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        stack: List[dict] = []          # open spans, innermost last
+        for e in evs:
+            dur = float(e.get("dur", 0))
+            end = e["ts"] + dur
+            while stack and e["ts"] >= stack[-1]["_end"] - 1e-9:
+                stack.pop()
+            if stack:                   # nested: charge the parent
+                stack[-1]["_child"] += dur
+            e["_end"], e["_child"] = end, 0.0
+            stack.append(e)
+            s = stats.setdefault(e["name"],
+                                 {"count": 0, "total": 0.0, "self": 0.0})
+            s["count"] += 1
+            s["total"] += dur
+        for e in evs:
+            stats[e["name"]]["self"] += max(
+                e.get("dur", 0) - e["_child"], 0.0)
+    return stats
+
+
+def request_timelines(events: List[dict]
+                      ) -> Dict[int, List[Tuple[str, float, float]]]:
+    """rid -> [(stage, start_ts, dur)] sorted by start time."""
+    out: Dict[int, List[Tuple[str, float, float]]] = {}
+    for e in _complete_events(events):
+        m = REQUEST_RE.match(e.get("name", ""))
+        if m:
+            out.setdefault(int(m.group(1)), []).append(
+                (m.group(2), float(e["ts"]), float(e.get("dur", 0))))
+    for evs in out.values():
+        evs.sort(key=lambda x: x[1])
+    return out
+
+
+def format_top(stats: Dict[str, Dict[str, float]], top: int = 20,
+               by: str = "total") -> str:
+    rows = sorted(stats.items(), key=lambda kv: kv[1][by], reverse=True)
+    lines = [f"{'name':<48}{'calls':>8}{'total(ms)':>12}{'self(ms)':>12}"
+             f"{'avg(ms)':>10}",
+             "-" * 90]
+    for name, s in rows[:top]:
+        lines.append(
+            f"{name[:47]:<48}{s['count']:>8}{s['total'] / 1e3:>12.3f}"
+            f"{s['self'] / 1e3:>12.3f}"
+            f"{s['total'] / s['count'] / 1e3:>10.3f}")
+    return "\n".join(lines)
+
+
+def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]]
+                    ) -> str:
+    if not timelines:
+        return ("no serving.request[<rid>].<stage> spans in this trace "
+                "(export one from a metrics-enabled ServingEngine run "
+                "inside an armed profiler window)")
+    lines = []
+    for rid in sorted(timelines):
+        evs = timelines[rid]
+        t0 = evs[0][1]
+        lines.append(f"request {rid}:")
+        for stage, ts, dur in evs:
+            tail = f"  ({dur / 1e3:.3f} ms)" if dur > 0 else ""
+            lines.append(f"  +{(ts - t0) / 1e3:10.3f} ms  {stage}{tail}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Top spans + per-request lifecycle timelines from a "
+                    "paddle_tpu chrome trace")
+    ap.add_argument("trace", help="chrome trace JSON path")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows in the span table (default 20)")
+    ap.add_argument("--by", choices=("total", "self"), default="total",
+                    help="span table sort key")
+    ap.add_argument("--requests", action="store_true",
+                    help="also print per-request lifecycle timelines")
+    args = ap.parse_args(argv)
+
+    events = load_trace(args.trace)
+    print(format_top(span_stats(events), top=args.top, by=args.by))
+    if args.requests:
+        print()
+        print(format_requests(request_timelines(events)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -313,6 +313,29 @@ def _run_serving_decode(on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
+def _run_serving_faults(on_tpu: bool) -> dict:
+    """Seeded chaos serving phase: the workload re-runs under a
+    FaultInjector schedule (transient dispatch faults, periodic alloc
+    faults, one persistent fault, one mid-flight cancel) and asserts
+    survivor-token parity against the fault-free run. Non-fatal like
+    the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_faults_phase(model, cfg, on_tpu)
+        _log(f"phase=serving_faults: fired {out['injected']['fired']} "
+             f"retries={out['transient_retries']} "
+             f"terminal={out['terminal']} "
+             f"survivor_parity_ok={out['survivor_parity_ok']} "
+             f"chaos_overhead={out['chaos_overhead']}x")
+        if not out["survivor_parity_ok"]:
+            _log("phase=serving_faults: WARN survivor parity FAILED")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_faults: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def make_train_step(model, opt):
     """The bench train step (fwd + MLM loss + grad + Adam, bf16 autocast).
 
@@ -505,6 +528,10 @@ def bench_child() -> None:
     # decode-horizon serving phase: same tiny model budget, non-fatal
     _enter_phase("serving_decode", 400.0)
     serving_decode = _run_serving_decode(on_tpu)
+
+    # seeded chaos phase: fault-injected run vs fault-free parity
+    _enter_phase("serving_faults", 400.0)
+    serving_faults = _run_serving_faults(on_tpu)
     _enter_phase("build")
 
     if on_tpu:
@@ -637,6 +664,7 @@ def bench_child() -> None:
                 "gates": gates,
                 "serving_prefix": serving_prefix,
                 "serving_decode": serving_decode,
+                "serving_faults": serving_faults,
                 "observability": _obs_snapshot(),
             },
         }

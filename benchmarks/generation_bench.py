@@ -7,7 +7,11 @@ reports mean ttft with the prefix cache on vs off (plus the hit rate), so
 one run shows what radix KV reuse buys on prefill-bound traffic; finally
 a serving_decode phase measures steady-state scheduled decode tokens/s
 and host-sync counts at decode_horizon 1 vs 8 (the fused multi-token
-decode block + async host/device overlap). Run directly:
+decode block + async host/device overlap); last, a serving_faults phase
+replays the workload under a seeded FaultInjector chaos schedule and
+asserts the survivors' tokens match the fault-free run (the resilience
+layer's isolation guarantee), reporting what the chaos cost. Run
+directly:
 
     python benchmarks/generation_bench.py [--cpu]
 
@@ -77,7 +81,8 @@ def main():
                    "decode_ms_per_token": round(decode_s_per_tok * 1000, 2),
                    "prefill_ms": round(prefill_s * 1000, 2),
                    "serving_prefix": serving_prefix_phase(m, cfg, on_tpu),
-                   "serving_decode": serving_decode_phase(m, cfg, on_tpu)},
+                   "serving_decode": serving_decode_phase(m, cfg, on_tpu),
+                   "serving_faults": serving_faults_phase(m, cfg, on_tpu)},
     }))
 
 
@@ -206,6 +211,77 @@ def serving_decode_phase(model, cfg, on_tpu):
                                             1e-9), 2),
         "sync_reduction": round(
             h1["syncs_per_token"] / max(h8["syncs_per_token"], 1e-9), 2),
+    }
+
+
+def serving_faults_phase(model, cfg, on_tpu):
+    """Resilience under a seeded chaos schedule: the same workload runs
+    fault-free and under a FaultInjector mixing transient dispatch
+    faults (retried with backoff), periodic alloc faults (degrade to
+    deferral/preemption), one persistent prefill fault (quarantines
+    exactly that request) and one mid-flight cancellation. Asserts the
+    SURVIVORS' token streams are identical to the fault-free run and the
+    allocator/scheduler invariants hold, and reports what the chaos
+    cost: fired counts, retries, terminal statuses, wall overhead."""
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.serving import FaultInjector, ServingEngine
+
+    rng = np.random.RandomState(11)
+    page_size = 16 if on_tpu else 8
+    max_seq = min(cfg.max_position_embeddings, 512 if on_tpu else 96)
+    n_req, new_tokens = 5, 24
+    prompts = [rng.randint(0, cfg.vocab_size, (6 + 3 * i,)).tolist()
+               for i in range(n_req)]
+
+    def build(fi=None):
+        eng = ServingEngine(model, page_size=page_size, max_batch_size=4,
+                            max_seq_len=max_seq, decode_horizon=4,
+                            fault_injector=fi, retry_backoff_s=0.0)
+        rids = [eng.add_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        return eng, rids
+
+    # warm compiles outside both timed regions
+    weng, _ = build()
+    weng.run()
+
+    eng0, rids0 = build()
+    t0 = time.perf_counter()
+    ref = eng0.run()
+    wall_ref = time.perf_counter() - t0
+
+    fi = (FaultInjector(seed=1234)
+          .fail_every("dispatch", 7)               # transient: retried
+          .fail_every("alloc", 5)                  # lossless deferral
+          .fail_at("dispatch", 2, transient=False))  # quarantines req #2
+    eng1, rids1 = build(fi)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        eng1.step()
+    eng1.cancel(rids1[-1])                         # mid-flight cancel
+    out = eng1.run()
+    wall_chaos = time.perf_counter() - t0
+    eng1.scheduler.check_consistency()
+
+    survivors = [(a, b) for a, b in zip(rids0, rids1)
+                 if eng1.status(b)[0] == "finished"]
+    parity_ok = bool(survivors) and all(
+        out[b] == ref[a] for a, b in survivors)
+    st = eng1.stats()
+    return {
+        "requests": n_req, "new_tokens": new_tokens,
+        "injected": {"checks": dict(fi.counts), "fired": dict(fi.fired)},
+        "transient_retries": st["transient_retries"],
+        "terminal": st["terminal"],
+        "survivors": len(survivors),
+        "survivor_parity_ok": parity_ok,
+        "consistency_ok": True,        # check_consistency() raised if not
+        "wall_fault_free_ms": round(wall_ref * 1000, 2),
+        "wall_chaos_ms": round(wall_chaos * 1000, 2),
+        "chaos_overhead": round(wall_chaos / max(wall_ref, 1e-9), 2),
     }
 
 

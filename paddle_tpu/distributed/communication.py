@@ -59,7 +59,10 @@ def _axis_in_scope(axis_name: str) -> bool:
     except Exception:  # noqa: BLE001 — private API; fall through to public
         pass
     try:
-        jax.lax.axis_size(axis_name)
+        axis_size = getattr(jax.lax, "axis_size", None)     # jax >= 0.5
+        if axis_size is None:                               # jax 0.4.x:
+            axis_size = jax.core.axis_frame                 # returns the size
+        axis_size(axis_name)
         return True
     except (NameError, KeyError, TypeError, ValueError):
         return False
@@ -91,7 +94,10 @@ def _axis_nranks(g: Group) -> int:
     the default group's nranks reflects the process world, which can differ
     from the mesh axis a shard_map region binds."""
     try:
-        return int(jax.lax.axis_size(g.axis_name))
+        axis_size = getattr(jax.lax, "axis_size", None)     # jax >= 0.5
+        if axis_size is None:                               # jax 0.4.x:
+            axis_size = jax.core.axis_frame                 # returns the size
+        return int(axis_size(g.axis_name))
     except (NameError, KeyError, TypeError, ValueError):
         return g.nranks
 

@@ -29,6 +29,13 @@ def _unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 
 
+def _axis_size(name):
+    fn = getattr(jax.lax, "axis_size", None)        # jax >= 0.5
+    if fn is None:
+        fn = jax.core.axis_frame                    # jax 0.4.x: returns size
+    return int(fn(name))
+
+
 def ring_flash_attention(q, k, v, group=None, causal: bool = False,
                          axis_name: Optional[str] = None,
                          scale: Optional[float] = None,
@@ -50,7 +57,7 @@ def ring_flash_attention(q, k, v, group=None, causal: bool = False,
     scale = scale if scale is not None else qd.shape[-1] ** -0.5
 
     try:
-        n = jax.lax.axis_size(name)
+        n = _axis_size(name)
     except (NameError, KeyError, Exception):
         n = 1
     if n == 1:
@@ -140,7 +147,7 @@ def ulysses_attention(q, k, v, group=None, causal: bool = False,
     qd, kd, vd = _unwrap(q), _unwrap(k), _unwrap(v)
     name = axis_name or (group.axis_name if group is not None else "sep")
     try:
-        n = jax.lax.axis_size(name)
+        n = _axis_size(name)
     except (NameError, KeyError, Exception):
         n = 1
     scale = scale if scale is not None else qd.shape[-1] ** -0.5

@@ -17,6 +17,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:                                    # jax >= 0.5 exports it at top level
+    from jax import shard_map as _shard_map
+except ImportError:                     # jax 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ....core.tensor import Tensor
 from .... import nn
 from ....nn import functional as F
@@ -267,7 +272,7 @@ class MoELayer(nn.Layer):
                 y, aux = self._routed_forward(x_loc, gw_loc, expert_run)
                 return y, jax.lax.pmean(aux, ep_axis)
 
-            return jax.shard_map(
+            return _shard_map(
                 local_fn, mesh=mesh,
                 in_specs=(P(ep_axis), P()) + tuple(P(ep_axis)
                                                    for _ in stacked_leaves),

@@ -97,7 +97,10 @@ def _sds(shape, dtype, vma):
     shard_map region (check_vma=True requires pallas outputs to declare
     which mesh axes they vary over)."""
     if vma is not None:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+        except TypeError:       # jax 0.4.x: no vma tracking to declare
+            pass
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
@@ -1062,7 +1065,10 @@ def ring_flash_attention_pallas(q, k, v, axis_name: str, causal=False,
     """Ring flash attention on raw (b, h, s_local, d) shards inside
     shard_map over `axis_name`. Differentiable (custom vjp rotating the
     gradient accumulators around the same ring)."""
-    n = int(jax.lax.axis_size(axis_name))
+    axis_size = getattr(jax.lax, "axis_size", None)         # jax >= 0.5
+    if axis_size is None:                                   # jax 0.4.x:
+        axis_size = jax.core.axis_frame                     # returns the size
+    n = int(axis_size(axis_name))
     b, h, s, d = q.shape
     if scale is None:
         scale = d ** -0.5

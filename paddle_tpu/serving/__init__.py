@@ -21,7 +21,13 @@ decoder models (LLaMA, GPT) with:
   latency/throughput counters exported through paddle_tpu.profiler. The
   decode hot path runs a fused decode+sample block of `decode_horizon`
   steps per jitted dispatch (device PRNG/EOS state, async host/device
-  overlap), syncing the host once per block instead of once per token.
+  overlap), syncing the host once per block instead of once per token;
+- `resilience`: failure semantics — `cancel()` in every request state,
+  per-request deadlines and bounded-queue load shedding
+  (`EngineOverloaded`), failure isolation with one transient retry
+  (quarantined requests end `failed`, everyone else keeps serving), and
+  a deterministic seeded `FaultInjector` over the dispatch/drain/alloc/
+  prefix_match sites. All of it strips to None checks when unused.
 
 See README.md "paddle_tpu.serving" for knobs and parity notes.
 """
@@ -35,6 +41,10 @@ from .kv_cache import (  # noqa: F401
     overflow_position, pages_for,
 )
 from .prefix_cache import PrefixCache, PrefixNode  # noqa: F401
+from .resilience import (  # noqa: F401
+    EngineOverloaded, FaultInjector, InjectedFault, TERMINAL_STATUSES,
+    is_transient,
+)
 from .scheduler import (  # noqa: F401
     Request, SamplingParams, ScheduleDecision, Scheduler,
 )
@@ -43,6 +53,8 @@ __all__ = [
     "ServingEngine", "ServingObs",
     "PagedKVCache", "PagedLayerCache", "BlockAllocator",
     "PrefixCache", "PrefixNode",
+    "EngineOverloaded", "FaultInjector", "InjectedFault",
+    "TERMINAL_STATUSES", "is_transient",
     "Scheduler", "ScheduleDecision", "Request", "SamplingParams",
     "paged_attend", "paged_decode_attention", "paged_decode_available",
     "advance_positions", "pages_for", "overflow_position",

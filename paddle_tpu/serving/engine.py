@@ -62,6 +62,7 @@ from .attention import advance_positions
 from .kv_cache import (PagedKVCache, PagedLayerCache, overflow_position,
                        pages_for)
 from .prefix_cache import PrefixCache
+from .resilience import TERMINAL_STATUSES, is_transient
 from .scheduler import Request, SamplingParams, Scheduler
 
 __all__ = ["ServingEngine", "ServingObs", "PAD_TOKEN"]
@@ -161,6 +162,19 @@ class ServingObs:
             "serving_inter_token_seconds",
             "per-token gap between host-visible emissions (a decode "
             "block's gap is spread evenly over its tokens)")
+        # resilience counters (ISSUE 6): one labelled series per
+        # non-finished terminal status, plus retry/park events
+        self.terminated = {
+            status: c("serving_requests_terminated_total",
+                      "requests reaching a non-finished terminal status",
+                      labels={"status": status})
+            for status in ("cancelled", "expired", "failed", "shed")}
+        self.retries = c("serving_transient_retries_total",
+                         "dispatch/drain sites retried after a "
+                         "transient fault")
+        self.parked_total = c("serving_requests_parked_total",
+                              "preemption-storm guard trips (victim "
+                              "requeued at the back of the queue)")
         self.queue_waiting = g("serving_queue_depth",
                                "scheduler queue depth",
                                labels={"state": "waiting"})
@@ -188,6 +202,17 @@ class ServingObs:
     def finished(self, req) -> None:
         self.lifecycle.point(req.request_id, "finished", req.finish_t)
 
+    def terminal(self, req, status: str) -> None:
+        """A request reached cancelled/expired/failed/shed: count it and
+        stamp the lifecycle so chrome traces and `trace_summary
+        --requests` show how the request ended."""
+        self.terminated[status].inc()
+        self.lifecycle.point(req.request_id, status, req.finish_t)
+
+    def parked(self, req) -> None:
+        self.parked_total.inc()
+        self.lifecycle.point(req.request_id, "parked")
+
     def sample_queues(self, waiting: int, running: int, allocator) -> None:
         self.queue_waiting.set(waiting)
         self.queue_running.set(running)
@@ -207,7 +232,12 @@ class ServingEngine:
                  enable_prefix_caching: bool = False,
                  decode_horizon: int = 8,
                  enable_metrics: bool = True,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 max_waiting: Optional[int] = None,
+                 max_queue_wait_s: Optional[float] = None,
+                 max_preemptions: Optional[int] = 8,
+                 fault_injector=None,
+                 retry_backoff_s: float = 0.02):
         from ..models.generation import _config_of
 
         self.model = model
@@ -243,18 +273,39 @@ class ServingEngine:
         self.prefix_cache = (PrefixCache(self.cache.allocator, page_size,
                                          metrics=self.metrics)
                              if enable_prefix_caching else None)
-        self.scheduler = Scheduler(self.cache.allocator, page_size,
-                                   max_batch_size, self.max_pages_per_seq,
-                                   prefix_cache=self.prefix_cache,
-                                   decode_horizon=self.decode_horizon,
-                                   drain_hook=self._drain_for_scheduler,
-                                   obs=self._obs)
+        # resilience (ISSUE 6): bounded queue + queue-wait shedding,
+        # per-request deadlines (add_request(deadline_s=...)), transient
+        # retry with backoff, preemption-storm parking, and seeded fault
+        # injection. Everything strips to a None/empty check when unused
+        # — the enable_metrics=False discipline.
+        self._max_queue_wait_s = (float(max_queue_wait_s)
+                                  if max_queue_wait_s is not None else None)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._faults = fault_injector
+        # live request ids carrying a deadline; the expiry sweep is
+        # skipped entirely while this is empty and no queue-wait bound
+        # is set, so deadline-free serving runs zero resilience code
+        self._deadlined: set = set()
+        if fault_injector is not None:
+            self.cache.allocator.bind_faults(fault_injector)
+            if self.prefix_cache is not None:
+                self.prefix_cache.bind_faults(fault_injector)
         self.prefill_buckets = tuple(sorted(
             prefill_buckets or _default_buckets(self.max_seq_len)))
         if self.prefill_buckets[-1] < self.max_seq_len:
             raise ValueError("prefill_buckets must cover max_seq_len "
                              "(preempted requests re-prefill at their "
                              "full current length)")
+        self.scheduler = Scheduler(self.cache.allocator, page_size,
+                                   max_batch_size, self.max_pages_per_seq,
+                                   prefix_cache=self.prefix_cache,
+                                   decode_horizon=self.decode_horizon,
+                                   drain_hook=self._drain_for_scheduler,
+                                   obs=self._obs,
+                                   max_waiting=max_waiting,
+                                   max_preemptions=max_preemptions,
+                                   max_prefill_tokens=
+                                   self.prefill_buckets[-1])
         self.params, self.buffers = extract_state(model)
         self.requests: Dict[int, Request] = {}
         # per-request PRNG state as raw (2,) uint32 key data, resident on
@@ -287,14 +338,22 @@ class ServingEngine:
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
                     temperature: float = 0.0, top_k: int = 0,
                     top_p: float = 1.0, seed: Optional[int] = None,
-                    eos_token_id: Optional[int] = None) -> int:
+                    eos_token_id: Optional[int] = None,
+                    deadline_s: Optional[float] = None) -> int:
         """Queue one prompt; returns a request id. Non-blocking — the
         request runs as `step()`/`stream()` turn the crank. ALL
         validation happens up front: a rejected request leaves no trace
-        (no page allocation, no engine/scheduler registration)."""
+        (no page allocation, no engine/scheduler registration). Raises
+        `EngineOverloaded` when the bounded waiting queue
+        (`max_waiting`) is full. `deadline_s` bounds the request's TOTAL
+        latency from arrival: past it, a waiting request is expired
+        before admission and a running one is cancelled at the next
+        block boundary (terminal status "expired" either way)."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0 (got {deadline_s})")
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -311,10 +370,15 @@ class ServingEngine:
                       sampling=SamplingParams(temperature, top_k, top_p,
                                               seed),
                       eos_token_id=eos_token_id)
-        # scheduler.add validates the page budget and may raise — only
-        # register the request with the engine once it is accepted
+        if deadline_s is not None:
+            req.deadline_t = req.arrival_t + deadline_s
+        # scheduler.add validates the page budget and the bounded queue
+        # and may raise (ValueError / EngineOverloaded) — only register
+        # the request with the engine once it is accepted
         self.scheduler.add(req)
         self.requests[req.request_id] = req
+        if deadline_s is not None:
+            self._deadlined.add(req.request_id)
         if seed is None:
             seed = int(np.random.randint(0, 2 ** 31 - 1))
         self._key_state[req.request_id] = jax.random.key_data(
@@ -328,6 +392,118 @@ class ServingEngine:
         req = self.requests[request_id]
         return list(req.prompt) + list(req.generated)
 
+    def status(self, request_id: int) -> Tuple[str, Optional[str]]:
+        """(status, error) for one request — error is set only for
+        status "failed" (the isolated failure, as text)."""
+        req = self.requests[request_id]
+        return req.status, req.error
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a request in ANY state: waiting (dequeued before it
+        ever runs), running (pages released through the refcounted path,
+        so shared prefix pages survive for the other holders), or
+        mid-decode-block with tokens in flight — the pending block is
+        DRAINED first, so already-sampled tokens surface through the
+        next `step()` and no dispatched computation keeps writing into
+        released pages. Returns True if the request was live and is now
+        "cancelled"; False for unknown/already-terminal ids (including a
+        request whose in-flight tokens completed it during the drain)."""
+        req = self.requests.get(request_id)
+        if req is None or req.status in TERMINAL_STATUSES:
+            return False
+        if self._pending is not None \
+                and request_id in self._pending["rids"]:
+            # drain first: the in-flight block's tokens reach host state
+            # (and the caller, via the spill queue) before teardown
+            self._spill.extend(self._drain_pending())
+            if req.status in TERMINAL_STATUSES:
+                return False      # the drained tokens finished it
+        return self._finalize(req, "cancelled")
+
+    # ----------------------------------------------------------- resilience
+    def _finalize(self, req: Request, status: str,
+                  error: Optional[str] = None) -> bool:
+        """Terminal transition through the scheduler (queues + refcounted
+        page release) plus engine-side deadline bookkeeping."""
+        done = self.scheduler.finalize(req, status, error=error)
+        if self._deadlined:
+            self._deadlined.discard(req.request_id)
+        return done
+
+    def _expire_and_shed(self) -> None:
+        """Deadline/queue-wait sweep, run at the top of `step()` — i.e.
+        at a block boundary — only while armed (some live request has a
+        deadline, or `max_queue_wait_s` is set): waiting requests past
+        their deadline expire and ones waiting longer than
+        `max_queue_wait_s` are shed, both BEFORE admission can spend
+        pages or a prefill on them; running requests past their deadline
+        are cancelled here, draining any in-flight block first."""
+        now = time.perf_counter()
+        for req in list(self.scheduler.waiting):
+            if req.deadline_t is not None and now >= req.deadline_t:
+                self._finalize(req, "expired")
+            elif self._max_queue_wait_s is not None and \
+                    now - req.arrival_t >= self._max_queue_wait_s:
+                self._finalize(req, "shed")
+        expired = [r for r in self.scheduler.running
+                   if r.deadline_t is not None and now >= r.deadline_t]
+        if expired:
+            if self._pending is not None:
+                # block boundary discipline: surface in-flight tokens
+                # and stop the device writing before releasing pages
+                self._spill.extend(self._drain_pending())
+            for req in expired:
+                if req.status == "running":   # drain may have finished it
+                    self._finalize(req, "expired")
+
+    def _guarded_call(self, site: str, fn):
+        """Failure-isolation wrapper for one jitted-dispatch or drain
+        site: consults the fault injector (when bound), retries a
+        TRANSIENT fault exactly once after `retry_backoff_s`, and
+        otherwise returns the exception for the caller to quarantine
+        with the right drain ordering. Returns (result, None) on
+        success, (None, exc) on isolation. The happy path runs no
+        resilience code beyond one None check."""
+        fi = self._faults
+        try:
+            if fi is not None:
+                fi.check(site)
+            return fn(), None
+        except Exception as e:  # noqa: BLE001 — isolation boundary
+            if not is_transient(e):
+                return None, e
+            if self._obs is not None:
+                self._obs.retries.inc()
+            if self.retry_backoff_s > 0:
+                time.sleep(self.retry_backoff_s)
+            try:
+                if fi is not None:
+                    fi.check(site)
+                return fn(), None
+            except Exception as e2:  # noqa: BLE001
+                return None, e2
+
+    def _quarantine(self, reqs: Sequence[Request], exc: BaseException,
+                    site: str) -> None:
+        """Isolate a failed dispatch/drain to exactly the implicated
+        requests: status "failed" with the error recorded on each
+        Request, pages released via refcounts, and the allocator +
+        scheduler invariants re-audited so the survivors keep serving on
+        a provably consistent pool. Any pending block belonging to the
+        implicated set is discarded (its device carries are suspect and
+        its writes target pages being released)."""
+        err = f"{site}: {type(exc).__name__}: {exc}"
+        rids = {r.request_id for r in reqs}
+        if self._pending is not None \
+                and rids & set(self._pending["rids"]):
+            rec, self._pending = self._pending, None
+            for i, r in enumerate(rec["reqs"]):
+                r.inflight = max(r.inflight - rec["incr"][i], 0)
+        for req in reqs:
+            if req.status not in TERMINAL_STATUSES:
+                self._finalize(req, "failed", error=err)
+        self.scheduler.check_consistency()
+
     # ---------------------------------------------------------------- steps
     def step(self) -> List[Tuple[int, int]]:
         """One scheduler decision + (at most) one jitted dispatch.
@@ -335,6 +511,8 @@ class ServingEngine:
         step — with a decode horizon and async overlap, a decode block's
         tokens surface one step AFTER its dispatch (the drain overlaps
         the next block's device time)."""
+        if self._deadlined or self._max_queue_wait_s is not None:
+            self._expire_and_shed()            # may spill drained tokens
         decision = self.scheduler.schedule()   # drain_hook may spill here
         spilled, self._spill = self._spill, []
         if decision.kind == "prefill":
@@ -346,9 +524,16 @@ class ServingEngine:
     def stream(self):
         """Generator of (request_id, token, done) events until every
         queued request completes."""
-        while self.scheduler.has_work() or self._pending is not None:
-            events = (self.step() if self.scheduler.has_work()
-                      else self._drain_pending())
+        while (self.scheduler.has_work() or self._pending is not None
+               or self._spill):
+            if self.scheduler.has_work():
+                events = self.step()
+            else:
+                # no schedulable work left: flush any spilled events
+                # (cancel/expiry drained them outside a step) plus the
+                # pending block
+                spilled, self._spill = self._spill, []
+                events = spilled + self._drain_pending()
             for i, (rid, tok) in enumerate(events):
                 done = (self.requests[rid].status == "finished"
                         and all(r != rid for r, _ in events[i + 1:]))
@@ -464,8 +649,8 @@ class ServingEngine:
                  jnp.asarray([sp.top_k], jnp.int32),
                  jnp.asarray([sp.top_p], jnp.float32))
         key_data = self._key_state[req.request_id][None]
-        t0 = time.perf_counter()
-        with RecordEvent("serving.prefill"):
+
+        def dispatch():
             if n_cached:
                 tok, new_kd, pools = self._prefill_offset_jit(bucket)(
                     self.params, self.buffers, jnp.asarray(ids),
@@ -479,7 +664,16 @@ class ServingEngine:
                     jnp.int32(len(suffix) - 1), key_data, *knobs)
             self.cache.pools = pools
             self._key_state[req.request_id] = new_kd[0]
-            token = int(np.asarray(tok)[0])
+            return int(np.asarray(tok)[0])
+
+        t0 = time.perf_counter()
+        with RecordEvent("serving.prefill"):
+            token, err = self._guarded_call("dispatch", dispatch)
+        if token is None:
+            # isolate THIS request; any pending decode block belongs to
+            # other (already-prefilled) requests and keeps flying
+            self._quarantine([req], err, "prefill")
+            return []
         if self.prefix_cache is not None:
             # register the prompt's full pages for future reuse (the
             # partial last page never enters the tree); in-flight
@@ -614,20 +808,36 @@ class ServingEngine:
             knobs = prev["knobs"]
         # in-flight accounting: the block may add up to min(h, budget)
         # tokens per row before the host sees them; _ensure_decode_pages
-        # reserves against this bound before the NEXT block
+        # reserves against this bound before the NEXT block (applied
+        # only once the dispatch actually succeeds)
         incr = []
         for req in reqs:
             cap = req.max_new_tokens - len(req.generated) - req.inflight
-            n = max(min(h, cap), 0)
-            req.inflight += n
-            incr.append(n)
+            incr.append(max(min(h, cap), 0))
+
+        def dispatch():
+            out = self._decode_block_jit(h)(
+                self.params, self.buffers, tokens, self.cache.pools,
+                page_tables, positions, key_data, *knobs, remaining)
+            self.cache.pools = out[1]
+            return out
+
         t0 = time.perf_counter()
         with RecordEvent("serving.decode_block"):
-            emitted, pools, tokens, positions, key_data, remaining = \
-                self._decode_block_jit(h)(
-                    self.params, self.buffers, tokens, self.cache.pools,
-                    page_tables, positions, key_data, *knobs, remaining)
-            self.cache.pools = pools
+            out, err = self._guarded_call("dispatch", dispatch)
+        if out is None:
+            # a decode dispatch implicates the whole batch. Drain the
+            # previous block FIRST (its tokens are sound and its writes
+            # must stop before pages are released), then isolate
+            # whatever is still running
+            ev = self._drain_pending()
+            self._quarantine(
+                [r for r in reqs if r.status == "running"], err,
+                "decode")
+            return events_prev + ev
+        emitted, pools, tokens, positions, key_data, remaining = out
+        for req, n in zip(reqs, incr):
+            req.inflight += n
         if self._obs is not None:
             self._obs.decode_steps.inc()
         self._pending = {
@@ -662,7 +872,19 @@ class ServingEngine:
         key state from the block's device carries."""
         o = self._obs
         with RecordEvent("serving.host_drain"):
-            toks = np.asarray(jax.device_get(rec["emitted"]))
+            toks, err = self._guarded_call(
+                "drain", lambda: np.asarray(jax.device_get(rec["emitted"])))
+        if toks is None:
+            # the block's tokens are unrecoverable: give back the
+            # in-flight reservation and isolate exactly the block's
+            # still-running requests (rec is already detached from
+            # self._pending, so teardown releases pages directly)
+            for i, req in enumerate(rec["reqs"]):
+                req.inflight = max(req.inflight - rec["incr"][i], 0)
+            self._quarantine(
+                [r for r in rec["reqs"] if r.status == "running"], err,
+                "drain")
+            return []
         if o is not None:
             o.host_syncs.inc()
         now = time.perf_counter()
@@ -741,6 +963,17 @@ class ServingEngine:
         s["num_requests"] = len(self.requests)
         s["num_finished"] = sum(r.status == "finished"
                                 for r in self.requests.values())
+        # resilience outcomes, derived from request state so the shape
+        # is identical with metrics off (the registry keeps the same
+        # counts under serving_requests_terminated_total{status=})
+        term = {st: 0 for st in ("cancelled", "expired", "failed", "shed")}
+        for r in self.requests.values():
+            if r.status in term:
+                term[r.status] += 1
+        s["terminal"] = term
+        s["transient_retries"] = (int(o.retries.value)
+                                  if o is not None else 0)
+        s["parked"] = sum(r.parked for r in self.requests.values())
         s["free_pages"] = self.cache.allocator.num_free
         s["latency"] = {
             "ttft": (o.ttft.summary() if o is not None
@@ -759,6 +992,7 @@ class ServingEngine:
                               if req.finish_t else None),
                 "tokens": len(req.generated),
                 "preemptions": req.preemptions,
+                "status": req.status,
             }
         s["requests"] = per_req
         return s

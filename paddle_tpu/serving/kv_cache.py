@@ -72,6 +72,9 @@ class BlockAllocator:
         self._m_alloc = None
         self._m_recycle = None
         self._m_share = None
+        # fault injection (bind_faults): same None-check discipline —
+        # an uninjected allocator executes zero resilience code
+        self._faults = None
 
     def bind_metrics(self, registry) -> None:
         """Attach page-lifecycle counters from an observability
@@ -87,6 +90,12 @@ class BlockAllocator:
             "serving_kv_page_shares_total",
             "extra references acquired on shared pages")
 
+    def bind_faults(self, injector) -> None:
+        """Attach a resilience.FaultInjector; every alloc/alloc_n entry
+        then consults its `alloc` site (one check per ENTRY, not per
+        page, so "alloc fails on call 7" schedules stay readable)."""
+        self._faults = injector
+
     @property
     def num_free(self) -> int:
         return len(self._free)
@@ -99,9 +108,7 @@ class BlockAllocator:
         """Live references on `page` (0 = free)."""
         return self._refs.get(page, 0)
 
-    def alloc(self) -> Optional[int]:
-        """One free page id (refcount 1), or None when the pool is
-        exhausted."""
+    def _alloc_unchecked(self) -> Optional[int]:
         if not self._free:
             return None
         page = self._free.pop()
@@ -110,11 +117,21 @@ class BlockAllocator:
             self._m_alloc.inc()
         return page
 
+    def alloc(self) -> Optional[int]:
+        """One free page id (refcount 1), or None when the pool is
+        exhausted. May raise InjectedFault under a bound FaultInjector
+        (callers in the scheduler degrade it to the exhausted path)."""
+        if self._faults is not None:
+            self._faults.check("alloc")
+        return self._alloc_unchecked()
+
     def alloc_n(self, n: int) -> Optional[List[int]]:
         """All-or-nothing batch alloc (request admission)."""
+        if self._faults is not None:
+            self._faults.check("alloc")
         if len(self._free) < n:
             return None
-        return [self.alloc() for _ in range(n)]
+        return [self._alloc_unchecked() for _ in range(n)]
 
     def acquire(self, page: int) -> None:
         """Add one reference to an allocated page (prefix-cache sharing:
@@ -144,6 +161,42 @@ class BlockAllocator:
     def free_all(self, pages: Sequence[int]) -> None:
         for p in pages:
             self.free(p)
+
+    def check_consistency(self) -> bool:
+        """Full invariant audit of the pool, run after every
+        failure-isolation event (and per step in chaos tests): the free
+        list and the refcount table must exactly partition the
+        allocatable ids [1, num_pages), with no duplicates, no null-page
+        entries, and every live refcount >= 1. Raises RuntimeError on
+        the first violation; returns True when the pool is sound."""
+        free = self._free
+        if len(set(free)) != len(free):
+            raise RuntimeError("allocator corrupt: duplicate free pages")
+        if NULL_PAGE in self._refs or NULL_PAGE in free:
+            raise RuntimeError(
+                "allocator corrupt: null page entered circulation")
+        both = set(free) & self._refs.keys()
+        if both:
+            raise RuntimeError(
+                f"allocator corrupt: pages {sorted(both)} are both free "
+                "and referenced")
+        for page, refs in self._refs.items():
+            if not 1 <= page < self.num_pages:
+                raise RuntimeError(
+                    f"allocator corrupt: page id {page} out of range")
+            if refs < 1:
+                raise RuntimeError(
+                    f"allocator corrupt: page {page} held at refcount "
+                    f"{refs}")
+        if any(not 1 <= p < self.num_pages for p in free):
+            raise RuntimeError(
+                "allocator corrupt: free-list id out of range")
+        if len(free) + len(self._refs) != self.num_pages - 1:
+            raise RuntimeError(
+                f"allocator corrupt: {len(free)} free + "
+                f"{len(self._refs)} live != {self.num_pages - 1} "
+                "allocatable pages (leak or double-account)")
+        return True
 
 
 @jax.tree_util.register_pytree_node_class

@@ -87,6 +87,14 @@ class PrefixCache:
         self._m_pages = reg.gauge(
             "serving_prefix_cached_pages",
             "pages resident in the radix tree")
+        # fault injection (bind_faults): None-check only when unbound
+        self._faults = None
+
+    def bind_faults(self, injector) -> None:
+        """Attach a resilience.FaultInjector; `match` then consults its
+        `prefix_match` site (the scheduler degrades an injected lookup
+        fault to a cache miss — correctness never depends on a hit)."""
+        self._faults = injector
 
     # ------------------------------------------------------------- lookup
     def match(self, tokens: Sequence[int]) -> List[int]:
@@ -96,6 +104,10 @@ class PrefixCache:
         through `allocator.free`. Capped at len(tokens)-1 tokens so a
         fully-cached prompt still has a suffix to prefill."""
         self._tick += 1
+        if self._faults is not None:
+            # raises BEFORE any ref is acquired, so an injected lookup
+            # fault leaks nothing
+            self._faults.check("prefix_match")
         with RecordEvent("serving.prefix_cache.lookup"):
             max_chunks = (len(tokens) - 1) // self.page_size
             node = self._root

@@ -42,7 +42,9 @@ import itertools
 import time
 from typing import List, Optional, Sequence, Tuple
 
-from .kv_cache import BlockAllocator, pages_for
+from .kv_cache import NULL_PAGE, BlockAllocator, pages_for
+from .resilience import (EngineOverloaded, InjectedFault,
+                         TERMINAL_STATUSES)
 
 __all__ = ["Request", "SamplingParams", "Scheduler", "ScheduleDecision"]
 
@@ -68,11 +70,22 @@ class Request:
     request_id: int = dataclasses.field(
         default_factory=lambda: next(_REQUEST_IDS))
 
-    # scheduler state
-    status: str = "waiting"             # waiting | running | finished
+    # scheduler state: waiting | running, then exactly one terminal
+    # status — finished | cancelled | expired | failed | shed
+    # (resilience.TERMINAL_STATUSES)
+    status: str = "waiting"
     generated: List[int] = dataclasses.field(default_factory=list)
     pages: List[int] = dataclasses.field(default_factory=list)
     preemptions: int = 0
+    # absolute perf_counter deadline (arrival_t + deadline_s); None =
+    # no deadline. Expired waiting requests are shed before admission;
+    # expired running requests are cancelled at the next block boundary
+    deadline_t: Optional[float] = None
+    # set when status lands on "failed": the isolated failure, as text
+    error: Optional[str] = None
+    # preemption-storm guard tripped: the request was requeued at the
+    # BACK of the waiting queue instead of the front
+    parked: bool = False
     # prompt tokens whose K/V came from the prefix cache (page-aligned);
     # prefill starts at this offset. pages[:cached_tokens // page_size]
     # are shared — the request holds a reference, never writes them
@@ -119,13 +132,27 @@ class Scheduler:
     def __init__(self, allocator: BlockAllocator, page_size: int,
                  max_batch_size: int, max_pages_per_seq: int,
                  prefix_cache=None, decode_horizon: int = 1,
-                 drain_hook=None, obs=None):
+                 drain_hook=None, obs=None,
+                 max_waiting: Optional[int] = None,
+                 max_preemptions: Optional[int] = None,
+                 max_prefill_tokens: Optional[int] = None):
         self.allocator = allocator
         self.page_size = page_size
         self.max_batch_size = max_batch_size
         self.max_pages_per_seq = max_pages_per_seq
         self.prefix_cache = prefix_cache
         self.decode_horizon = max(int(decode_horizon), 1)
+        # bounded waiting queue: add() past this raises EngineOverloaded
+        # (backpressure to the caller); None = unbounded, as before
+        self.max_waiting = max_waiting
+        # preemption-storm guard: a victim preempted more than this many
+        # times is parked (requeued at the BACK of the waiting queue)
+        # instead of jumping the line into another preempt cycle
+        self.max_preemptions = max_preemptions
+        # largest prompt the engine can ever prefill (its biggest
+        # bucket); _preempt refuses to fold a sequence past it with a
+        # clear error instead of failing deep in _bucket_for later
+        self.max_prefill_tokens = max_prefill_tokens
         # called once per _ensure_decode_pages on pool exhaustion, before
         # any preemption: the engine drains its in-flight decode block so
         # (a) device-finished requests release their pages and (b) a
@@ -146,6 +173,13 @@ class Scheduler:
             raise ValueError(
                 f"request needs {need} pages > max_pages_per_seq "
                 f"{self.max_pages_per_seq}; raise max_seq_len/page budget")
+        if self.max_waiting is not None and \
+                len(self.waiting) >= self.max_waiting:
+            # bounded queue = the backpressure signal: nothing was
+            # registered, the caller retries later or sheds upstream
+            raise EngineOverloaded(
+                f"waiting queue is full ({len(self.waiting)} >= "
+                f"max_waiting={self.max_waiting}); retry later")
         self.waiting.append(req)
         if self.obs is not None:
             self.obs.enqueued(req)
@@ -160,6 +194,34 @@ class Scheduler:
             self.running.remove(req)
         if self.obs is not None:
             self.obs.finished(req)
+
+    def finalize(self, req: Request, status: str,
+                 error: Optional[str] = None) -> bool:
+        """Terminal transition for the failure-side statuses (cancelled /
+        expired / failed / shed): pull the request out of whichever queue
+        holds it and release its pages through the refcounted path, so a
+        shared prefix page only loses THIS request's reference and every
+        survivor's table stays intact. Idempotent — a request already
+        terminal is left alone (returns False). The engine drains any
+        in-flight decode block BEFORE calling this for a running request,
+        so no dispatched block still writes to the released pages."""
+        if req.status in TERMINAL_STATUSES:
+            return False
+        if status not in TERMINAL_STATUSES or status == "finished":
+            raise ValueError(f"finalize cannot set status {status!r}")
+        req.status = status
+        req.error = error
+        req.inflight = 0
+        req.finish_t = time.perf_counter()
+        self.allocator.free_all(req.pages)
+        req.pages = []
+        if req in self.running:
+            self.running.remove(req)
+        if req in self.waiting:
+            self.waiting.remove(req)
+        if self.obs is not None:
+            self.obs.terminal(req, status)
+        return True
 
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
@@ -195,18 +257,26 @@ class Scheduler:
 
     def _alloc_n(self, n: int) -> Optional[List[int]]:
         """All-or-nothing alloc that reclaims unreferenced prefix-cache
-        pages before reporting exhaustion."""
-        pages = self.allocator.alloc_n(n)
-        if pages is None and self.prefix_cache is not None:
-            self.prefix_cache.evict(n - self.allocator.num_free)
+        pages before reporting exhaustion. An injected alloc fault
+        degrades to the exhausted path — admission simply defers a step,
+        which is already lossless."""
+        try:
             pages = self.allocator.alloc_n(n)
+            if pages is None and self.prefix_cache is not None:
+                self.prefix_cache.evict(n - self.allocator.num_free)
+                pages = self.allocator.alloc_n(n)
+        except InjectedFault:
+            return None
         return pages
 
     def _alloc_one(self) -> Optional[int]:
-        page = self.allocator.alloc()
-        if page is None and self.prefix_cache is not None \
-                and self.prefix_cache.evict(1):
+        try:
             page = self.allocator.alloc()
+            if page is None and self.prefix_cache is not None \
+                    and self.prefix_cache.evict(1):
+                page = self.allocator.alloc()
+        except InjectedFault:
+            return None
         return page
 
     def _try_admit(self) -> Optional[Request]:
@@ -216,8 +286,13 @@ class Scheduler:
         cached: List[int] = []
         if self.prefix_cache is not None:
             # longest cached full-page prefix; the pool is charged only
-            # for the uncached suffix (match acquires one ref per page)
-            cached = self.prefix_cache.match(req.prompt)
+            # for the uncached suffix (match acquires one ref per page).
+            # An injected lookup fault degrades to a miss — the request
+            # prefills its whole prompt, bit-identical either way
+            try:
+                cached = self.prefix_cache.match(req.prompt)
+            except InjectedFault:
+                cached = []
         pages = self._alloc_n(self._admission_pages(req) - len(cached))
         if pages is None:
             # pool exhausted. Drop the match refs FIRST — holding them
@@ -246,7 +321,26 @@ class Scheduler:
         waiting queue with its generated tokens folded into the prompt
         (re-prefill resumes it bit-exactly — prefill and decode share the
         cache numerics). Shared prefix pages only lose the victim's
-        reference; survivors and the prefix cache keep theirs."""
+        reference; survivors and the prefix cache keep theirs.
+
+        Two resilience guards ride here: (1) the folded prompt must stay
+        prefillable — if it would exceed the engine's largest prefill
+        bucket, raise a CLEAR error NOW, before any state is torn down,
+        instead of failing deep in `_bucket_for` after the victim's pages
+        are gone; (2) the preemption-storm guard — a victim already
+        preempted more than `max_preemptions` times is PARKED: requeued
+        at the BACK of the waiting queue, so it stops cycling through the
+        front->admit->preempt churn and younger arrivals get a turn
+        first."""
+        folded = len(victim.prompt) + len(victim.generated)
+        if self.max_prefill_tokens is not None \
+                and folded > self.max_prefill_tokens:
+            raise RuntimeError(
+                f"cannot preempt request {victim.request_id}: its folded "
+                f"prompt+generated length {folded} exceeds the largest "
+                f"prefill bucket ({self.max_prefill_tokens} tokens) — "
+                "re-prefill after requeue would be impossible. "
+                "prefill_buckets must cover max_seq_len")
         self.running.remove(victim)
         self.allocator.free_all(victim.pages)
         victim.pages = []
@@ -257,7 +351,14 @@ class Scheduler:
         victim.generated = []
         victim.status = "waiting"
         victim.preemptions += 1
-        self.waiting.insert(0, victim)
+        if self.max_preemptions is not None \
+                and victim.preemptions > self.max_preemptions:
+            victim.parked = True
+            self.waiting.append(victim)
+            if self.obs is not None:
+                self.obs.parked(victim)
+        else:
+            self.waiting.insert(0, victim)
         if self.obs is not None:
             self.obs.preempted(victim)
 
@@ -275,11 +376,20 @@ class Scheduler:
         for req in list(self.running):
             if req not in self.running:   # preempted by an older peer
                 continue
+            faulted = 0
             while req in self.running and \
                     self._block_pages(req) > len(req.pages):
                 page = self._alloc_one()
                 if page is not None:
                     req.pages.append(page)
+                    continue
+                if self.allocator.num_free > 0 and faulted < 8:
+                    # _alloc_one only reports None with pages still free
+                    # when an injected alloc fault fired: retry (the
+                    # injector advanced past the armed index) instead of
+                    # mistaking the fault for real exhaustion; the bound
+                    # keeps a fail_every(1) schedule from spinning
+                    faulted += 1
                     continue
                 if self.drain_hook is not None and not drained:
                     drained = True
@@ -309,11 +419,53 @@ class Scheduler:
             batch = self.running[:self.max_batch_size]
             return ScheduleDecision(kind="decode", decode=list(batch))
         if self.waiting:
-            # nothing running and the head request cannot be admitted:
-            # the pool cannot ever satisfy it
             req = self.waiting[0]
-            raise RuntimeError(
-                f"request {req.request_id} needs "
-                f"{self._admission_pages(req)} pages but the pool has "
-                f"{self.allocator.num_pages - 1} allocatable in total")
+            need = self._admission_pages(req)
+            if need > self.allocator.num_pages - 1:
+                # nothing running and the head request cannot fit even
+                # in an EMPTY pool: no amount of waiting helps
+                raise RuntimeError(
+                    f"request {req.request_id} needs {need} pages but "
+                    f"the pool has {self.allocator.num_pages - 1} "
+                    "allocatable in total")
+            # otherwise the deferral is transient (an injected alloc
+            # fault, or pages still pinned that will be released): stay
+            # idle and retry next step
         return ScheduleDecision(kind="idle")
+
+    # ----------------------------------------------------------- invariants
+    def check_consistency(self) -> bool:
+        """Scheduler+allocator invariant audit, run after every
+        failure-isolation event: queues disjoint with statuses matching,
+        every running request's pages live in the allocator (never the
+        null page), waiting requests holding no pages, and the allocator
+        itself sound (`BlockAllocator.check_consistency`). Raises
+        RuntimeError on the first violation."""
+        self.allocator.check_consistency()
+        if set(map(id, self.waiting)) & set(map(id, self.running)):
+            raise RuntimeError("scheduler corrupt: request in both "
+                               "waiting and running queues")
+        for req in self.running:
+            if req.status != "running":
+                raise RuntimeError(
+                    f"scheduler corrupt: request {req.request_id} in the "
+                    f"running queue with status {req.status!r}")
+            for p in req.pages:
+                if p == NULL_PAGE:
+                    raise RuntimeError(
+                        f"scheduler corrupt: request {req.request_id} "
+                        "holds the null page")
+                if self.allocator.ref_count(p) < 1:
+                    raise RuntimeError(
+                        f"scheduler corrupt: request {req.request_id} "
+                        f"holds freed page {p}")
+        for req in self.waiting:
+            if req.status != "waiting":
+                raise RuntimeError(
+                    f"scheduler corrupt: request {req.request_id} in the "
+                    f"waiting queue with status {req.status!r}")
+            if req.pages:
+                raise RuntimeError(
+                    f"scheduler corrupt: waiting request "
+                    f"{req.request_id} holds pages {req.pages}")
+        return True

@@ -14,7 +14,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:                                    # jax >= 0.5 exports it at top level
+    from jax import shard_map
+except ImportError:                     # jax 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map
 
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist

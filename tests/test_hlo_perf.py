@@ -299,7 +299,10 @@ def test_ring_attention_kernel_compiles_with_mosaic(monkeypatch):
     passed Mosaic off-CPU (VERDICT r4 weak #6); compile the sep=4 ring
     attention through the real pipeline."""
     _patch_tpu_gates(monkeypatch)
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:                 # jax 0.4.x: experimental home
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from paddle_tpu.ops import pallas_kernels as pk

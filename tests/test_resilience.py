@@ -1,0 +1,622 @@
+"""Serving resilience layer (ISSUE 6): deterministic FaultInjector,
+allocator/scheduler invariant audits, cancellation in every request
+state (waiting / running / mid-decode-block, and across prefix-cache
+page sharing), per-request deadlines + bounded-queue load shedding,
+failure isolation with one transient retry (persistent faults
+quarantine exactly the implicated requests), preemption-storm parking,
+the `_preempt` fold-length bucket guard, and the chaos-parity
+acceptance test: under a seeded schedule of alloc faults + transient
+dispatch faults + mid-block cancellations, every non-quarantined
+request's token stream is identical to a fault-free run while the pool
+invariants hold after every step. The zero-overhead guard pins that a
+resilience-free engine executes no resilience code on the hot path
+(the enable_metrics=False raise-on-touch discipline).
+
+Single tiny LLaMA reused module-wide (tests/test_serving.py's pattern)
+so the fast lane compiles one prefill-bucket + decode set.
+"""
+import functools
+import importlib.util
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    BlockAllocator, EngineOverloaded, FaultInjector, InjectedFault,
+    Request, SamplingParams, Scheduler, ServingEngine, TERMINAL_STATUSES,
+    is_transient,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _llama():
+    paddle.seed(1234)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _engine(**kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("decode_horizon", 4)
+    kw.setdefault("retry_backoff_s", 0.0)
+    return ServingEngine(_llama(), **kw)
+
+
+_PROMPTS = [[7, 3, 9, 1, 4], [2, 8, 6, 5, 1, 9, 3, 7, 2],
+            [4, 4, 1, 8, 8, 2, 6, 3, 9, 5, 1, 7, 3]]
+
+
+def _reference(prompts=_PROMPTS, max_new_tokens=6, **kw):
+    eng = _engine(**kw)
+    rids = [eng.add_request(p, max_new_tokens=max_new_tokens)
+            for p in prompts]
+    return eng.run(), rids
+
+
+# ------------------------------------------------------------ FaultInjector
+
+class TestFaultInjector:
+    def test_fail_at_fires_exactly_once(self):
+        fi = FaultInjector().fail_at("alloc", 2)
+        fi.check("alloc")
+        fi.check("alloc")
+        with pytest.raises(InjectedFault) as ei:
+            fi.check("alloc")
+        assert ei.value.site == "alloc" and ei.value.index == 2
+        assert ei.value.transient
+        fi.check("alloc")                      # index 3: past the arm
+        assert fi.counts["alloc"] == 4
+        assert fi.fired == {"alloc": 1}
+        assert fi.log == [("alloc", 2, True)]
+
+    def test_fail_every_period(self):
+        fi = FaultInjector().fail_every("dispatch", 3)
+        hits = []
+        for i in range(9):
+            try:
+                fi.check("dispatch")
+            except InjectedFault:
+                hits.append(i)
+        assert hits == [2, 5, 8]
+        assert fi.total_fired() == 3
+
+    def test_fail_rate_deterministic_per_seed_and_site(self):
+        def trace(seed):
+            fi = (FaultInjector(seed=seed).fail_rate("drain", 0.5)
+                  .fail_rate("alloc", 0.5))
+            out = []
+            for site in ("drain", "alloc") * 50:
+                try:
+                    fi.check(site)
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        a, b = trace(3), trace(3)
+        assert a == b                          # same seed: same schedule
+        assert trace(4) != a                   # different seed: different
+        assert 10 < sum(a) < 90                # sanity: rate is ~0.5
+
+    def test_persistent_flag_and_is_transient(self):
+        fi = FaultInjector().fail_at("drain", 0, transient=False)
+        with pytest.raises(InjectedFault) as ei:
+            fi.check("drain")
+        assert not ei.value.transient
+        assert not is_transient(ei.value)
+        assert is_transient(InjectedFault("drain", 1))
+        assert not is_transient(RuntimeError("boom"))
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultInjector().fail_at("nonsense", 0)
+
+
+# ------------------------------------------------------- invariant audits
+
+class TestCheckConsistency:
+    def test_sound_allocator_passes(self):
+        a = BlockAllocator(8)
+        pages = [a.alloc() for _ in range(3)]
+        a.acquire(pages[0])
+        assert a.check_consistency() is True
+        a.free(pages[0])
+        a.free_all(pages)
+        assert a.check_consistency() is True
+
+    def test_detects_double_accounting(self):
+        a = BlockAllocator(8)
+        p = a.alloc()
+        a._free.append(p)                      # corrupt: free AND live
+        with pytest.raises(RuntimeError, match="both free and referenced"):
+            a.check_consistency()
+
+    def test_detects_leak(self):
+        a = BlockAllocator(8)
+        a.alloc()
+        del a._refs[next(iter(a._refs))]       # page vanishes entirely
+        with pytest.raises(RuntimeError, match="leak or double-account"):
+            a.check_consistency()
+
+    def test_detects_null_page_in_circulation(self):
+        a = BlockAllocator(8)
+        a._free.append(0)
+        with pytest.raises(RuntimeError, match="null page"):
+            a.check_consistency()
+
+    def test_scheduler_audit_catches_status_mismatch(self):
+        a = BlockAllocator(8)
+        s = Scheduler(a, page_size=4, max_batch_size=2, max_pages_per_seq=2)
+        req = Request(prompt=[1, 2], max_new_tokens=2,
+                      sampling=SamplingParams())
+        req.pages = [a.alloc()]
+        s.running.append(req)                  # status still "waiting"
+        with pytest.raises(RuntimeError, match="running queue with status"):
+            s.check_consistency()
+        req.status = "running"
+        assert s.check_consistency() is True
+
+
+# --------------------------------------------------- backpressure/overload
+
+class TestOverload:
+    def test_bounded_queue_raises_typed_overload(self):
+        eng = _engine(max_batch_size=1, max_waiting=2)
+        eng.add_request(_PROMPTS[0])
+        eng.add_request(_PROMPTS[1])
+        with pytest.raises(EngineOverloaded, match="max_waiting=2"):
+            eng.add_request(_PROMPTS[2])
+        # the rejected request left no trace and the rest still serve
+        assert len(eng.requests) == 2
+        out = eng.run()
+        assert all(eng.status(r)[0] == "finished" for r in out)
+
+    def test_overload_is_not_a_valueerror_catchall(self):
+        assert issubclass(EngineOverloaded, RuntimeError)
+        assert not issubclass(EngineOverloaded, ValueError)
+
+
+# ------------------------------------------------------------ cancellation
+
+class TestCancellation:
+    def test_cancel_waiting_request(self):
+        eng = _engine(max_batch_size=1)
+        a = eng.add_request(_PROMPTS[0], max_new_tokens=4)
+        b = eng.add_request(_PROMPTS[1], max_new_tokens=4)
+        assert eng.cancel(b) is True           # never admitted
+        assert eng.status(b) == ("cancelled", None)
+        out = eng.run()
+        assert eng.status(a)[0] == "finished"
+        assert out[b] == list(_PROMPTS[1])     # no tokens ever generated
+
+    def test_cancel_running_request_releases_pages(self):
+        eng = _engine()
+        rid = eng.add_request(_PROMPTS[0], max_new_tokens=8)
+        eng.step()                             # prefill: now running
+        assert eng.requests[rid].status == "running"
+        free_before = eng.cache.allocator.num_free
+        assert eng.cancel(rid) is True
+        assert eng.status(rid)[0] == "cancelled"
+        assert eng.cache.allocator.num_free > free_before
+        eng.scheduler.check_consistency()
+        assert eng.cache.allocator.num_used == 0
+
+    def test_cancel_mid_block_drains_inflight_tokens_first(self):
+        eng = _engine(decode_horizon=8)
+        ref, _ = _reference(prompts=[_PROMPTS[0]], max_new_tokens=16)
+        rid = eng.add_request(_PROMPTS[0], max_new_tokens=16)
+        while eng._pending is None:            # dispatch a decode block
+            eng.step()
+        assert rid in eng._pending["rids"]
+        assert eng.cancel(rid) is True
+        # the in-flight block's tokens surfaced (spill queue) before the
+        # pages were torn down, and they match the fault-free prefix
+        got = eng.output(rid)
+        assert len(got) > len(_PROMPTS[0])
+        assert got == list(ref.values())[0][:len(got)]
+        for _ in eng.stream():                 # flushes any spill
+            pass
+        eng.scheduler.check_consistency()
+        assert eng.cache.allocator.num_used == 0
+
+    def test_cancel_unknown_and_terminal_returns_false(self):
+        eng = _engine()
+        rid = eng.add_request(_PROMPTS[0], max_new_tokens=2)
+        eng.run()
+        assert eng.status(rid)[0] == "finished"
+        assert eng.cancel(rid) is False        # already terminal
+        assert eng.cancel(123456) is False     # unknown
+        assert eng.status(rid)[0] == "finished"
+
+    def test_cancel_one_prefix_sharer_never_corrupts_survivors(self):
+        """ISSUE 6 satellite: two requests share radix-cached prefix
+        pages; cancelling one mid-flight must only drop ITS references —
+        the survivor keeps decoding on the shared pages and its tokens
+        stay identical to an undisturbed run."""
+        eng = _engine(enable_prefix_caching=True, num_pages=128)
+        shared = [5, 1, 3, 7, 2, 9, 4, 6]      # two full pages at ps=4
+        pa, pb = shared + [11, 12], shared + [13, 14, 15]
+        # undisturbed oracle (same engine config, cold cache)
+        ref_eng = _engine(enable_prefix_caching=True, num_pages=128)
+        r0 = ref_eng.add_request(shared + [1], max_new_tokens=1)
+        ref_eng.run()                          # warm the radix tree
+        ra = ref_eng.add_request(pa, max_new_tokens=8)
+        rb = ref_eng.add_request(pb, max_new_tokens=8)
+        ref = ref_eng.run()
+
+        w = eng.add_request(shared + [1], max_new_tokens=1)
+        eng.run()
+        a = eng.add_request(pa, max_new_tokens=8)
+        b = eng.add_request(pb, max_new_tokens=8)
+        while eng.requests[b].status != "running":
+            eng.step()
+        shared_pages = [p for p in eng.requests[b].pages
+                        if eng.cache.allocator.ref_count(p) > 1]
+        assert shared_pages                    # they really do share
+        assert eng.cancel(a) is True
+        eng.scheduler.check_consistency()
+        for p in shared_pages:                 # survivor + tree refs live
+            assert eng.cache.allocator.ref_count(p) >= 1
+        out = eng.run()
+        assert eng.status(b)[0] == "finished"
+        assert out[b] == ref[rb]
+        eng.scheduler.check_consistency()
+
+
+# ------------------------------------------------- deadlines/load shedding
+
+class TestDeadlines:
+    def test_deadline_validation(self):
+        eng = _engine()
+        with pytest.raises(ValueError, match="deadline_s"):
+            eng.add_request(_PROMPTS[0], deadline_s=0.0)
+        with pytest.raises(ValueError, match="deadline_s"):
+            eng.add_request(_PROMPTS[0], deadline_s=-1.0)
+
+    @pytest.mark.parametrize("horizon", [1, 8])
+    def test_waiting_request_expires_before_admission(self, horizon):
+        eng = _engine(max_batch_size=1, decode_horizon=horizon)
+        a = eng.add_request(_PROMPTS[0], max_new_tokens=6)
+        b = eng.add_request(_PROMPTS[1], max_new_tokens=6,
+                            deadline_s=60.0)
+        eng.requests[b].deadline_t = time.perf_counter() - 1.0
+        out = eng.run()
+        assert eng.status(b)[0] == "expired"
+        assert out[b] == list(_PROMPTS[1])     # shed before any prefill
+        assert eng.status(a)[0] == "finished"
+        eng.scheduler.check_consistency()
+
+    @pytest.mark.parametrize("horizon", [1, 8])
+    def test_running_request_expires_at_block_boundary(self, horizon):
+        eng = _engine(decode_horizon=horizon)
+        rid = eng.add_request(_PROMPTS[0], max_new_tokens=16,
+                              deadline_s=60.0)
+        while eng.requests[rid].status != "running":
+            eng.step()
+        eng.requests[rid].deadline_t = time.perf_counter() - 1.0
+        for _ in eng.stream():
+            pass
+        assert eng.status(rid)[0] == "expired"
+        assert len(eng.requests[rid].generated) < 16
+        eng.scheduler.check_consistency()
+        assert eng.cache.allocator.num_used == 0
+
+    def test_queue_wait_shedding(self):
+        eng = _engine(max_batch_size=1, max_queue_wait_s=30.0)
+        a = eng.add_request(_PROMPTS[0], max_new_tokens=6)
+        b = eng.add_request(_PROMPTS[1], max_new_tokens=6)
+        eng.requests[b].arrival_t -= 60.0      # waited "too long"
+        eng.run()
+        assert eng.status(a)[0] == "finished"
+        assert eng.status(b)[0] == "shed"
+        assert eng.stats()["terminal"]["shed"] == 1
+        eng.scheduler.check_consistency()
+
+
+# ------------------------------------------------------ preemption guards
+
+class TestPreemptionGuards:
+    def _sched(self, **kw):
+        a = BlockAllocator(32)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("max_batch_size", 2)
+        kw.setdefault("max_pages_per_seq", 8)
+        return a, Scheduler(a, **kw)
+
+    def _running(self, sched, alloc, prompt, generated):
+        req = Request(prompt=list(prompt), max_new_tokens=16,
+                      sampling=SamplingParams())
+        req.generated = list(generated)
+        req.status = "running"
+        req.pages = [alloc.alloc() for _ in range(2)]
+        sched.running.append(req)
+        return req
+
+    def test_preempt_bucket_guard_raises_before_mutation(self):
+        a, s = self._sched(max_prefill_tokens=8)
+        req = self._running(s, a, range(6), range(4))   # folds to 10 > 8
+        with pytest.raises(RuntimeError,
+                           match="largest prefill bucket"):
+            s._preempt(req)
+        # clear error BEFORE teardown: nothing was mutated
+        assert req.status == "running" and req in s.running
+        assert len(req.pages) == 2 and req.generated == list(range(4))
+        s.check_consistency()
+
+    def test_preempt_within_bucket_still_works(self):
+        a, s = self._sched(max_prefill_tokens=16)
+        req = self._running(s, a, range(6), range(4))
+        s._preempt(req)
+        assert req.status == "waiting" and req.prompt == list(range(6)) \
+            + list(range(4))
+        s.check_consistency()
+
+    def test_preemption_storm_parks_victim_at_back(self):
+        a, s = self._sched(max_preemptions=2)
+        other = Request(prompt=[1], max_new_tokens=2,
+                        sampling=SamplingParams())
+        s.waiting.append(other)
+        req = self._running(s, a, range(4), [])
+        req.preemptions = 2                    # already at the limit
+        s._preempt(req)
+        assert req.parked and req.preemptions == 3
+        # parked: BACK of the queue, not jumping the line anymore
+        assert s.waiting == [other, req]
+
+    def test_below_storm_limit_requeues_at_front(self):
+        a, s = self._sched(max_preemptions=2)
+        other = Request(prompt=[1], max_new_tokens=2,
+                        sampling=SamplingParams())
+        s.waiting.append(other)
+        req = self._running(s, a, range(4), [])
+        s._preempt(req)
+        assert not req.parked
+        assert s.waiting == [req, other]
+
+
+# ------------------------------------------------------ failure isolation
+
+class TestFailureIsolation:
+    def test_transient_dispatch_fault_costs_latency_never_tokens(self):
+        ref, _ = _reference()
+        fi = FaultInjector().fail_every("dispatch", 3)
+        eng = _engine(fault_injector=fi)
+        rids = [eng.add_request(p, max_new_tokens=6) for p in _PROMPTS]
+        out = eng.run()
+        assert fi.fired.get("dispatch", 0) >= 2
+        assert eng.stats()["transient_retries"] == fi.fired["dispatch"]
+        for (r0, v0), (r1, v1) in zip(sorted(ref.items()),
+                                      sorted(out.items())):
+            assert v0 == v1
+        assert all(eng.status(r)[0] == "finished" for r in rids)
+        eng.scheduler.check_consistency()
+
+    def test_persistent_prefill_fault_quarantines_exactly_one(self):
+        ref, ref_rids = _reference()
+        fi = FaultInjector().fail_at("dispatch", 0, transient=False)
+        eng = _engine(fault_injector=fi)
+        rids = [eng.add_request(p, max_new_tokens=6) for p in _PROMPTS]
+        out = eng.run()
+        status, err = eng.status(rids[0])
+        assert status == "failed"
+        assert "InjectedFault" in err and "dispatch" in err
+        # exactly one casualty; survivors bit-identical to fault-free
+        for a, b in zip(ref_rids[1:], rids[1:]):
+            assert eng.status(b)[0] == "finished"
+            assert out[b] == ref[a]
+        assert eng.stats()["terminal"]["failed"] == 1
+        eng.scheduler.check_consistency()
+        assert eng.cache.allocator.num_used == 0
+
+    def test_persistent_drain_fault_isolates_block_batch(self):
+        fi = FaultInjector().fail_every("drain", 2, transient=False)
+        eng = _engine()
+        eng._faults = fi                       # arm ONLY the drain site
+        rid = eng.add_request(_PROMPTS[0], max_new_tokens=8)
+        for _ in eng.stream():
+            pass
+        assert eng.status(rid)[0] == "failed"
+        assert "drain" in eng.status(rid)[1]
+        eng.scheduler.check_consistency()
+        assert eng.cache.allocator.num_used == 0
+        assert eng._pending is None
+
+    def test_injected_alloc_faults_degrade_losslessly(self):
+        ref, _ = _reference()
+        fi = FaultInjector().fail_every("alloc", 2)
+        eng = _engine(fault_injector=fi)
+        rids = [eng.add_request(p, max_new_tokens=6) for p in _PROMPTS]
+        out = eng.run()
+        assert fi.fired["alloc"] >= 1
+        assert all(eng.status(r)[0] == "finished" for r in rids)
+        for (r0, v0), (r1, v1) in zip(sorted(ref.items()),
+                                      sorted(out.items())):
+            assert v0 == v1
+        eng.scheduler.check_consistency()
+
+    def test_injected_prefix_fault_degrades_to_cache_miss(self):
+        fi = FaultInjector().fail_every("prefix_match", 1)
+        eng = _engine(enable_prefix_caching=True, num_pages=128,
+                      fault_injector=fi)
+        shared = [5, 1, 3, 7, 2, 9, 4, 6]
+        w = eng.add_request(shared + [1], max_new_tokens=1)
+        eng.run()
+        rid = eng.add_request(shared + [11, 12], max_new_tokens=4)
+        out = eng.run()
+        assert fi.fired["prefix_match"] >= 1
+        # every lookup faulted -> zero hits, but the request still ran
+        assert eng.requests[rid].cached_tokens == 0
+        assert eng.status(rid)[0] == "finished"
+        # parity against an uninjected prefix-cache engine
+        ref_eng = _engine(enable_prefix_caching=True, num_pages=128)
+        ref_eng.add_request(shared + [1], max_new_tokens=1)
+        ref_eng.run()
+        rr = ref_eng.add_request(shared + [11, 12], max_new_tokens=4)
+        assert ref_eng.run()[rr] == out[rid]
+        eng.scheduler.check_consistency()
+
+
+# ----------------------------------------------------------- chaos parity
+
+class TestChaosParity:
+    def test_seeded_chaos_survivor_parity(self):
+        """THE acceptance criterion: a seeded schedule of alloc faults,
+        transient dispatch faults, and a mid-block cancellation; every
+        non-quarantined, non-cancelled request's token stream must be
+        identical to the fault-free run, with the allocator + scheduler
+        invariants holding after EVERY step."""
+        prompts = _PROMPTS + [[9, 9, 2, 4, 1, 6]]
+        ref, ref_rids = _reference(prompts=prompts, max_new_tokens=10)
+
+        fi = (FaultInjector(seed=42)
+              .fail_every("alloc", 4)
+              .fail_every("dispatch", 5)       # transient: retried
+              .fail_rate("drain", 0.2))        # transient: retried
+        eng = _engine(fault_injector=fi)
+        rids = [eng.add_request(p, max_new_tokens=10) for p in prompts]
+        cancelled = None
+        for _ in range(400):
+            if not (eng.scheduler.has_work() or eng._pending is not None
+                    or eng._spill):
+                break
+            eng.step()
+            eng.scheduler.check_consistency()
+            if cancelled is None and eng._pending is not None:
+                victim = eng._pending["rids"][-1]
+                assert eng.cancel(victim)      # mid-block, tokens in flight
+                cancelled = victim
+                eng.scheduler.check_consistency()
+        else:
+            pytest.fail("chaos run did not converge")
+        assert fi.total_fired() > 0 and cancelled is not None
+        out = {r: eng.output(r) for r in rids}
+        for a, b in zip(ref_rids, rids):
+            if b == cancelled:
+                assert eng.status(b)[0] == "cancelled"
+                # drained prefix still matches the fault-free stream
+                assert out[b] == ref[a][:len(out[b])]
+            else:
+                assert eng.status(b)[0] == "finished"
+                assert out[b] == ref[a]
+        eng.scheduler.check_consistency()
+        assert eng.cache.allocator.num_used == 0
+
+
+# ------------------------------------------------------ zero-overhead pin
+
+class TestZeroResilienceHotPath:
+    def test_disabled_resilience_executes_no_resilience_code(
+            self, monkeypatch):
+        """Raise-on-touch guard (the enable_metrics=False discipline):
+        with no FaultInjector bound, no deadlines and no queue bounds, a
+        full request lifecycle must never enter ANY resilience entry
+        point — injector checks, transience tests, quarantine, expiry
+        sweeps, terminal finalization, invariant audits."""
+        import paddle_tpu.serving.engine as eng_mod
+        import paddle_tpu.serving.kv_cache as kv_mod
+        import paddle_tpu.serving.scheduler as sched_mod
+
+        eng = _engine()
+        eng.add_request([9, 8, 7], max_new_tokens=3)
+        eng.run()                              # warm compiles first
+
+        def boom(*a, **kw):
+            raise AssertionError("resilience code on a clean hot path")
+
+        for obj, meth in [
+                (FaultInjector, "check"),
+                (eng_mod.ServingEngine, "_quarantine"),
+                (eng_mod.ServingEngine, "_expire_and_shed"),
+                (eng_mod.ServingEngine, "cancel"),
+                (sched_mod.Scheduler, "finalize"),
+                (sched_mod.Scheduler, "check_consistency"),
+                (kv_mod.BlockAllocator, "check_consistency")]:
+            monkeypatch.setattr(obj, meth, boom)
+        monkeypatch.setattr(eng_mod, "is_transient", boom)
+        monkeypatch.setattr(sched_mod, "InjectedFault", ())  # except ()
+        rid = eng.add_request([1, 2, 3], max_new_tokens=4)
+        out = eng.run()
+        assert len(out[rid]) == 7
+        assert eng.status(rid)[0] == "finished"
+
+
+# ---------------------------------------------------------- trace summary
+
+def _trace_summary_mod():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("trace_summary2", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTraceSummaryFlagsCasualties:
+    EVENTS = [
+        {"name": "serving.request[1].enqueued", "ph": "X", "ts": 0,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[1].finished", "ph": "X", "ts": 50,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[2].enqueued", "ph": "X", "ts": 5,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[2].failed", "ph": "X", "ts": 30,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[3].enqueued", "ph": "X", "ts": 6,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[3].expired", "ph": "X", "ts": 20,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[4].enqueued", "ph": "X", "ts": 7,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[4].cancelled", "ph": "X", "ts": 9,
+         "dur": 0, "pid": 1, "tid": 2},
+    ]
+
+    def test_failed_expired_shed_are_flagged(self):
+        ts = _trace_summary_mod()
+        out = ts.format_requests(
+            ts.request_timelines(list(map(dict, self.EVENTS))))
+        assert "request 1:" in out and "request 1:  !!" not in out
+        assert "request 2:  !! failed" in out
+        assert "request 3:  !! expired" in out
+        # caller-initiated cancel is shown but not flagged
+        assert "request 4:  !!" not in out and "cancelled" in out
+        assert "2 of 4 requests did not finish" in out
+        assert "1 failed" in out and "1 expired" in out
+
+    def test_all_finished_prints_no_flags(self):
+        ts = _trace_summary_mod()
+        evs = [e for e in map(dict, self.EVENTS)
+               if "[1]" in e["name"]]
+        out = ts.format_requests(ts.request_timelines(evs))
+        assert "!!" not in out
+
+
+# ----------------------------------------------------------- engine stats
+
+class TestResilienceStats:
+    def test_terminal_counts_surface_with_metrics_on_and_off(self):
+        for enable in (True, False):
+            eng = _engine(enable_metrics=enable, max_batch_size=1)
+            a = eng.add_request(_PROMPTS[0], max_new_tokens=3)
+            b = eng.add_request(_PROMPTS[1], max_new_tokens=3)
+            eng.cancel(b)
+            eng.run()
+            st = eng.stats()
+            assert st["terminal"]["cancelled"] == 1
+            assert st["requests"][a]["status"] == "finished"
+            assert st["requests"][b]["status"] == "cancelled"
+            if enable:
+                snap = eng.metrics.snapshot()
+                assert any(
+                    m.get("labels", {}).get("status") == "cancelled"
+                    and m["value"] == 1
+                    for m in snap["metrics"]
+                    if m["name"] == "serving_requests_terminated_total")

@@ -19,6 +19,22 @@ from paddle_tpu.distributed.fleet.meta_parallel.ring_attention import (
 SEP = 4
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """jax.shard_map with the vma/rep checker off, on any jax.
+
+    Interpret-mode pallas expands to dynamic_slices mixing varying and
+    constant operands, which the checker rejects (jax suggests exactly
+    this workaround); 0.4.x spells the knob check_rep, >=0.5 check_vma.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:                                  # jax >= 0.5
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm   # jax 0.4.x
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
+
+
 def _mesh():
     return Mesh(np.asarray(jax.devices()[:SEP]), ("sep",))
 
@@ -39,12 +55,12 @@ def _ring(q, k, v, causal, impl="pallas"):
     # mix varying and constant operands, which the vma checker rejects (jax
     # suggests this exact workaround); the compiled TPU path declares vma on
     # the kernel outputs and runs under the default checker
-    fn = jax.shard_map(
+    fn = _shard_map(
         lambda a, b_, c: ring_flash_attention(
             a, b_, c, axis_name="sep", causal=causal, impl=impl,
             interpret=True),
         mesh=_mesh(), in_specs=(P(None, None, "sep", None),) * 3,
-        out_specs=P(None, None, "sep", None), check_vma=False)
+        out_specs=P(None, None, "sep", None))
     return fn(q, k, v)
 
 
@@ -89,12 +105,12 @@ def test_ring_pallas_no_quadratic_buffer():
     args = [jax.ShapeDtypeStruct(shape, jnp.float32)] * 3
 
     def lowered(impl):
-        fn = jax.shard_map(
+        fn = _shard_map(
             lambda a, b_, c: ring_flash_attention(
                 a, b_, c, axis_name="sep", causal=True, impl=impl,
                 interpret=True),
             mesh=_mesh(), in_specs=(P(None, None, "sep", None),) * 3,
-            out_specs=P(None, None, "sep", None), check_vma=False)
+            out_specs=P(None, None, "sep", None))
         return jax.jit(fn).lower(*args).as_text()
 
     assert "1024x1024" not in lowered("pallas")
@@ -156,12 +172,12 @@ def test_ulysses_pallas_matches_dense(causal, rng):
     k = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
     v = jnp.asarray(rng.standard_normal((b, h, s, d)).astype("float32"))
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         lambda a, b_, c: ulysses_attention(
             a, b_, c, axis_name="sep", causal=causal, impl="pallas",
             interpret=True),
         mesh=_mesh(), in_specs=(P(None, None, "sep", None),) * 3,
-        out_specs=P(None, None, "sep", None), check_vma=False)
+        out_specs=P(None, None, "sep", None))
     out = fn(q, k, v)
     ref = _dense_ref(q, k, v, causal, d ** -0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),

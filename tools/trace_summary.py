@@ -12,6 +12,9 @@ Two views over a `*.pt.trace.json` (or any chrome://tracing JSON):
   `serving.request[<rid>].<stage>`, so the timeline of
   enqueued -> admitted -> prefill -> first_token -> decode_block* ->
   preempted/requeued -> finished reconstructs straight from the file.
+  Requests ending in a failure-side terminal status (failed / expired /
+  shed) are flagged with `!!` plus a trailing count, so a chaos or
+  overload run's casualties stand out from the finished majority.
 
 Usage:
     python tools/trace_summary.py TRACE.json [--top N] [--requests]
@@ -102,6 +105,13 @@ def format_top(stats: Dict[str, Dict[str, float]], top: int = 20,
     return "\n".join(lines)
 
 
+# terminal stages worth shouting about: the request did NOT finish —
+# it was quarantined (failed), missed its deadline (expired), or was
+# shed by queue-wait backpressure. "cancelled" is caller-initiated, so
+# it is shown but not flagged.
+BAD_TERMINALS = ("failed", "expired", "shed")
+
+
 def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]]
                     ) -> str:
     if not timelines:
@@ -109,13 +119,28 @@ def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]]
                 "(export one from a metrics-enabled ServingEngine run "
                 "inside an armed profiler window)")
     lines = []
+    bad_counts: Dict[str, int] = {}
     for rid in sorted(timelines):
         evs = timelines[rid]
         t0 = evs[0][1]
-        lines.append(f"request {rid}:")
+        stages = {stage for stage, _, _ in evs}
+        bad = next((s for s in BAD_TERMINALS if s in stages), None)
+        if bad is None:
+            lines.append(f"request {rid}:")
+        else:
+            bad_counts[bad] = bad_counts.get(bad, 0) + 1
+            lines.append(f"request {rid}:  !! {bad}")
         for stage, ts, dur in evs:
             tail = f"  ({dur / 1e3:.3f} ms)" if dur > 0 else ""
-            lines.append(f"  +{(ts - t0) / 1e3:10.3f} ms  {stage}{tail}")
+            mark = " !!" if stage in BAD_TERMINALS else ""
+            lines.append(
+                f"  +{(ts - t0) / 1e3:10.3f} ms  {stage}{tail}{mark}")
+    if bad_counts:
+        summary = ", ".join(f"{bad_counts[s]} {s}"
+                            for s in BAD_TERMINALS if s in bad_counts)
+        lines.append("")
+        lines.append(f"!! {sum(bad_counts.values())} of {len(timelines)} "
+                     f"requests did not finish: {summary}")
     return "\n".join(lines)
 
 

@@ -336,6 +336,30 @@ def _run_serving_faults(on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
+def _run_serving_chunked(on_tpu: bool) -> dict:
+    """Long-prompt interference phase: decoders' inter-token p99 and the
+    decode-stall histogram with chunked prefill on vs off while one long
+    prompt lands mid-decode (head-of-line blocking vs Sarathi-style
+    stall-free batching). Non-fatal like the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_chunked_phase(model, cfg, on_tpu)
+        _log(f"phase=serving_chunked: inter-token p99 "
+             f"{out['chunking_off']['inter_token_p99_ms']}ms -> "
+             f"{out['chunking_on']['inter_token_p99_ms']}ms, "
+             f"stall p99 {out['chunking_off']['decode_stall_p99_ms']}ms "
+             f"-> {out['chunking_on']['decode_stall_p99_ms']}ms, "
+             f"ttft(long) {out['chunking_off']['ttft_long_ms']}ms -> "
+             f"{out['chunking_on']['ttft_long_ms']}ms "
+             f"({out['chunking_on']['prefill_chunks']} chunks of "
+             f"{out['chunk_tokens']})")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_chunked: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def make_train_step(model, opt):
     """The bench train step (fwd + MLM loss + grad + Adam, bf16 autocast).
 
@@ -532,6 +556,10 @@ def bench_child() -> None:
     # seeded chaos phase: fault-injected run vs fault-free parity
     _enter_phase("serving_faults", 400.0)
     serving_faults = _run_serving_faults(on_tpu)
+
+    # chunked-prefill interference phase: stall-free batching on vs off
+    _enter_phase("serving_chunked", 400.0)
+    serving_chunked = _run_serving_chunked(on_tpu)
     _enter_phase("build")
 
     if on_tpu:
@@ -665,6 +693,7 @@ def bench_child() -> None:
                 "serving_prefix": serving_prefix,
                 "serving_decode": serving_decode,
                 "serving_faults": serving_faults,
+                "serving_chunked": serving_chunked,
                 "observability": _obs_snapshot(),
             },
         }

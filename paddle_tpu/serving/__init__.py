@@ -21,7 +21,12 @@ decoder models (LLaMA, GPT) with:
   latency/throughput counters exported through paddle_tpu.profiler. The
   decode hot path runs a fused decode+sample block of `decode_horizon`
   steps per jitted dispatch (device PRNG/EOS state, async host/device
-  overlap), syncing the host once per block instead of once per token;
+  overlap), syncing the host once per block instead of once per token.
+  With `enable_chunked_prefill=True` prompts run in page-aligned chunks
+  of `prefill_chunk_tokens` co-scheduled with decode under a
+  `max_num_batched_tokens` budget (Sarathi-Serve stall-free batching):
+  long prompts stop stalling running decoders, and ONE traced-offset
+  chunked executable replaces the whole per-bucket prefill family;
 - `resilience`: failure semantics — `cancel()` in every request state,
   per-request deadlines and bounded-queue load shedding
   (`EngineOverloaded`), failure isolation with one transient retry
@@ -46,7 +51,7 @@ from .resilience import (  # noqa: F401
     is_transient,
 )
 from .scheduler import (  # noqa: F401
-    Request, SamplingParams, ScheduleDecision, Scheduler,
+    ChunkTask, Request, SamplingParams, ScheduleDecision, Scheduler,
 )
 
 __all__ = [
@@ -55,7 +60,8 @@ __all__ = [
     "PrefixCache", "PrefixNode",
     "EngineOverloaded", "FaultInjector", "InjectedFault",
     "TERMINAL_STATUSES", "is_transient",
-    "Scheduler", "ScheduleDecision", "Request", "SamplingParams",
+    "Scheduler", "ScheduleDecision", "ChunkTask", "Request",
+    "SamplingParams",
     "paged_attend", "paged_decode_attention", "paged_decode_available",
     "advance_positions", "pages_for", "overflow_position",
     "NULL_PAGE", "PAD_TOKEN",

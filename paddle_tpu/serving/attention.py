@@ -142,8 +142,12 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
         _count_dispatch("prefill")
         ctx = _prefill_attention(q, kd, vd, pos, rep, bias=bias)
     else:
-        # suffix prefill from a cached prefix: earlier K/V lives only in
-        # the pool's shared pages, so attend over the page table
+        # prefill at a TRACED (or nonzero) offset: earlier K/V lives
+        # only in the pool's pages, so attend through the page table.
+        # Both offset prefills land here — a prefix-cache suffix prefill
+        # AND every chunk of a chunked prefill (its offset is traced, so
+        # even a first chunk at offset 0 takes this path; that is what
+        # lets one chunked executable serve every chunk of every prompt)
         _count_dispatch("prefill_paged")
         ctx = _prefill_attention_paged(q, new_cache, pos, rep, bias=bias)
     return ctx, new_cache

@@ -25,7 +25,20 @@ jitted dispatch):
   run while the device computes. The scheduler reserves each block's
   pages up front (`_ensure_decode_pages` with in-flight upper bounds)
   and drains the pipeline before any preemption, keeping emitted
-  streams token-identical to `decode_horizon=1`.
+  streams token-identical to `decode_horizon=1`;
+- chunked prefill (`enable_chunked_prefill=True`, Sarathi-Serve style):
+  prompts run in page-aligned chunks of `prefill_chunk_tokens` (default
+  256), co-scheduled with the step's decode block under a
+  `max_num_batched_tokens` budget, so a long prompt never stalls the
+  running decoders for a full bucket-padded forward pass. Each chunk is
+  a prefill at a TRACED start offset with a TRACED valid length, so the
+  whole per-bucket `prefill`/`prefill_offset` executable family
+  collapses into ONE `prefill_chunked` executable for every prompt
+  length, and padding waste is capped at one chunk (the prompt's final
+  one) instead of up-to-2x of a power-of-two bucket. Intermediate
+  chunks never sync the host and leave the per-request PRNG state
+  untouched (one key split per EMITTED token), so token streams stay
+  bit-identical to the unchunked engine.
 
 The engine talks to any decoder model that follows the
 `forward(input_ids, caches=..., start_pos=...)` cache protocol of
@@ -129,7 +142,8 @@ class ServingObs:
     everywhere and the hot path does literally no metrics work
     (tests/test_serving.py pins that with a raise-on-touch guard)."""
 
-    FAMILIES = ("prefill", "prefill_offset", "decode", "sample")
+    FAMILIES = ("prefill", "prefill_offset", "prefill_chunked", "decode",
+                "sample")
 
     def __init__(self, registry: MetricsRegistry):
         self.registry = registry
@@ -137,6 +151,8 @@ class ServingObs:
         c, g, h = registry.counter, registry.gauge, registry.histogram
         self.prefill_steps = c("serving_prefill_steps_total",
                                "prefill dispatches")
+        self.prefill_chunks = c("serving_prefill_chunks_total",
+                                "chunked-prefill chunk dispatches")
         self.decode_steps = c("serving_decode_steps_total",
                               "fused decode-block dispatches")
         self.tokens = c("serving_tokens_generated_total",
@@ -162,6 +178,15 @@ class ServingObs:
             "serving_inter_token_seconds",
             "per-token gap between host-visible emissions (a decode "
             "block's gap is spread evenly over its tokens)")
+        # the head-of-line metric chunked prefill exists to shrink: the
+        # wall gap between consecutive decode-block DISPATCHES while at
+        # least one request is running — an unchunked engine shows a
+        # full bucket-padded prefill here whenever a prompt arrives
+        # mid-decode, a chunked one at most ~one chunk's compute
+        self.decode_stall = h(
+            "serving_decode_stall_seconds",
+            "gap between consecutive decode-block dispatches while "
+            "requests are running")
         # resilience counters (ISSUE 6): one labelled series per
         # non-finished terminal status, plus retry/park events
         self.terminated = {
@@ -217,7 +242,7 @@ class ServingObs:
         self.queue_waiting.set(waiting)
         self.queue_running.set(running)
         free = allocator.num_free
-        total = allocator.num_pages - 1          # page 0 never allocates
+        total = allocator.num_allocatable        # page 0 never allocates
         self.free_pages.set(free)
         self.kv_util.set(1.0 - free / total if total else 0.0)
 
@@ -231,6 +256,9 @@ class ServingEngine:
                  cache_dtype=jnp.float32,
                  enable_prefix_caching: bool = False,
                  decode_horizon: int = 8,
+                 enable_chunked_prefill: bool = False,
+                 prefill_chunk_tokens: int = 256,
+                 max_num_batched_tokens: Optional[int] = None,
                  enable_metrics: bool = True,
                  metrics: Optional[MetricsRegistry] = None,
                  max_waiting: Optional[int] = None,
@@ -250,6 +278,35 @@ class ServingEngine:
         self.decode_horizon = int(decode_horizon)
         if self.decode_horizon < 1:
             raise ValueError("decode_horizon must be >= 1")
+        # chunked prefill (Sarathi-Serve): prompts run in page-aligned
+        # chunks co-scheduled with decode under a per-step token budget.
+        # Off by default; when on, the chunk width must be a positive
+        # multiple of page_size (chunk starts stay page-aligned so every
+        # non-final chunk's page charge is exact) and the budget must fit
+        # at least one chunk or prefill could never progress
+        self.enable_chunked_prefill = bool(enable_chunked_prefill)
+        if self.enable_chunked_prefill:
+            self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+            if self.prefill_chunk_tokens < page_size or \
+                    self.prefill_chunk_tokens % page_size:
+                raise ValueError(
+                    f"prefill_chunk_tokens ({prefill_chunk_tokens}) must "
+                    f"be a positive multiple of page_size ({page_size})")
+            if max_num_batched_tokens is None:
+                # default: one full chunk always fits alongside a full
+                # decode batch (decoders charge a block's worst case)
+                max_num_batched_tokens = (
+                    self.prefill_chunk_tokens
+                    + max_batch_size * self.decode_horizon)
+            self.max_num_batched_tokens = int(max_num_batched_tokens)
+            if self.max_num_batched_tokens < self.prefill_chunk_tokens:
+                raise ValueError(
+                    f"max_num_batched_tokens ({max_num_batched_tokens}) "
+                    "must be >= prefill_chunk_tokens "
+                    f"({self.prefill_chunk_tokens})")
+        else:
+            self.prefill_chunk_tokens = None
+            self.max_num_batched_tokens = None
         if num_pages is None:
             # worst case every slot runs a full-length sequence, +1 null
             num_pages = max_batch_size * self.max_pages_per_seq + 1
@@ -304,8 +361,15 @@ class ServingEngine:
                                    obs=self._obs,
                                    max_waiting=max_waiting,
                                    max_preemptions=max_preemptions,
-                                   max_prefill_tokens=
-                                   self.prefill_buckets[-1])
+                                   # chunked prefill handles any folded
+                                   # length — no bucket ceiling to guard
+                                   max_prefill_tokens=(
+                                       None if self.enable_chunked_prefill
+                                       else self.prefill_buckets[-1]),
+                                   prefill_chunk_tokens=
+                                   self.prefill_chunk_tokens,
+                                   max_num_batched_tokens=
+                                   self.max_num_batched_tokens)
         self.params, self.buffers = extract_state(model)
         self.requests: Dict[int, Request] = {}
         # per-request PRNG state as raw (2,) uint32 key data, resident on
@@ -319,6 +383,11 @@ class ServingEngine:
         # schedule(); step() returns them ahead of its own
         self._spill: List[Tuple[int, int]] = []
         self._last_drain_t = 0.0
+        # decode-stall observability: perf_counter of the most recent
+        # decode-block dispatch, cleared whenever the running set
+        # empties, so the serving_decode_stall_seconds histogram only
+        # sees gaps while some request was actually being served
+        self._last_decode_dispatch_t: Optional[float] = None
         # jitted steps are memoized ON THE MODEL (generation.py's trick):
         # the closures only capture `model`, so engines over the same model
         # — restarts, tests, multiple pools — share compiled executables,
@@ -331,8 +400,8 @@ class ServingEngine:
         # stays for compatibility: sampling is fused into prefill/decode,
         # so it counts the (now extinct) standalone sampler dispatches
         self._exec_shapes: Dict[str, set] = {
-            "prefill": set(), "prefill_offset": set(), "decode": set(),
-            "sample": set()}
+            "prefill": set(), "prefill_offset": set(),
+            "prefill_chunked": set(), "decode": set(), "sample": set()}
 
     # ----------------------------------------------------------- request API
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
@@ -359,10 +428,13 @@ class ServingEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len "
                 f"{self.max_seq_len}")
-        if len(prompt) > self.prefill_buckets[-1]:
+        if not self.enable_chunked_prefill \
+                and len(prompt) > self.prefill_buckets[-1]:
             # belt over the constructor's buckets-cover-max_seq_len check:
             # admitting this request would allocate pages and then blow up
-            # in _bucket_for mid-prefill, leaking them
+            # in _bucket_for mid-prefill, leaking them. Chunked prefill
+            # has no bucket ceiling — any prompt under max_seq_len runs
+            # chunk by chunk
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds the largest "
                 f"prefill bucket {self.prefill_buckets[-1]}")
@@ -513,13 +585,46 @@ class ServingEngine:
         the next block's device time)."""
         if self._deadlined or self._max_queue_wait_s is not None:
             self._expire_and_shed()            # may spill drained tokens
+        if not any(r.prefill_done for r in self.scheduler.running):
+            # decode-stall gaps are only meaningful while some request
+            # continuously WANTED decode steps; a wave boundary — or a
+            # stretch where every running request is still mid-prefill
+            # with nobody decode-ready — resets the gap clock
+            self._last_decode_dispatch_t = None
         decision = self.scheduler.schedule()   # drain_hook may spill here
         spilled, self._spill = self._spill, []
         if decision.kind == "prefill":
             return spilled + self._prefill(decision.prefill)
         if decision.kind == "decode":
             return spilled + self._decode(decision.decode)
+        if decision.kind == "mixed":
+            return spilled + self._mixed_step(decision)
         return spilled + self._drain_pending()
+
+    def _mixed_step(self, decision) -> List[Tuple[int, int]]:
+        """One chunked-prefill step: the decode block dispatches FIRST
+        (async — its drain below overlaps the chunks' device time), then
+        every scheduled chunk chains on the block's donated pools, so the
+        device serializes decode-block -> chunks while the host runs
+        ahead. One shared drain: the block's tokens surface through the
+        ordinary pending-drain path; intermediate chunks sync nothing."""
+        events: List[Tuple[int, int]] = []
+        if decision.decode:
+            events.extend(self._decode(decision.decode))
+        elif self._pending is not None:
+            # belt: every pending block's requests are running decoders,
+            # so an empty decode batch should imply no pending block
+            events.extend(self._drain_pending())
+        for task in decision.chunks:
+            if task.req.status != "running":
+                continue    # finalized mid-step (cancel/expiry/fault)
+            if task.start != task.req.num_computed_tokens:
+                # stale extent: the request was preempted (and possibly
+                # re-admitted with a fresh first chunk) after this task
+                # was queued — its pages and cursor no longer match
+                continue
+            events.extend(self._chunk_prefill(task))
+        return events
 
     def stream(self):
         """Generator of (request_id, token, done) events until every
@@ -674,6 +779,7 @@ class ServingEngine:
             # other (already-prefilled) requests and keeps flying
             self._quarantine([req], err, "prefill")
             return []
+        req.num_computed_tokens = len(req.prompt)
         if self.prefix_cache is not None:
             # register the prompt's full pages for future reuse (the
             # partial last page never enters the tree); in-flight
@@ -691,6 +797,113 @@ class ServingEngine:
         if o is not None and prev_t is not None:
             # requeued request: the gap since its last pre-preemption
             # token is honest inter-token latency
+            o.inter_token.observe(max(now - prev_t, 0.0))
+        return events
+
+    # ------------------------------------------------------ chunked prefill
+    def _chunked_prefill_jit(self):
+        """THE chunked-prefill executable — one per engine, not per
+        bucket: ids are a fixed (1, prefill_chunk_tokens) window, the
+        start offset and the valid length (via `last_idx`) are TRACED
+        scalars, and attention reaches the earlier chunks' (and cached
+        prefix's) K/V through the page table, exactly the machinery the
+        prefix-cache offset prefill proved out. Every chunk of every
+        prompt length shares this single compiled program; only its
+        final chunk carries padding. The sampled token and split key are
+        computed unconditionally (same trace for every chunk) but the
+        host ADOPTS them only on the final chunk."""
+        key = ("prefill_chunked", self.prefill_chunk_tokens)
+        if key not in self._jit_cache:
+            model = self.model
+
+            def prefill(params, buffers, ids, pools, page_table, last_idx,
+                        offset, key_data, temps, top_ks, top_ps):
+                views = [PagedLayerCache(kp, vp, page_table)
+                         for kp, vp in pools]
+                (logits, new_views), _ = call_functional(
+                    model, params, buffers, (Tensor(ids),),
+                    kwargs={"caches": views, "start_pos": offset},
+                    training=False)
+                last = jax.lax.dynamic_slice_in_dim(
+                    logits, last_idx, 1, axis=1)[:, 0]
+                key_data, subs = _split_rows(key_data)
+                tok = _sample_batch(last, subs, temps, top_ks, top_ps)
+                return (tok.astype(jnp.int32), key_data,
+                        [(v.k_pool, v.v_pool) for v in new_views])
+
+            self._jit_cache[key] = jax.jit(prefill, donate_argnums=(3,))
+        return self._jit_cache[key]
+
+    def _chunk_prefill(self, task) -> List[Tuple[int, int]]:
+        """Dispatch one scheduled prefill chunk. Intermediate chunks
+        write K/V and return WITHOUT a host sync (their sampled token is
+        discarded and the per-request key state stays untouched — one
+        key split per emitted token keeps streams bit-identical to
+        unchunked); the final chunk adopts the sampled first token,
+        exactly like the tail of `_prefill`. Padding lanes inside the
+        chunk are harmless by construction: sub-prompt padding is
+        overwritten by the next chunk before anything reads it, tail
+        padding past the prompt is overwritten by the first decode
+        steps, and positions past the page table's capacity route to
+        the null page."""
+        req, start, n = task.req, task.start, task.length
+        rid = req.request_id
+        chunk = self.prefill_chunk_tokens
+        final = task.is_final
+        self._note_exec("prefill_chunked",
+                        (chunk, self.cache.num_pages,
+                         self.max_pages_per_seq))
+        ids = np.zeros((1, chunk), np.int32)
+        ids[0, :n] = req.prompt[start:start + n]
+        page_table = self.cache.page_table_array([req.pages],
+                                                 self.max_pages_per_seq)
+        sp = req.sampling
+        knobs = (jnp.asarray([sp.temperature], jnp.float32),
+                 jnp.asarray([sp.top_k], jnp.int32),
+                 jnp.asarray([sp.top_p], jnp.float32))
+        key_data = self._key_state[rid][None]
+
+        def dispatch():
+            tok, new_kd, pools = self._chunked_prefill_jit()(
+                self.params, self.buffers, jnp.asarray(ids),
+                self.cache.pools, page_table, jnp.int32(n - 1),
+                jnp.int32(start), key_data, *knobs)
+            self.cache.pools = pools
+            if not final:
+                return PAD_TOKEN          # async: no host round-trip
+            self._key_state[rid] = new_kd[0]
+            return int(np.asarray(tok)[0])
+
+        t0 = time.perf_counter()
+        with RecordEvent("serving.prefill_chunk"):
+            token, err = self._guarded_call("dispatch", dispatch)
+        if token is None:
+            # fault mid-chunk: quarantine ONLY this request — the cursor
+            # never advanced, so finalize releases exactly its
+            # chunk-to-date pages; the decode block and its peers'
+            # chunks keep flying (their pools/pages are disjoint)
+            self._quarantine([req], err, "prefill_chunk")
+            return []
+        req.num_computed_tokens = start + n
+        now = time.perf_counter()
+        o = self._obs
+        if o is not None:
+            o.prefill_chunks.inc()
+            o.prefill_seconds.inc(now - t0)
+            # profiler-only spans for intermediate chunks (retained
+            # lifecycle lists must not grow per chunk); the final chunk
+            # is the retained "prefill" stage
+            o.lifecycle.span(rid, "prefill", t0, now, retain=final)
+        if not final:
+            return []
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, req.pages)
+        prev_t = req.last_token_t            # set => this is a re-prefill
+        if o is not None:
+            o.prefill_steps.inc()
+            o.host_syncs.inc()
+        events = [self._emit(req, token, now)]
+        if o is not None and prev_t is not None:
             o.inter_token.observe(max(now - prev_t, 0.0))
         return events
 
@@ -840,6 +1053,13 @@ class ServingEngine:
             req.inflight += n
         if self._obs is not None:
             self._obs.decode_steps.inc()
+            if self._last_decode_dispatch_t is not None:
+                # dispatch-to-dispatch gap while requests were running:
+                # whatever kept the engine away from decode (a prefill,
+                # scheduling, host work) shows up here
+                self._obs.decode_stall.observe(
+                    max(t0 - self._last_decode_dispatch_t, 0.0))
+        self._last_decode_dispatch_t = t0
         self._pending = {
             "rids": rids, "reqs": list(reqs), "incr": incr,
             "emitted": emitted, "tokens": tokens, "positions": positions,
@@ -937,6 +1157,7 @@ class ServingEngine:
         if o is not None:
             s = {
                 "prefill_steps": int(o.prefill_steps.value),
+                "prefill_chunks": int(o.prefill_chunks.value),
                 "decode_steps": int(o.decode_steps.value),
                 "tokens_generated": int(o.tokens.value),
                 "prefill_time_s": float(o.prefill_seconds.value),
@@ -946,7 +1167,8 @@ class ServingEngine:
             }
         else:
             s = {
-                "prefill_steps": 0, "decode_steps": 0,
+                "prefill_steps": 0, "prefill_chunks": 0,
+                "decode_steps": 0,
                 "tokens_generated": 0, "prefill_time_s": 0.0,
                 "decode_time_s": 0.0,
                 "preemptions": sum(r.preemptions
@@ -980,7 +1202,11 @@ class ServingEngine:
                      else Histogram.empty_summary()),
             "inter_token": (o.inter_token.summary() if o is not None
                             else Histogram.empty_summary()),
+            "decode_stall": (o.decode_stall.summary() if o is not None
+                             else Histogram.empty_summary()),
         }
+        s["prefill_chunk_tokens"] = self.prefill_chunk_tokens
+        s["max_num_batched_tokens"] = self.max_num_batched_tokens
         if self.prefix_cache is not None:
             s["prefix_cache"] = self.prefix_cache.stats()
         per_req = {}

@@ -101,6 +101,15 @@ class BlockAllocator:
         return len(self._free)
 
     @property
+    def num_allocatable(self) -> int:
+        """Pages the allocator can ever hand out: `num_pages` minus the
+        reserved null page. Every capacity check and error message counts
+        against THIS, never the raw pool size — the scheduler's
+        too-large-for-pool paths used to disagree by one (num_pages vs
+        num_pages - 1) depending on which raised."""
+        return self.num_pages - 1
+
+    @property
     def num_used(self) -> int:
         return len(self._refs)
 

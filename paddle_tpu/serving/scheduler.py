@@ -33,7 +33,20 @@ to decide which call. Policy:
   for the UNCACHED suffix; release paths go through the refcounted
   allocator, so shared pages outlive any one request, and on pool
   pressure unreferenced cached pages are evicted before anyone is
-  preempted.
+  preempted;
+- chunked prefill (`prefill_chunk_tokens=C`, Sarathi-Serve style): the
+  prefill-XOR-decode policy above is replaced by MIXED steps assembled
+  under a per-step token budget (`max_num_batched_tokens`). A prompt (or
+  its uncached suffix) runs in page-aligned chunks of C tokens, tracked
+  by a `num_computed_tokens` cursor on the request; every step schedules
+  ALL running decoders first (decode never waits behind a long prompt —
+  the head-of-line fix), then as many prefill chunks as the leftover
+  budget allows, admitting multiple new requests per step when it fits.
+  Page accounting charges chunks incrementally — admission reserves only
+  the FIRST chunk's pages, each later chunk tops the request up, and the
+  final chunk reserves through the first decode block exactly like
+  unchunked `_admission_pages` — so a half-prefilled request holds pages
+  only for the tokens it has actually computed.
 """
 from __future__ import annotations
 
@@ -46,7 +59,8 @@ from .kv_cache import NULL_PAGE, BlockAllocator, pages_for
 from .resilience import (EngineOverloaded, InjectedFault,
                          TERMINAL_STATUSES)
 
-__all__ = ["Request", "SamplingParams", "Scheduler", "ScheduleDecision"]
+__all__ = ["ChunkTask", "Request", "SamplingParams", "Scheduler",
+           "ScheduleDecision"]
 
 _REQUEST_IDS = itertools.count()
 
@@ -94,6 +108,14 @@ class Request:
     # block (the engine's async overlap): page demand must cover them,
     # and host state (generated/num_tokens) lags behind by this much
     inflight: int = 0
+    # chunked-prefill cursor: prompt tokens whose K/V is resident —
+    # cached prefix plus every chunk dispatched so far. The engine
+    # advances it only after a chunk dispatch SUCCEEDS, so a faulted
+    # chunk never claims tokens it did not write. A request with
+    # num_computed_tokens < len(prompt) is mid-prefill: it never joins
+    # the decode batch and its page charge covers exactly its computed
+    # tokens (the final chunk charges through the first decode block)
+    num_computed_tokens: int = 0
 
     # metrics (perf_counter timestamps, filled by the engine)
     arrival_t: float = dataclasses.field(default_factory=time.perf_counter)
@@ -114,6 +136,14 @@ class Request:
         """Position the next decode token will occupy."""
         return self.num_tokens
 
+    @property
+    def prefill_done(self) -> bool:
+        """Whole prompt's K/V resident — the request can decode. Only
+        consulted on the chunked path; preemption folds generated tokens
+        into the prompt and resets the cursor, so a requeued victim
+        re-prefills from scratch either way."""
+        return self.num_computed_tokens >= len(self.prompt)
+
     def is_done(self) -> bool:
         if len(self.generated) >= self.max_new_tokens:
             return True
@@ -122,10 +152,30 @@ class Request:
 
 
 @dataclasses.dataclass
+class ChunkTask:
+    """One page-aligned prefill chunk of one request, scheduled into a
+    mixed step: compute prompt[start : start+length] at traced offset
+    `start`, attending over the request's earlier pages through its page
+    table. `length` < the engine's chunk width only on the prompt's
+    final chunk (the one padded spot in the whole prefill)."""
+
+    req: Request
+    start: int
+    length: int
+
+    @property
+    def is_final(self) -> bool:
+        return self.start + self.length >= len(self.req.prompt)
+
+
+@dataclasses.dataclass
 class ScheduleDecision:
-    kind: str                            # "prefill" | "decode" | "idle"
+    # "prefill" | "decode" | "idle" classic; "mixed" when chunked prefill
+    # is on — decode batch plus zero or more prefill chunks in ONE step
+    kind: str
     prefill: Optional[Request] = None
     decode: Sequence[Request] = ()
+    chunks: Sequence[ChunkTask] = ()
 
 
 class Scheduler:
@@ -135,7 +185,9 @@ class Scheduler:
                  drain_hook=None, obs=None,
                  max_waiting: Optional[int] = None,
                  max_preemptions: Optional[int] = None,
-                 max_prefill_tokens: Optional[int] = None):
+                 max_prefill_tokens: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 max_num_batched_tokens: Optional[int] = None):
         self.allocator = allocator
         self.page_size = page_size
         self.max_batch_size = max_batch_size
@@ -151,8 +203,19 @@ class Scheduler:
         self.max_preemptions = max_preemptions
         # largest prompt the engine can ever prefill (its biggest
         # bucket); _preempt refuses to fold a sequence past it with a
-        # clear error instead of failing deep in _bucket_for later
+        # clear error instead of failing deep in _bucket_for later.
+        # Chunked prefill has no bucket ceiling (any length re-prefills
+        # in chunks), so the engine passes None there
         self.max_prefill_tokens = max_prefill_tokens
+        # chunked prefill: None = classic prefill-XOR-decode scheduling;
+        # an int C (a positive multiple of page_size, validated by the
+        # engine) switches schedule() to mixed steps of decode + chunks
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        # per-step token budget for mixed steps: each running decoder
+        # charges decode_horizon (its block's worst-case query tokens),
+        # each chunk charges the full padded chunk width — the honest
+        # compute cost of the fixed-shape chunk executable
+        self.max_num_batched_tokens = max_num_batched_tokens
         # called once per _ensure_decode_pages on pool exhaustion, before
         # any preemption: the engine drains its in-flight decode block so
         # (a) device-finished requests release their pages and (b) a
@@ -308,6 +371,9 @@ class Scheduler:
         self.waiting.pop(0)
         req.pages = cached + pages
         req.cached_tokens = len(cached) * self.page_size
+        # the engine advances the cursor to len(prompt) once the (whole-
+        # prompt) prefill dispatch succeeds
+        req.num_computed_tokens = req.cached_tokens
         if self.prefix_cache is not None:
             self.prefix_cache.record(len(req.prompt), req.cached_tokens)
         req.status = "running"
@@ -345,6 +411,7 @@ class Scheduler:
         self.allocator.free_all(victim.pages)
         victim.pages = []
         victim.cached_tokens = 0
+        victim.num_computed_tokens = 0   # re-prefill from scratch
         victim.inflight = 0     # drain_hook ran first: nothing undrained
         victim.prompt = victim.prompt + victim.generated
         victim.max_new_tokens -= len(victim.generated)
@@ -376,6 +443,13 @@ class Scheduler:
         for req in list(self.running):
             if req not in self.running:   # preempted by an older peer
                 continue
+            if self.prefill_chunk_tokens is not None \
+                    and not req.prefill_done:
+                # mid-prefill under chunking: the request does not decode
+                # this step, and _block_pages would charge its WHOLE
+                # prompt (num_tokens counts uncomputed tokens too) —
+                # its pages are charged chunk-by-chunk instead
+                continue
             faulted = 0
             while req in self.running and \
                     self._block_pages(req) > len(req.pages):
@@ -397,11 +471,15 @@ class Scheduler:
                     continue
                 victim = self.running[-1]
                 if victim is req and len(self.running) == 1:
+                    # same accounting as schedule()'s too-large check:
+                    # the null page is not allocatable, so report
+                    # num_allocatable, not the raw pool size
                     raise RuntimeError(
                         "KV page pool too small for a single request: "
                         f"request {req.request_id} at position "
-                        f"{req.next_pos} with {self.allocator.num_pages} "
-                        "pages total")
+                        f"{req.next_pos} with "
+                        f"{self.allocator.num_allocatable} "
+                        "allocatable pages in total")
                 self._preempt(victim)
                 if victim is req:         # self-preempted: sit this one out
                     break
@@ -411,6 +489,8 @@ class Scheduler:
             # queue-depth + page-pool gauges, sampled once per step
             self.obs.sample_queues(len(self.waiting), len(self.running),
                                    self.allocator)
+        if self.prefill_chunk_tokens is not None:
+            return self._schedule_chunked()
         admitted = self._try_admit()
         if admitted is not None:
             return ScheduleDecision(kind="prefill", prefill=admitted)
@@ -418,20 +498,175 @@ class Scheduler:
             self._ensure_decode_pages()
             batch = self.running[:self.max_batch_size]
             return ScheduleDecision(kind="decode", decode=list(batch))
-        if self.waiting:
-            req = self.waiting[0]
-            need = self._admission_pages(req)
-            if need > self.allocator.num_pages - 1:
-                # nothing running and the head request cannot fit even
-                # in an EMPTY pool: no amount of waiting helps
-                raise RuntimeError(
-                    f"request {req.request_id} needs {need} pages but "
-                    f"the pool has {self.allocator.num_pages - 1} "
-                    "allocatable in total")
-            # otherwise the deferral is transient (an injected alloc
-            # fault, or pages still pinned that will be released): stay
-            # idle and retry next step
+        self._check_head_fits()
         return ScheduleDecision(kind="idle")
+
+    def _check_head_fits(self) -> None:
+        """About to go idle with requests still waiting: if nothing is
+        running and the head request cannot fit even in an EMPTY pool,
+        no amount of waiting helps — raise now instead of idling
+        forever. Otherwise the deferral is transient (an injected alloc
+        fault, or pages still pinned that will be released)."""
+        if self.running or not self.waiting:
+            return
+        req = self.waiting[0]
+        need = self._admission_pages(req)
+        if need > self.allocator.num_allocatable:
+            raise RuntimeError(
+                f"request {req.request_id} needs {need} pages but "
+                f"the pool has {self.allocator.num_allocatable} "
+                "allocatable in total")
+
+    # ------------------------------------------------------ chunked prefill
+    def _schedule_chunked(self) -> ScheduleDecision:
+        """Mixed-step assembly under the per-step token budget
+        (Sarathi-Serve stall-free batching): ALL running decoders first
+        — a decode step is never skipped because prefill work exists,
+        which is the head-of-line fix — then prefill chunks from the
+        leftover budget: first the partially-prefilled running requests
+        (oldest first), then NEW admissions for as long as batch slots
+        and budget last (multi-request admission per step)."""
+        budget = self.max_num_batched_tokens
+        chunk = self.prefill_chunk_tokens
+        decode: List[Request] = []
+        if any(r.prefill_done for r in self.running):
+            self._ensure_decode_pages()      # may drain and/or preempt
+            decode = [r for r in self.running
+                      if r.prefill_done][:self.max_batch_size]
+            budget -= self.decode_horizon * len(decode)
+        chunks: List[ChunkTask] = []
+        for req in list(self.running):
+            if budget < chunk:
+                break
+            if req not in self.running or req.prefill_done:
+                continue
+            task = self._next_chunk(req)
+            if task is not None:
+                chunks.append(task)
+                budget -= chunk
+        while (budget >= chunk and self.waiting
+               and len(self.running) < self.max_batch_size):
+            req = self._admit_chunked()
+            if req is None:
+                break
+            task = self._next_chunk(req)
+            if task is None:      # cannot happen: admission just paid
+                break             # for this chunk's pages; stay safe
+            chunks.append(task)
+            budget -= chunk
+        # Chunk-page reservation above may have preempted a request that
+        # was already picked for this step's decode batch (or had a
+        # chunk queued): its pages are gone, so dispatching it now would
+        # decode from freed state. Keep only entries still running; a
+        # same-step re-admission is represented by its NEW chunk task
+        # (the engine drops any stale task via the cursor check).
+        decode = [r for r in decode
+                  if r.status == "running" and r.prefill_done]
+        chunks = [t for t in chunks if t.req.status == "running"]
+        if decode or chunks:
+            return ScheduleDecision(kind="mixed", decode=decode,
+                                    chunks=chunks)
+        self._check_head_fits()
+        return ScheduleDecision(kind="idle")
+
+    def _chunk_pages_needed(self, req: Request, end: int) -> int:
+        """Total pages `req` must hold once its prompt is computed up to
+        `end`: the final chunk reserves through the first decode block
+        (identical to unchunked `_admission_pages`, so the first decode
+        block never allocates mid-flight); earlier chunks charge exactly
+        their computed tokens — `end` is page-aligned there because the
+        cached prefix and the chunk width both are."""
+        if end >= len(req.prompt):
+            return self._admission_pages(req)
+        return pages_for(end, self.page_size)
+
+    def _admit_chunked(self) -> Optional[Request]:
+        """Admission under chunking: charge the pool only for the FIRST
+        chunk (after the prefix-cache match), not the whole prompt — a
+        long prompt no longer needs its full page demand free to start.
+        Same cache-miss fallback as `_try_admit`: on exhaustion drop the
+        match refs (they pin exactly the evictable pages) and retry
+        cache-free once."""
+        req = self.waiting[0]
+        cached: List[int] = []
+        if self.prefix_cache is not None:
+            try:
+                cached = self.prefix_cache.match(req.prompt)
+            except InjectedFault:
+                cached = []
+        start = len(cached) * self.page_size
+        need = self._chunk_pages_needed(
+            req, min(start + self.prefill_chunk_tokens, len(req.prompt)))
+        pages = self._alloc_n(need - len(cached))
+        if pages is None:
+            self.allocator.free_all(cached)
+            if cached:
+                cached = []
+                need = self._chunk_pages_needed(
+                    req, min(self.prefill_chunk_tokens, len(req.prompt)))
+                pages = self._alloc_n(need)
+            if pages is None:
+                return None
+        self.waiting.pop(0)
+        req.pages = cached + pages
+        req.cached_tokens = len(cached) * self.page_size
+        req.num_computed_tokens = req.cached_tokens
+        if self.prefix_cache is not None:
+            self.prefix_cache.record(len(req.prompt), req.cached_tokens)
+        req.status = "running"
+        self.running.append(req)
+        if self.obs is not None:
+            self.obs.admitted(req)
+        return req
+
+    def _next_chunk(self, req: Request) -> Optional[ChunkTask]:
+        """The next chunk of a mid-prefill request, with its pages
+        reserved — or None when the pool cannot cover it this step (the
+        request keeps its chunk-to-date pages and simply makes no
+        progress until pages free up)."""
+        start = req.num_computed_tokens
+        n = min(self.prefill_chunk_tokens, len(req.prompt) - start)
+        if n <= 0:
+            return None
+        need = self._chunk_pages_needed(req, start + n)
+        if not self._reserve_chunk_pages(req, need):
+            return None
+        return ChunkTask(req=req, start=start, length=n)
+
+    def _reserve_chunk_pages(self, req: Request, need: int) -> bool:
+        """Top `req` up to `need` pages, mirroring _ensure_decode_pages'
+        escalation: retry past injected alloc faults, drain the pending
+        block once (may free pages), preempt the YOUNGEST running
+        request — but never `req` itself: if req IS the youngest, it
+        sits the step out so its elders progress, unless it is alone and
+        over the pool's whole capacity, which no waiting can fix."""
+        drained = False
+        faulted = 0
+        while need > len(req.pages) and req in self.running:
+            pages = self._alloc_n(need - len(req.pages))
+            if pages is not None:
+                req.pages.extend(pages)
+                return True
+            if self.allocator.num_free >= need - len(req.pages) \
+                    and faulted < 8:
+                faulted += 1          # injected alloc fault, not real
+                continue              # exhaustion: retry
+            if self.drain_hook is not None and not drained:
+                drained = True
+                self.drain_hook()     # may finish reqs / free pages
+                continue
+            victim = self.running[-1]
+            if victim is req:
+                if len(self.running) == 1 \
+                        and need > self.allocator.num_allocatable:
+                    raise RuntimeError(
+                        "KV page pool too small for a single request: "
+                        f"request {req.request_id} needs {need} pages "
+                        f"with {self.allocator.num_allocatable} "
+                        "allocatable pages in total")
+                return False
+            self._preempt(victim)
+        return req in self.running and len(req.pages) >= need
 
     # ----------------------------------------------------------- invariants
     def check_consistency(self) -> bool:
@@ -450,6 +685,19 @@ class Scheduler:
                 raise RuntimeError(
                     f"scheduler corrupt: request {req.request_id} in the "
                     f"running queue with status {req.status!r}")
+            if self.prefill_chunk_tokens is not None:
+                if req.num_computed_tokens > len(req.prompt):
+                    raise RuntimeError(
+                        f"scheduler corrupt: request {req.request_id} "
+                        f"computed {req.num_computed_tokens} prompt "
+                        f"tokens of {len(req.prompt)}")
+                if pages_for(req.num_computed_tokens,
+                             self.page_size) > len(req.pages):
+                    raise RuntimeError(
+                        f"scheduler corrupt: request {req.request_id} "
+                        f"holds {len(req.pages)} pages but its "
+                        f"{req.num_computed_tokens} computed tokens "
+                        "need more")
             for p in req.pages:
                 if p == NULL_PAGE:
                     raise RuntimeError(

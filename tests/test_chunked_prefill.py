@@ -1,0 +1,401 @@
+"""Chunked prefill with mixed prefill/decode steps (ISSUE 7).
+
+The acceptance gates, as tests:
+
+- token parity: chunked engines (chunk widths from several-chunks-per-
+  prompt up to whole-prompt-in-one-chunk) emit streams BIT-IDENTICAL to
+  the unchunked engine — under staggered arrivals, prefix-cache hits,
+  decode_horizon 1 and 8, pool-pressure preemption, greedy AND seeded
+  stochastic sampling (one PRNG split per emitted token either way);
+- ONE chunked-prefill executable regardless of prompt-length mix, where
+  the unchunked engine needs a prefill executable per touched bucket;
+- mixed-step scheduling: running decoders are scheduled EVERY step (a
+  long prompt arriving mid-decode no longer stalls them — the
+  head-of-line fix), multiple requests admit per step under the token
+  budget, and page accounting charges chunks incrementally;
+- resilience through the mixed path: cancel and deadline expiry between
+  chunks are exact (chunk-to-date pages released, pool drains to zero),
+  a fault mid-chunk quarantines only the implicated request;
+- decode-stall observability: serving_decode_stall_seconds sees the
+  dispatch-to-dispatch gaps.
+
+Fast-lane tests share ONE chunked configuration (chunk 8, horizon 8)
+plus the jit-free scheduler-level checks; the chunk-width x horizon
+parity matrix and the pressure sweeps are `slow`.
+"""
+import functools
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    BlockAllocator, FaultInjector, Request, SamplingParams, Scheduler,
+    ServingEngine, pages_for,
+)
+
+VOCAB = LlamaConfig.tiny().vocab_size
+
+
+@functools.lru_cache(maxsize=None)
+def _llama():
+    paddle.seed(1234)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _prompts(seed, lengths):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, (n,)).tolist() for n in lengths]
+
+
+def _staggered_run(eng, prompts, max_new=10, temperature=0.0,
+                   stagger=(3, 2)):
+    """Arrival pattern shared by every parity test: request 0 starts
+    alone, the rest arrive a few steps apart — mid-decode of their
+    elders — so prefill/decode mixing actually happens."""
+    rids = [eng.add_request(prompts[0], max_new_tokens=max_new,
+                            temperature=temperature, seed=101)]
+    for i, p in enumerate(prompts[1:], start=1):
+        for _ in range(stagger[(i - 1) % len(stagger)]):
+            eng.step()
+        rids.append(eng.add_request(p, max_new_tokens=max_new,
+                                    temperature=temperature,
+                                    seed=101 + i))
+    out = eng.run()
+    return [out[r] for r in rids]
+
+
+def _engine(chunk=None, horizon=8, **kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    if chunk is not None:
+        kw.update(enable_chunked_prefill=True,
+                  prefill_chunk_tokens=chunk)
+    return ServingEngine(_llama(), decode_horizon=horizon, **kw)
+
+
+@functools.lru_cache(maxsize=None)
+def _canonical_pair():
+    """One staggered workload (prompt lengths spanning three prefill
+    buckets) run unchunked and chunked(8) at horizon 8; several fast
+    tests assert against this single compiled pair."""
+    prompts = tuple(map(tuple, _prompts(3, (5, 19, 33, 11))))
+    lists = [list(p) for p in prompts]
+    ref_eng = _engine()
+    ref = _staggered_run(ref_eng, lists)
+    ch_eng = _engine(chunk=8)
+    got = _staggered_run(ch_eng, lists)
+    return ref, got, ref_eng, ch_eng
+
+
+# --------------------------------------------------- scheduler level (jit-free)
+
+class TestChunkedScheduler:
+    def _sched(self, num_pages=64, chunk=8, budget=None, batch=4,
+               horizon=1):
+        return Scheduler(BlockAllocator(num_pages), page_size=8,
+                         max_batch_size=batch, max_pages_per_seq=8,
+                         decode_horizon=horizon,
+                         prefill_chunk_tokens=chunk,
+                         max_num_batched_tokens=budget or 8 + batch)
+
+    def _req(self, n, max_new=4):
+        return Request(prompt=[1] * n, max_new_tokens=max_new,
+                       sampling=SamplingParams())
+
+    def test_admission_charges_first_chunk_only(self):
+        sched = self._sched()
+        req = self._req(30)
+        sched.add(req)
+        dec = sched.schedule()
+        assert dec.kind == "mixed" and not dec.decode
+        [task] = dec.chunks
+        assert (task.req, task.start, task.length) == (req, 0, 8)
+        # one page for 8 tokens — NOT pages_for(30 + first block)
+        assert len(req.pages) == 1
+        assert req.num_computed_tokens == 0   # engine advances it
+
+    def test_chunk_topup_and_final_chunk_reserves_decode_block(self):
+        sched = self._sched(horizon=4)
+        req = self._req(30, max_new=8)
+        sched.add(req)
+        sched.schedule()
+        used = []
+        for computed in (8, 16, 24):          # engine's cursor advance
+            req.num_computed_tokens = computed
+            [task] = sched.schedule().chunks
+            assert task.start == computed
+            used.append(len(req.pages))
+        # chunks 2..3 top up one page each; the FINAL chunk (24 -> 30)
+        # reserves through the first decode block like _admission_pages
+        assert used == [2, 3, sched._admission_pages(req)]
+        assert used[-1] == pages_for(30 + 4, 8)
+
+    def test_multi_request_admission_per_step(self):
+        sched = self._sched(budget=24)        # room for 3 chunks
+        reqs = [self._req(6) for _ in range(3)]
+        for r in reqs:
+            sched.add(r)
+        dec = sched.schedule()
+        assert dec.kind == "mixed"
+        assert [t.req for t in dec.chunks] == reqs
+        assert all(r.status == "running" for r in reqs)
+
+    def test_budget_bounds_chunks_per_step(self):
+        sched = self._sched(budget=16)        # room for 2 chunks only
+        for _ in range(3):
+            sched.add(self._req(6))
+        assert len(sched.schedule().chunks) == 2
+        assert len(sched.running) == 2 and len(sched.waiting) == 1
+
+    def test_decoders_schedule_every_step_ahead_of_prefill(self):
+        """The head-of-line fix at the policy level: with a decoder
+        running AND a long prompt waiting, one mixed step carries
+        BOTH the decode batch and the new prompt's first chunk."""
+        sched = self._sched(budget=16, horizon=1)
+        decoder = self._req(8)
+        decoder.status = "running"
+        decoder.pages = sched.allocator.alloc_n(2)
+        decoder.num_computed_tokens = 8
+        decoder.generated.append(0)
+        sched.running.append(decoder)
+        sched.add(self._req(40))
+        dec = sched.schedule()
+        assert dec.kind == "mixed"
+        assert dec.decode == [decoder]
+        assert len(dec.chunks) == 1 and dec.chunks[0].length == 8
+
+    def test_mid_prefill_requests_never_join_decode(self):
+        sched = self._sched(budget=64, horizon=1)
+        sched.add(self._req(30))
+        dec = sched.schedule()
+        assert not dec.decode                 # still mid-prefill
+        [task] = dec.chunks
+        task.req.num_computed_tokens = 8
+        dec = sched.schedule()
+        assert not dec.decode and dec.chunks[0].start == 8
+
+    def test_pool_exhaustion_defers_chunk_losslessly(self):
+        sched = self._sched(num_pages=2, budget=64)   # 1 allocatable
+        a, b = self._req(12, max_new=2), self._req(12, max_new=2)
+        sched.add(a)
+        sched.add(b)
+        dec = sched.schedule()
+        # a's first chunk takes the only page: b's admission defers, a
+        # keeps its page and its chunk — nothing is lost or leaked
+        assert [t.req for t in dec.chunks] == [a]
+        assert b.status == "waiting" and not b.pages
+        sched.check_consistency()
+
+    def test_preempt_resets_cursor(self):
+        sched = self._sched()
+        req = self._req(30)
+        sched.add(req)
+        sched.schedule()
+        req.num_computed_tokens = 8
+        sched._preempt(req)
+        assert req.status == "waiting"
+        assert req.num_computed_tokens == 0 and not req.pages
+
+
+# ----------------------------------------------------------- engine parity
+
+class TestChunkedParity:
+    def test_staggered_parity_and_single_executable(self):
+        """THE acceptance gate: bit-identical streams, and ONE chunked
+        executable where the unchunked engine burned one prefill
+        executable per touched bucket."""
+        ref, got, ref_eng, ch_eng = _canonical_pair()
+        assert got == ref
+        cc = ch_eng.compile_counts()
+        assert cc["prefill_chunked"] == 1
+        assert cc["prefill"] == 0 and cc["prefill_offset"] == 0
+        assert ref_eng.compile_counts()["prefill"] >= 2   # per-bucket
+        assert ch_eng.cache.allocator.num_used == 0
+
+    def test_prefill_chunks_counted_and_pool_drains(self):
+        _, _, _, ch_eng = _canonical_pair()
+        st = ch_eng.stats()
+        # 4 prompts of 5/19/33/11 tokens in chunks of 8 -> 1+3+5+2
+        assert st["prefill_chunks"] == 11
+        assert st["prefill_steps"] == 4       # one final chunk each
+        assert st["prefill_chunk_tokens"] == 8
+        assert st["max_num_batched_tokens"] == 8 + 4 * 8
+
+    def test_decode_stall_histogram_populated(self):
+        _, _, ref_eng, ch_eng = _canonical_pair()
+        for eng in (ref_eng, ch_eng):
+            stall = eng.stats()["latency"]["decode_stall"]
+            assert stall["count"] >= 1
+            assert stall["p99"] >= 0.0
+
+    def test_seeded_stochastic_sampling_bit_identical(self):
+        """Intermediate chunks must not consume PRNG splits: seeded
+        temperature>0 streams match unchunked exactly."""
+        prompts = _prompts(17, (21, 6))
+        ref = _staggered_run(_engine(), prompts, temperature=0.9)
+        got = _staggered_run(_engine(chunk=8), prompts, temperature=0.9)
+        assert got == ref
+
+    def test_prefix_cache_hits_with_chunked_suffix(self):
+        prompts = _prompts(23, (0,))
+        shared = _prompts(29, (24,))[0]
+        prompts = [shared + t for t in ([1, 2, 3], [4, 5, 6, 7])]
+
+        def run(chunk):
+            # stagger past the leader's LAST chunk: the prefix cache
+            # only learns a prompt once its final chunk completes
+            eng = _engine(chunk=chunk, enable_prefix_caching=True)
+            return _staggered_run(eng, prompts, max_new=8,
+                                  stagger=(6,)), eng
+
+        ref, _ = run(None)
+        got, eng = run(8)
+        assert got == ref
+        pc = eng.stats()["prefix_cache"]
+        assert pc["hit_tokens"] == 24         # follower skipped 3 pages
+        # only the radix tree's cached-prefix pages stay resident
+        assert eng.cache.allocator.num_used == pages_for(24, 8)
+
+    def test_prompt_longer_than_largest_bucket_is_rejected_only_unchunked(
+            self):
+        """Chunked prefill has no bucket ceiling: a prompt the unchunked
+        engine rejects (exceeds its largest bucket) runs fine in
+        chunks."""
+        eng = _engine(chunk=8, max_seq_len=64,
+                      prefill_buckets=(16, 64))
+        long_prompt = _prompts(31, (50,))[0]
+        rid = eng.add_request(long_prompt, max_new_tokens=4)
+        out = eng.run()
+        assert len(out[rid]) == 54
+        assert eng.status(rid)[0] == "finished"
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError, match="multiple of page_size"):
+            _engine(chunk=12)                 # not a multiple of 8
+        with pytest.raises(ValueError, match="multiple of page_size"):
+            _engine(chunk=0)
+        with pytest.raises(ValueError,
+                           match="max_num_batched_tokens"):
+            _engine(chunk=16, max_num_batched_tokens=8)
+
+
+# ------------------------------------------------- resilience through mixed
+
+class TestChunkedResilience:
+    def test_cancel_mid_prefill_releases_chunk_pages_exactly(self):
+        eng = _engine(chunk=8)
+        long_prompt = _prompts(37, (40,))[0]
+        rid = eng.add_request(long_prompt, max_new_tokens=8)
+        eng.step()
+        req = eng.requests[rid]
+        assert 0 < req.num_computed_tokens < len(long_prompt)
+        # non-final chunks hold exactly the pages computed so far
+        assert len(req.pages) == pages_for(req.num_computed_tokens, 8)
+        assert eng.cancel(rid) is True
+        assert eng.status(rid)[0] == "cancelled"
+        assert eng.cache.allocator.num_used == 0
+        eng.scheduler.check_consistency()
+
+    def test_deadline_expiry_between_chunks_is_exact(self):
+        eng = _engine(chunk=8)
+        long_prompt = _prompts(41, (40,))[0]
+        rid = eng.add_request(long_prompt, max_new_tokens=8,
+                              deadline_s=0.001)
+        eng.step()                            # first chunk dispatches
+        time.sleep(0.005)
+        eng.step()                            # sweep expires it
+        assert eng.status(rid)[0] == "expired"
+        assert eng.requests[rid].first_token_t is None   # never emitted
+        assert eng.cache.allocator.num_used == 0
+        eng.scheduler.check_consistency()
+
+    def test_fault_mid_chunk_quarantines_only_that_request(self):
+        # dispatch #3 is the long prompt's SECOND chunk (its first
+        # already landed), so the quarantine is genuinely mid-prefill
+        fi = FaultInjector(seed=7).fail_at("dispatch", 3,
+                                           transient=False)
+        eng = _engine(chunk=8, fault_injector=fi, retry_backoff_s=0.0)
+        short = eng.add_request(_prompts(43, (6,))[0], max_new_tokens=6)
+        long = eng.add_request(_prompts(47, (32,))[0], max_new_tokens=6)
+        out = eng.run()
+        assert eng.status(long)[0] == "failed"
+        assert "prefill_chunk" in eng.status(long)[1]
+        assert eng.status(short)[0] == "finished"
+        assert len(out[short]) == 12
+        assert eng.cache.allocator.num_used == 0
+        eng.scheduler.check_consistency()
+
+    def test_transient_fault_mid_chunk_is_retried(self):
+        fi = FaultInjector(seed=7).fail_at("dispatch", 2, transient=True)
+        eng = _engine(chunk=8, fault_injector=fi, retry_backoff_s=0.0)
+        ref = _engine()
+        prompts = _prompts(53, (20,))
+        rid = eng.add_request(prompts[0], max_new_tokens=6, seed=5)
+        rr = ref.add_request(prompts[0], max_new_tokens=6, seed=5)
+        assert eng.run()[rid] == ref.run()[rr]
+        assert eng.status(rid)[0] == "finished"
+        assert eng.stats()["transient_retries"] == 1
+
+
+# --------------------------------------------------------------- slow matrix
+
+@pytest.mark.slow
+class TestChunkedMatrix:
+    """The chunk-width x horizon parity matrix. At this test scale
+    (max_seq_len 64, prompts up to 33 tokens) chunk=8 exercises 1-5
+    chunks per prompt, 16 the two-chunk shapes, and 64/256 collapse to
+    whole-prompt-in-one-chunk — the matrix's {64, 256, whole-prompt}
+    datapoints at tiny scale. Each width compiles exactly one
+    executable; horizons reuse the decode blocks other tests built."""
+
+    @pytest.mark.parametrize("horizon", [1, 8])
+    @pytest.mark.parametrize("chunk", [8, 16, 64, 256])
+    def test_parity_matrix(self, chunk, horizon):
+        prompts = _prompts(3, (5, 19, 33, 11))
+        kw = {}
+        if chunk > 64:
+            kw["max_seq_len"] = 448           # chunk must fit a prompt
+            kw["page_size"] = 8
+        ref = _staggered_run(_engine(horizon=horizon, **kw), prompts)
+        got = _staggered_run(_engine(chunk=chunk, horizon=horizon, **kw),
+                             prompts)
+        assert got == ref
+
+    @pytest.mark.parametrize("horizon", [1, 8])
+    def test_preemption_pressure_parity(self, horizon):
+        """Pool sized to force preemption mid-stream; chunked streams
+        stay identical and the cursor reset re-prefills victims in
+        chunks."""
+        prompts = _prompts(59, (14, 18, 10))
+
+        def run(chunk):
+            eng = _engine(chunk=chunk, horizon=horizon,
+                          max_batch_size=3, max_seq_len=48, num_pages=9)
+            rids = [eng.add_request(p, max_new_tokens=20, seed=i)
+                    for i, p in enumerate(prompts)]
+            out = eng.run()
+            return [out[r] for r in rids], eng
+
+        ref, _ = run(None)
+        got, eng = run(8)
+        assert got == ref
+        eng.scheduler.check_consistency()
+        assert eng.cache.allocator.num_used == 0
+
+    def test_compile_count_invariant_over_length_sweep(self):
+        """One chunked executable across prompts spanning every bucket
+        the unchunked engine would touch (16/32/64/128)."""
+        eng = _engine(chunk=16, max_seq_len=128)
+        for i, n in enumerate((3, 17, 40, 100)):
+            eng.add_request(_prompts(61 + i, (n,))[0], max_new_tokens=4)
+        eng.run()
+        cc = eng.compile_counts()
+        assert cc["prefill_chunked"] == 1
+        assert cc["prefill"] == 0 and cc["prefill_offset"] == 0
+        assert eng.cache.allocator.num_used == 0

@@ -350,6 +350,28 @@ class TestTraceSummary:
         assert sorted(tl) == [3, 4]
         assert [s for s, _, _ in tl[3]] == ["prefill", "first_token"]
 
+    def test_span_gap_between_consecutive_same_name_spans(self):
+        # decode-stall in trace form: time between the end of one
+        # decode_block span and the start of the next, per thread
+        ts = _trace_summary_mod()
+        events = [
+            {"name": "decode_block", "ph": "X", "ts": 0, "dur": 10,
+             "pid": 1, "tid": 1},
+            {"name": "decode_block", "ph": "X", "ts": 25, "dur": 10,
+             "pid": 1, "tid": 1},
+            {"name": "decode_block", "ph": "X", "ts": 40, "dur": 10,
+             "pid": 1, "tid": 1},
+            # other thread: never merges into tid 1's gap chain
+            {"name": "decode_block", "ph": "X", "ts": 500, "dur": 10,
+             "pid": 1, "tid": 2},
+        ]
+        stats = ts.span_stats(events)
+        assert stats["decode_block"]["gap"] == (25 - 10) + (40 - 35)
+        assert stats["decode_block"]["count"] == 4
+        # single spans have no gap
+        assert ts.span_stats(list(map(dict, _SYNTH_EVENTS)))[
+            "step"]["gap"] == 0.0
+
     def test_cli_end_to_end(self, tmp_path, capsys):
         ts = _trace_summary_mod()
         path = tmp_path / "trace.json"
@@ -357,4 +379,5 @@ class TestTraceSummary:
         assert ts.main([str(path), "--requests", "--top", "5"]) == 0
         out = capsys.readouterr().out
         assert "step" in out
+        assert "gap(ms)" in out
         assert "request 3:" in out and "first_token" in out

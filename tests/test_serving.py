@@ -275,6 +275,60 @@ class TestAdmissionPageAccounting:
         assert eng.cache.allocator.num_used == 0
 
 
+# ------------------------------------------- allocatable-page accounting
+
+class TestAllocatablePageAccounting:
+    """ISSUE 7 satellite: every too-large-for-pool error path must count
+    ALLOCATABLE pages (num_pages minus the reserved null page). Before
+    the fix, `_ensure_decode_pages` reported `num_pages` "pages total"
+    while `schedule()` reported `num_pages - 1` "allocatable" — the same
+    pool described with two different capacities depending on which path
+    raised. Pinned here across all three raise sites."""
+
+    def test_num_allocatable_property(self):
+        a = BlockAllocator(4)
+        assert a.num_allocatable == 3
+        assert a.alloc_n(a.num_allocatable) is not None   # exactly fits
+        assert a.alloc() is None                          # and no more
+
+    def test_idle_too_large_check_counts_allocatable(self):
+        sched = Scheduler(BlockAllocator(4), page_size=8,
+                          max_batch_size=2, max_pages_per_seq=8)
+        req = Request(prompt=[1] * 25, max_new_tokens=2,
+                      sampling=SamplingParams())
+        sched.add(req)                    # needs 4 pages, 3 allocatable
+        with pytest.raises(RuntimeError, match="3 allocatable in total"):
+            sched.schedule()
+
+    def test_decode_too_large_check_counts_allocatable(self):
+        sched = Scheduler(BlockAllocator(4), page_size=8,
+                          max_batch_size=2, max_pages_per_seq=8)
+        req = Request(prompt=[1] * 24, max_new_tokens=4,
+                      sampling=SamplingParams())
+        req.status = "running"
+        req.pages = sched.allocator.alloc_n(3)
+        sched.running.append(req)
+        req.generated.append(0)           # next block needs a 4th page
+        with pytest.raises(RuntimeError,
+                           match="3 allocatable pages in total"):
+            sched._ensure_decode_pages()
+
+    def test_chunked_too_large_check_counts_allocatable(self):
+        sched = Scheduler(BlockAllocator(4), page_size=8,
+                          max_batch_size=2, max_pages_per_seq=8,
+                          prefill_chunk_tokens=8,
+                          max_num_batched_tokens=16)
+        req = Request(prompt=[1] * 30, max_new_tokens=4,
+                      sampling=SamplingParams())
+        req.status = "running"
+        req.pages = sched.allocator.alloc_n(3)
+        req.num_computed_tokens = 24      # final chunk needs a 4th page
+        sched.running.append(req)
+        with pytest.raises(RuntimeError,
+                           match="3 allocatable pages in total"):
+            sched.schedule()
+
+
 # ------------------------------------------------- prefix caching engine
 
 def _shared_prefix_prompts(rng, vocab, prefix_pages, page_size, tails):
@@ -916,10 +970,13 @@ class TestServingObservability:
         reg_counts = {
             fam: eng.metrics.get("serving_jit_compile_misses_total",
                                  {"family": fam}).value
-            for fam in ("prefill", "prefill_offset", "decode", "sample")}
+            for fam in ("prefill", "prefill_offset", "prefill_chunked",
+                        "decode", "sample")}
         assert counts["prefill"] == reg_counts["prefill"] == 1
         assert counts["decode"] == reg_counts["decode"] == 1
         assert counts["sample"] == reg_counts["sample"] == 0
+        assert counts["prefill_chunked"] == \
+            reg_counts["prefill_chunked"] == 0     # chunking off
         # dedup sets and registry counters stay in lockstep
         assert {f: len(s) for f, s in eng._exec_shapes.items()} == \
             reg_counts

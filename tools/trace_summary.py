@@ -46,10 +46,13 @@ def _complete_events(events: List[dict]) -> List[dict]:
 
 
 def span_stats(events: List[dict]) -> Dict[str, Dict[str, float]]:
-    """Per-name {count, total, self} in trace time units (µs for
+    """Per-name {count, total, self, gap} in trace time units (µs for
     profiler exports). Self time subtracts child spans nested on the
     same (pid, tid); chrome complete events on one thread nest properly
-    by construction."""
+    by construction. Gap is the summed idle time between consecutive
+    same-name spans on the same thread — for periodic spans like
+    serving.decode_block it is the stall time between dispatches, the
+    trace-side view of the serving_decode_stall_seconds histogram."""
     stats: Dict[str, Dict[str, float]] = {}
     by_thread: Dict[Tuple, List[dict]] = {}
     for e in _complete_events(events):
@@ -58,6 +61,7 @@ def span_stats(events: List[dict]) -> Dict[str, Dict[str, float]]:
         # parents before children: earlier start first, longer span first
         evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
         stack: List[dict] = []          # open spans, innermost last
+        last_end: Dict[str, float] = {}  # per-name end of previous span
         for e in evs:
             dur = float(e.get("dur", 0))
             end = e["ts"] + dur
@@ -67,10 +71,13 @@ def span_stats(events: List[dict]) -> Dict[str, Dict[str, float]]:
                 stack[-1]["_child"] += dur
             e["_end"], e["_child"] = end, 0.0
             stack.append(e)
-            s = stats.setdefault(e["name"],
-                                 {"count": 0, "total": 0.0, "self": 0.0})
+            s = stats.setdefault(e["name"], {"count": 0, "total": 0.0,
+                                             "self": 0.0, "gap": 0.0})
             s["count"] += 1
             s["total"] += dur
+            if e["name"] in last_end:
+                s["gap"] += max(e["ts"] - last_end[e["name"]], 0.0)
+            last_end[e["name"]] = max(end, last_end.get(e["name"], end))
         for e in evs:
             stats[e["name"]]["self"] += max(
                 e.get("dur", 0) - e["_child"], 0.0)
@@ -95,13 +102,14 @@ def format_top(stats: Dict[str, Dict[str, float]], top: int = 20,
                by: str = "total") -> str:
     rows = sorted(stats.items(), key=lambda kv: kv[1][by], reverse=True)
     lines = [f"{'name':<48}{'calls':>8}{'total(ms)':>12}{'self(ms)':>12}"
-             f"{'avg(ms)':>10}",
-             "-" * 90]
+             f"{'avg(ms)':>10}{'gap(ms)':>11}",
+             "-" * 101]
     for name, s in rows[:top]:
         lines.append(
             f"{name[:47]:<48}{s['count']:>8}{s['total'] / 1e3:>12.3f}"
             f"{s['self'] / 1e3:>12.3f}"
-            f"{s['total'] / s['count'] / 1e3:>10.3f}")
+            f"{s['total'] / s['count'] / 1e3:>10.3f}"
+            f"{s.get('gap', 0.0) / 1e3:>11.3f}")
     return "\n".join(lines)
 
 

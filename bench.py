@@ -360,6 +360,35 @@ def _run_serving_chunked(on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
+def _run_serving_recovery(on_tpu: bool) -> dict:
+    """Crash recovery phase: the workload re-runs under an
+    EngineSupervisor killed mid-flight by an injected `device_lost`
+    fatal (with and without prefix caching on the rebuilt engine) and
+    asserts post-restore token parity against the uninterrupted run.
+    Non-fatal like the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_recovery_phase(model, cfg, on_tpu)
+        nc, wc = out["no_prefix_cache"], out["with_prefix_cache"]
+        _log(f"phase=serving_recovery: t_recover "
+             f"{nc['t_recover_ms']}ms, readmitted {nc['readmitted']}, "
+             f"re-prefill tokens {nc['reprefill_tokens_paid']} -> "
+             f"{wc['reprefill_tokens_paid']} with prefix cache "
+             f"(saved {out['reprefill_saved_by_prefix_cache']}), "
+             f"parity_ok={nc['post_restore_parity_ok']}/"
+             f"{wc['post_restore_parity_ok']}, "
+             f"crash_overhead={out['crash_overhead']}x")
+        if not (nc["post_restore_parity_ok"]
+                and wc["post_restore_parity_ok"]):
+            _log("phase=serving_recovery: WARN post-restore parity "
+                 "FAILED")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_recovery: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def make_train_step(model, opt):
     """The bench train step (fwd + MLM loss + grad + Adam, bf16 autocast).
 
@@ -560,6 +589,10 @@ def bench_child() -> None:
     # chunked-prefill interference phase: stall-free batching on vs off
     _enter_phase("serving_chunked", 400.0)
     serving_chunked = _run_serving_chunked(on_tpu)
+
+    # crash-recovery phase: supervisor kill/rebuild/re-admit parity
+    _enter_phase("serving_recovery", 400.0)
+    serving_recovery = _run_serving_recovery(on_tpu)
     _enter_phase("build")
 
     if on_tpu:
@@ -694,6 +727,7 @@ def bench_child() -> None:
                 "serving_decode": serving_decode,
                 "serving_faults": serving_faults,
                 "serving_chunked": serving_chunked,
+                "serving_recovery": serving_recovery,
                 "observability": _obs_snapshot(),
             },
         }

@@ -13,7 +13,11 @@ asserts the survivors' tokens match the fault-free run (the resilience
 layer's isolation guarantee), reporting what the chaos cost; and a
 serving_chunked phase measures long-prompt interference — decoders'
 inter-token p99, the decode-stall histogram, and the long request's
-ttft with chunked prefill on vs off. Run directly:
+ttft with chunked prefill on vs off; and a serving_recovery phase kills
+the engine mid-flight with an injected `device_lost` fatal under an
+EngineSupervisor and reports time-to-recover, re-prefill tokens paid
+with and without prefix caching, and post-restore token parity against
+the uninterrupted run. Run directly:
 
     python benchmarks/generation_bench.py [--cpu]
 
@@ -86,7 +90,9 @@ def main():
                    "serving_decode": serving_decode_phase(m, cfg, on_tpu),
                    "serving_faults": serving_faults_phase(m, cfg, on_tpu),
                    "serving_chunked": serving_chunked_phase(m, cfg,
-                                                            on_tpu)},
+                                                            on_tpu),
+                   "serving_recovery": serving_recovery_phase(m, cfg,
+                                                              on_tpu)},
     }))
 
 
@@ -286,6 +292,100 @@ def serving_faults_phase(model, cfg, on_tpu):
         "wall_fault_free_ms": round(wall_ref * 1000, 2),
         "wall_chaos_ms": round(wall_chaos * 1000, 2),
         "chaos_overhead": round(wall_chaos / max(wall_ref, 1e-9), 2),
+    }
+
+
+def serving_recovery_phase(model, cfg, on_tpu):
+    """Crash recovery cost (ISSUE 8): the same workload runs once
+    uninterrupted, then twice under an EngineSupervisor killed
+    mid-flight by an injected `device_lost` fatal at a deterministic
+    step — once with and once without prefix caching on the rebuilt
+    engine. Reports time-to-recover (salvage + snapshot + rebuild +
+    re-admit), the folded re-prefill tokens the restart paid (the
+    prompts share a page-aligned prefix, so with prefix caching the
+    re-admitted requests reuse each other's re-prefilled pages and pay
+    fewer), and post-restore token parity vs the uninterrupted run."""
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.serving import (EngineSupervisor, FaultInjector,
+                                    RequestJournal, ServingEngine)
+
+    rng = np.random.RandomState(31)
+    page_size = 16 if on_tpu else 8
+    max_seq = min(cfg.max_position_embeddings, 512 if on_tpu else 128)
+    n_req, new_tokens = 4, 16
+    # two full shared pages: big enough that the restart's re-prefill
+    # visibly shrinks when re-admitted requests share them
+    shared = rng.randint(0, cfg.vocab_size, (2 * page_size,)).tolist()
+    prompts = [shared + rng.randint(0, cfg.vocab_size,
+                                    (3 + 2 * i,)).tolist()
+               for i in range(n_req)]
+    kill_step = n_req + 2             # a few decode blocks in flight
+
+    def build(prefix, fi=None):
+        return ServingEngine(model, page_size=page_size,
+                             max_batch_size=n_req, max_seq_len=max_seq,
+                             decode_horizon=4, retry_backoff_s=0.0,
+                             enable_prefix_caching=prefix,
+                             fault_injector=fi)
+
+    # warm compiles outside every timed region (jit cache on the model)
+    weng = build(False)
+    for p in prompts:
+        weng.add_request(p, max_new_tokens=new_tokens)
+    weng.run()
+
+    eng0 = build(False)
+    rids0 = [eng0.add_request(p, max_new_tokens=new_tokens)
+             for p in prompts]
+    t0 = time.perf_counter()
+    ref = eng0.run()
+    wall_ref = time.perf_counter() - t0
+
+    def crash_run(prefix):
+        # the injector outlives the engine: the factory hands the SAME
+        # schedule to every incarnation, and fail_at fires once
+        fi = FaultInjector(seed=7).fail_at("device_lost", kill_step)
+        sup = EngineSupervisor(lambda: build(prefix, fi=fi),
+                               journal=RequestJournal())
+        rids = [sup.add_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        t1 = time.perf_counter()
+        out = sup.run()
+        wall = time.perf_counter() - t1
+        assert len(sup.restarts) == 1, sup.restarts
+        info = sup.restarts[0]
+        parity = all(out[b] == ref[a] for a, b in zip(rids0, rids))
+        # the rebuilt engine's registry is fresh, so its prefix-cache
+        # hit counter is exactly the re-prefill tokens NOT paid
+        st = sup.engine.stats()
+        hit = (st.get("prefix_cache", {}).get("hit_tokens", 0)
+               if prefix else 0)
+        return {
+            "wall_ms": round(wall * 1000, 2),
+            "t_recover_ms": round(info["t_recover_s"] * 1000, 2),
+            "readmitted": info["readmitted"],
+            "replayed_prompt_tokens": info["replayed_tokens"],
+            "reprefill_tokens_paid": info["replayed_tokens"] - hit,
+            "prefix_hit_tokens": hit,
+            "post_restore_parity_ok": parity,
+        }
+
+    no_cache = crash_run(False)
+    with_cache = crash_run(True)
+    return {
+        "requests": n_req, "new_tokens": new_tokens,
+        "kill_step": kill_step,
+        "wall_uninterrupted_ms": round(wall_ref * 1000, 2),
+        "no_prefix_cache": no_cache,
+        "with_prefix_cache": with_cache,
+        "crash_overhead": round(
+            no_cache["wall_ms"] / 1000 / max(wall_ref, 1e-9), 2),
+        "reprefill_saved_by_prefix_cache": (
+            no_cache["reprefill_tokens_paid"]
+            - with_cache["reprefill_tokens_paid"]),
     }
 
 

@@ -32,7 +32,14 @@ decoder models (LLaMA, GPT) with:
   (`EngineOverloaded`), failure isolation with one transient retry
   (quarantined requests end `failed`, everyone else keeps serving), and
   a deterministic seeded `FaultInjector` over the dispatch/drain/alloc/
-  prefix_match sites. All of it strips to None checks when unused.
+  prefix_match/device_lost sites. All of it strips to None checks when
+  unused;
+- `recovery`: crash recovery — an append-only `RequestJournal` (the
+  exactly-once delivery ledger), `EngineSnapshot`/`restore()` (rebuild a
+  killed engine with every unfinished request re-admitted as a folded
+  prompt, continuing bit-identically), and an `EngineSupervisor` whose
+  watchdog / fault-storm / fatal-fault escalation ladder drains,
+  snapshots, rebuilds and re-admits automatically.
 
 See README.md "paddle_tpu.serving" for knobs and parity notes.
 """
@@ -46,12 +53,17 @@ from .kv_cache import (  # noqa: F401
     overflow_position, pages_for,
 )
 from .prefix_cache import PrefixCache, PrefixNode  # noqa: F401
+from .recovery import (  # noqa: F401
+    EngineSnapshot, EngineSupervisor, RequestJournal, RequestSnapshot,
+    replay_key_state,
+)
 from .resilience import (  # noqa: F401
     EngineOverloaded, FaultInjector, InjectedFault, TERMINAL_STATUSES,
-    is_transient,
+    is_fatal, is_transient,
 )
 from .scheduler import (  # noqa: F401
     ChunkTask, Request, SamplingParams, ScheduleDecision, Scheduler,
+    reserve_request_ids,
 )
 
 __all__ = [
@@ -59,9 +71,11 @@ __all__ = [
     "PagedKVCache", "PagedLayerCache", "BlockAllocator",
     "PrefixCache", "PrefixNode",
     "EngineOverloaded", "FaultInjector", "InjectedFault",
-    "TERMINAL_STATUSES", "is_transient",
+    "TERMINAL_STATUSES", "is_fatal", "is_transient",
+    "RequestJournal", "EngineSnapshot", "RequestSnapshot",
+    "EngineSupervisor", "replay_key_state",
     "Scheduler", "ScheduleDecision", "ChunkTask", "Request",
-    "SamplingParams",
+    "SamplingParams", "reserve_request_ids",
     "paged_attend", "paged_decode_attention", "paged_decode_available",
     "advance_positions", "pages_for", "overflow_position",
     "NULL_PAGE", "PAD_TOKEN",

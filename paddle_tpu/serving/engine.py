@@ -75,8 +75,10 @@ from .attention import advance_positions
 from .kv_cache import (PagedKVCache, PagedLayerCache, overflow_position,
                        pages_for)
 from .prefix_cache import PrefixCache
-from .resilience import TERMINAL_STATUSES, is_transient
-from .scheduler import Request, SamplingParams, Scheduler
+from .recovery import EngineSnapshot, RequestSnapshot, replay_key_state
+from .resilience import TERMINAL_STATUSES, is_fatal, is_transient
+from .scheduler import (Request, SamplingParams, Scheduler,
+                        reserve_request_ids)
 
 __all__ = ["ServingEngine", "ServingObs", "PAD_TOKEN"]
 
@@ -265,7 +267,8 @@ class ServingEngine:
                  max_queue_wait_s: Optional[float] = None,
                  max_preemptions: Optional[int] = 8,
                  fault_injector=None,
-                 retry_backoff_s: float = 0.02):
+                 retry_backoff_s: float = 0.02,
+                 journal=None):
         from ..models.generation import _config_of
 
         self.model = model
@@ -339,6 +342,16 @@ class ServingEngine:
                                   if max_queue_wait_s is not None else None)
         self.retry_backoff_s = float(retry_backoff_s)
         self._faults = fault_injector
+        # crash recovery (ISSUE 8): the journal is the exactly-once
+        # delivery ledger — tokens are appended at the moment a step
+        # RETURNS them, never at drain time (recovery.py). None = no
+        # journaling, and the only cost is one None check per step.
+        self._journal = journal
+        # engine-level fault count (every fault _guarded_call or the
+        # device_lost gate observes, transient or not): the supervisor's
+        # fault-storm window reads deltas of this — a plain int, so it
+        # works with metrics off
+        self.fault_events = 0
         # live request ids carrying a deadline; the expiry sweep is
         # skipped entirely while this is empty and no queue-wait bound
         # is set, so deadline-free serving runs zero resilience code
@@ -455,6 +468,19 @@ class ServingEngine:
             seed = int(np.random.randint(0, 2 ** 31 - 1))
         self._key_state[req.request_id] = jax.random.key_data(
             jax.random.key(seed))
+        if self._journal is not None:
+            # the EFFECTIVE seed (drawn above when the caller passed
+            # None) and a wall-clock deadline anchor go in the ledger:
+            # both are what a post-crash rebuild continues from
+            now_wall = time.time()
+            self._journal.submit(
+                request_id=req.request_id, prompt=prompt,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed,
+                eos_token_id=eos_token_id,
+                deadline_wall=(now_wall + deadline_s
+                               if deadline_s is not None else None),
+                arrival_wall=now_wall)
         return req.request_id
 
     def output(self, request_id: int) -> List[int]:
@@ -496,8 +522,15 @@ class ServingEngine:
     def _finalize(self, req: Request, status: str,
                   error: Optional[str] = None) -> bool:
         """Terminal transition through the scheduler (queues + refcounted
-        page release) plus engine-side deadline bookkeeping."""
+        page release) plus engine-side deadline bookkeeping. The journal
+        records the terminal status here — the one place every
+        failure-side ending (cancelled/expired/failed/shed) funnels
+        through — so replay never resurrects a request that already
+        ended."""
         done = self.scheduler.finalize(req, status, error=error)
+        if done and self._journal is not None \
+                and self._journal.known(req.request_id):
+            self._journal.terminal(req.request_id, status, error)
         if self._deadlined:
             self._deadlined.discard(req.request_id)
         return done
@@ -533,15 +566,22 @@ class ServingEngine:
         site: consults the fault injector (when bound), retries a
         TRANSIENT fault exactly once after `retry_backoff_s`, and
         otherwise returns the exception for the caller to quarantine
-        with the right drain ordering. Returns (result, None) on
-        success, (None, exc) on isolation. The happy path runs no
-        resilience code beyond one None check."""
+        with the right drain ordering. A FATAL fault (`is_fatal`) is
+        re-raised untouched — the engine is the casualty, and retrying
+        or quarantining would hide that from the supervisor. Every
+        fault observed here bumps `fault_events` (the supervisor's
+        fault-storm signal). Returns (result, None) on success,
+        (None, exc) on isolation. The happy path runs no resilience
+        code beyond one None check."""
         fi = self._faults
         try:
             if fi is not None:
                 fi.check(site)
             return fn(), None
         except Exception as e:  # noqa: BLE001 — isolation boundary
+            self.fault_events += 1
+            if is_fatal(e):
+                raise
             if not is_transient(e):
                 return None, e
             if self._obs is not None:
@@ -553,6 +593,9 @@ class ServingEngine:
                     fi.check(site)
                 return fn(), None
             except Exception as e2:  # noqa: BLE001
+                self.fault_events += 1
+                if is_fatal(e2):
+                    raise
                 return None, e2
 
     def _quarantine(self, reqs: Sequence[Request], exc: BaseException,
@@ -582,7 +625,25 @@ class ServingEngine:
         Returns the (request_id, token) pairs that reached the host this
         step — with a decode horizon and async overlap, a decode block's
         tokens surface one step AFTER its dispatch (the drain overlaps
-        the next block's device time)."""
+        the next block's device time). This wrapper is also the crash
+        recovery boundary: the injector's `device_lost` site fires here
+        (fatal by default — it propagates untouched for the supervisor),
+        and the step's returned events are journaled at this exact
+        point, the host-visible delivery moment that exactly-once
+        replay keys on."""
+        fi = self._faults
+        if fi is not None:
+            try:
+                fi.check("device_lost")
+            except Exception:
+                self.fault_events += 1
+                raise
+        events = self._step_impl()
+        if self._journal is not None and events:
+            self._journal_delivery(events)
+        return events
+
+    def _step_impl(self) -> List[Tuple[int, int]]:
         if self._deadlined or self._max_queue_wait_s is not None:
             self._expire_and_shed()            # may spill drained tokens
         if not any(r.prefill_done for r in self.scheduler.running):
@@ -626,6 +687,39 @@ class ServingEngine:
             events.extend(self._chunk_prefill(task))
         return events
 
+    def _journal_delivery(self, events: List[Tuple[int, int]]) -> None:
+        """Append just-returned events to the journal — called at the
+        single point tokens become host-visible to a `step()`/`stream()`
+        consumer, never at drain time (a drained-but-unreturned token
+        must stay recomputable, not re-deliverable). Consecutive
+        same-request runs land as one block record; a request whose
+        delivered stream just completed gets its `finished` terminal
+        record here, after its tokens."""
+        j = self._journal
+        t_wall = time.time()
+        i = 0
+        while i < len(events):
+            rid = events[i][0]
+            k = i + 1
+            while k < len(events) and events[k][0] == rid:
+                k += 1
+            if j.known(rid):
+                j.tokens(rid, [t for _, t in events[i:k]], t_wall=t_wall)
+            i = k
+        for rid in dict.fromkeys(r for r, _ in events):
+            if j.known(rid) and self.requests[rid].status == "finished":
+                j.terminal(rid, "finished")
+
+    def drain_all(self) -> List[Tuple[int, int]]:
+        """Flush everything already computed out to the caller: spilled
+        events (cancel/expiry drained them outside a step) plus the
+        pending block — journaled exactly like a step's return."""
+        spilled, self._spill = self._spill, []
+        events = spilled + self._drain_pending()
+        if self._journal is not None and events:
+            self._journal_delivery(events)
+        return events
+
     def stream(self):
         """Generator of (request_id, token, done) events until every
         queued request completes."""
@@ -634,11 +728,9 @@ class ServingEngine:
             if self.scheduler.has_work():
                 events = self.step()
             else:
-                # no schedulable work left: flush any spilled events
-                # (cancel/expiry drained them outside a step) plus the
+                # no schedulable work left: flush the spill plus the
                 # pending block
-                spilled, self._spill = self._spill, []
-                events = spilled + self._drain_pending()
+                events = self.drain_all()
             for i, (rid, tok) in enumerate(events):
                 done = (self.requests[rid].status == "finished"
                         and all(r != rid for r, _ in events[i + 1:]))
@@ -1143,6 +1235,203 @@ class ServingEngine:
             o.decode_seconds.inc(max(now - start, 0.0))
         self._last_drain_t = now
         return events
+
+    # ------------------------------------------------------------- recovery
+    def attach_journal(self, journal) -> None:
+        """Attach the RequestJournal this engine appends to (the
+        exactly-once delivery ledger; recovery.py). Must happen before
+        any request is added — a request unknown to the journal cannot
+        be recovered."""
+        self._journal = journal
+
+    def salvage(self) -> List[Tuple[int, int]]:
+        """Recovery-side best-effort drain (the supervisor's restart
+        step 1): surface whatever a still-answering device can deliver —
+        spilled events plus the pending block — and journal it, so the
+        rebuild folds it into prompts instead of recomputing it. Unlike
+        the steady-state drain path this NEVER quarantines: a block the
+        device cannot hand back is simply discarded — its tokens were
+        never delivered, so the journal never saw them and the rebuilt
+        engine recomputes them bit-identically — and its requests stay
+        live for re-admission. The injector's `drain` site is consulted
+        so chaos schedules can kill the salvage too."""
+        events = list(self._spill)
+        self._spill = []
+        rec, self._pending = self._pending, None
+        if rec is not None:
+            toks = None
+            try:
+                fi = self._faults
+                if fi is not None:
+                    fi.check("drain")
+                toks = np.asarray(jax.device_get(rec["emitted"]))
+            except Exception:  # noqa: BLE001 — the device may be gone
+                self.fault_events += 1
+            for i, req in enumerate(rec["reqs"]):
+                req.inflight = max(req.inflight - rec["incr"][i], 0)
+            if toks is not None:
+                now = time.perf_counter()
+                kd = rec["key_data"]
+                for i, req in enumerate(rec["reqs"]):
+                    self._key_state[req.request_id] = kd[i]
+                    if req.status != "running":
+                        continue
+                    for t in toks[i]:
+                        t = int(t)
+                        if t == PAD_TOKEN:
+                            break
+                        events.append(self._emit(req, t, now))
+                        if req.status != "running":
+                            break
+        if self._journal is not None and events:
+            self._journal_delivery(events)
+        return events
+
+    def snapshot(self) -> EngineSnapshot:
+        """Serializable boundary state of every journal-live request:
+        original prompt, delivered tokens, sampling knobs + effective
+        seed, wall-clock-anchored deadlines/timestamps, and the PRNG
+        key state replayed from the seed by delivered count — never the
+        live `_key_state`, which a crash can leave AHEAD of what was
+        actually delivered (a lost spill), and delivered is what
+        restore continues from. KV pages and the pending block are
+        deliberately absent: restore re-prefills the fold instead of
+        checkpointing pools. Requires an attached journal."""
+        if self._journal is None:
+            raise RuntimeError(
+                "snapshot() needs an attached journal — the journal is "
+                "the source of truth for what each consumer was shown")
+        snaps: List[RequestSnapshot] = []
+        for rec in self._journal.live_records():
+            live = self.requests.get(rec.request_id)
+            kd = replay_key_state(rec.seed, len(rec.delivered))
+            snaps.append(RequestSnapshot(
+                request_id=rec.request_id, prompt=list(rec.prompt),
+                delivered=list(rec.delivered),
+                max_new_tokens=rec.max_new_tokens,
+                temperature=rec.temperature, top_k=rec.top_k,
+                top_p=rec.top_p, seed=rec.seed,
+                eos_token_id=rec.eos_token_id,
+                deadline_wall=rec.deadline_wall,
+                arrival_wall=rec.arrival_wall,
+                first_token_wall=rec.first_token_wall,
+                last_token_wall=rec.last_token_wall,
+                preemptions=live.preemptions if live is not None else 0,
+                parked=live.parked if live is not None else False,
+                key_data=tuple(int(x) for x in np.asarray(kd))))
+        config = {
+            "page_size": self.page_size,
+            "max_batch_size": self.max_batch_size,
+            "max_seq_len": self.max_seq_len,
+            "decode_horizon": self.decode_horizon,
+            "enable_chunked_prefill": self.enable_chunked_prefill,
+            "enable_prefix_caching": self.prefix_cache is not None,
+        }
+        return EngineSnapshot(config=config, requests=snaps,
+                              taken_wall=time.time())
+
+    def restore(self, snapshot: EngineSnapshot,
+                cancelled: Sequence[int] = ()) -> List[int]:
+        """Rebuild request state on a FRESH engine from a snapshot.
+        Each unfinished request is re-admitted (in submission order,
+        with its ORIGINAL request id) as a folded prompt — original
+        prompt + delivered tokens, the preemption trick — so its
+        re-prefill rides the ordinary chunked-prefill / prefix-cache
+        paths and its continuation is bit-identical to never having
+        crashed. A request whose delivered stream already satisfies its
+        stopping rule is reconstructed as finished (nothing recomputed);
+        one named in `cancelled` (a cancel issued while the restore was
+        in flight) ends "cancelled"; one whose wall-clock deadline
+        passed during the outage ends "expired" — never resurrected.
+        Returns the re-admitted request ids."""
+        if self.requests:
+            raise RuntimeError(
+                "restore() needs a fresh engine — this one already "
+                f"holds {len(self.requests)} requests")
+        if snapshot.config.get("max_seq_len", self.max_seq_len) > \
+                self.max_seq_len:
+            raise ValueError(
+                f"restore target's max_seq_len ({self.max_seq_len}) is "
+                "smaller than the snapshot's "
+                f"({snapshot.config['max_seq_len']}) — folded prompts "
+                "may not fit")
+        if snapshot.requests:
+            reserve_request_ids(max(r.request_id
+                                    for r in snapshot.requests))
+        cancelled = set(cancelled)
+        now_wall = time.time()
+        # translate the snapshot's wall-clock anchors back into this
+        # process's perf_counter timeline: deadlines keep counting down
+        # across the outage, and TTFT/latency metrics stay honest
+        offset = time.perf_counter() - now_wall
+        readmitted: List[int] = []
+        for rs in snapshot.requests:
+            rid = rs.request_id
+            done = (len(rs.delivered) >= rs.max_new_tokens
+                    or (rs.eos_token_id is not None and rs.delivered
+                        and rs.delivered[-1] == rs.eos_token_id))
+            sampling = SamplingParams(rs.temperature, rs.top_k,
+                                      rs.top_p, rs.seed)
+            if done:
+                # everything was delivered before the crash and only the
+                # `finished` record was lost: reconstruct, never
+                # recompute
+                req = Request(prompt=list(rs.prompt),
+                              max_new_tokens=rs.max_new_tokens,
+                              sampling=sampling,
+                              eos_token_id=rs.eos_token_id,
+                              request_id=rid)
+                req.generated = list(rs.delivered)
+                req.num_computed_tokens = len(rs.prompt)
+                self._restore_times(req, rs, offset)
+                req.finish_t = time.perf_counter()
+                self.requests[rid] = req
+                self._key_state[rid] = jnp.asarray(rs.key_data,
+                                                   dtype=jnp.uint32)
+                self.scheduler.finish(req)
+                if self._journal is not None \
+                        and self._journal.known(rid):
+                    self._journal.terminal(rid, "finished")
+                continue
+            req = Request(prompt=list(rs.prompt) + list(rs.delivered),
+                          max_new_tokens=(rs.max_new_tokens
+                                          - len(rs.delivered)),
+                          sampling=sampling,
+                          eos_token_id=rs.eos_token_id,
+                          request_id=rid)
+            req.preemptions = rs.preemptions
+            req.parked = rs.parked
+            self._restore_times(req, rs, offset)
+            self.requests[rid] = req
+            self._key_state[rid] = jnp.asarray(rs.key_data,
+                                               dtype=jnp.uint32)
+            if rid in cancelled:
+                # a cancel issued mid-restore wins over re-admission
+                self._finalize(req, "cancelled")
+                continue
+            if rs.deadline_wall is not None:
+                req.deadline_t = rs.deadline_wall + offset
+                if now_wall >= rs.deadline_wall:
+                    # the deadline passed during the outage: expired
+                    # requests may NOT be resurrected by replay
+                    self._finalize(req, "expired")
+                    continue
+            self.scheduler.add(req, force=True)
+            if req.deadline_t is not None:
+                self._deadlined.add(rid)
+            if self._obs is not None:
+                self._obs.lifecycle.point(rid, "recovered")
+            readmitted.append(rid)
+        return readmitted
+
+    @staticmethod
+    def _restore_times(req: Request, rs: RequestSnapshot,
+                       offset: float) -> None:
+        req.arrival_t = rs.arrival_wall + offset
+        if rs.first_token_wall is not None:
+            req.first_token_t = rs.first_token_wall + offset
+        if rs.last_token_wall is not None:
+            req.last_token_t = rs.last_token_wall + offset
 
     # -------------------------------------------------------------- metrics
     def stats(self) -> Dict[str, object]:

@@ -117,6 +117,12 @@ class BlockAllocator:
         """Live references on `page` (0 = free)."""
         return self._refs.get(page, 0)
 
+    def live_pages(self) -> List[int]:
+        """Sorted page ids holding at least one live reference — the
+        restore-side audit compares this against the pages the rebuilt
+        requests and prefix cache actually account for."""
+        return sorted(self._refs)
+
     def _alloc_unchecked(self) -> Optional[int]:
         if not self._free:
             return None

@@ -195,6 +195,45 @@ class PrefixCache:
         checks; a still-shared prefix survives)."""
         return self.evict(self._num_pages)
 
+    # ----------------------------------------------------------- invariants
+    def check_consistency(self) -> bool:
+        """Radix-tree invariant audit (run by `Scheduler.check_consistency`
+        after failure isolation and on both sides of a supervisor
+        restart): every node below the root owns a real page with a live
+        tree-held reference, chunks are exactly page_size tokens keyed
+        under their own chunk, and `_num_pages` matches the tree. Raises
+        RuntimeError on the first violation."""
+        seen = 0
+        stack = [(self._root, True)]
+        while stack:
+            node, is_root = stack.pop()
+            if not is_root:
+                seen += 1
+                if node.page is None or node.page == 0:
+                    raise RuntimeError(
+                        "prefix cache corrupt: node without a real page "
+                        f"(chunk {node.chunk!r})")
+                if self.allocator.ref_count(node.page) < 1:
+                    raise RuntimeError(
+                        "prefix cache corrupt: cached page "
+                        f"{node.page} has no live reference")
+                if len(node.chunk) != self.page_size:
+                    raise RuntimeError(
+                        "prefix cache corrupt: chunk of "
+                        f"{len(node.chunk)} tokens in a page_size="
+                        f"{self.page_size} tree")
+            for chunk, child in node.children.items():
+                if chunk != child.chunk:
+                    raise RuntimeError(
+                        "prefix cache corrupt: child keyed under "
+                        f"{chunk!r} but owns chunk {child.chunk!r}")
+                stack.append((child, False))
+        if seen != self._num_pages:
+            raise RuntimeError(
+                f"prefix cache corrupt: tree holds {seen} pages but "
+                f"_num_pages says {self._num_pages}")
+        return True
+
     # ------------------------------------------------------------ metrics
     @property
     def cached_pages(self) -> int:

@@ -22,12 +22,26 @@ engine/scheduler/allocator wiring uses to drop that assumption:
   raises", runs the engine, and asserts the survivors' token streams are
   identical to a fault-free run.
 
-Transient vs persistent: a fault whose exception carries
-`transient=True` (every `InjectedFault` defaults to it) is retried once
-with a small backoff at dispatch/drain sites; anything else quarantines
-exactly the implicated request(s) (status `failed`, error string on the
-Request, pages released through the refcounted paths) and the engine
-keeps serving the rest.
+Fault taxonomy (ISSUE 8) — every fault the serving stack can observe
+falls in exactly one of three classes, escalating in blast radius:
+
+- **transient** — the exception carries `transient=True` (every
+  `InjectedFault` defaults to it). The dispatch/drain guard retries the
+  site once after `retry_backoff_s`; a transient fault costs latency,
+  never a request. Models: a flaky RPC, a timed-out collective.
+- **persistent** — `transient=False` (or any unknown exception: retrying
+  a NaN or a tripped invariant would just fail again). Quarantines
+  exactly the implicated request(s): status `failed`, error string on
+  the Request, pages released through the refcounted paths,
+  `check_consistency()` re-audited — the engine keeps serving the rest.
+  Models: one request whose batch keeps producing garbage.
+- **fatal** — `fatal=True` (`is_fatal`). The ENGINE is the casualty,
+  not a request: the fault propagates out of the engine untouched (no
+  retry, no quarantine) for the `EngineSupervisor` (recovery.py) to
+  catch, which then drains what it can, snapshots, rebuilds a fresh
+  engine and re-admits every unfinished request from the journal.
+  Models: a device reset / `device_lost`, a wedged runtime. The
+  injector's `device_lost` site defaults its rules to fatal.
 """
 from __future__ import annotations
 
@@ -36,7 +50,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "EngineOverloaded", "FaultInjector", "InjectedFault",
-    "TERMINAL_STATUSES", "is_transient",
+    "TERMINAL_STATUSES", "is_fatal", "is_transient",
 ]
 
 # every way a request's lifecycle can end; `Request.status` lands on
@@ -60,15 +74,24 @@ class InjectedFault(RuntimeError):
     engine's dispatch/drain guard retries the site once with backoff, so
     a transient fault costs latency, never a request. `transient=False`
     models a hard failure and quarantines the implicated request(s).
+    `fatal=True` (which forces `transient=False`) models an engine-level
+    failure — a lost device, a wedged runtime — that no per-request
+    isolation can contain: the engine re-raises it for the supervisor's
+    snapshot/rebuild/re-admit ladder.
     """
 
-    def __init__(self, site: str, index: int, transient: bool = True):
-        kind = "transient" if transient else "persistent"
+    def __init__(self, site: str, index: int, transient: bool = True,
+                 fatal: bool = False):
+        if fatal:
+            transient = False
+        kind = ("fatal" if fatal
+                else "transient" if transient else "persistent")
         super().__init__(
             f"injected {kind} {site} fault (call #{index})")
         self.site = site
         self.index = index
         self.transient = transient
+        self.fatal = fatal
 
 
 def is_transient(exc: BaseException) -> bool:
@@ -79,6 +102,15 @@ def is_transient(exc: BaseException) -> bool:
     return bool(getattr(exc, "transient", False))
 
 
+def is_fatal(exc: BaseException) -> bool:
+    """True when `exc` marks the whole ENGINE as dead (duck-typed `fatal`
+    attribute; InjectedFault sets it for `device_lost`-style schedules,
+    real runtime errors can too). Fatal faults are never retried or
+    quarantined — they escalate to the EngineSupervisor's
+    snapshot/rebuild/re-admit path (recovery.py)."""
+    return bool(getattr(exc, "fatal", False))
+
+
 class FaultInjector:
     """Deterministic fault schedule over named trigger points.
 
@@ -86,9 +118,12 @@ class FaultInjector:
     launch, counted together in launch order — retries advance the
     count), `drain` (the device->host token pull), `alloc` (every
     BlockAllocator alloc/alloc_n entry), `prefix_match` (PrefixCache
-    radix lookups). Instrumented code calls `check(site)` once per
-    event; the injector counts the call and raises `InjectedFault` when
-    a rule matches. Three rule shapes:
+    radix lookups), `device_lost` (checked once at the top of every
+    `ServingEngine.step()` — rules armed there default to FATAL, so
+    `fail_at("device_lost", k)` kills the whole engine deterministically
+    at step k, the recovery chaos tests' kill switch). Instrumented code
+    calls `check(site)` once per event; the injector counts the call and
+    raises `InjectedFault` when a rule matches. Three rule shapes:
 
     - `fail_at(site, index)` — fire on exactly the `index`-th call
       (0-based) of that site: "alloc fails on call 7";
@@ -104,7 +139,8 @@ class FaultInjector:
     `log` expose what actually happened for assertions.
     """
 
-    SITES = ("dispatch", "drain", "alloc", "prefix_match")
+    SITES = ("dispatch", "drain", "alloc", "prefix_match",
+             "device_lost")
 
     def __init__(self, seed: int = 0):
         self.seed = seed
@@ -121,27 +157,51 @@ class FaultInjector:
                 f"unknown fault site {site!r}; one of {self.SITES}")
         return site
 
+    def _flags(self, site: str, transient: Optional[bool],
+               fatal: Optional[bool]) -> Tuple[bool, bool]:
+        """Resolve a rule's (transient, fatal) flags. `device_lost` rules
+        default to fatal — losing the device is by definition an
+        engine-level failure — while every other site defaults to a
+        plain transient fault; `fatal=True` always forces
+        `transient=False` (a dead engine is not retryable)."""
+        if fatal is None:
+            fatal = site == "device_lost"
+        if transient is None:
+            transient = not fatal
+        if fatal:
+            transient = False
+        return transient, fatal
+
     # ------------------------------------------------------------- rules
     def fail_at(self, site: str, index: int,
-                transient: bool = True) -> "FaultInjector":
-        self._rules.setdefault(self._site(site), []).append(
-            ("at", int(index), transient))
+                transient: Optional[bool] = None,
+                fatal: Optional[bool] = None) -> "FaultInjector":
+        site = self._site(site)
+        transient, fatal = self._flags(site, transient, fatal)
+        self._rules.setdefault(site, []).append(
+            ("at", int(index), transient, fatal))
         return self
 
     def fail_every(self, site: str, n: int,
-                   transient: bool = True) -> "FaultInjector":
+                   transient: Optional[bool] = None,
+                   fatal: Optional[bool] = None) -> "FaultInjector":
         if n < 1:
             raise ValueError("fail_every needs n >= 1")
-        self._rules.setdefault(self._site(site), []).append(
-            ("every", int(n), transient))
+        site = self._site(site)
+        transient, fatal = self._flags(site, transient, fatal)
+        self._rules.setdefault(site, []).append(
+            ("every", int(n), transient, fatal))
         return self
 
     def fail_rate(self, site: str, p: float,
-                  transient: bool = True) -> "FaultInjector":
+                  transient: Optional[bool] = None,
+                  fatal: Optional[bool] = None) -> "FaultInjector":
         if not 0.0 <= p <= 1.0:
             raise ValueError("fail_rate needs p in [0, 1]")
-        self._rules.setdefault(self._site(site), []).append(
-            ("rate", float(p), transient))
+        site = self._site(site)
+        transient, fatal = self._flags(site, transient, fatal)
+        self._rules.setdefault(site, []).append(
+            ("rate", float(p), transient, fatal))
         return self
 
     # ------------------------------------------------------------ firing
@@ -151,7 +211,7 @@ class FaultInjector:
         stack without an injector never reaches this."""
         i = self.counts.get(site, 0)
         self.counts[site] = i + 1
-        for kind, arg, transient in self._rules.get(site, ()):
+        for kind, arg, transient, fatal in self._rules.get(site, ()):
             if kind == "at":
                 hit = i == arg
             elif kind == "every":
@@ -168,7 +228,7 @@ class FaultInjector:
             if hit:
                 self.fired[site] = self.fired.get(site, 0) + 1
                 self.log.append((site, i, transient))
-                raise InjectedFault(site, i, transient)
+                raise InjectedFault(site, i, transient, fatal=fatal)
 
     def total_fired(self) -> int:
         return sum(self.fired.values())

@@ -60,9 +60,19 @@ from .resilience import (EngineOverloaded, InjectedFault,
                          TERMINAL_STATUSES)
 
 __all__ = ["ChunkTask", "Request", "SamplingParams", "Scheduler",
-           "ScheduleDecision"]
+           "ScheduleDecision", "reserve_request_ids"]
 
 _REQUEST_IDS = itertools.count()
+
+
+def reserve_request_ids(up_to: int) -> None:
+    """Advance the global request-id counter past `up_to`. Restore-time
+    re-admission rebuilds Requests with their ORIGINAL ids (stream
+    consumers and the journal key on them), so a rebuilt engine must
+    never hand a new request an id the snapshot already owns."""
+    global _REQUEST_IDS
+    nxt = next(_REQUEST_IDS)
+    _REQUEST_IDS = itertools.count(max(nxt, up_to + 1))
 
 
 @dataclasses.dataclass
@@ -229,14 +239,18 @@ class Scheduler:
         self.running: List[Request] = []
 
     # ------------------------------------------------------------ lifecycle
-    def add(self, req: Request) -> None:
+    def add(self, req: Request, force: bool = False) -> None:
+        """Enqueue `req`. `force=True` bypasses the bounded-queue check —
+        restore-time re-admission replays requests the engine ALREADY
+        accepted once; bouncing them off `max_waiting` would turn a
+        restart into a shedding event."""
         need = pages_for(len(req.prompt) + req.max_new_tokens,
                          self.page_size)
         if need > self.max_pages_per_seq:
             raise ValueError(
                 f"request needs {need} pages > max_pages_per_seq "
                 f"{self.max_pages_per_seq}; raise max_seq_len/page budget")
-        if self.max_waiting is not None and \
+        if not force and self.max_waiting is not None and \
                 len(self.waiting) >= self.max_waiting:
             # bounded queue = the backpressure signal: nothing was
             # registered, the caller retries later or sheds upstream
@@ -677,6 +691,8 @@ class Scheduler:
         itself sound (`BlockAllocator.check_consistency`). Raises
         RuntimeError on the first violation."""
         self.allocator.check_consistency()
+        if self.prefix_cache is not None:
+            self.prefix_cache.check_consistency()
         if set(map(id, self.waiting)) & set(map(id, self.running)):
             raise RuntimeError("scheduler corrupt: request in both "
                                "waiting and running queues")

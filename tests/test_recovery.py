@@ -1,0 +1,622 @@
+"""Crash recovery for the serving engine (ISSUE 8): the RequestJournal
+exactly-once delivery ledger (in-memory and file-backed, with
+`RequestJournal.load` round-trips), `snapshot()`/`restore()` folded
+re-prefill resumption (bit-identical for greedy AND seeded-stochastic
+sampling at decode horizons 1 and 8), and the EngineSupervisor
+escalation ladder (fatal fault / wall-time watchdog / fault-rate storm
+/ manual restart). The kill-anywhere chaos matrix is THE acceptance
+criterion: a `device_lost` fatal injected at every interesting step —
+mid-prefill, mid-decode-block, during preemption pressure, while
+requests share prefix-cache pages, under chunked prefill — must leave
+every request's token stream identical to an uninterrupted run with
+zero duplicated or lost tokens, scheduler + journal invariants clean
+after the restore. Satellite regressions: a wall-clock deadline that
+passes during the outage expires the request (never resurrected), a
+`cancel()` issued mid-restore wins over re-admission, and the
+zero-cost-when-disabled guard pins that an engine without a journal
+executes no recovery code on the hot path.
+
+Single tiny LLaMA reused module-wide (tests/test_serving.py's pattern)
+so the fast lane compiles one prefill-bucket + decode set.
+"""
+import functools
+import importlib.util
+import os
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.serving import (
+    EngineSnapshot, EngineSupervisor, FaultInjector, RequestJournal,
+    ServingEngine, is_fatal, replay_key_state,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _llama():
+    paddle.seed(1234)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _engine(**kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("decode_horizon", 4)
+    kw.setdefault("retry_backoff_s", 0.0)
+    return ServingEngine(_llama(), **kw)
+
+
+_PROMPTS = [[7, 3, 9, 1, 4], [2, 8, 6, 5, 1, 9, 3, 7, 2],
+            [4, 4, 1, 8, 8, 2, 6, 3, 9, 5, 1, 7, 3]]
+
+# a two-page shared system prompt so the prefix-sharing chaos config
+# actually shares pages (page_size=4)
+_SHARED = [6, 1, 6, 1, 8, 0, 3, 3]
+_SHARED_PROMPTS = [_SHARED + [7, 3, 9], _SHARED + [2, 8, 6, 5, 1],
+                   _SHARED + [4, 4, 1, 8, 8, 2, 6]]
+
+_SUBMIT_KW = dict(max_new_tokens=6, temperature=0.0, top_k=0, top_p=1.0,
+                  seed=7, eos_token_id=None, deadline_wall=None)
+
+
+def _sampling_kw(i, seeded):
+    return (dict(temperature=0.8, top_k=5, seed=100 + i) if seeded
+            else {})
+
+
+# --------------------------------------------------------- key replay
+
+class TestReplayKeyState:
+    def test_matches_manual_split_chain(self):
+        import jax
+        import numpy as np
+
+        key = jax.random.key(42)
+        for n in range(4):
+            got = np.asarray(replay_key_state(42, n))
+            assert got.tolist() == np.asarray(
+                jax.random.key_data(key)).tolist(), n
+            key = jax.random.split(key)[0]
+
+    def test_snapshot_replays_from_seed_not_live_key_state(self):
+        """snapshot() must NEVER trust the live `_key_state`: a block
+        that over-runs the budget (or a spill lost to the crash) leaves
+        it AHEAD of what was delivered. The snapshot's key_data is the
+        chain replayed from (seed, delivered-count), always."""
+        import numpy as np
+
+        eng = _engine(journal=RequestJournal())
+        rid = eng.add_request(_PROMPTS[0], max_new_tokens=6,
+                              temperature=0.8, top_k=5, seed=3)
+        eng.step()                       # prefill: first token delivered
+        snap = eng.snapshot()
+        rs = next(r for r in snap.requests if r.request_id == rid)
+        want = replay_key_state(3, len(eng._journal.delivered(rid)))
+        assert list(rs.key_data) == np.asarray(want).tolist()
+
+
+# ------------------------------------------------------------ journal
+
+class TestRequestJournal:
+    def test_submit_tokens_terminal_flow(self):
+        j = RequestJournal()
+        j.submit(request_id=1, prompt=[1, 2, 3], **_SUBMIT_KW)
+        assert j.known(1) and not j.known(2)
+        assert j.record(1).live
+        j.tokens(1, [4, 5])
+        j.tokens(1, [6])
+        assert j.delivered(1) == [4, 5, 6]
+        assert [r.request_id for r in j.live_records()] == [1]
+        j.terminal(1, "finished")
+        assert j.record(1).status == "finished"
+        assert j.live_records() == []
+        assert j.check_consistency()
+
+    def test_duplicate_submit_raises(self):
+        j = RequestJournal()
+        j.submit(request_id=1, prompt=[1], **_SUBMIT_KW)
+        with pytest.raises(ValueError, match="already journaled"):
+            j.submit(request_id=1, prompt=[1], **_SUBMIT_KW)
+
+    def test_terminal_validates_status_and_first_wins(self):
+        j = RequestJournal()
+        j.submit(request_id=1, prompt=[1], **_SUBMIT_KW)
+        with pytest.raises(ValueError, match="not a terminal status"):
+            j.terminal(1, "running")
+        j.terminal(1, "cancelled")
+        j.terminal(1, "finished")      # idempotent no-op: first wins
+        assert j.record(1).status == "cancelled"
+
+    def test_is_complete_budget_and_eos(self):
+        j = RequestJournal()
+        kw = dict(_SUBMIT_KW, max_new_tokens=3, eos_token_id=9)
+        j.submit(request_id=1, prompt=[1], **kw)
+        assert not j.record(1).is_complete()
+        j.tokens(1, [4, 9])            # EOS before budget
+        assert j.record(1).is_complete()
+        j.submit(request_id=2, prompt=[1], **kw)
+        j.tokens(2, [4, 5, 6])         # budget exhausted, no EOS
+        assert j.record(2).is_complete()
+
+    def test_check_consistency_catches_corruption(self):
+        j = RequestJournal()
+        j.submit(request_id=1, prompt=[1], **dict(_SUBMIT_KW,
+                                                  max_new_tokens=2))
+        j.tokens(1, [4, 5, 6])          # over budget
+        with pytest.raises(RuntimeError, match="over its budget"):
+            j.check_consistency()
+        j2 = RequestJournal()
+        j2.submit(request_id=1, prompt=[1], **dict(_SUBMIT_KW,
+                                                   eos_token_id=9))
+        j2.tokens(1, [9, 4])            # tokens past a delivered EOS
+        with pytest.raises(RuntimeError, match="past EOS"):
+            j2.check_consistency()
+
+    def test_file_backed_round_trip(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        j = RequestJournal(path=path)
+        j.submit(request_id=5, prompt=[1, 2], **dict(_SUBMIT_KW, seed=11))
+        j.tokens(5, [7, 8], t_wall=123.0)
+        j.submit(request_id=6, prompt=[3], **_SUBMIT_KW)
+        j.terminal(6, "cancelled", error="caller")
+        j.restart(1, "manual", 0.5, readmitted=1, replayed_tokens=4)
+        j.close()
+
+        j2 = RequestJournal.load(path)
+        assert j2.request_ids() == [5, 6]
+        rec = j2.record(5)
+        assert rec.delivered == [7, 8] and rec.seed == 11
+        assert rec.first_token_wall == 123.0
+        assert j2.record(6).status == "cancelled"
+        assert j2.record(6).error == "caller"
+        assert j2.restarts[0]["reason"] == "manual"
+        assert j2.check_consistency()
+        # the reloaded journal keeps appending to the same file
+        j2.tokens(5, [9])
+        j2.close()
+        j3 = RequestJournal.load(path)
+        assert j3.delivered(5) == [7, 8, 9]
+        j3.close()
+
+    def test_engine_journals_at_delivery_not_computation(self):
+        """Exactly-once core: the journal tracks what step() RETURNED —
+        tokens in an undrained pending block are never journaled."""
+        eng = _engine(journal=RequestJournal())
+        rid = eng.add_request(_PROMPTS[0], max_new_tokens=6)
+        delivered = []
+        for _ in range(100):
+            if not (eng.scheduler.has_work() or eng._pending is not None
+                    or eng._spill):
+                break
+            delivered += [t for r, t in eng.step() if r == rid]
+            assert eng._journal.delivered(rid) == delivered
+        assert eng.status(rid)[0] == "finished"
+        assert eng._journal.record(rid).status == "finished"
+        assert eng.output(rid) == list(_PROMPTS[0]) + delivered
+
+
+# --------------------------------------------------- snapshot / restore
+
+class TestSnapshotRestore:
+    def _ref(self, seeded, **kw):
+        eng = _engine(**kw)
+        rids = [eng.add_request(p, max_new_tokens=6,
+                                **_sampling_kw(i, seeded))
+                for i, p in enumerate(_PROMPTS)]
+        return eng.run(), rids
+
+    @pytest.mark.parametrize("seeded", [False, True])
+    @pytest.mark.parametrize("horizon", [1, 8])
+    def test_restore_resumes_bit_identically(self, seeded, horizon):
+        ref, ref_rids = self._ref(seeded, decode_horizon=horizon)
+        eng = _engine(decode_horizon=horizon, journal=RequestJournal())
+        rids = [eng.add_request(p, max_new_tokens=6,
+                                **_sampling_kw(i, seeded))
+                for i, p in enumerate(_PROMPTS)]
+        for _ in range(4):              # part-way: some tokens delivered
+            eng.step()
+        snap = eng.snapshot()
+        # the snapshot is a pure-JSON boundary: round-trip it
+        snap = EngineSnapshot.from_json(snap.to_json())
+        eng2 = _engine(decode_horizon=horizon,
+                       journal=eng._journal)
+        readmitted = eng2.restore(snap)
+        assert set(readmitted) <= set(rids)
+        out = eng2.run()
+        for a, b in zip(ref_rids, rids):
+            assert out[b] == ref[a], (seeded, horizon, b)
+            assert eng2.status(b)[0] == "finished"
+        eng2.scheduler.check_consistency()
+        eng._journal.check_consistency()
+
+    def test_complete_but_unfinalized_request_is_reconstructed(self):
+        """All tokens delivered, only the `finished` record lost to the
+        crash: restore reconstructs the request as finished without
+        recomputing anything."""
+        j = RequestJournal()
+        j.submit(request_id=1, prompt=[1, 2, 3],
+                 **dict(_SUBMIT_KW, max_new_tokens=3))
+        j.tokens(1, [4, 5, 6])           # budget met, no terminal record
+        donor = _engine(journal=j)
+        snap = donor.snapshot()
+        eng = _engine(journal=j)
+        assert eng.restore(snap) == []   # nothing re-admitted
+        assert eng.status(1)[0] == "finished"
+        assert eng.output(1) == [1, 2, 3, 4, 5, 6]
+        assert j.record(1).status == "finished"
+        assert not eng.scheduler.has_work()
+
+    def test_snapshot_requires_journal(self):
+        eng = _engine()
+        with pytest.raises(RuntimeError, match="journal"):
+            eng.snapshot()
+
+    def test_restore_requires_fresh_engine(self):
+        eng = _engine(journal=RequestJournal())
+        eng.add_request(_PROMPTS[0], max_new_tokens=4)
+        snap = eng.snapshot()
+        with pytest.raises(RuntimeError, match="fresh engine"):
+            eng.restore(snap)
+
+    def test_restore_rejects_smaller_max_seq_len(self):
+        eng = _engine(journal=RequestJournal())
+        snap = eng.snapshot()
+        small = _engine(max_seq_len=32, journal=RequestJournal())
+        with pytest.raises(ValueError, match="max_seq_len"):
+            small.restore(snap)
+
+    def test_restored_ids_never_collide_with_new_requests(self):
+        eng = _engine(journal=RequestJournal())
+        rid = eng.add_request(_PROMPTS[0], max_new_tokens=6)
+        eng.step()
+        snap = eng.snapshot()
+        eng2 = _engine(journal=eng._journal)
+        eng2.restore(snap)
+        fresh = eng2.add_request(_PROMPTS[1], max_new_tokens=2)
+        assert fresh > rid               # reserve_request_ids advanced
+        out = eng2.run()
+        assert len(out[fresh]) == len(_PROMPTS[1]) + 2
+
+
+# ------------------------------------------------- kill-anywhere chaos
+
+class TestKillAnywhereParity:
+    """THE acceptance criterion: inject a `device_lost` fatal at every
+    interesting step; every request's stream must be bit-identical to
+    an uninterrupted run, exactly-once, with scheduler + journal
+    invariants clean after the restore."""
+
+    def _chaos(self, kills, *, prompts=_PROMPTS, seeded=False,
+               max_new=6, **engine_kw):
+        ref_eng = _engine(**engine_kw)
+        ref_rids = [ref_eng.add_request(p, max_new_tokens=max_new,
+                                        **_sampling_kw(i, seeded))
+                    for i, p in enumerate(prompts)]
+        ref = ref_eng.run()
+        for kill in kills:
+            fi = FaultInjector().fail_at("device_lost", kill)
+            sup = EngineSupervisor(
+                lambda: _engine(fault_injector=fi, **engine_kw),
+                journal=RequestJournal())
+            rids = [sup.add_request(p, max_new_tokens=max_new,
+                                    **_sampling_kw(i, seeded))
+                    for i, p in enumerate(prompts)]
+            streamed = {r: [] for r in rids}
+            for rid, tok, done in sup.stream():
+                streamed[rid].append(tok)
+            assert len(sup.restarts) == 1, (kill, sup.restarts)
+            assert sup.restarts[0]["reason"] == "fatal_fault"
+            for i, rid in enumerate(rids):
+                want = ref[ref_rids[i]]
+                assert sup.output(rid) == want, (kill, rid)
+                # the streamed view: zero duplicated, zero lost tokens
+                assert list(prompts[i]) + streamed[rid] == want, \
+                    (kill, rid)
+                assert sup.status(rid)[0] == "finished"
+            sup.engine.scheduler.check_consistency()
+            sup.journal.check_consistency()
+        return ref_eng
+
+    @pytest.mark.parametrize("seeded", [False, True])
+    def test_kill_anywhere_plain(self, seeded):
+        # steps 0-2 are prefills, 3+ decode blocks: kills cover
+        # mid-prefill, mid-decode and after-last-delivery
+        self._chaos(range(6), seeded=seeded)
+
+    @pytest.mark.parametrize("horizon,kills", [(1, (1, 3, 5)),
+                                               (8, (1, 3, 4))])
+    def test_kill_anywhere_across_horizons(self, horizon, kills):
+        # h=8 finishes 6 tokens in one fused block: the last kill lands
+        # on the final drain step instead of a fifth step that never runs
+        self._chaos(kills, seeded=True, decode_horizon=horizon)
+
+    def test_kill_during_chunked_prefill(self):
+        # chunk of 8 splits the 13-token prompt: kills land mid-chunk
+        self._chaos((1, 2, 4), enable_chunked_prefill=True,
+                    prefill_chunk_tokens=8)
+
+    def test_kill_under_preemption_pressure(self):
+        # test_serving.py's in-flight-preemption pool: h=4 admission
+        # reserves only the first block, copy-on-extend then exhausts
+        # the 7 usable pages mid-stream and someone must requeue
+        import numpy as np
+
+        rng = np.random.RandomState(41)
+        vocab = LlamaConfig.tiny().vocab_size
+        prompts = [rng.randint(0, vocab, (n,)).tolist()
+                   for n in (10, 8, 12)]
+        ref_eng = self._chaos(
+            (2, 4, 6), prompts=prompts, max_new=12, page_size=8,
+            max_batch_size=3, max_seq_len=32, prefill_buckets=(16, 32),
+            num_pages=8)
+        assert ref_eng.stats()["preemptions"] > 0
+
+    def test_kill_while_sharing_prefix_pages(self):
+        self._chaos((1, 3, 5), prompts=_SHARED_PROMPTS,
+                    enable_prefix_caching=True)
+
+
+# ------------------------------------------------- supervisor ladder
+
+class TestWatchdog:
+    def test_slow_step_triggers_watchdog_restart(self):
+        class FakeClock:
+            t, tick = 0.0, 10.0       # first step: dt = 10s
+
+            def __call__(self):
+                self.t += self.tick
+                return self.t
+
+        clk = FakeClock()
+        sup = EngineSupervisor(_engine, journal=RequestJournal(),
+                               max_step_wall_s=1.0, clock=clk)
+        # after the restart, steps become fast again
+        sup._mid_restore_hook = \
+            lambda s: setattr(clk, "tick", 0.0)
+        ref, ref_rids = _engine(), []
+        ref_rids = [ref.add_request(p, max_new_tokens=6)
+                    for p in _PROMPTS]
+        ref_out = ref.run()
+        rids = [sup.add_request(p, max_new_tokens=6) for p in _PROMPTS]
+        out = sup.run()
+        assert [r["reason"] for r in sup.restarts] == ["watchdog"]
+        for a, b in zip(ref_rids, rids):
+            assert out[b] == ref_out[a]
+            assert sup.status(b)[0] == "finished"
+
+
+class TestFaultStorm:
+    def test_fault_rate_threshold_restarts(self):
+        # every 3rd dispatch faults transiently (each retry succeeds, so
+        # tokens never change) — the sustained rate must trip the storm
+        # escalation even though every individual fault was isolated
+        fi = FaultInjector(seed=5).fail_every("dispatch", 3)
+        sup = EngineSupervisor(
+            lambda: _engine(fault_injector=fi),
+            journal=RequestJournal(),
+            fault_rate_threshold=2, fault_rate_window=16)
+        ref = _engine()
+        ref_rids = [ref.add_request(p, max_new_tokens=6)
+                    for p in _PROMPTS]
+        ref_out = ref.run()
+        rids = [sup.add_request(p, max_new_tokens=6) for p in _PROMPTS]
+        out = sup.run()
+        assert sup.restarts and all(r["reason"] == "fault_storm"
+                                    for r in sup.restarts)
+        for a, b in zip(ref_rids, rids):
+            assert out[b] == ref_out[a]
+            assert sup.status(b)[0] == "finished"
+        sup.journal.check_consistency()
+
+    def test_max_restarts_gives_up(self):
+        fi = FaultInjector().fail_every("device_lost", 1)  # always fatal
+        sup = EngineSupervisor(
+            lambda: _engine(fault_injector=fi),
+            journal=RequestJournal(), max_restarts=2)
+        sup.add_request(_PROMPTS[0], max_new_tokens=6)
+        with pytest.raises(RuntimeError, match="max_restarts"):
+            for _ in range(10):
+                sup.step()
+
+    def test_fatal_faults_bypass_retry_and_quarantine(self):
+        """A fatal fault reaches the caller untouched: no retry, no
+        quarantine — the engine is presumed dead (`is_fatal` contract,
+        `device_lost` defaults fatal)."""
+        fi = FaultInjector().fail_at("dispatch", 0, fatal=True)
+        eng = _engine(fault_injector=fi)
+        eng.add_request(_PROMPTS[0], max_new_tokens=4)
+        with pytest.raises(Exception) as ei:
+            for _ in range(10):
+                eng.step()
+        assert is_fatal(ei.value)
+        # nothing was quarantined — the request is still live
+        assert eng.status(
+            list(eng.requests)[0])[0] in ("waiting", "running")
+
+
+class TestManualRestart:
+    def test_operator_restart_mid_run_keeps_parity(self):
+        ref = _engine()
+        ref_rids = [ref.add_request(p, max_new_tokens=6)
+                    for p in _PROMPTS]
+        ref_out = ref.run()
+        reg = MetricsRegistry()
+        sup = EngineSupervisor(_engine, journal=RequestJournal(),
+                               metrics=reg)
+        rids = [sup.add_request(p, max_new_tokens=6) for p in _PROMPTS]
+        sup.step()
+        sup.step()
+        sup.restart()
+        out = sup.run()
+        assert [r["reason"] for r in sup.restarts] == ["manual"]
+        for a, b in zip(ref_rids, rids):
+            assert out[b] == ref_out[a]
+        restarts = reg.get("serving_engine_restarts_total",
+                           {"reason": "manual"})
+        assert restarts is not None and restarts.value == 1
+        assert reg.get("serving_recovery_seconds")._count == 1
+        assert sup.stats()["num_restarts"] == 1
+
+
+# ------------------------------------- deadlines / cancels over restore
+
+class TestDeadlineAcrossRestore:
+    def test_deadline_passing_during_outage_expires_not_resurrects(self):
+        _engine().run()                  # warm compiles off the clock
+        fi = FaultInjector().fail_at("device_lost", 0)
+        sup = EngineSupervisor(lambda: _engine(fault_injector=fi),
+                               journal=RequestJournal())
+        # the outage (hook below) outlives this deadline
+        doomed = sup.add_request(_PROMPTS[0], max_new_tokens=6,
+                                 deadline_s=0.4)
+        safe = sup.add_request(_PROMPTS[1], max_new_tokens=6)
+        sup._mid_restore_hook = lambda s: time.sleep(0.5)
+        ref = _engine()
+        ref_rid = ref.add_request(_PROMPTS[1], max_new_tokens=6)
+        ref_out = ref.run()
+        out = sup.run()
+        assert sup.status(doomed)[0] == "expired"
+        assert sup.journal.record(doomed).status == "expired"
+        assert sup.restarts[0]["readmitted"] == 1   # only `safe`
+        assert out[safe] == ref_out[ref_rid]
+        assert sup.status(safe)[0] == "finished"
+
+    def test_live_deadline_survives_restore_and_finishes(self):
+        _engine().run()                  # warm compiles off the clock
+        fi = FaultInjector().fail_at("device_lost", 1)
+        sup = EngineSupervisor(lambda: _engine(fault_injector=fi),
+                               journal=RequestJournal())
+        rid = sup.add_request(_PROMPTS[0], max_new_tokens=6,
+                              deadline_s=30.0)
+        out = sup.run()
+        assert len(sup.restarts) == 1
+        assert sup.status(rid)[0] == "finished"
+        # the translated deadline rode along into the rebuilt engine
+        assert sup.engine.requests[rid].deadline_t is not None
+        assert len(out[rid]) == len(_PROMPTS[0]) + 6
+
+
+class TestCancelMidRestore:
+    def test_cancel_issued_mid_restore_wins_over_readmission(self):
+        ref = _engine()
+        ref_rids = [ref.add_request(p, max_new_tokens=6)
+                    for p in _PROMPTS]
+        ref_out = ref.run()
+        fi = FaultInjector().fail_at("device_lost", 4)
+        sup = EngineSupervisor(lambda: _engine(fault_injector=fi),
+                               journal=RequestJournal())
+        rids = [sup.add_request(p, max_new_tokens=6) for p in _PROMPTS]
+        victim = rids[1]
+        sup._mid_restore_hook = lambda s: s.cancel(victim)
+        out = sup.run()
+        assert len(sup.restarts) == 1
+        assert sup.status(victim)[0] == "cancelled"
+        assert victim not in sup.engine.scheduler.waiting
+        # the delivered prefix is still a prefix of the reference — the
+        # cancel lost the undelivered tail, never corrupted the stream
+        assert out[victim] == ref_out[ref_rids[1]][:len(out[victim])]
+        for i, rid in enumerate(rids):
+            if rid == victim:
+                continue
+            assert out[rid] == ref_out[ref_rids[i]]
+            assert sup.status(rid)[0] == "finished"
+        sup.engine.scheduler.check_consistency()
+        sup.journal.check_consistency()
+
+
+# --------------------------------------------------- zero-cost-disabled
+
+class TestZeroCostWhenDisabled:
+    def test_journal_free_engine_executes_no_recovery_code(
+            self, monkeypatch):
+        """Raise-on-touch guard: with no journal attached, a full
+        request lifecycle must never enter ANY recovery entry point."""
+        import paddle_tpu.serving.engine as eng_mod
+        import paddle_tpu.serving.recovery as rec_mod
+
+        eng = _engine()
+        eng.add_request([9, 8, 7], max_new_tokens=3)
+        eng.run()                        # warm compiles first
+
+        def boom(*a, **kw):
+            raise AssertionError("recovery code on a clean hot path")
+
+        for obj, meth in [
+                (eng_mod.ServingEngine, "_journal_delivery"),
+                (eng_mod.ServingEngine, "salvage"),
+                (eng_mod.ServingEngine, "restore"),
+                (rec_mod.RequestJournal, "submit"),
+                (rec_mod.RequestJournal, "tokens"),
+                (rec_mod.RequestJournal, "terminal")]:
+            monkeypatch.setattr(obj, meth, boom)
+        monkeypatch.setattr(eng_mod, "replay_key_state", boom)
+        rid = eng.add_request([1, 2, 3], max_new_tokens=4)
+        out = eng.run()
+        assert len(out[rid]) == 7
+        assert eng.status(rid)[0] == "finished"
+
+
+# ------------------------------------------------------- trace summary
+
+def _trace_summary_mod():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("trace_summary3", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTraceSummaryRestartDividers:
+    EVENTS = [
+        {"name": "serving.request[1].enqueued", "ph": "X", "ts": 0,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[1].prefill", "ph": "X", "ts": 10,
+         "dur": 5, "pid": 1, "tid": 2},
+        {"name": "serving.recovery[1].fatal_fault", "ph": "X", "ts": 20,
+         "dur": 4000, "pid": 1, "tid": 3},
+        {"name": "serving.request[1].recovered", "ph": "X", "ts": 25,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[1].finished", "ph": "X", "ts": 50,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[2].enqueued", "ph": "X", "ts": 5,
+         "dur": 0, "pid": 1, "tid": 2},
+        {"name": "serving.request[2].finished", "ph": "X", "ts": 15,
+         "dur": 0, "pid": 1, "tid": 2},
+    ]
+
+    def test_restart_divider_and_recovered_marker(self):
+        ts = _trace_summary_mod()
+        events = list(map(dict, self.EVENTS))
+        out = ts.format_requests(ts.request_timelines(events),
+                                 restarts=ts.recovery_epochs(events))
+        assert "request 1:  ~ recovered" in out
+        assert "-- restart #1 (fatal_fault, 4.000 ms) --" in out
+        # the divider lands inside request 1's timeline, between the
+        # prefill and the recovered point
+        r1 = out[out.index("request 1:"):out.index("request 2:")]
+        assert r1.index("prefill") < r1.index("-- restart #1") \
+            < r1.index("recovered ~")
+        # request 2 finished before the restart: no divider, no marker
+        # (slice stops at the blank line before the trailing summary)
+        r2 = out[out.index("request 2:"):out.index("\n\n")]
+        assert "restart" not in r2 and "~" not in r2
+        assert "1 engine restart(s)" in out
+        assert "1 request(s) recovered" in out
+        assert "!!" not in out           # a survivor is not a casualty
+
+    def test_no_restarts_renders_without_dividers(self):
+        ts = _trace_summary_mod()
+        events = [dict(e) for e in self.EVENTS
+                  if "recovery" not in e["name"]
+                  and "recovered" not in e["name"]]
+        out = ts.format_requests(ts.request_timelines(events),
+                                 restarts=ts.recovery_epochs(events))
+        assert "restart" not in out and "~" not in out

@@ -15,6 +15,11 @@ Two views over a `*.pt.trace.json` (or any chrome://tracing JSON):
   Requests ending in a failure-side terminal status (failed / expired /
   shed) are flagged with `!!` plus a trailing count, so a chaos or
   overload run's casualties stand out from the finished majority.
+  Supervisor restarts (`serving.recovery[<k>].<reason>` spans from
+  recovery.py) render as `-- restart #k (reason, t_recover ms) --`
+  dividers inside the timelines they interrupted, and requests that
+  were re-admitted across a restart are marked `~ recovered` — a
+  survivor, distinct from the `!!` casualties.
 
 Usage:
     python tools/trace_summary.py TRACE.json [--top N] [--requests]
@@ -31,6 +36,9 @@ import sys
 from typing import Dict, List, Tuple
 
 REQUEST_RE = re.compile(r"^serving\.request\[(\d+)\]\.(.+)$")
+# EngineSupervisor restart spans (recovery.py): one per engine rebuild,
+# named serving.recovery[<epoch>].<reason>
+RECOVERY_RE = re.compile(r"^serving\.recovery\[(\d+)\]\.(.+)$")
 
 
 def load_trace(path: str) -> List[dict]:
@@ -98,6 +106,20 @@ def request_timelines(events: List[dict]
     return out
 
 
+def recovery_epochs(events: List[dict]
+                    ) -> List[Tuple[int, str, float, float]]:
+    """[(epoch, reason, start_ts, dur)] for every supervisor restart
+    span in the trace, sorted by start time."""
+    out: List[Tuple[int, str, float, float]] = []
+    for e in _complete_events(events):
+        m = RECOVERY_RE.match(e.get("name", ""))
+        if m:
+            out.append((int(m.group(1)), m.group(2), float(e["ts"]),
+                        float(e.get("dur", 0))))
+    out.sort(key=lambda x: x[2])
+    return out
+
+
 def format_top(stats: Dict[str, Dict[str, float]], top: int = 20,
                by: str = "total") -> str:
     rows = sorted(stats.items(), key=lambda kv: kv[1][by], reverse=True)
@@ -120,7 +142,8 @@ def format_top(stats: Dict[str, Dict[str, float]], top: int = 20,
 BAD_TERMINALS = ("failed", "expired", "shed")
 
 
-def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]]
+def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]],
+                    restarts: List[Tuple[int, str, float, float]] = ()
                     ) -> str:
     if not timelines:
         return ("no serving.request[<rid>].<stage> spans in this trace "
@@ -128,21 +151,44 @@ def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]]
                 "inside an armed profiler window)")
     lines = []
     bad_counts: Dict[str, int] = {}
+    recovered_count = 0
     for rid in sorted(timelines):
         evs = timelines[rid]
         t0 = evs[0][1]
         stages = {stage for stage, _, _ in evs}
         bad = next((s for s in BAD_TERMINALS if s in stages), None)
-        if bad is None:
-            lines.append(f"request {rid}:")
-        else:
+        recovered = "recovered" in stages
+        if bad is not None:
             bad_counts[bad] = bad_counts.get(bad, 0) + 1
             lines.append(f"request {rid}:  !! {bad}")
+        elif recovered:
+            # survived one or more engine restarts (re-admitted from the
+            # journal) — worth a marker, but NOT a casualty
+            recovered_count += 1
+            lines.append(f"request {rid}:  ~ recovered")
+        else:
+            lines.append(f"request {rid}:")
+        # restart epochs that fell inside this request's lifetime show
+        # as dividers, interleaved with its stages by timestamp
+        cuts = [r for r in restarts if evs[0][1] < r[2] <= evs[-1][1]]
         for stage, ts, dur in evs:
+            while cuts and cuts[0][2] <= ts:
+                epoch, reason, _, rdur = cuts.pop(0)
+                lines.append(f"  -- restart #{epoch} ({reason}, "
+                             f"{rdur / 1e3:.3f} ms) --")
             tail = f"  ({dur / 1e3:.3f} ms)" if dur > 0 else ""
-            mark = " !!" if stage in BAD_TERMINALS else ""
+            mark = " !!" if stage in BAD_TERMINALS else (
+                " ~" if stage == "recovered" else "")
             lines.append(
                 f"  +{(ts - t0) / 1e3:10.3f} ms  {stage}{tail}{mark}")
+    if restarts:
+        lines.append("")
+        lines.append(
+            f"~ {len(restarts)} engine restart(s): " + ", ".join(
+                f"#{epoch} {reason} ({dur / 1e3:.3f} ms)"
+                for epoch, reason, _, dur in restarts)
+            + (f"; {recovered_count} request(s) recovered"
+               if recovered_count else ""))
     if bad_counts:
         summary = ", ".join(f"{bad_counts[s]} {s}"
                             for s in BAD_TERMINALS if s in bad_counts)
@@ -169,7 +215,8 @@ def main(argv=None) -> int:
     print(format_top(span_stats(events), top=args.top, by=args.by))
     if args.requests:
         print()
-        print(format_requests(request_timelines(events)))
+        print(format_requests(request_timelines(events),
+                              restarts=recovery_epochs(events)))
     return 0
 
 

@@ -389,6 +389,35 @@ def _run_serving_recovery(on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
+def _run_serving_cluster(on_tpu: bool) -> dict:
+    """Replicated-cluster phase: a 3-replica ServingCluster loses one
+    replica to a seeded `device_lost` mid-workload — reports throughput
+    before/during/after the kill, migration latency, and the
+    prefix-affinity hit-token payoff vs round-robin routing, asserting
+    bit-exact parity against an uninterrupted single engine. Non-fatal
+    like the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_cluster_phase(model, cfg, on_tpu)
+        _log(f"phase=serving_cluster: tok/s "
+             f"{out['tok_s_before_kill']} -> {out['tok_s_during_kill']}"
+             f" (kill) -> {out['tok_s_after_kill']} (2 replicas), "
+             f"{out['migrations']} migration(s) "
+             f"({out['migrated_tokens']} folded tokens, "
+             f"p50 {out['migration_ms'].get('p50', 0.0)}ms), "
+             f"affinity hit tokens {out['affinity_hit_tokens']} vs "
+             f"{out['round_robin_hit_tokens']} round-robin, "
+             f"parity_ok={out['parity_ok']}")
+        if not out["parity_ok"]:
+            _log("phase=serving_cluster: WARN replica-loss parity "
+                 "FAILED")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_cluster: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def make_train_step(model, opt):
     """The bench train step (fwd + MLM loss + grad + Adam, bf16 autocast).
 
@@ -593,6 +622,10 @@ def bench_child() -> None:
     # crash-recovery phase: supervisor kill/rebuild/re-admit parity
     _enter_phase("serving_recovery", 400.0)
     serving_recovery = _run_serving_recovery(on_tpu)
+
+    # replicated-cluster phase: replica kill, migration, affinity payoff
+    _enter_phase("serving_cluster", 400.0)
+    serving_cluster = _run_serving_cluster(on_tpu)
     _enter_phase("build")
 
     if on_tpu:
@@ -728,6 +761,7 @@ def bench_child() -> None:
                 "serving_faults": serving_faults,
                 "serving_chunked": serving_chunked,
                 "serving_recovery": serving_recovery,
+                "serving_cluster": serving_cluster,
                 "observability": _obs_snapshot(),
             },
         }

@@ -39,13 +39,22 @@ decoder models (LLaMA, GPT) with:
   killed engine with every unfinished request re-admitted as a folded
   prompt, continuing bit-identically), and an `EngineSupervisor` whose
   watchdog / fault-storm / fatal-fault escalation ladder drains,
-  snapshots, rebuilds and re-admits automatically.
+  snapshots, rebuilds and re-admits automatically;
+- `cluster`: replicated serving — `ServingCluster` runs N supervised
+  engine replicas behind the single-engine API, with load-aware +
+  prefix-affinity routing, spill-over admission, per-replica health
+  states (degrade/heal/drain), hedged re-dispatch of stuck requests,
+  and exactly-once journal-replay migration of every unfinished
+  request when a replica dies (`EngineDead`).
 
 See README.md "paddle_tpu.serving" for knobs and parity notes.
 """
 from .attention import (  # noqa: F401
     advance_positions, paged_attend, paged_decode_attention,
     paged_decode_available,
+)
+from .cluster import (  # noqa: F401
+    ClusterRequest, ReplicaHandle, ServingCluster,
 )
 from .engine import PAD_TOKEN, ServingEngine, ServingObs  # noqa: F401
 from .kv_cache import (  # noqa: F401
@@ -58,8 +67,8 @@ from .recovery import (  # noqa: F401
     replay_key_state,
 )
 from .resilience import (  # noqa: F401
-    EngineOverloaded, FaultInjector, InjectedFault, TERMINAL_STATUSES,
-    is_fatal, is_transient,
+    EngineDead, EngineOverloaded, FaultInjector, InjectedFault,
+    TERMINAL_STATUSES, is_fatal, is_transient,
 )
 from .scheduler import (  # noqa: F401
     ChunkTask, Request, SamplingParams, ScheduleDecision, Scheduler,
@@ -68,9 +77,10 @@ from .scheduler import (  # noqa: F401
 
 __all__ = [
     "ServingEngine", "ServingObs",
+    "ServingCluster", "ClusterRequest", "ReplicaHandle",
     "PagedKVCache", "PagedLayerCache", "BlockAllocator",
     "PrefixCache", "PrefixNode",
-    "EngineOverloaded", "FaultInjector", "InjectedFault",
+    "EngineDead", "EngineOverloaded", "FaultInjector", "InjectedFault",
     "TERMINAL_STATUSES", "is_fatal", "is_transient",
     "RequestJournal", "EngineSnapshot", "RequestSnapshot",
     "EngineSupervisor", "replay_key_state",

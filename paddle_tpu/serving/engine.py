@@ -1433,6 +1433,96 @@ class ServingEngine:
         if rs.last_token_wall is not None:
             req.last_token_t = rs.last_token_wall + offset
 
+    def adopt_request(self, *, prompt: List[int],
+                      delivered: Sequence[int] = (),
+                      max_new_tokens: int,
+                      temperature: float = 0.0, top_k: int = 0,
+                      top_p: float = 1.0, seed: int,
+                      eos_token_id: Optional[int] = None,
+                      deadline_wall: Optional[float] = None,
+                      key_splits: int = 0,
+                      request_id: Optional[int] = None) -> int:
+        """Re-admit another engine's in-flight request into THIS engine
+        while it keeps serving — the cluster's migration/hedging
+        primitive. `restore()` demands a fresh engine (it rebuilds a
+        whole snapshot); this is the single-request equivalent for a
+        running survivor: the request enters as a folded prompt
+        (`prompt + delivered`) with the REMAINING budget, its PRNG chain
+        replayed to `key_splits + len(delivered)` splits past `seed`, so
+        the continuation is bit-identical to the stream the dead replica
+        would have produced. `request_id=None` mints a fresh id (hedge
+        clones); passing one keeps the consumer-visible id across a
+        migration (`reserve_request_ids` fences the global counter
+        either way). If a journal is attached and does not already know
+        the id, the FOLD is journaled as a new submission carrying the
+        accumulated split count — a later crash of this engine replays
+        correctly however many folds deep the request is. A
+        `deadline_wall` already in the past finalizes the request as
+        "expired" on arrival (never resurrected), mirroring restore().
+        Returns the request id under which the request now runs."""
+        prompt = [int(t) for t in prompt]
+        delivered = [int(t) for t in delivered]
+        if not prompt:
+            raise ValueError("empty prompt")
+        remaining = max_new_tokens - len(delivered)
+        if remaining < 1:
+            raise ValueError(
+                f"nothing left to generate: {len(delivered)} of "
+                f"{max_new_tokens} tokens already delivered")
+        folded = prompt + delivered
+        if len(folded) + remaining > self.max_seq_len:
+            raise ValueError(
+                f"folded prompt ({len(folded)}) + remaining budget "
+                f"({remaining}) exceeds max_seq_len {self.max_seq_len}")
+        if not self.enable_chunked_prefill \
+                and len(folded) > self.prefill_buckets[-1]:
+            raise ValueError(
+                f"folded prompt length {len(folded)} exceeds the "
+                f"largest prefill bucket {self.prefill_buckets[-1]}")
+        if request_id is not None:
+            if request_id in self.requests:
+                raise ValueError(
+                    f"request {request_id} already lives on this engine")
+            reserve_request_ids(request_id)
+        req = Request(prompt=folded, max_new_tokens=remaining,
+                      sampling=SamplingParams(temperature, top_k, top_p,
+                                              seed),
+                      eos_token_id=eos_token_id,
+                      **({"request_id": request_id}
+                         if request_id is not None else {}))
+        rid = req.request_id
+        now_wall = time.time()
+        offset = time.perf_counter() - now_wall
+        expired = (deadline_wall is not None
+                   and now_wall >= deadline_wall)
+        if not expired:
+            # may raise on the page budget — before any registration,
+            # so a rejected adoption leaves no trace (add_request's
+            # discipline); force=True because this request was already
+            # admitted once, by the engine that died holding it
+            self.scheduler.add(req, force=True)
+        self.requests[rid] = req
+        self._key_state[rid] = jnp.asarray(
+            replay_key_state(seed, key_splits + len(delivered)),
+            dtype=jnp.uint32)
+        if self._journal is not None and not self._journal.known(rid):
+            self._journal.submit(
+                request_id=rid, prompt=folded,
+                max_new_tokens=remaining, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed,
+                eos_token_id=eos_token_id, deadline_wall=deadline_wall,
+                arrival_wall=now_wall,
+                key_splits=key_splits + len(delivered))
+        if deadline_wall is not None:
+            req.deadline_t = deadline_wall + offset
+            if expired:
+                self._finalize(req, "expired")
+                return rid
+            self._deadlined.add(rid)
+        if self._obs is not None:
+            self._obs.lifecycle.point(rid, "adopted")
+        return rid
+
     # -------------------------------------------------------------- metrics
     def stats(self) -> Dict[str, object]:
         """Aggregate serving metrics — a THIN VIEW over the metrics

@@ -124,6 +124,27 @@ class PrefixCache:
                 node = child
             return pages
 
+    def peek(self, tokens: Sequence[int]) -> int:
+        """Longest cached full-page prefix of `tokens`, in TOKENS — a
+        read-only probe for the cluster router's affinity scoring.
+        Unlike `match` it acquires no references, never ticks the LRU
+        clock, counts no lookup, and skips the fault injector: probing N
+        replicas to pick one must not perturb any replica's cache state
+        (or fire faults armed for real lookups). Same len(tokens)-1 cap
+        as `match`, so the probe predicts exactly what admission there
+        would reuse."""
+        max_chunks = (len(tokens) - 1) // self.page_size
+        node = self._root
+        n = 0
+        for i in range(max_chunks):
+            child = node.children.get(
+                tuple(tokens[i * self.page_size:(i + 1) * self.page_size]))
+            if child is None:
+                break
+            n += self.page_size
+            node = child
+        return n
+
     def record(self, total_tokens: int, hit_tokens: int) -> None:
         """Count one committed lookup (called on successful admission, so
         a deferred-and-retried request isn't double counted)."""

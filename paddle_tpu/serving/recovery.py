@@ -49,10 +49,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import time
+import warnings
 from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
-from .resilience import TERMINAL_STATUSES, is_fatal
+from .resilience import EngineDead, TERMINAL_STATUSES, is_fatal
 
 __all__ = ["EngineSnapshot", "EngineSupervisor", "RequestJournal",
            "RequestRecord", "RequestSnapshot", "replay_key_state"]
@@ -98,6 +99,13 @@ class RequestRecord:
     error: Optional[str] = None
     first_token_wall: Optional[float] = None
     last_token_wall: Optional[float] = None
+    # PRNG splits consumed BEFORE this record's first delivered token.
+    # 0 for ordinary submissions; a hedge clone admitted as a fold of an
+    # older request inherits that request's split count, so
+    # `replay_key_state(seed, key_splits + len(delivered))` is the
+    # correct chain position for ANY record, however many times it has
+    # been folded or migrated.
+    key_splits: int = 0
 
     @property
     def live(self) -> bool:
@@ -150,7 +158,8 @@ class RequestJournal:
                max_new_tokens: int, temperature: float, top_k: int,
                top_p: float, seed: int, eos_token_id: Optional[int],
                deadline_wall: Optional[float],
-               arrival_wall: Optional[float] = None) -> None:
+               arrival_wall: Optional[float] = None,
+               key_splits: int = 0) -> None:
         if request_id in self._records:
             raise ValueError(
                 f"request {request_id} already journaled")
@@ -161,18 +170,46 @@ class RequestJournal:
             top_p=float(top_p), seed=int(seed),
             eos_token_id=eos_token_id, deadline_wall=deadline_wall,
             arrival_wall=(time.time() if arrival_wall is None
-                          else arrival_wall))
+                          else arrival_wall),
+            key_splits=int(key_splits))
         self._records[request_id] = rec
         self._order.append(request_id)
-        self._persist({"ev": "submit", "rid": request_id,
-                       "prompt": rec.prompt,
-                       "max_new_tokens": rec.max_new_tokens,
-                       "temperature": rec.temperature,
-                       "top_k": rec.top_k, "top_p": rec.top_p,
-                       "seed": rec.seed,
-                       "eos_token_id": rec.eos_token_id,
-                       "deadline_wall": rec.deadline_wall,
-                       "arrival_wall": rec.arrival_wall})
+        obj = {"ev": "submit", "rid": request_id,
+               "prompt": rec.prompt,
+               "max_new_tokens": rec.max_new_tokens,
+               "temperature": rec.temperature,
+               "top_k": rec.top_k, "top_p": rec.top_p,
+               "seed": rec.seed,
+               "eos_token_id": rec.eos_token_id,
+               "deadline_wall": rec.deadline_wall,
+               "arrival_wall": rec.arrival_wall}
+        if rec.key_splits:
+            obj["key_splits"] = rec.key_splits
+        self._persist(obj)
+
+    def adopt(self, rec: RequestRecord) -> None:
+        """Register a copy of another journal's record (cluster
+        migration: the consumer-visible history of a request moving off
+        a dead replica). The copy is live (terminal state stays with the
+        old incarnation), carries the ORIGINAL prompt plus everything
+        delivered so far, and persists as an equivalent submit + tokens
+        pair so a reload of THIS journal reconstructs it."""
+        if rec.request_id in self._records:
+            raise ValueError(
+                f"request {rec.request_id} already journaled")
+        self.submit(request_id=rec.request_id, prompt=rec.prompt,
+                    max_new_tokens=rec.max_new_tokens,
+                    temperature=rec.temperature, top_k=rec.top_k,
+                    top_p=rec.top_p, seed=rec.seed,
+                    eos_token_id=rec.eos_token_id,
+                    deadline_wall=rec.deadline_wall,
+                    arrival_wall=rec.arrival_wall,
+                    key_splits=rec.key_splits)
+        if rec.delivered:
+            self.tokens(rec.request_id, list(rec.delivered),
+                        t_wall=rec.last_token_wall)
+            self._records[rec.request_id].first_token_wall = \
+                rec.first_token_wall
 
     def tokens(self, request_id: int, toks: List[int],
                t_wall: Optional[float] = None) -> None:
@@ -255,14 +292,28 @@ class RequestJournal:
     def load(cls, path: str) -> "RequestJournal":
         """Rebuild a journal from its JSONL file (a restart in a fresh
         process): replays every record through the ordinary append path
-        with persistence off, then re-attaches the file for appends."""
+        with persistence off, then re-attaches the file for appends.
+
+        A TORN FINAL LINE — the writer died mid-append, so the file ends
+        in a partial JSON record — is tolerated: the tail is truncated
+        off (with a warning) and everything before it loads normally.
+        One torn record is exactly what a kill-anywhere crash can
+        produce, and by the delivery contract a token record that never
+        finished hitting the disk was never shown to a consumer, so
+        dropping it is correct (the token is recomputed, not lost).
+        Corruption anywhere BEFORE the final record is still an error:
+        that is not a torn append but a damaged file."""
         j = cls()
-        with open(path, encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                obj = json.loads(line)
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        for raw in data.splitlines(keepends=True):
+            start, pos = pos, pos + len(raw)
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line.decode("utf-8"))
                 ev = obj["ev"]
                 if ev == "submit":
                     j.submit(request_id=obj["rid"], prompt=obj["prompt"],
@@ -272,7 +323,8 @@ class RequestJournal:
                              seed=obj["seed"],
                              eos_token_id=obj["eos_token_id"],
                              deadline_wall=obj["deadline_wall"],
-                             arrival_wall=obj["arrival_wall"])
+                             arrival_wall=obj["arrival_wall"],
+                             key_splits=obj.get("key_splits", 0))
                 elif ev == "tokens":
                     j.tokens(obj["rid"], obj["toks"],
                              t_wall=obj["t_wall"])
@@ -280,6 +332,20 @@ class RequestJournal:
                     j.terminal(obj["rid"], obj["status"], obj["error"])
                 elif ev == "restart":
                     j.restarts.append(obj)
+            except (ValueError, KeyError, UnicodeDecodeError) as e:
+                if data[pos:].strip():
+                    # damage with intact records AFTER it cannot be a
+                    # torn append — refuse to guess
+                    raise ValueError(
+                        f"corrupt journal record at byte {start} of "
+                        f"{path}: {line[:80]!r}") from e
+                warnings.warn(
+                    f"journal {path}: dropping torn final record "
+                    f"({len(raw)} bytes, writer died mid-append)",
+                    RuntimeWarning, stacklevel=2)
+                with open(path, "r+b") as fh:
+                    fh.truncate(start)
+                break
         j.path = path
         j._fh = open(path, "a", encoding="utf-8")
         return j
@@ -393,6 +459,11 @@ class EngineSupervisor:
         self._fault_window: deque = deque(maxlen=max(fault_rate_window, 1))
         self._pending_cancels: set = set()
         self._restoring = False
+        # set when max_restarts is exhausted: the engine object is
+        # dropped (`self.engine = None` — it IS gone) and every
+        # drive-the-engine entry point raises EngineDead, while
+        # status/output/stats keep answering from the journal
+        self.dead_reason: Optional[str] = None
         # test/ops hook: called between snapshot and re-admission, the
         # window where a concurrent control-plane cancel() must still win
         self._mid_restore_hook: Optional[Callable] = None
@@ -418,8 +489,21 @@ class EngineSupervisor:
         self.engine = factory()
         self.engine.attach_journal(self.journal)
 
+    # --------------------------------------------------------- dead state
+    @property
+    def dead(self) -> bool:
+        return self.dead_reason is not None
+
+    def _check_alive(self) -> None:
+        if self.dead_reason is not None:
+            raise EngineDead(
+                f"engine is dead ({self.dead_reason}); journal queries "
+                "(status/output/stats) still answer",
+                reason=self.dead_reason, restarts=len(self.restarts))
+
     # ------------------------------------------------------- request API
     def add_request(self, *args, **kwargs) -> int:
+        self._check_alive()
         return self.engine.add_request(*args, **kwargs)
 
     def cancel(self, request_id: int) -> bool:
@@ -428,13 +512,24 @@ class EngineSupervisor:
             # this request — recorded here, applied by restore()
             self._pending_cancels.add(request_id)
             return True
+        if self.engine is None:
+            # dead supervisor: no engine to stop, but the journal record
+            # must still end so consumers (and a migrating cluster) see
+            # the cancel — first terminal wins as usual
+            rec = self.journal.record(request_id)
+            if rec.status is not None:
+                return False
+            self.journal.terminal(request_id, "cancelled")
+            return True
         return self.engine.cancel(request_id)
 
     def status(self, request_id: int) -> Tuple[str, Optional[str]]:
         """(status, error), falling back to the journal for requests that
         ended before the last restart (terminal requests are not carried
-        into rebuilt engines — the journal is their record)."""
-        req = self.engine.requests.get(request_id)
+        into rebuilt engines — the journal is their record) and for
+        everything once the supervisor is dead."""
+        req = (self.engine.requests.get(request_id)
+               if self.engine is not None else None)
         if req is not None:
             return req.status, req.error
         rec = self.journal.record(request_id)
@@ -442,7 +537,8 @@ class EngineSupervisor:
                 rec.error)
 
     def output(self, request_id: int) -> List[int]:
-        req = self.engine.requests.get(request_id)
+        req = (self.engine.requests.get(request_id)
+               if self.engine is not None else None)
         if req is not None:
             return self.engine.output(request_id)
         rec = self.journal.record(request_id)
@@ -451,10 +547,13 @@ class EngineSupervisor:
     # ------------------------------------------------------------- steps
     def has_work(self) -> bool:
         eng = self.engine
+        if eng is None:
+            return False
         return (eng.scheduler.has_work() or eng._pending is not None
                 or bool(eng._spill))
 
     def step(self) -> List[Tuple[int, int]]:
+        self._check_alive()
         eng = self.engine
         faults_before = eng.fault_events
         t0 = self._clock()
@@ -470,12 +569,26 @@ class EngineSupervisor:
         if self.max_step_wall_s is not None and dt > self.max_step_wall_s:
             # the step DID return, but a step this slow means the runtime
             # is wedging; restart proactively at a clean boundary
-            return events + self._restart("watchdog")
+            return events + self._escalate("watchdog", events)
         if self.fault_rate_threshold is not None and \
                 sum(self._fault_window) >= self.fault_rate_threshold:
             self._fault_window.clear()
-            return events + self._restart("fault_storm")
+            return events + self._escalate("fault_storm", events)
         return events
+
+    def _escalate(self, reason: str,
+                  events: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+        """Post-step escalation: the step returned (and journaled)
+        `events` before the restart decision. If the restart budget is
+        gone the EngineDead raise would otherwise swallow them — already
+        marked delivered in the journal, never shown to the caller — so
+        they ride on the exception for the caller (a ServingCluster) to
+        deliver before migrating."""
+        try:
+            return self._restart(reason)
+        except EngineDead as e:
+            e.undelivered = list(events)
+            raise
 
     def stream(self) -> Iterable[Tuple[int, int, bool]]:
         """Generator of (request_id, token, done) across restarts: the
@@ -509,6 +622,7 @@ class EngineSupervisor:
         """Operator-initiated restart (planned maintenance, config
         rollouts): same drain/snapshot/rebuild/re-admit ladder as the
         automatic escalations."""
+        self._check_alive()
         return self._restart("manual")
 
     # ---------------------------------------------------------- recovery
@@ -518,10 +632,19 @@ class EngineSupervisor:
         from ..profiler import add_host_span
 
         if len(self.restarts) >= self.max_restarts:
-            raise RuntimeError(
+            # the budget is gone — declare the replica dead. The engine
+            # object is dropped (the device it wrapped is the thing that
+            # kept failing); the journal stays as the record of every
+            # request, which is what stats/status/output answer from and
+            # what a ServingCluster replays to migrate the survivors.
+            self.dead_reason = (
+                f"{reason}" + (f": {exc}" if exc else ""))
+            self.engine = None
+            raise EngineDead(
                 f"engine restarted {len(self.restarts)} times "
                 f"(max_restarts={self.max_restarts}); giving up on "
-                f"{reason}" + (f": {exc}" if exc else ""))
+                f"{reason}" + (f": {exc}" if exc else ""),
+                reason=reason, restarts=len(self.restarts))
         t0 = time.perf_counter()
         old = self.engine
         try:
@@ -569,7 +692,29 @@ class EngineSupervisor:
 
     # ------------------------------------------------------------- stats
     def stats(self) -> Dict[str, object]:
-        s = self.engine.stats()
+        """Engine stats plus restart history. After the supervisor is
+        declared dead (max_restarts exhausted) the engine object is
+        gone, so the summary is rebuilt from the journal — reporting the
+        terminal reason instead of raising."""
+        if self.engine is None:
+            terminal: Dict[str, int] = {}
+            live = 0
+            for rid in self.journal.request_ids():
+                rec = self.journal.record(rid)
+                if rec.status is None:
+                    live += 1
+                else:
+                    terminal[rec.status] = terminal.get(rec.status, 0) + 1
+            s: Dict[str, object] = {
+                "num_requests": len(self.journal.request_ids()),
+                "num_finished": terminal.get("finished", 0),
+                "num_live": live,
+                "terminal": terminal,
+            }
+        else:
+            s = self.engine.stats()
+        s["dead"] = self.engine is None
+        s["dead_reason"] = self.dead_reason
         s["restarts"] = list(self.restarts)
         s["num_restarts"] = len(self.restarts)
         return s

@@ -49,7 +49,7 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 __all__ = [
-    "EngineOverloaded", "FaultInjector", "InjectedFault",
+    "EngineDead", "EngineOverloaded", "FaultInjector", "InjectedFault",
     "TERMINAL_STATUSES", "is_fatal", "is_transient",
 ]
 
@@ -65,6 +65,29 @@ class EngineOverloaded(RuntimeError):
     Deliberately a distinct type (not ValueError) so callers can tell
     "malformed request" from "come back later" without string matching.
     """
+
+
+class EngineDead(RuntimeError):
+    """An `EngineSupervisor` exhausted `max_restarts` and gave up.
+
+    Raised by the restart that crosses the budget, and by every
+    subsequent `add_request`/`step`/`restart` on the dead supervisor.
+    Past this point the supervisor keeps answering `status`/`output`/
+    `stats` from the journal (the engine object is gone), and a
+    `ServingCluster` treats the raise as the replica-death signal that
+    triggers journal-replay migration onto the survivors. Also raised by
+    the cluster itself when replica losses exceed `max_dead_replicas`.
+
+    `reason` is the escalation reason of the final straw (one of
+    `RESTART_REASONS` in recovery.py); `restarts` the number of restarts
+    that were attempted before giving up.
+    """
+
+    def __init__(self, msg: str, reason: Optional[str] = None,
+                 restarts: int = 0):
+        super().__init__(msg)
+        self.reason = reason
+        self.restarts = restarts
 
 
 class InjectedFault(RuntimeError):

@@ -1,0 +1,517 @@
+"""Replicated serving cluster (ISSUE 9): `ServingCluster` over N
+supervised engine replicas. Router: load-aware placement, prefix
+affinity, round-robin, spill-over on `EngineOverloaded` before the
+caller ever sees it. Health: degraded on supervisor restarts / fault
+bursts, healed after clean steps, drain/resume, `max_dead_replicas`.
+Failover: THE acceptance criterion is replica-loss parity — three
+replicas, a seeded `device_lost` kill of one mid-run, and every
+request (including the migrated ones) completes with a token stream
+bit-identical to an uninterrupted single-engine run, exactly-once
+across `stream()` consumers, for greedy AND seeded-stochastic sampling
+at decode horizons 1 and 8. The chaos matrix varies the kill site
+(mid-prefill, mid-horizon, victim holding shared prefix pages) and the
+routing mode. Hedged re-dispatch races a stuck request's clone against
+the original (winner-agnostic assertions: exactly one survivor, zero
+duplicate tokens, bit-identical output). The zero-cost guard pins that
+a single-engine serve path executes NO cluster code.
+
+Single tiny LLaMA reused module-wide (tests/test_serving.py's pattern);
+every replica shares the model's memoized jit cache, so the matrix
+compiles one prefill-bucket + decode set.
+"""
+import functools
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    EngineDead, EngineOverloaded, FaultInjector, RequestJournal,
+    ServingCluster, ServingEngine,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _llama():
+    paddle.seed(1234)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+_ENGINE_KW = dict(page_size=4, num_pages=64, max_batch_size=4,
+                  max_seq_len=64, decode_horizon=4, retry_backoff_s=0.0)
+
+
+def _factory(**overrides):
+    kw = dict(_ENGINE_KW, **overrides)
+
+    def make(replica=None, fault_injector=None):
+        return ServingEngine(_llama(), fault_injector=fault_injector,
+                             **kw)
+    return make
+
+
+def _engine(**overrides):
+    return ServingEngine(_llama(), **dict(_ENGINE_KW, **overrides))
+
+
+_PROMPTS = [[7, 3, 9, 1, 4], [2, 8, 6, 5, 1, 9, 3, 7, 2],
+            [4, 4, 1, 8, 8, 2, 6, 3, 9, 5, 1, 7, 3]]
+
+# two-page shared system prompt (page_size=4) so affinity/shared-prefix
+# configs actually share cached pages
+_SHARED = [6, 1, 6, 1, 8, 0, 3, 3]
+_SHARED_PROMPTS = [_SHARED + [7, 3, 9], _SHARED + [2, 8, 6, 5, 1],
+                   _SHARED + [4, 4, 1, 8, 8, 2, 6]]
+
+
+def _sampling_kw(i, seeded):
+    return (dict(temperature=0.8, top_k=5, seed=100 + i) if seeded
+            else dict(seed=7))
+
+
+def _reference(prompts, seeded=False, max_new_tokens=6, **engine_kw):
+    """Fault-free single-engine run: the parity oracle."""
+    eng = _engine(**engine_kw)
+    rids = [eng.add_request(p, max_new_tokens=max_new_tokens,
+                            **_sampling_kw(i, seeded))
+            for i, p in enumerate(prompts)]
+    out = eng.run()
+    return [out[r] for r in rids]
+
+
+# ------------------------------------------------------------- routing
+
+class TestRouting:
+    def test_load_placement_spreads_requests(self):
+        cl = ServingCluster(_factory(), num_replicas=2)
+        for p in _PROMPTS:
+            cl.add_request(p, max_new_tokens=4, seed=7)
+        routed = cl.stats()["router"]["routed"]
+        assert sum(routed) == 3 and all(n > 0 for n in routed)
+
+    def test_round_robin_rotates(self):
+        cl = ServingCluster(_factory(), num_replicas=3,
+                            placement="round_robin",
+                            prefix_affinity=False)
+        for p in _PROMPTS:
+            cl.add_request(p, max_new_tokens=4, seed=7)
+        assert cl.stats()["router"]["routed"] == [1, 1, 1]
+
+    def test_prefix_affinity_steers_shared_prompts_together(self):
+        cl = ServingCluster(_factory(enable_prefix_caching=True),
+                            num_replicas=3)
+        first = cl.add_request(_SHARED_PROMPTS[0], max_new_tokens=4,
+                               seed=7)
+        home = cl._records[first].replica
+        # prefill so the shared pages actually enter r<home>'s cache
+        out = cl.run()
+        assert len(out[first]) == len(_SHARED_PROMPTS[0]) + 4
+        for p in _SHARED_PROMPTS[1:]:
+            rid = cl.add_request(p, max_new_tokens=4, seed=7)
+            assert cl._records[rid].replica == home
+        assert cl.stats()["router"]["affinity_hits"] >= 2
+
+    def test_affinity_disabled_ignores_prefix(self):
+        cl = ServingCluster(_factory(enable_prefix_caching=True),
+                            num_replicas=2, prefix_affinity=False)
+        for p in _SHARED_PROMPTS:
+            cl.add_request(p, max_new_tokens=4, seed=7)
+        st = cl.stats()["router"]
+        assert st["affinity_hits"] == 0 and st["affinity_misses"] == 0
+        assert st["affinity_table"] == 0
+
+    def test_spillover_then_shed(self):
+        # each replica holds at most one waiting request; the third
+        # admission spills off the full first choice onto the second
+        # replica, the fifth finds everyone full and sheds
+        cl = ServingCluster(_factory(max_waiting=2, max_batch_size=1),
+                            num_replicas=2, prefix_affinity=False)
+        for k in range(4):
+            cl.add_request(_PROMPTS[k % 3], max_new_tokens=2, seed=7)
+        with pytest.raises(EngineOverloaded):
+            cl.add_request(_PROMPTS[0], max_new_tokens=2, seed=7)
+        st = cl.stats()["router"]
+        assert st["routed"] == [2, 2]
+        assert st["spillovers"] >= 1 and st["shed"] == 1
+
+    def test_unknown_placement_rejected(self):
+        with pytest.raises(ValueError, match="placement"):
+            ServingCluster(_factory(), placement="bogus")
+
+
+# ----------------------------------------------------- single-API parity
+
+class TestClusterParity:
+    @pytest.mark.parametrize("seeded", [False, True])
+    def test_matches_single_engine(self, seeded):
+        want = _reference(_PROMPTS, seeded=seeded)
+        cl = ServingCluster(_factory(), num_replicas=2)
+        rids = [cl.add_request(p, max_new_tokens=6,
+                               **_sampling_kw(i, seeded))
+                for i, p in enumerate(_PROMPTS)]
+        out = cl.run()
+        assert [out[r] for r in rids] == want
+        assert all(cl.status(r) == ("finished", None) for r in rids)
+        assert cl.check_consistency()
+
+    def test_stream_exactly_once_with_done_flags(self):
+        cl = ServingCluster(_factory(), num_replicas=2)
+        rids = [cl.add_request(p, max_new_tokens=5, seed=7)
+                for p in _PROMPTS]
+        seen, done_for = {}, set()
+        for rid, tok, done in cl.stream():
+            seen.setdefault(rid, []).append(tok)
+            if done:
+                done_for.add(rid)
+        assert done_for == set(rids)
+        for rid in rids:
+            assert cl.output(rid) == \
+                list(cl._records[rid].prompt) + seen[rid]
+            assert len(seen[rid]) == 5
+
+    def test_cancel_and_status(self):
+        cl = ServingCluster(_factory(), num_replicas=2)
+        rid = cl.add_request(_PROMPTS[0], max_new_tokens=8, seed=7)
+        assert cl.status(rid)[0] == "waiting"
+        assert cl.cancel(rid) is True
+        assert cl.cancel(rid) is False
+        assert cl.status(rid) == ("cancelled", None)
+        cl.run()
+        assert cl.status(rid) == ("cancelled", None)
+        with pytest.raises(KeyError):
+            cl.status(12345)
+
+
+# ------------------------------------------------------ health lifecycle
+
+class TestHealth:
+    def test_drain_resume_routing(self):
+        cl = ServingCluster(_factory(), num_replicas=2)
+        cl.drain(0)
+        assert cl.health() == ["draining", "healthy"]
+        for p in _PROMPTS:
+            cl.add_request(p, max_new_tokens=2, seed=7)
+        assert cl.stats()["router"]["routed"] == [0, 3]
+        cl.resume(0)
+        assert cl.health() == ["healthy", "healthy"]
+        cl.drain(0)
+        cl.drain(1)
+        with pytest.raises(EngineOverloaded, match="no placeable"):
+            cl.add_request(_PROMPTS[0], max_new_tokens=2, seed=7)
+
+    def test_fault_burst_degrades_then_heals(self):
+        inj = [FaultInjector().fail_at("dispatch", 1, transient=True),
+               FaultInjector()]
+        cl = ServingCluster(_factory(), num_replicas=2,
+                            fault_injectors=inj,
+                            degrade_after_faults=1,
+                            degrade_recovery_steps=2)
+        # both replicas busy so maintenance keeps running after the fault
+        rids = [cl.add_request(p, max_new_tokens=10, seed=7)
+                for p in _PROMPTS]
+        states = set()
+        while cl.has_work():
+            cl.step()
+            states.add(cl.health()[0])
+        assert "degraded" in states        # the burst tripped it
+        assert cl.health()[0] == "healthy"  # ...and clean steps healed it
+        # the transient fault cost latency, never a token
+        out = {r: cl.output(r) for r in rids}
+        want = _reference(_PROMPTS, max_new_tokens=10)
+        assert [out[r] for r in rids] == want
+
+    def test_restart_marks_degraded(self):
+        inj = [FaultInjector().fail_at("device_lost", 1),
+               FaultInjector()]
+        cl = ServingCluster(_factory(), num_replicas=2,
+                            fault_injectors=inj,
+                            degrade_recovery_steps=10 ** 6)
+        for p in _PROMPTS:
+            cl.add_request(p, max_new_tokens=6, seed=7)
+        cl.run()
+        assert cl.health()[0] == "degraded"
+        assert len(cl.replicas[0].supervisor.restarts) == 1
+
+    def test_max_dead_replicas_raises(self):
+        inj = [FaultInjector().fail_at("device_lost", 1),
+               FaultInjector()]
+        cl = ServingCluster(_factory(), num_replicas=2,
+                            fault_injectors=inj,
+                            supervisor_kw=dict(max_restarts=0),
+                            max_dead_replicas=0)
+        for p in _PROMPTS:
+            cl.add_request(p, max_new_tokens=6, seed=7)
+        with pytest.raises(EngineDead, match="max_dead_replicas"):
+            cl.run()
+
+
+# ----------------------------------------------- replica-loss acceptance
+
+class TestReplicaLossParity:
+    """THE acceptance criterion: kill one of three replicas mid-run and
+    every request — including the ones migrated off the corpse —
+    completes bit-identical to an uninterrupted single-engine run,
+    exactly-once across the stream, journal + scheduler invariants clean
+    on every survivor."""
+
+    @pytest.mark.parametrize("horizon", [1, 8])
+    @pytest.mark.parametrize("seeded", [False, True])
+    def test_kill_one_replica_bit_identical(self, horizon, seeded):
+        want = _reference(_PROMPTS, seeded=seeded,
+                          decode_horizon=horizon)
+        inj = [FaultInjector(),
+               FaultInjector().fail_at("device_lost", 2),
+               FaultInjector()]
+        cl = ServingCluster(_factory(decode_horizon=horizon),
+                            num_replicas=3, fault_injectors=inj,
+                            supervisor_kw=dict(max_restarts=0))
+        rids = [cl.add_request(p, max_new_tokens=6,
+                               **_sampling_kw(i, seeded))
+                for i, p in enumerate(_PROMPTS)]
+        seen = {}
+        for rid, tok, done in cl.stream():
+            seen.setdefault(rid, []).append(tok)
+        assert cl.health().count("dead") == 1
+        out = {r: cl.output(r) for r in rids}
+        assert [out[r] for r in rids] == want
+        for i, rid in enumerate(rids):      # stream == output, no dup/lost
+            assert seen[rid] == out[rid][len(_PROMPTS[i]):]
+        assert cl.check_consistency()
+        st = cl.stats()
+        assert st["replica_deaths"] == 1
+        assert st["num_finished"] == len(rids)
+
+    def test_double_death_chained_migration(self):
+        """A migrated request's new home dying too re-migrates it from
+        the full-history record the first migration registered."""
+        want = _reference(_PROMPTS, max_new_tokens=8)
+        inj = [FaultInjector().fail_at("device_lost", 1),
+               FaultInjector().fail_at("device_lost", 3),
+               FaultInjector()]
+        cl = ServingCluster(_factory(), num_replicas=3,
+                            fault_injectors=inj, prefix_affinity=False,
+                            supervisor_kw=dict(max_restarts=0))
+        rids = [cl.add_request(p, max_new_tokens=8, seed=7)
+                for p in _PROMPTS]
+        out = cl.run()
+        assert cl.health().count("dead") == 2
+        assert [out[r] for r in rids] == want
+        assert cl.check_consistency()
+
+    def test_dead_replica_unroutable_and_tagged_in_stats(self):
+        inj = [FaultInjector().fail_at("device_lost", 1),
+               FaultInjector()]
+        cl = ServingCluster(_factory(), num_replicas=2,
+                            fault_injectors=inj,
+                            supervisor_kw=dict(max_restarts=0))
+        rids = [cl.add_request(p, max_new_tokens=6, seed=7)
+                for p in _PROMPTS]
+        cl.run()
+        assert cl.health()[0] == "dead"
+        rid = cl.add_request(_PROMPTS[0], max_new_tokens=2, seed=7)
+        assert cl._records[rid].replica == 1
+        with pytest.raises(ValueError, match="dead"):
+            cl.drain(0)
+        st = cl.stats()
+        assert st["dead_replicas"] == 1
+        assert st["replicas"][0]["stats"]["dead"] is True
+        assert all(cl.status(r)[0] == "finished" for r in rids)
+
+
+# ------------------------------------------------------------ chaos matrix
+
+_CHAOS_MODES = [("load", True), ("round_robin", False)]
+
+
+class TestClusterChaosMatrix:
+    """Seeded kills at every interesting site × routing modes: survivors
+    bit-identical to a fault-free single-engine run, zero duplicated or
+    lost tokens, per-replica invariants clean after every migration."""
+
+    @pytest.mark.parametrize("placement,affinity", _CHAOS_MODES)
+    @pytest.mark.parametrize("kill_at", [0, 2])
+    def test_kill_anywhere(self, placement, affinity, kill_at):
+        # kill_at=0 dies on its very first step (mid-prefill: nothing
+        # delivered yet); kill_at=2 mid-decode with horizon partials
+        want = _reference(_PROMPTS, max_new_tokens=6)
+        injectors = [FaultInjector() for _ in range(3)]
+        injectors[1].fail_at("device_lost", kill_at)
+        cl = ServingCluster(_factory(), num_replicas=3,
+                            placement=placement,
+                            prefix_affinity=affinity,
+                            fault_injectors=injectors,
+                            supervisor_kw=dict(max_restarts=0))
+        rids = [cl.add_request(p, max_new_tokens=6, seed=7)
+                for p in _PROMPTS]
+        seen = {}
+        for rid, tok, done in cl.stream():
+            seen.setdefault(rid, []).append(tok)
+        out = {r: cl.output(r) for r in rids}
+        assert [out[r] for r in rids] == want
+        for i, rid in enumerate(rids):
+            assert seen.get(rid, []) == out[rid][len(_PROMPTS[i]):]
+        assert cl.check_consistency()
+
+    def test_kill_replica_holding_shared_prefix_pages(self):
+        """Affinity packs the shared-prefix requests onto one replica;
+        killing exactly that replica migrates all of them at once —
+        folded re-prefills on survivors whose caches never saw the
+        prefix — still bit-identical."""
+        want = _reference(_SHARED_PROMPTS, max_new_tokens=6,
+                          enable_prefix_caching=True)
+        injectors = [FaultInjector() for _ in range(3)]
+        cl = ServingCluster(_factory(enable_prefix_caching=True),
+                            num_replicas=3, fault_injectors=injectors,
+                            supervisor_kw=dict(max_restarts=0))
+        rids = [cl.add_request(_SHARED_PROMPTS[0], max_new_tokens=6,
+                               seed=7)]
+        victim = cl._records[rids[0]].replica
+        cl.run()                          # prefix pages now cached there
+        rids += [cl.add_request(p, max_new_tokens=6, seed=7)
+                 for p in _SHARED_PROMPTS[1:]]
+        # affinity pulled every shared prompt onto the same replica
+        assert all(cl._records[r].replica == victim for r in rids)
+        injectors[victim].fail_at(
+            "device_lost",
+            injectors[victim].counts.get("device_lost", 0) + 1)
+        out = cl.run()
+        assert cl.health()[victim] == "dead"
+        assert [out[r] for r in rids] == want
+        assert cl.stats()["migrations"] >= 1
+        assert cl.check_consistency()
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("chaos_seed", [11, 23])
+    def test_seeded_cluster_chaos_deterministic(self, chaos_seed):
+        """One integer drives every replica's injector; two clusters
+        built from the same seed take identical fault schedules and
+        produce identical outputs (and both match the oracle)."""
+        want = _reference(_PROMPTS, max_new_tokens=6)
+
+        def run_once():
+            cl = ServingCluster(_factory(), num_replicas=3,
+                                chaos_seed=chaos_seed,
+                                supervisor_kw=dict(max_restarts=1))
+            for inj in cl.fault_injectors:
+                inj.fail_rate("dispatch", 0.05)
+            rids = [cl.add_request(p, max_new_tokens=6, seed=7)
+                    for p in _PROMPTS]
+            out = cl.run()
+            fired = [dict(i.fired) for i in cl.fault_injectors]
+            return [out[r] for r in rids], fired
+
+        out_a, fired_a = run_once()
+        out_b, fired_b = run_once()
+        assert out_a == out_b == want
+        assert fired_a == fired_b
+
+
+# -------------------------------------------------------------- hedging
+
+class TestHedging:
+    def _stuck_cluster(self, tick):
+        """2 replicas, r0 degraded and the fake clock far past
+        `hedge_after_s`: the next step MUST hedge r0's request onto r1.
+        Winner-agnostic from here on — both copies race."""
+        cl = ServingCluster(_factory(), num_replicas=2,
+                            hedge_after_s=5.0,
+                            clock=lambda: tick[0])
+        cl.drain(1)                       # force placement onto r0
+        rid = cl.add_request(_PROMPTS[0], max_new_tokens=6, seed=7)
+        cl.resume(1)
+        cl._set_health(cl.replicas[0], "degraded")
+        tick[0] += 100.0                  # way past the hedge deadline
+        return cl, rid
+
+    def test_hedge_fires_and_consumer_sees_one_stream(self):
+        want = _reference(_PROMPTS[:1], max_new_tokens=6)[0]
+        tick = [0.0]
+        cl, rid = self._stuck_cluster(tick)
+        seen = []
+        for r, tok, done in cl.stream():
+            assert r == rid               # the clone never leaks its id
+            seen.append(tok)
+        assert cl.stats()["hedges"] == 1
+        assert cl.stats()["hedge_cancels"] == 1
+        assert cl.output(rid) == want     # bit-identical, zero dups
+        assert seen == want[len(_PROMPTS[0]):]
+        assert len(cl._records[rid].copies) <= 1
+        assert cl.status(rid) == ("finished", None)
+        assert cl.check_consistency()
+
+    def test_hedge_then_owner_death_survivor_owns_stream(self):
+        """The original's replica dies after the hedge: the clone is
+        the surviving copy and the migration path hands it the stream
+        instead of re-admitting anything."""
+        want = _reference(_PROMPTS[:1], max_new_tokens=6)[0]
+        tick = [0.0]
+        cl = ServingCluster(_factory(), num_replicas=2,
+                            hedge_after_s=5.0,
+                            supervisor_kw=dict(max_restarts=0),
+                            clock=lambda: tick[0])
+        cl.drain(1)
+        rid = cl.add_request(_PROMPTS[0], max_new_tokens=6, seed=7)
+        cl.resume(1)
+        cl._set_health(cl.replicas[0], "degraded")
+        # r0 dies on its NEXT step — the same step whose maintenance
+        # phase plants the hedge on r1
+        cl.replicas[0].injector = None    # (not used; death via below)
+        inj = FaultInjector().fail_at("device_lost", 0)
+        cl.replicas[0].supervisor.engine._faults = inj
+        tick[0] += 100.0
+        out = cl.run()
+        assert cl.health() == ["dead", "healthy"]
+        assert out[rid] == want
+        assert cl.stats()["hedges"] == 1
+        assert cl.stats()["migrations"] == 0   # survivor, not re-admit
+        assert cl.status(rid) == ("finished", None)
+        assert cl.check_consistency()
+
+    def test_no_hedge_when_disabled_or_healthy(self):
+        tick = [0.0]
+        cl = ServingCluster(_factory(), num_replicas=2,
+                            hedge_after_s=5.0, clock=lambda: tick[0])
+        cl.add_request(_PROMPTS[0], max_new_tokens=4, seed=7)
+        tick[0] += 100.0                  # stale but owner is healthy
+        cl.run()
+        assert cl.stats()["hedges"] == 0
+
+
+# ------------------------------------------------------ zero-cost guard
+
+class TestZeroCostWhenUnused:
+    def test_single_engine_path_executes_no_cluster_code(self,
+                                                         monkeypatch):
+        """An engine + supervisor serve (journal attached, faults
+        injected and recovered — the full PR-7 surface) must execute
+        ZERO new code: every cluster entry point, the engine's adopt
+        path, the cache's peek probe and the journal's adopt are
+        booby-trapped."""
+        def boom(*a, **k):
+            raise AssertionError("cluster code on single-engine path")
+
+        import paddle_tpu.serving.cluster as cluster_mod
+        from paddle_tpu.serving import EngineSupervisor, PrefixCache
+        for name in ("add_request", "step", "stream", "run", "cancel",
+                     "status", "output", "stats", "drain", "resume",
+                     "_candidates", "_ingest", "_maintenance", "_hedge",
+                     "_on_replica_death", "_migrate_one", "_adopt_on",
+                     "_affinity_keys", "_load_score", "chaos_injectors"):
+            monkeypatch.setattr(cluster_mod.ServingCluster, name, boom)
+        monkeypatch.setattr(ServingEngine, "adopt_request", boom)
+        monkeypatch.setattr(PrefixCache, "peek", boom)
+        monkeypatch.setattr(RequestJournal, "adopt", boom)
+
+        inj = FaultInjector().fail_at("device_lost", 1)
+        sup = EngineSupervisor(
+            lambda: _engine(enable_prefix_caching=True,
+                            fault_injector=inj),
+            journal=RequestJournal())
+        rids = [sup.add_request(p, max_new_tokens=4, seed=7)
+                for p in _SHARED_PROMPTS]
+        out = sup.run()
+        assert len(sup.restarts) == 1     # the recovery path DID run
+        for i, rid in enumerate(rids):
+            assert len(out[rid]) == len(_SHARED_PROMPTS[i]) + 4

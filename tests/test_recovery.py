@@ -30,8 +30,8 @@ import paddle_tpu as paddle
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.observability import MetricsRegistry
 from paddle_tpu.serving import (
-    EngineSnapshot, EngineSupervisor, FaultInjector, RequestJournal,
-    ServingEngine, is_fatal, replay_key_state,
+    EngineDead, EngineSnapshot, EngineSupervisor, FaultInjector,
+    RequestJournal, ServingEngine, is_fatal, replay_key_state,
 )
 
 
@@ -620,3 +620,119 @@ class TestTraceSummaryRestartDividers:
         out = ts.format_requests(ts.request_timelines(events),
                                  restarts=ts.recovery_epochs(events))
         assert "restart" not in out and "~" not in out
+
+
+# ------------------------------------------------- torn journal tail
+
+class TestTornJournalLine:
+    """A writer killed mid-append leaves a partial JSONL record at the
+    end of the file. `load` must drop exactly that tail (with a
+    warning), truncate it off so subsequent appends produce valid JSONL,
+    and keep every complete record — while corruption anywhere BEFORE
+    the final record stays a hard error."""
+
+    def _journal_file(self, path):
+        j = RequestJournal(path=path)
+        j.submit(request_id=1, prompt=[1, 2, 3],
+                 **dict(_SUBMIT_KW, seed=11))
+        j.tokens(1, [7, 8], t_wall=50.0)
+        j.submit(request_id=2, prompt=[4], **_SUBMIT_KW)
+        j.terminal(2, "finished")
+        j.close()
+
+    def test_writer_killed_mid_record_truncates_and_warns(self, tmp_path):
+        path = str(tmp_path / "torn.jsonl")
+        self._journal_file(path)
+        intact = open(path, "rb").read()
+        # the writer died mid-append: half a tokens record, no newline
+        with open(path, "ab") as fh:
+            fh.write(b'{"ev": "tokens", "rid": 1, "toks": [9, 1')
+        with pytest.warns(RuntimeWarning, match="torn final record"):
+            j = RequestJournal.load(path)
+        # every complete record survived; the torn token append is as if
+        # it never happened (it never reached a consumer either)
+        assert j.delivered(1) == [7, 8]
+        assert j.record(2).status == "finished"
+        assert j.check_consistency()
+        # the tail is truncated off the FILE, so appends resume cleanly
+        assert open(path, "rb").read() == intact
+        j.tokens(1, [9])
+        j.close()
+        j2 = RequestJournal.load(path)
+        assert j2.delivered(1) == [7, 8, 9]
+        j2.close()
+
+    def test_torn_json_variants(self, tmp_path):
+        for i, tail in enumerate((b'{"ev": "term',
+                                  b'{"ev": "tokens", "rid"',
+                                  b'\xff\xfe garbage')):
+            path = str(tmp_path / f"torn{i}.jsonl")
+            self._journal_file(path)
+            with open(path, "ab") as fh:
+                fh.write(tail)
+            with pytest.warns(RuntimeWarning, match="torn final record"):
+                j = RequestJournal.load(path)
+            assert j.request_ids() == [1, 2]
+            j.close()
+
+    def test_corruption_before_the_tail_is_fatal(self, tmp_path):
+        path = str(tmp_path / "midcorrupt.jsonl")
+        self._journal_file(path)
+        lines = open(path, "rb").read().splitlines(keepends=True)
+        lines[1] = lines[1][:len(lines[1]) // 2] + b"\n"  # torn MID-file
+        with open(path, "wb") as fh:
+            fh.writelines(lines)
+        with pytest.raises(ValueError, match="corrupt journal record"):
+            RequestJournal.load(path)
+
+
+# -------------------------------------------------- dead supervisor
+
+class TestDeadSupervisorStats:
+    """`max_restarts` exhausted: the supervisor drops its engine and
+    raises `EngineDead` — but `stats()`/`status()`/`output()` keep
+    answering from the journal (an operator debugging a dead replica
+    needs them MOST right then), and `cancel()` still closes the books.
+    Regression: `stats()` used to raise AttributeError on
+    `self.engine.stats()` with the engine gone."""
+
+    def _dead_supervisor(self):
+        fi = FaultInjector().fail_every("device_lost", 1)
+        sup = EngineSupervisor(lambda: _engine(fault_injector=fi),
+                               journal=RequestJournal(), max_restarts=0)
+        rids = [sup.add_request(p, max_new_tokens=6, seed=7)
+                for p in _PROMPTS[:2]]
+        with pytest.raises(EngineDead, match="giving up"):
+            sup.step()
+        return sup, rids
+
+    def test_stats_reports_terminal_reason_instead_of_raising(self):
+        sup, rids = self._dead_supervisor()
+        assert sup.dead and sup.engine is None
+        s = sup.stats()                      # must NOT raise
+        assert s["dead"] is True
+        assert "fatal_fault" in s["dead_reason"]
+        assert s["num_restarts"] == 0        # it never got a restart
+        assert s["num_requests"] == 2 and s["num_live"] == 2
+        assert s["num_finished"] == 0
+
+    def test_queries_answer_from_journal_after_death(self):
+        sup, rids = self._dead_supervisor()
+        for i, rid in enumerate(rids):
+            assert sup.status(rid)[0] == "waiting"
+            assert sup.output(rid) == _PROMPTS[i]   # nothing delivered
+        assert sup.has_work() is False
+        assert sup.cancel(rids[0]) is True
+        assert sup.status(rids[0])[0] == "cancelled"
+        assert sup.cancel(rids[0]) is False
+        s = sup.stats()
+        assert s["terminal"] == {"cancelled": 1} and s["num_live"] == 1
+
+    def test_drive_entry_points_raise_engine_dead(self):
+        sup, rids = self._dead_supervisor()
+        for call in (lambda: sup.add_request([1, 2], max_new_tokens=2),
+                     sup.step, sup.restart):
+            with pytest.raises(EngineDead, match="engine is dead"):
+                call()
+        exc = pytest.raises(EngineDead, sup.step).value
+        assert exc.reason is not None and "fatal_fault" in exc.reason

@@ -19,7 +19,13 @@ Two views over a `*.pt.trace.json` (or any chrome://tracing JSON):
   recovery.py) render as `-- restart #k (reason, t_recover ms) --`
   dividers inside the timelines they interrupted, and requests that
   were re-admitted across a restart are marked `~ recovered` — a
-  survivor, distinct from the `!!` casualties.
+  survivor, distinct from the `!!` casualties. Cluster runs
+  (serving/cluster.py) tag every request with replica spans
+  (`serving.request[<rid>].replica[r<i>]`): the header grows a
+  `[r0->r2]`-style journey, migrations and hedges
+  (`serving.cluster.migrate[<rid>].r0->r2`, `...hedge[...]`) interleave
+  as `>> migrated r0->r2` markers, and a per-replica lane summary maps
+  each replica to the requests it carried.
 
 Usage:
     python tools/trace_summary.py TRACE.json [--top N] [--requests]
@@ -39,6 +45,13 @@ REQUEST_RE = re.compile(r"^serving\.request\[(\d+)\]\.(.+)$")
 # EngineSupervisor restart spans (recovery.py): one per engine rebuild,
 # named serving.recovery[<epoch>].<reason>
 RECOVERY_RE = re.compile(r"^serving\.recovery\[(\d+)\]\.(.+)$")
+# ServingCluster failover spans (cluster.py): a request moving between
+# replicas, named serving.cluster.migrate[<rid>].r0->r2 (replica death)
+# or serving.cluster.hedge[<rid>].r0->r1 (stuck-request re-dispatch)
+CLUSTER_MOVE_RE = re.compile(
+    r"^serving\.cluster\.(migrate|hedge)\[(\d+)\]\.(r\d+)->(r\d+)$")
+# the replica tag inside a request's own lifecycle lane
+REPLICA_STAGE_RE = re.compile(r"^replica\[(r\d+)\]$")
 
 
 def load_trace(path: str) -> List[dict]:
@@ -120,6 +133,22 @@ def recovery_epochs(events: List[dict]
     return out
 
 
+def cluster_moves(events: List[dict]
+                  ) -> Dict[int, List[Tuple[str, str, str, float, float]]]:
+    """rid -> [(kind, src, dst, start_ts, dur)] for every cluster
+    migration/hedge span, sorted by start time."""
+    out: Dict[int, List[Tuple[str, str, str, float, float]]] = {}
+    for e in _complete_events(events):
+        m = CLUSTER_MOVE_RE.match(e.get("name", ""))
+        if m:
+            out.setdefault(int(m.group(2)), []).append(
+                (m.group(1), m.group(3), m.group(4), float(e["ts"]),
+                 float(e.get("dur", 0))))
+    for evs in out.values():
+        evs.sort(key=lambda x: x[3])
+    return out
+
+
 def format_top(stats: Dict[str, Dict[str, float]], top: int = 20,
                by: str = "total") -> str:
     rows = sorted(stats.items(), key=lambda kv: kv[1][by], reverse=True)
@@ -143,7 +172,9 @@ BAD_TERMINALS = ("failed", "expired", "shed")
 
 
 def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]],
-                    restarts: List[Tuple[int, str, float, float]] = ()
+                    restarts: List[Tuple[int, str, float, float]] = (),
+                    moves: Dict[int, List[Tuple[str, str, str, float,
+                                                float]]] = {}
                     ) -> str:
     if not timelines:
         return ("no serving.request[<rid>].<stage> spans in this trace "
@@ -152,35 +183,74 @@ def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]],
     lines = []
     bad_counts: Dict[str, int] = {}
     recovered_count = 0
+    migrations = hedges = 0
+    lanes: Dict[str, List[int]] = {}    # replica tag -> rids it carried
     for rid in sorted(timelines):
         evs = timelines[rid]
         t0 = evs[0][1]
         stages = {stage for stage, _, _ in evs}
         bad = next((s for s in BAD_TERMINALS if s in stages), None)
         recovered = "recovered" in stages
+        # replica journey from the cluster's placement tags, in time
+        # order with consecutive duplicates collapsed: [r1] for a
+        # request that never moved, [r1->r2] across a migration/hedge
+        journey: List[str] = []
+        for stage, _, _ in evs:
+            rm = REPLICA_STAGE_RE.match(stage)
+            if rm and (not journey or journey[-1] != rm.group(1)):
+                journey.append(rm.group(1))
+        for tag in journey:
+            lanes.setdefault(tag, []).append(rid)
+        lane = f" [{'->'.join(journey)}]" if journey else ""
         if bad is not None:
             bad_counts[bad] = bad_counts.get(bad, 0) + 1
-            lines.append(f"request {rid}:  !! {bad}")
+            lines.append(f"request {rid}{lane}:  !! {bad}")
         elif recovered:
             # survived one or more engine restarts (re-admitted from the
             # journal) — worth a marker, but NOT a casualty
             recovered_count += 1
-            lines.append(f"request {rid}:  ~ recovered")
+            lines.append(f"request {rid}{lane}:  ~ recovered")
         else:
-            lines.append(f"request {rid}:")
+            lines.append(f"request {rid}{lane}:")
         # restart epochs that fell inside this request's lifetime show
-        # as dividers, interleaved with its stages by timestamp
+        # as dividers, interleaved with its stages by timestamp; cluster
+        # migrations/hedges of THIS request interleave the same way
         cuts = [r for r in restarts if evs[0][1] < r[2] <= evs[-1][1]]
+        jumps = list(moves.get(rid, ()))
         for stage, ts, dur in evs:
             while cuts and cuts[0][2] <= ts:
                 epoch, reason, _, rdur = cuts.pop(0)
                 lines.append(f"  -- restart #{epoch} ({reason}, "
                              f"{rdur / 1e3:.3f} ms) --")
+            while jumps and jumps[0][3] <= ts:
+                kind, src, dst, _, mdur = jumps.pop(0)
+                lines.append(f"  >> {kind}d {src}->{dst}"
+                             f" ({mdur / 1e3:.3f} ms)")
+            if REPLICA_STAGE_RE.match(stage):
+                continue                # folded into the header journey
             tail = f"  ({dur / 1e3:.3f} ms)" if dur > 0 else ""
             mark = " !!" if stage in BAD_TERMINALS else (
                 " ~" if stage == "recovered" else "")
             lines.append(
                 f"  +{(ts - t0) / 1e3:10.3f} ms  {stage}{tail}{mark}")
+        for kind, src, dst, _, mdur in jumps:   # moves after last stage
+            lines.append(f"  >> {kind}d {src}->{dst}"
+                         f" ({mdur / 1e3:.3f} ms)")
+        migrations += sum(1 for m in moves.get(rid, ()) if m[0] == "migrate")
+        hedges += sum(1 for m in moves.get(rid, ()) if m[0] == "hedge")
+    if lanes:
+        lines.append("")
+        lines.append("replica lanes:")
+        for tag in sorted(lanes):
+            rids = ", ".join(str(r) for r in lanes[tag])
+            lines.append(f"  {tag}: requests {rids}")
+    if migrations or hedges:
+        parts = []
+        if migrations:
+            parts.append(f"{migrations} migration(s)")
+        if hedges:
+            parts.append(f"{hedges} hedge(s)")
+        lines.append(f">> {' + '.join(parts)} across replicas")
     if restarts:
         lines.append("")
         lines.append(
@@ -216,7 +286,8 @@ def main(argv=None) -> int:
     if args.requests:
         print()
         print(format_requests(request_timelines(events),
-                              restarts=recovery_epochs(events)))
+                              restarts=recovery_epochs(events),
+                              moves=cluster_moves(events)))
     return 0
 
 

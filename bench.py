@@ -313,6 +313,34 @@ def _run_serving_decode(on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
+def _run_serving_tp(on_tpu: bool) -> dict:
+    """Tensor-parallel serving phase: the scheduled decode workload at
+    tp 1/2/4 with bit-identical-token assertion and the psum-probe
+    collective time. A null throughput result on CPU fake devices is
+    expected (shards are threads on one chip); the parity bit is the
+    CPU-meaningful signal. Non-fatal like the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_tp_phase(model, cfg, on_tpu)
+        if "skipped" in out:
+            _log(f"phase=serving_tp: skipped ({out['skipped']})")
+            return out
+        degrees = ", ".join(
+            f"tp{d}={out[f'tp{d}']['decode_tokens_per_s']} tok/s"
+            + (f" (psum probe {out[f'tp{d}']['psum_probe_us']}us)"
+               if "psum_probe_us" in out[f"tp{d}"] else "")
+            for d in out["degrees"])
+        _log(f"phase=serving_tp: {degrees}, "
+             f"parity_ok={out['parity_ok']}")
+        if not out["parity_ok"]:
+            _log("phase=serving_tp: WARN tp token parity FAILED")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_tp: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def _run_serving_faults(on_tpu: bool) -> dict:
     """Seeded chaos serving phase: the workload re-runs under a
     FaultInjector schedule (transient dispatch faults, periodic alloc
@@ -611,6 +639,10 @@ def bench_child() -> None:
     _enter_phase("serving_decode", 400.0)
     serving_decode = _run_serving_decode(on_tpu)
 
+    # tensor-parallel sweep: parity bit + psum probe, null tok/s on CPU
+    _enter_phase("serving_tp", 400.0)
+    serving_tp = _run_serving_tp(on_tpu)
+
     # seeded chaos phase: fault-injected run vs fault-free parity
     _enter_phase("serving_faults", 400.0)
     serving_faults = _run_serving_faults(on_tpu)
@@ -758,6 +790,7 @@ def bench_child() -> None:
                 "gates": gates,
                 "serving_prefix": serving_prefix,
                 "serving_decode": serving_decode,
+                "serving_tp": serving_tp,
                 "serving_faults": serving_faults,
                 "serving_chunked": serving_chunked,
                 "serving_recovery": serving_recovery,

@@ -7,7 +7,10 @@ reports mean ttft with the prefix cache on vs off (plus the hit rate), so
 one run shows what radix KV reuse buys on prefill-bound traffic; finally
 a serving_decode phase measures steady-state scheduled decode tokens/s
 and host-sync counts at decode_horizon 1 vs 8 (the fused multi-token
-decode block + async host/device overlap); last, a serving_faults phase
+decode block + async host/device overlap); a serving_tp phase sweeps
+tensor parallelism tp 1/2/4, asserting bit-identical tokens and
+reporting decode tokens/s + the psum-probe collective time (a deliberate
+null result on the CPU fake-device mesh); last, a serving_faults phase
 replays the workload under a seeded FaultInjector chaos schedule and
 asserts the survivors' tokens match the fault-free run (the resilience
 layer's isolation guarantee), reporting what the chaos cost; and a
@@ -88,6 +91,7 @@ def main():
                    "prefill_ms": round(prefill_s * 1000, 2),
                    "serving_prefix": serving_prefix_phase(m, cfg, on_tpu),
                    "serving_decode": serving_decode_phase(m, cfg, on_tpu),
+                   "serving_tp": serving_tp_phase(m, cfg, on_tpu),
                    "serving_faults": serving_faults_phase(m, cfg, on_tpu),
                    "serving_chunked": serving_chunked_phase(m, cfg,
                                                             on_tpu),
@@ -224,6 +228,96 @@ def serving_decode_phase(model, cfg, on_tpu):
         "sync_reduction": round(
             h1["syncs_per_token"] / max(h8["syncs_per_token"], 1e-9), 2),
     }
+
+
+def serving_tp_phase(model, cfg, on_tpu):
+    """Tensor-parallel serving sweep (ISSUE 10): the same scheduled
+    decode workload at tp 1 vs 2 vs 4 on one host, asserting per-request
+    token parity vs tp=1 (the bit-identical contract) and reporting
+    decode tokens/s plus the construction-time psum probe
+    (`serving_tp_collective_seconds`) as the collective-time breakdown.
+    On the CPU fake-device mesh the throughput row is an EXPECTED null
+    result — shards are threads on one chip, so tp adds psum overhead
+    and buys no memory bandwidth or FLOPs; the phase exists to carry the
+    harness (and the parity assertion) to multi-chip hardware, where
+    "what fraction of a decode step is the collective" (the EQuARX
+    question) becomes a real number."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+
+    ndev = len(jax.devices())
+    if on_tpu:
+        tp_model, tp_cfg = model, cfg
+    else:
+        # LlamaConfig.tiny() has 2 kv heads (GQA caps tp at 2); a
+        # 4-kv-head sibling lets the CPU sweep reach tp=4
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        tp_cfg = LlamaConfig(vocab_size=512, hidden_size=64,
+                             num_hidden_layers=2, num_attention_heads=4,
+                             num_key_value_heads=4, intermediate_size=128,
+                             max_position_embeddings=128)
+        tp_model = LlamaForCausalLM(tp_cfg)
+        tp_model.eval()
+
+    kv = getattr(tp_cfg, "num_key_value_heads",
+                 tp_cfg.num_attention_heads)
+    degrees = [d for d in (1, 2, 4)
+               if d <= ndev and kv % d == 0
+               and tp_cfg.num_attention_heads % d == 0
+               and tp_cfg.intermediate_size % d == 0]
+    if degrees == [1]:
+        return {"skipped": f"no tp degree fits (devices={ndev}, "
+                           f"kv_heads={kv})"}
+
+    rng = np.random.RandomState(11)
+    n_req = 4
+    new_tokens = 96 if on_tpu else 48
+    prompts = [rng.randint(0, tp_cfg.vocab_size, (12,)).tolist()
+               for _ in range(n_req)]
+    max_seq = min(tp_cfg.max_position_embeddings, 128)
+
+    def run(tp):
+        eng = ServingEngine(tp_model, page_size=8, max_batch_size=n_req,
+                            max_seq_len=max_seq, decode_horizon=8,
+                            tp_size=tp)
+        for p in prompts:            # warm wave: tp-keyed executables
+            eng.add_request(p, max_new_tokens=new_tokens)
+        eng.run()
+        toks0 = eng.stats()["tokens_generated"]
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        out = eng.run()
+        wall = time.perf_counter() - t0
+        toks = eng.stats()["tokens_generated"] - toks0
+        entry = {"decode_tokens_per_s": round(toks / wall, 1),
+                 "wall_ms": round(wall * 1000, 2), "tokens": toks}
+        if tp > 1 and eng.metrics is not None:
+            probe = eng.metrics.get("serving_tp_collective_seconds")
+            if probe is not None and probe.count:
+                entry["psum_probe_us"] = round(
+                    1e6 * probe.sum / probe.count, 1)
+        return entry, [out[r] for r in rids]
+
+    results, streams = {}, {}
+    for d in degrees:
+        results[f"tp{d}"], streams[d] = run(d)
+    base = streams[1]
+    out = {"devices": ndev, "degrees": degrees, "requests": n_req,
+           "new_tokens": new_tokens, **results,
+           "parity_ok": all(streams[d] == base for d in degrees[1:])}
+    for d in degrees[1:]:
+        out[f"tp{d}_speedup"] = round(
+            results[f"tp{d}"]["decode_tokens_per_s"]
+            / max(results["tp1"]["decode_tokens_per_s"], 1e-9), 2)
+    return out
 
 
 def serving_faults_phase(model, cfg, on_tpu):

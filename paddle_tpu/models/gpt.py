@@ -70,11 +70,16 @@ class GPTAttention(nn.Layer):
         if cache is not None:  # KV-cache decode (inference only)
             from .generation import attend_with_cache
             ctx, new_cache = attend_with_cache(q, k, v, cache, start_pos, 1)
-            return self.out(ctx.reshape([b, s, h])), new_cache
+            # num_heads*head_dim, not cfg.hidden_size: under tensor
+            # parallelism this module runs with num_heads/tp local heads,
+            # so ctx is narrower than the input (and b may be a symbolic
+            # -1 under to_static, ruling out a -1 here)
+            return self.out(
+                ctx.reshape([b, s, self.num_heads * self.head_dim])), new_cache
         ctx = F.scaled_dot_product_attention(
             q, k, v, is_causal=True,
             dropout_p=self.dropout_p if self.training else 0.0)
-        ctx = ctx.reshape([b, s, h])
+        ctx = ctx.reshape([b, s, self.num_heads * self.head_dim])
         return self.out(ctx)
 
 

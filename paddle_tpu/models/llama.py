@@ -84,10 +84,16 @@ class LlamaAttention(nn.Layer):
                 k = k.repeat_interleave(rep, axis=2)
                 v = v.repeat_interleave(rep, axis=2)
             ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-            return self.o_proj(ctx.reshape([b, s, h]))
+            # num_heads*head_dim, not cfg.hidden_size: under tensor
+            # parallelism this module runs with num_heads/tp local heads,
+            # so ctx is narrower than the input (and b may be a symbolic
+            # -1 under to_static, ruling out a -1 here)
+            return self.o_proj(
+                ctx.reshape([b, s, self.num_heads * self.head_dim]))
         from .generation import attend_with_cache
         ctx, new_cache = attend_with_cache(q, k, v, cache, start_pos, rep)
-        return self.o_proj(ctx.reshape([b, s, h])), new_cache
+        return self.o_proj(
+            ctx.reshape([b, s, self.num_heads * self.head_dim])), new_cache
 
 
 class LlamaMLP(nn.Layer):

@@ -18,14 +18,21 @@ whether a profiler window happens to be armed; high-volume spans
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 __all__ = ["LifecycleTracker"]
 
 
 class LifecycleTracker:
-    def __init__(self, max_events_per_request: int = 512):
+    def __init__(self, max_events_per_request: int = 512,
+                 tag: Optional[str] = None):
         self.max_events_per_request = max_events_per_request
+        # deployment tag appended to every EMITTED span name
+        # (`serving.request[rid].stage@tag`, e.g. tag="tp=2"); the
+        # locally retained events keep the plain stage so stats/tests
+        # are tag-agnostic. The host tracer's events carry only a name,
+        # so the tag rides in the name by design.
+        self.tag = tag
         # rid -> [(stage, t0, t1)] in emission order; points have t0 == t1
         self._events: Dict[int, List[Tuple[str, float, float]]] = {}
         self._dropped = 0
@@ -42,8 +49,10 @@ class LifecycleTracker:
              retain: bool = True) -> None:
         from ..profiler import add_host_span
 
-        add_host_span(self.span_name(rid, stage), start, end,
-                      event_type="RequestLifecycle")
+        name = self.span_name(rid, stage)
+        if self.tag:
+            name = f"{name}@{self.tag}"
+        add_host_span(name, start, end, event_type="RequestLifecycle")
         if not retain:
             return
         lst = self._events.setdefault(rid, [])

@@ -45,7 +45,16 @@ decoder models (LLaMA, GPT) with:
   prefix-affinity routing, spill-over admission, per-replica health
   states (degrade/heal/drain), hedged re-dispatch of stuck requests,
   and exactly-once journal-replay migration of every unfinished
-  request when a replica dies (`EngineDead`).
+  request when a replica dies (`EngineDead`);
+- `tp`: tensor parallelism — `ServingEngine(tp_size=N)` Megatron-shards
+  the model weights (column QKV/up, row O/down, one psum per block) and
+  the KV pools' kv-head axis over a sorted-device-id sub-mesh, wrapping
+  every serving executable in shard_map; sampling runs from the full
+  replicated logits on every shard, so tokens are bit-identical to
+  tp_size=1. `ServingCluster(tp_size=N)` carves jax.devices() into
+  `num_replicas x tp_size` disjoint sub-meshes. Page accounting,
+  scheduling, recovery and migration are untouched (one logical page =
+  tp physical slabs; the journal is device-independent).
 
 See README.md "paddle_tpu.serving" for knobs and parity notes.
 """
@@ -75,8 +84,24 @@ from .scheduler import (  # noqa: F401
     reserve_request_ids,
 )
 
+# TP exports stay LAZY (PEP 562): importing paddle_tpu.serving must not
+# load serving.tp — the tp_size=1 zero-touch guarantee is pinned by a
+# poisoned-module test
+_TP_EXPORTS = ("TPContext", "validate_tp_config", "tp_device_order")
+
+
+def __getattr__(name):
+    if name in _TP_EXPORTS:
+        from . import tp
+
+        return getattr(tp, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "ServingEngine", "ServingObs",
+    "TPContext", "validate_tp_config", "tp_device_order",
     "ServingCluster", "ClusterRequest", "ReplicaHandle",
     "PagedKVCache", "PagedLayerCache", "BlockAllocator",
     "PrefixCache", "PrefixNode",

@@ -17,6 +17,14 @@ Two paths, mirroring ops/pallas_kernels.py's selection policy:
 Both steps stay inside ONE jitted call per decode (T3's single-dispatch
 rule, arxiv 2401.16677): the write, the gather and the softmax never
 bounce logits or pages to the host.
+
+Tensor parallelism (serving.tp) needs no changes here: under shard_map
+each shard traces this op with the SAME code on shard-local shapes —
+kv pool slabs of num_kv_heads/tp heads, queries of num_heads/tp heads —
+while page tables, positions and lengths arrive replicated. Attention is
+embarrassingly parallel over heads, so the shard-local result is exact;
+the block's single psum lives downstream in the row-parallel O
+projection, never in the attention op itself.
 """
 from __future__ import annotations
 

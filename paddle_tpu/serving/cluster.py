@@ -187,7 +187,9 @@ class ServingCluster:
                  fault_injectors: Optional[Sequence[FaultInjector]] = None,
                  chaos_seed: Optional[int] = None,
                  journal_paths: Optional[Sequence[str]] = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 tp_size: int = 1,
+                 devices: Optional[Sequence] = None):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
         if placement not in ("load", "round_robin"):
@@ -222,6 +224,28 @@ class ServingCluster:
         self.metrics = metrics if metrics is not None else (
             MetricsRegistry() if enable_metrics else None)
         self._init_metrics()
+        # tensor parallelism (ISSUE 10): carve the local device list —
+        # SORTED by device id, so every process carves identically no
+        # matter how its jax.devices() happens to be ordered — into
+        # num_replicas disjoint tp_size-wide sub-meshes; replica i gets
+        # devices [i*tp : (i+1)*tp]. tp_size=1 touches zero TP code.
+        self.tp_size = int(tp_size)
+        if self.tp_size < 1:
+            raise ValueError(f"tp_size must be >= 1, got {tp_size}")
+        if self.tp_size > 1:
+            from .tp import tp_device_order
+
+            devs = tp_device_order(devices)
+            need = num_replicas * self.tp_size
+            if len(devs) < need:
+                raise ValueError(
+                    f"{num_replicas} replicas x tp_size={self.tp_size} "
+                    f"needs {need} devices, got {len(devs)}")
+            self._replica_devices: Optional[List[tuple]] = [
+                tuple(devs[i * self.tp_size:(i + 1) * self.tp_size])
+                for i in range(num_replicas)]
+        else:
+            self._replica_devices = None
         # factory protocol: pass only what the signature admits
         params = inspect.signature(factory).parameters
         varkw = any(p.kind == inspect.Parameter.VAR_KEYWORD
@@ -229,7 +253,15 @@ class ServingCluster:
         self._factory_kw = {
             "replica": varkw or "replica" in params,
             "fault_injector": varkw or "fault_injector" in params,
+            "tp_size": varkw or "tp_size" in params,
+            "devices": varkw or "devices" in params,
         }
+        if self.tp_size > 1 and not (self._factory_kw["tp_size"]
+                                     and self._factory_kw["devices"]):
+            raise ValueError(
+                "ServingCluster(tp_size>1) needs a factory that accepts "
+                "tp_size= and devices= keywords (or **kwargs) so each "
+                "replica's engine lands on its carved sub-mesh")
         self._factory = factory
         sup_kw = dict(supervisor_kw or {})
         self.replicas: List[ReplicaHandle] = []
@@ -343,6 +375,9 @@ class ServingCluster:
             if self._factory_kw["fault_injector"] \
                     and self.fault_injectors is not None:
                 kw["fault_injector"] = self.fault_injectors[index]
+            if self._replica_devices is not None:
+                kw["tp_size"] = self.tp_size
+                kw["devices"] = self._replica_devices[index]
             return self._factory(**kw)
         return make
 

@@ -212,6 +212,28 @@ class ServingObs:
                             "allocatable KV pages right now")
         self.kv_util = g("serving_kv_page_utilization",
                          "fraction of allocatable KV pages in use")
+        # tensor-parallel handles, bound by bind_tp() only when the
+        # engine runs with tp_size>1 — None means zero TP metrics work
+        self.tp_collective = None
+        self.tp_free_pages = None
+
+    def bind_tp(self, tp_size: int) -> None:
+        """TP observability (ISSUE 10): the measured all-reduce latency
+        histogram, one free-page gauge per shard (page accounting is
+        shard-replicated, so every shard reports the same number — the
+        label keeps per-shard dashboards well-formed), and a `tp=N` tag
+        appended to every lifecycle span name."""
+        r = self.registry
+        self.tp_collective = r.histogram(
+            "serving_tp_collective_seconds",
+            "measured all-reduce wall seconds on the engine's tp "
+            "sub-mesh (decode-step payload shape)")
+        self.tp_free_pages = [
+            r.gauge("serving_kv_pages_free",
+                    "free KV pages per tensor-parallel shard",
+                    labels={"shard": str(i)})
+            for i in range(tp_size)]
+        self.lifecycle.tag = f"tp={tp_size}"
 
     # --------------------------------------------------- scheduler hooks
     def enqueued(self, req) -> None:
@@ -247,6 +269,9 @@ class ServingObs:
         total = allocator.num_allocatable        # page 0 never allocates
         self.free_pages.set(free)
         self.kv_util.set(1.0 - free / total if total else 0.0)
+        if self.tp_free_pages is not None:
+            for shard_gauge in self.tp_free_pages:
+                shard_gauge.set(free)
 
 
 class ServingEngine:
@@ -268,12 +293,29 @@ class ServingEngine:
                  max_preemptions: Optional[int] = 8,
                  fault_injector=None,
                  retry_backoff_s: float = 0.02,
-                 journal=None):
+                 journal=None,
+                 tp_size: int = 1,
+                 devices: Optional[Sequence] = None):
         from ..models.generation import _config_of
 
         self.model = model
         model.eval()
         cfg = _config_of(model)
+        # tensor parallelism (ISSUE 10): tp_size>1 shards the model
+        # weights (Megatron column/row specs) and the KV pools' kv-head
+        # axis over a sub-mesh of `devices` (sorted by id; default the
+        # first tp_size of jax.devices()) and wraps every jitted step in
+        # shard_map. The import stays inside the branch: the tp_size=1
+        # path runs ZERO tp code (pinned by a raise-on-touch test)
+        self.tp_size = int(tp_size)
+        if self.tp_size < 1:
+            raise ValueError(f"tp_size must be >= 1, got {tp_size}")
+        if self.tp_size > 1:
+            from .tp import TPContext
+
+            self._tp = TPContext(model, self.tp_size, devices=devices)
+        else:
+            self._tp = None
         self.page_size = page_size
         self.max_batch_size = max_batch_size
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
@@ -315,6 +357,8 @@ class ServingEngine:
             num_pages = max_batch_size * self.max_pages_per_seq + 1
         self.cache = PagedKVCache.for_model(model, num_pages, page_size,
                                             cache_dtype)
+        if self._tp is not None:
+            self.cache.shard_pools(self._tp.mesh, self._tp.pool_spec)
         # observability: ONE registry per engine is the single source of
         # truth behind stats()/compile_counts() and the exporters. Pass
         # `metrics=` to aggregate several engines into a shared registry,
@@ -324,6 +368,8 @@ class ServingEngine:
             MetricsRegistry() if enable_metrics else None)
         self._obs = (ServingObs(self.metrics)
                      if self.metrics is not None else None)
+        if self._obs is not None and self._tp is not None:
+            self._obs.bind_tp(self.tp_size)
         if self.metrics is not None:
             self.cache.allocator.bind_metrics(self.metrics)
         # automatic prefix caching (full-page granularity, LRU eviction):
@@ -384,6 +430,9 @@ class ServingEngine:
                                    max_num_batched_tokens=
                                    self.max_num_batched_tokens)
         self.params, self.buffers = extract_state(model)
+        if self._tp is not None:
+            self.params = self._tp.shard_params(self.params)
+            self.buffers = self._tp.replicate(self.buffers)
         self.requests: Dict[int, Request] = {}
         # per-request PRNG state as raw (2,) uint32 key data, resident on
         # device — sampling never splits keys on the host
@@ -415,6 +464,14 @@ class ServingEngine:
         self._exec_shapes: Dict[str, set] = {
             "prefill": set(), "prefill_offset": set(),
             "prefill_chunked": set(), "decode": set(), "sample": set()}
+        # measure this sub-mesh's all-reduce latency ONCE at construction
+        # (a few samples of the decode-step payload shape) — blocking on
+        # a probe per step would measure device-queue time, not the
+        # collective; the bench phase takes denser samples when asked
+        if self._tp is not None and self._obs is not None:
+            for dt in self._tp.collective_seconds(
+                    samples=3, rows=self.max_batch_size):
+                self._obs.tp_collective.observe(dt)
 
     # ----------------------------------------------------------- request API
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
@@ -760,9 +817,14 @@ class ServingEngine:
         raise ValueError(f"prompt length {n} exceeds largest bucket")
 
     def _prefill_jit(self, bucket: int):
-        key = ("prefill", bucket)
+        # TP engines key per (tp degree, device subset) — the cache is
+        # shared model-wide, and cluster replicas on different sub-meshes
+        # must never exchange executables; tp_size=1 keys are UNCHANGED,
+        # so this PR compiles the exact same executables as before
+        tp = self._tp
+        key = ("prefill", bucket) + (tp.jit_key if tp is not None else ())
         if key not in self._jit_cache:
-            model = self.model
+            model = self.model if tp is None else tp.shard_model
 
             def prefill(params, buffers, ids, pools, page_table, last_idx,
                         key_data, temps, top_ks, top_ps):
@@ -779,6 +841,8 @@ class ServingEngine:
                 return (tok.astype(jnp.int32), key_data,
                         [(v.k_pool, v.v_pool) for v in new_views])
 
+            if tp is not None:
+                prefill = tp.wrap_prefill_exec(prefill)
             self._jit_cache[key] = jax.jit(prefill, donate_argnums=(3,))
         return self._jit_cache[key]
 
@@ -788,9 +852,11 @@ class ServingEngine:
         tokens sit at positions offset..offset+bucket-1 and attend over
         the cached prefix pages through the page table. One extra
         executable per bucket, shared by every hit length."""
-        key = ("prefill_offset", bucket)
+        tp = self._tp
+        key = (("prefill_offset", bucket)
+               + (tp.jit_key if tp is not None else ()))
         if key not in self._jit_cache:
-            model = self.model
+            model = self.model if tp is None else tp.shard_model
 
             def prefill(params, buffers, ids, pools, page_table, last_idx,
                         offset, key_data, temps, top_ks, top_ps):
@@ -807,6 +873,8 @@ class ServingEngine:
                 return (tok.astype(jnp.int32), key_data,
                         [(v.k_pool, v.v_pool) for v in new_views])
 
+            if tp is not None:
+                prefill = tp.wrap_prefill_exec(prefill)
             self._jit_cache[key] = jax.jit(prefill, donate_argnums=(3,))
         return self._jit_cache[key]
 
@@ -904,9 +972,11 @@ class ServingEngine:
         final chunk carries padding. The sampled token and split key are
         computed unconditionally (same trace for every chunk) but the
         host ADOPTS them only on the final chunk."""
-        key = ("prefill_chunked", self.prefill_chunk_tokens)
+        tp = self._tp
+        key = (("prefill_chunked", self.prefill_chunk_tokens)
+               + (tp.jit_key if tp is not None else ()))
         if key not in self._jit_cache:
-            model = self.model
+            model = self.model if tp is None else tp.shard_model
 
             def prefill(params, buffers, ids, pools, page_table, last_idx,
                         offset, key_data, temps, top_ks, top_ps):
@@ -923,6 +993,8 @@ class ServingEngine:
                 return (tok.astype(jnp.int32), key_data,
                         [(v.k_pool, v.v_pool) for v in new_views])
 
+            if tp is not None:
+                prefill = tp.wrap_prefill_exec(prefill)
             self._jit_cache[key] = jax.jit(prefill, donate_argnums=(3,))
         return self._jit_cache[key]
 
@@ -1006,9 +1078,10 @@ class ServingEngine:
         jitted lax.scan. Returns the (b, N) emitted block plus the
         device carries (tokens/positions/keys/budgets) the next chained
         block consumes without a host round-trip."""
-        key = ("decode", horizon)
+        tp = self._tp
+        key = ("decode", horizon) + (tp.jit_key if tp is not None else ())
         if key not in self._jit_cache:
-            model = self.model
+            model = self.model if tp is None else tp.shard_model
             page_size = self.page_size
 
             def decode_block(params, buffers, tokens, pools, page_tables,
@@ -1045,6 +1118,8 @@ class ServingEngine:
                 return (jnp.transpose(emitted), pools, tokens, positions,
                         key_data, remaining)
 
+            if tp is not None:
+                decode_block = tp.wrap_decode_exec(decode_block)
             self._jit_cache[key] = jax.jit(decode_block,
                                            donate_argnums=(3,))
         return self._jit_cache[key]
@@ -1326,6 +1401,10 @@ class ServingEngine:
             "decode_horizon": self.decode_horizon,
             "enable_chunked_prefill": self.enable_chunked_prefill,
             "enable_prefix_caching": self.prefix_cache is not None,
+            # informational only: the journal's token record is device-
+            # independent, so a snapshot taken at one tp degree restores
+            # at ANY tp degree (restore() never reads this key)
+            "tp_size": self.tp_size,
         }
         return EngineSnapshot(config=config, requests=snaps,
                               taken_wall=time.time())
@@ -1558,6 +1637,9 @@ class ServingEngine:
         s["decode_tokens_per_s"] = (
             s["tokens_generated"] / dt if dt > 0 else 0.0)
         s["decode_horizon"] = self.decode_horizon
+        s["tp_size"] = self.tp_size
+        if self._tp is not None:
+            s["tp"] = self._tp.describe()
         s["tokens_per_sync"] = (
             s["tokens_generated"] / s["host_syncs"]
             if s["host_syncs"] else 0.0)

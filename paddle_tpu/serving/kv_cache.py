@@ -272,6 +272,20 @@ class PagedKVCache:
         return cls(cfg.num_hidden_layers, num_pages, page_size, kv_heads,
                    head_dim, dtype)
 
+    def shard_pools(self, mesh, spec) -> None:
+        """Place every layer's (k, v) pool onto `mesh` under `spec` —
+        tensor-parallel serving shards the kv-head axis (`P("tp", ...)`)
+        so each device owns a (kv_heads/tp, num_pages, page_size,
+        head_dim) slab. The pools' LOGICAL shape, the allocator, page
+        ids and the null page are untouched: one logical page is tp
+        physical slabs, so all host-side accounting stays byte-identical
+        to the single-device layout."""
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(mesh, spec)
+        self.pools = [(jax.device_put(kp, sh), jax.device_put(vp, sh))
+                      for kp, vp in self.pools]
+
     def page_table_array(self, page_lists: Sequence[Sequence[int]],
                          max_pages: int) -> jnp.ndarray:
         """(B, max_pages) int32 device page table from host page lists,

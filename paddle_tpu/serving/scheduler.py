@@ -47,6 +47,15 @@ to decide which call. Policy:
   final chunk reserves through the first decode block exactly like
   unchunked `_admission_pages` — so a half-prefilled request holds pages
   only for the tokens it has actually computed.
+
+Tensor parallelism (serving.tp) changes NOTHING in this module: the
+scheduler runs on the host once per engine regardless of tp_size, and
+all of its state — free-page budget, page tables, chunk cursors,
+request ids — is shard-replicated by construction. One logical page
+simply denotes tp physical slabs of num_kv_heads/tp heads each, so
+admission, preemption and prefix-cache accounting are byte-identical
+to the tp_size=1 engine. Keeping the policy degree-blind is what makes
+cross-degree snapshot/restore and migration work without translation.
 """
 from __future__ import annotations
 

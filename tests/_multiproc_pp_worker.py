@@ -9,13 +9,12 @@ analog the single-controller engine structurally cannot exercise. Prints
 per-step losses; the parent asserts rank agreement and parity with the
 sequential (unpipelined) reference.
 """
-import os
-
 if __name__ == "__main__":
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    import jax
+    # force=True: a spawned worker must not inherit the parent pytest
+    # process's 8-device XLA_FLAGS
+    from _device_env import ensure_fake_devices
 
-    jax.config.update("jax_platforms", "cpu")
+    ensure_fake_devices(4, force=True)
     from paddle_tpu.distributed import env as dist_env
 
     dist_env.init_parallel_env()

@@ -11,17 +11,14 @@ the cross-host gradient all-reduce. Prints the per-step losses; the parent
 asserts both ranks agree and that the numbers match a single-process run
 over the same global batches.
 """
-import os
-
 # ALL process-level side effects (env clobber, backend pin, distributed
 # init) are gated on __main__: the pytest parent imports this module for
 # the model/dataset definitions and must not have its 8-device XLA_FLAGS
 # or dist-env state overwritten
 if __name__ == "__main__":
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    import jax
+    from _device_env import ensure_fake_devices
 
-    jax.config.update("jax_platforms", "cpu")
+    ensure_fake_devices(1, force=True)
     from paddle_tpu.distributed import env as dist_env
 
     dist_env.init_parallel_env()

@@ -7,24 +7,20 @@ Must run before jax is first imported.
 """
 import os
 
-# PADDLE_TPU_TEST_PLATFORM=tpu runs the suite on real hardware instead of the
-# hermetic 8-fake-device CPU default.
+from _device_env import ensure_fake_devices
+
+# PADDLE_TPU_TEST_PLATFORM=tpu runs the suite on real hardware instead of
+# the hermetic 8-fake-device CPU default. The axon sitecustomize pins
+# jax_platforms at interpreter start; ensure_fake_devices selects the
+# backend via config before any backend is initialized ("axon" skips the
+# pin; non-cpu platforms skip the fake-device flag).
 _plat = os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if _plat == "cpu" and "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+ensure_fake_devices(8 if _plat == "cpu" else None,
+                    platform=None if _plat == "axon" else _plat)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 import jax  # noqa: E402
-
-# The axon sitecustomize pins jax_platforms to "axon,cpu" at interpreter
-# start; env vars alone cannot undo that, so select the backend via config
-# before any backend is initialized.
-if _plat != "axon":
-    jax.config.update("jax_platforms", _plat)
 
 # full fp32 matmuls for numeric comparisons (TPU bench keeps its own default)
 jax.config.update("jax_default_matmul_precision", "highest")

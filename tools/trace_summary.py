@@ -25,7 +25,11 @@ Two views over a `*.pt.trace.json` (or any chrome://tracing JSON):
   `[r0->r2]`-style journey, migrations and hedges
   (`serving.cluster.migrate[<rid>].r0->r2`, `...hedge[...]`) interleave
   as `>> migrated r0->r2` markers, and a per-replica lane summary maps
-  each replica to the requests it carried.
+  each replica to the requests it carried. Tensor-parallel engines
+  (serving/tp.py) suffix every lifecycle span with `@tp=N`; the suffix
+  is stripped from the timeline stages, each request header shows its
+  `@tp=N`, and the TP degree(s) present print in the report's header
+  line.
 
 Usage:
     python tools/trace_summary.py TRACE.json [--top N] [--requests]
@@ -42,6 +46,10 @@ import sys
 from typing import Dict, List, Tuple
 
 REQUEST_RE = re.compile(r"^serving\.request\[(\d+)\]\.(.+)$")
+# deployment tag a tensor-parallel engine appends to every lifecycle
+# span name (`serving.request[3].prefill@tp=2`): stripped from the stage
+# for the timeline, surfaced in the request header instead
+STAGE_TAG_RE = re.compile(r"^(.+)@(tp=\d+)$")
 # EngineSupervisor restart spans (recovery.py): one per engine rebuild,
 # named serving.recovery[<epoch>].<reason>
 RECOVERY_RE = re.compile(r"^serving\.recovery\[(\d+)\]\.(.+)$")
@@ -112,10 +120,27 @@ def request_timelines(events: List[dict]
     for e in _complete_events(events):
         m = REQUEST_RE.match(e.get("name", ""))
         if m:
+            stage = m.group(2)
+            tm = STAGE_TAG_RE.match(stage)
+            if tm:
+                stage = tm.group(1)
             out.setdefault(int(m.group(1)), []).append(
-                (m.group(2), float(e["ts"]), float(e.get("dur", 0))))
+                (stage, float(e["ts"]), float(e.get("dur", 0))))
     for evs in out.values():
         evs.sort(key=lambda x: x[1])
+    return out
+
+
+def request_tags(events: List[dict]) -> Dict[int, str]:
+    """rid -> deployment tag (e.g. "tp=2") for requests whose lifecycle
+    spans carry one; untagged requests are absent."""
+    out: Dict[int, str] = {}
+    for e in _complete_events(events):
+        m = REQUEST_RE.match(e.get("name", ""))
+        if m:
+            tm = STAGE_TAG_RE.match(m.group(2))
+            if tm:
+                out[int(m.group(1))] = tm.group(2)
     return out
 
 
@@ -174,13 +199,20 @@ BAD_TERMINALS = ("failed", "expired", "shed")
 def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]],
                     restarts: List[Tuple[int, str, float, float]] = (),
                     moves: Dict[int, List[Tuple[str, str, str, float,
-                                                float]]] = {}
-                    ) -> str:
+                                                float]]] = {},
+                    tags: Dict[int, str] = {}) -> str:
     if not timelines:
         return ("no serving.request[<rid>].<stage> spans in this trace "
                 "(export one from a metrics-enabled ServingEngine run "
                 "inside an armed profiler window)")
     lines = []
+    if tags:
+        # TP degree(s) seen across the trace, in the header line — a
+        # mixed-degree cluster (e.g. a tp=2 corpse migrated onto a tp=1
+        # survivor) legitimately lists several
+        degrees = sorted(set(tags.values()))
+        lines.append(f"tensor-parallel: {', '.join(degrees)}")
+        lines.append("")
     bad_counts: Dict[str, int] = {}
     recovered_count = 0
     migrations = hedges = 0
@@ -202,6 +234,8 @@ def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]],
         for tag in journey:
             lanes.setdefault(tag, []).append(rid)
         lane = f" [{'->'.join(journey)}]" if journey else ""
+        if rid in tags:
+            lane += f" @{tags[rid]}"
         if bad is not None:
             bad_counts[bad] = bad_counts.get(bad, 0) + 1
             lines.append(f"request {rid}{lane}:  !! {bad}")
@@ -287,7 +321,8 @@ def main(argv=None) -> int:
         print()
         print(format_requests(request_timelines(events),
                               restarts=recovery_epochs(events),
-                              moves=cluster_moves(events)))
+                              moves=cluster_moves(events),
+                              tags=request_tags(events)))
     return 0
 
 

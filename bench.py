@@ -276,6 +276,36 @@ def _tiny_serving_model():
     return model, cfg
 
 
+def _run_lint() -> dict:
+    """graftlint phase: the static-analysis gate's JSON report embedded in
+    the bench detail, so a hazard count regression shows up next to the
+    perf numbers it predicts. Pure AST in a subprocess — no jax, runs
+    before the backend comes up. Non-fatal: a failure is recorded, not
+    raised (the gate itself is tests/test_lint.py; the bench only
+    observes)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join("tools", "graftlint.py"),
+             "paddle_tpu", "--format", "json"],
+            capture_output=True, text=True, timeout=120,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        report = json.loads(proc.stdout)
+        out = {
+            "clean": report["clean"],
+            "unbaselined": report["unbaselined_count"],
+            "baselined": report["baselined_count"],
+            "stale_baseline": report["stale_baseline_count"],
+            "by_rule": report["by_rule"],
+        }
+        _log(f"phase=lint: {'clean' if out['clean'] else 'DIRTY'} "
+             f"({out['unbaselined']} unbaselined, "
+             f"{out['baselined']} baselined)")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=lint: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def _run_serving_prefix(on_tpu: bool) -> dict:
     """Shared-system-prompt serving phase: ttft with the prefix cache on
     vs off plus hit rate (benchmarks/generation_bench.py's phase, reused
@@ -598,6 +628,10 @@ def bench_child() -> None:
     # head, ~4-6 min each through the relay) + measurement; the per-phase
     # bench_partial.json still rescues a mid-run wedge
     _start_watchdog(float(os.environ.get("BENCH_WATCHDOG_SECS", "1250")))
+    # static-analysis snapshot first: pure AST, no backend, ~1s — a lint
+    # regression is visible even if every later phase wedges
+    _enter_phase("lint", 150.0)
+    lint = _run_lint()
     _enter_phase("init")
     _log("phase=init: importing jax")
     import jax
@@ -795,6 +829,7 @@ def bench_child() -> None:
                 "serving_chunked": serving_chunked,
                 "serving_recovery": serving_recovery,
                 "serving_cluster": serving_cluster,
+                "lint": lint,
                 "observability": _obs_snapshot(),
             },
         }

@@ -1,0 +1,36 @@
+"""graftlint — AST-based static analysis for this repo's JAX hazard classes.
+
+Pure-stdlib (never imports jax): the tier-1 gate must stay cheap and run
+before any backend comes up. Each rule encodes a bug class this repo has
+actually shipped — see rules/*.py docstrings for the postmortems.
+
+Entry points:
+
+    from paddle_tpu.analysis import run_paths, run_source, all_rules
+    findings = run_paths(["paddle_tpu"], root=repo_root)
+
+Suppression contract (two mechanisms, both explicit):
+
+  * inline  — ``# noqa: <CODE> — <reason>`` on the flagged line. Codes are
+    rule names (``SWALLOWED-API``) or their aliases (``BLE001``). A bare
+    ``# noqa`` suppresses every rule on that line.
+  * baseline — ``tools/graftlint_baseline.json`` entries keyed by a
+    line-drift-stable fingerprint; each carries a human reason. The gate
+    fails on any finding in neither set.
+"""
+from .core import (  # noqa: F401
+    Finding,
+    ModuleCache,
+    ParsedModule,
+    Rule,
+)
+from .baseline import Baseline, load_baseline  # noqa: F401
+from .runner import iter_python_files, run_paths, run_source  # noqa: F401
+from .rules import all_rules, get_rule  # noqa: F401
+
+__all__ = [
+    "Finding", "ModuleCache", "ParsedModule", "Rule",
+    "Baseline", "load_baseline",
+    "iter_python_files", "run_paths", "run_source",
+    "all_rules", "get_rule",
+]

@@ -1,0 +1,103 @@
+"""Checked-in baseline: intentional findings made explicit, with reasons.
+
+``tools/graftlint_baseline.json`` is the second suppression mechanism
+(inline ``# noqa`` being the first). Every entry carries the finding's
+fingerprint — stable across line drift — plus the human-facing context
+(path/line/snippet) and a mandatory ``reason``. ``--baseline-update``
+regenerates entries while preserving reasons for fingerprints that
+survive, so a refreshed baseline never silently drops its rationale.
+"""
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import Finding
+
+_FORMAT_VERSION = 1
+
+
+@dataclass
+class Baseline:
+    entries: Dict[str, dict] = field(default_factory=dict)  # fp -> entry
+
+    def __contains__(self, finding: Finding) -> bool:
+        return finding.fingerprint in self.entries
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def split(self, findings: Iterable[Finding]) -> Tuple[List[Finding],
+                                                          List[Finding]]:
+        """(unbaselined, baselined)."""
+        fresh: List[Finding] = []
+        known: List[Finding] = []
+        for f in findings:
+            (known if f in self else fresh).append(f)
+        return fresh, known
+
+    def stale_entries(self, findings: Iterable[Finding]) -> List[dict]:
+        """Entries whose finding no longer occurs — fixed code whose
+        baseline debt should be deleted (reported, not fatal)."""
+        live = {f.fingerprint for f in findings}
+        return [e for fp, e in self.entries.items() if fp not in live]
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding],
+                      reasons: Optional[Dict[str, str]] = None,
+                      default_reason: str = "baselined pending triage",
+                      ) -> "Baseline":
+        reasons = reasons or {}
+        entries: Dict[str, dict] = {}
+        for f in findings:
+            fp = f.fingerprint
+            entries[fp] = {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,          # informational; fp is the key
+                "snippet": f.snippet,
+                "fingerprint": fp,
+                "reason": reasons.get(fp, default_reason),
+            }
+        return cls(entries)
+
+    def carry_reasons_from(self, old: "Baseline") -> None:
+        for fp, entry in self.entries.items():
+            prev = old.entries.get(fp)
+            if prev is not None and prev.get("reason"):
+                entry["reason"] = prev["reason"]
+
+    def dump(self, path: str) -> None:
+        ordered = sorted(self.entries.values(),
+                         key=lambda e: (e["path"], e["rule"], e["line"]))
+        doc = {"version": _FORMAT_VERSION, "entries": ordered}
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    def to_json(self) -> dict:
+        return {"version": _FORMAT_VERSION,
+                "entries": sorted(self.entries.values(),
+                                  key=lambda e: (e["path"], e["rule"],
+                                                 e["line"]))}
+
+
+def load_baseline(path: Optional[str]) -> Baseline:
+    """Missing file -> empty baseline (a fresh checkout lints clean only
+    if the tree is clean). Malformed JSON raises: a corrupt suppression
+    store must never silently allow everything."""
+    if path is None:
+        return Baseline()
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return Baseline()
+    if not isinstance(doc, dict) or "entries" not in doc:
+        raise ValueError(f"{path}: not a graftlint baseline file")
+    entries: Dict[str, dict] = {}
+    for e in doc["entries"]:
+        fp = e.get("fingerprint")
+        if not fp:
+            raise ValueError(f"{path}: baseline entry missing fingerprint: {e}")
+        entries[fp] = dict(e)
+    return Baseline(entries)

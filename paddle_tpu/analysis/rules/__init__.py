@@ -1,0 +1,32 @@
+"""Rule registry: one module per hazard class, all pure-AST."""
+from typing import Dict, List
+
+from ..core import Rule
+from .swallowed_api import SwallowedApiRule
+from .stale_capture import StaleCaptureRule
+from .traced_branch import TracedBranchRule
+from .host_sync import HostSyncRule
+from .wallclock_replay import WallclockInReplayRule
+from .jit_cache_key import JitCacheKeyRule
+
+_RULES: List[Rule] = [
+    SwallowedApiRule(),
+    StaleCaptureRule(),
+    TracedBranchRule(),
+    HostSyncRule(),
+    WallclockInReplayRule(),
+    JitCacheKeyRule(),
+]
+
+
+def all_rules() -> List[Rule]:
+    return list(_RULES)
+
+
+def get_rule(name: str) -> Rule:
+    wanted = name.upper()
+    for rule in _RULES:
+        if wanted in {c.upper() for c in rule.codes}:
+            return rule
+    known = ", ".join(r.name for r in _RULES)
+    raise KeyError(f"unknown rule {name!r} (known: {known})")

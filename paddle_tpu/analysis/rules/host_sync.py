@@ -1,0 +1,142 @@
+"""HOST-SYNC — device→host round-trips inside the serving hot path.
+
+Every ``.item()`` / ``np.asarray`` / ``jax.device_get`` on a jitted
+output blocks the host on the device stream. PR 3 spent an entire
+tentpole getting the decode loop down to ONE host sync per horizon
+block; a stray ``int(tokens[i])`` added in the scheduler would quietly
+serialize the async pipeline and show up only as a throughput regression
+three PRs later.
+
+Scope is intentionally narrow: the rule applies only to
+``serving/engine.py`` and ``serving/scheduler.py``, and within those
+only to functions *reachable from the hot roots* (`ServingEngine.step`,
+`Scheduler.schedule`) through same-module calls — the call graph is
+computed over the AST (``self.f()`` / bare ``f()`` edges), so a helper
+newly wired into the step path is covered automatically while cold
+paths (add_request, snapshot/restore, stats) stay out of scope.
+
+Fires on: ``.item()``, ``.tolist()``, ``.block_until_ready()``,
+``np.asarray``/``np.array``/``np.copy``, ``jax.device_get``, and
+``int()``/``float()``/``bool()`` over a subscript or call result (the
+typical scalar read off a device array). ``jnp.asarray`` is device-side
+and clean.
+
+The one *intentional* sync per decode block carries
+``# noqa: HOST-SYNC — <reason>`` or a baseline entry — the point is
+that it is explicit, audited, and unique.
+"""
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, ParsedModule, Rule, dotted_chain
+
+_HOT_FILES = ("serving/engine.py", "serving/scheduler.py")
+_HOT_ROOTS = {"step", "schedule"}
+_SYNC_METHOD_TAILS = {"item", "tolist", "block_until_ready"}
+_SYNC_CHAINS = {
+    ("np", "asarray"), ("np", "array"), ("np", "copy"),
+    ("numpy", "asarray"), ("numpy", "array"),
+    ("jax", "device_get"),
+}
+_CAST_FUNCS = {"int", "float", "bool"}
+
+
+def _function_table(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> def nodes (methods of any class and free functions alike;
+    the serving modules have no colliding hot names)."""
+    table: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            table.setdefault(node.name, []).append(node)
+    return table
+
+
+def _called_names(fn: ast.AST) -> Set[str]:
+    """Names invoked as `self.f(...)`, `cls.f(...)` or `f(...)` in fn."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            out.add(f.id)
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name)
+              and f.value.id in {"self", "cls"}):
+            out.add(f.attr)
+    return out
+
+
+def _reachable(table: Dict[str, List[ast.AST]],
+               roots: Set[str]) -> Set[str]:
+    seen: Set[str] = set()
+    frontier = [r for r in roots if r in table]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for fn in table[name]:
+            for callee in _called_names(fn):
+                if callee in table and callee not in seen:
+                    frontier.append(callee)
+    return seen
+
+
+def _walk_own(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's own statements, NOT descending into nested
+    defs: those are either traced closures (device world — jnp calls
+    there are not host syncs) or reachable by name on their own.
+    Lambdas ARE descended into — hot-path lambdas (profiler thunks,
+    drain callbacks) run inline on the host."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _sync_hit(node: ast.Call) -> Optional[str]:
+    chain = dotted_chain(node.func)
+    if chain is not None:
+        if tuple(chain) in _SYNC_CHAINS:
+            return ".".join(chain)
+        if len(chain) == 1 and chain[0] in _CAST_FUNCS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, (ast.Subscript, ast.Call)):
+                return f"{chain[0]}(...)"
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _SYNC_METHOD_TAILS and not node.args:
+        return f".{node.func.attr}()"
+    return None
+
+
+class HostSyncRule(Rule):
+    name = "HOST-SYNC"
+    description = ("device->host sync (.item()/np.asarray/device_get/"
+                   "scalar casts) inside the decode/step hot path of "
+                   "serving/engine.py and serving/scheduler.py")
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        if not module.path.replace("\\", "/").endswith(_HOT_FILES):
+            return
+        table = _function_table(module.tree)
+        hot = _reachable(table, _HOT_ROOTS)
+        hits: List[Tuple[int, str]] = []
+        for name in sorted(hot):
+            for fn in table[name]:
+                for node in _walk_own(fn):
+                    if isinstance(node, ast.Call):
+                        what = _sync_hit(node)
+                        if what is not None:
+                            hits.append((
+                                node.lineno,
+                                f"host sync `{what}` inside hot-path "
+                                f"function `{name}` (reachable from "
+                                f"step/schedule) — each one blocks the "
+                                f"async decode pipeline; batch it into "
+                                f"the per-block drain or annotate "
+                                f"`# noqa: HOST-SYNC — <reason>`"))
+        yield from self.findings(module, hits)

@@ -1,0 +1,95 @@
+"""TRACED-BRANCH — Python control flow on traced array values.
+
+Inside a function that jax traces, ``if``/``while`` on a value derived
+from a jax array either raises a ConcretizationTypeError (best case) or
+— under ``jax.ensure_compile_time_eval`` / weak-type promotion corners —
+bakes ONE branch into the executable for every future input. The repo's
+decode path is a lax.scan over fused sampling precisely because of this;
+a new contributor re-adding ``if jnp.any(done): break`` inside the block
+would compile-freeze the first step's predicate.
+
+Heuristic, deliberately shallow (one forward pass, no fixpoint):
+
+  * a function counts as traced when it is jit-decorated or passed to a
+    trace entry point (jax.jit, lax.scan/cond/while_loop, shard_map,
+    vmap, pallas_call, ...) in an enclosing scope;
+  * names assigned from a jax/jnp/lax call inside that function are
+    tainted, and propagate through expressions over tainted names;
+  * an ``if``/``while`` whose test reads a tainted name — or calls a jax
+    API directly in the test — fires. Static escapes (``.shape``,
+    ``.ndim``, ``.dtype``, ``.size``, ``len()``, ``isinstance``,
+    ``is``/``is None``) are recognized and stay clean.
+
+Function *parameters* are not tainted: static Python config flags on
+traced functions are the common, legitimate case.
+
+Suppress with ``# noqa: TRACED-BRANCH — <reason>``.
+"""
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from ..core import Finding, ParsedModule, Rule, dotted_chain, traced_functions
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+_HOST_BUILTINS = {"isinstance", "len", "hasattr", "getattr", "callable",
+                  "type", "id", "repr", "str"}
+
+
+def _expr_tainted(node: ast.AST, tainted: Set[str],
+                  jax_aliases: Set[str]) -> bool:
+    """Recursive taint evaluator with static-escape pruning."""
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False  # .shape/.dtype/... are static at trace time
+        return _expr_tainted(node.value, tainted, jax_aliases)
+    if isinstance(node, ast.Call):
+        chain = dotted_chain(node.func)
+        if chain is not None:
+            if chain[0] in _HOST_BUILTINS and chain[0] not in jax_aliases:
+                return False  # result is a host-level value
+            if chain[0] in jax_aliases and chain[-1] not in _STATIC_ATTRS:
+                return True  # e.g. `if jnp.any(mask):`
+        return any(_expr_tainted(c, tainted, jax_aliases)
+                   for c in [node.func] + list(node.args)
+                   + [kw.value for kw in node.keywords])
+    if isinstance(node, ast.Name):
+        return isinstance(node.ctx, ast.Load) and node.id in tainted
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False  # `x is None` — host-level identity
+    return any(_expr_tainted(c, tainted, jax_aliases)
+               for c in ast.iter_child_nodes(node))
+
+
+class TracedBranchRule(Rule):
+    name = "TRACED-BRANCH"
+    description = ("Python if/while on values derived from jax arrays "
+                   "inside traced functions — use lax.cond/select/while_loop")
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        hits: List[Tuple[int, str]] = []
+        aliases = module.jax_aliases
+        for info in traced_functions(module):
+            body = getattr(info.node, "body", None)
+            if body is None:
+                continue  # a Lambda cannot contain statements
+            tainted: Set[str] = set()
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Assign):
+                        if _expr_tainted(node.value, tainted, aliases):
+                            for t in node.targets:
+                                for n in ast.walk(t):
+                                    if isinstance(n, ast.Name):
+                                        tainted.add(n.id)
+                    elif isinstance(node, (ast.If, ast.While)):
+                        if _expr_tainted(node.test, tainted, aliases):
+                            kind = ("while" if isinstance(node, ast.While)
+                                    else "if")
+                            hits.append((
+                                node.test.lineno,
+                                f"`{kind}` on a traced array value inside "
+                                f"`{info.name}` ({info.traced_via}) — the "
+                                f"predicate is baked in at trace time; use "
+                                f"lax.cond / lax.while_loop / jnp.where"))
+        yield from self.findings(module, hits)

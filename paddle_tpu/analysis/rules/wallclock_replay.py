@@ -1,0 +1,108 @@
+"""WALLCLOCK-IN-REPLAY — nondeterminism in the replay-deterministic paths.
+
+The exactly-once guarantees of crash recovery (PR 7) and cluster
+migration (PR 8) rest on one property: re-running the journal produces
+bit-identical tokens. Anything that samples the wall clock, an unseeded
+RNG, or set iteration order inside those paths can make a replayed
+decision diverge from the original — a hazard no finite test matrix can
+exhaust, which is why it gets a standing rule instead of more tests.
+
+Scope: ``serving/recovery.py`` and ``serving/cluster.py`` (the journal,
+snapshot/restore, supervisor, and migration machinery).
+
+Fires on:
+  * ``time.time()`` / ``time.time_ns()`` / ``datetime.now()`` etc. —
+    wall-clock reads (``time.perf_counter`` is allowed: it feeds
+    metrics/watchdogs, never journaled decisions);
+  * ``random.*`` / ``np.random.*`` — unseeded global RNG streams
+    (``jax.random`` is explicitly keyed and fine);
+  * iterating directly over a ``set(...)`` / set literal in a ``for`` or
+    comprehension — order varies across processes, so any journaled
+    consequence of the order diverges on replay (wrap in ``sorted()``).
+
+Built-in allowlist: a flagged line mentioning a ``*_wall`` binding is
+skipped — the ``deadline_wall``/``arrival_wall`` anchoring is the one
+*intentional* wall-clock dependency (deadlines must survive an outage in
+wall time, and the translation is re-anchored on restore). Naming the
+binding ``*_wall`` IS the declaration of that intent.
+
+Suppress elsewhere with ``# noqa: WALLCLOCK-IN-REPLAY — <reason>``.
+"""
+import ast
+import re
+from typing import Iterator, List, Optional, Tuple
+
+from ..core import Finding, ParsedModule, Rule, dotted_chain
+
+_SCOPE_FILES = ("serving/recovery.py", "serving/cluster.py")
+_WALL_RE = re.compile(r"\b\w*_wall\b|\bdeadline_wall\b")
+
+_WALLCLOCK_CHAINS = {
+    ("time", "time"), ("time", "time_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("datetime", "datetime", "now"), ("datetime", "datetime", "utcnow"),
+    ("datetime", "date", "today"),
+}
+_RNG_ROOTS = {"random"}           # the stdlib module
+_NP_ROOTS = {"np", "numpy"}
+
+
+def _wallclock_hit(chain: Tuple[str, ...]) -> Optional[str]:
+    if chain in _WALLCLOCK_CHAINS:
+        return ".".join(chain) + "()"
+    if chain[0] in _RNG_ROOTS and len(chain) > 1:
+        return ".".join(chain) + "()"
+    if (chain[0] in _NP_ROOTS and len(chain) > 2 and chain[1] == "random"):
+        return ".".join(chain) + "()"
+    return None
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Call):
+        chain = dotted_chain(node.func)
+        return chain == ["set"] or chain == ["frozenset"]
+    return False
+
+
+class WallclockInReplayRule(Rule):
+    name = "WALLCLOCK-IN-REPLAY"
+    description = ("wall-clock/unseeded-RNG/set-iteration-order "
+                   "dependence in the replay-deterministic recovery and "
+                   "migration paths")
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        if not module.path.replace("\\", "/").endswith(_SCOPE_FILES):
+            return
+        hits: List[Tuple[int, str]] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                chain = dotted_chain(node.func)
+                if chain is not None:
+                    what = _wallclock_hit(tuple(chain))
+                    if what is not None:
+                        if _WALL_RE.search(module.line_text(node.lineno)):
+                            continue  # the *_wall anchoring allowlist
+                        hits.append((
+                            node.lineno,
+                            f"`{what}` in a replay-deterministic path — a "
+                            f"replayed run will see a different value and "
+                            f"diverge from the journal; derive it from "
+                            f"journaled state, inject a clock, or bind it "
+                            f"to a `*_wall` anchor"))
+            iters: List[ast.AST] = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(g.iter for g in node.generators)
+            for it in iters:
+                if _is_set_expr(it):
+                    hits.append((
+                        it.lineno,
+                        "iteration over a set in a replay-deterministic "
+                        "path — element order varies across processes, so "
+                        "any journaled consequence diverges on replay; "
+                        "wrap in sorted(...)"))
+        yield from self.findings(module, hits)

@@ -1,0 +1,87 @@
+"""Drive the rules over files/trees and produce findings + reports."""
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+from .core import Finding, ModuleCache, Rule
+from .rules import all_rules
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    """Expand files/directories into .py files, deterministic order."""
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def _rel(path: str, root: Optional[str]) -> str:
+    if root is not None:
+        try:
+            rel = os.path.relpath(path, root)
+            if not rel.startswith(".."):
+                return rel.replace(os.sep, "/")
+        except ValueError:
+            pass  # different drive on windows
+    return path.replace(os.sep, "/")
+
+
+def run_paths(paths: Sequence[str],
+              rules: Optional[Sequence[Rule]] = None,
+              root: Optional[str] = None,
+              cache: Optional[ModuleCache] = None) -> List[Finding]:
+    """Analyze all .py files under `paths`; findings carry paths relative
+    to `root` (so baselines are checkout-location independent). Inline
+    noqa suppressions are already applied; baseline filtering is the
+    caller's job (the CLI/gate owns the baseline)."""
+    rules = list(rules) if rules is not None else all_rules()
+    cache = cache or ModuleCache()
+    findings: List[Finding] = []
+    for filename in iter_python_files(paths):
+        module = cache.parse_file(filename, _rel(filename, root))
+        if module is None:
+            continue
+        for rule in rules:
+            findings.extend(rule.check(module))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run_source(source: str, path: str = "<memory>",
+               rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Analyze one in-memory snippet (the fixture-test entry point)."""
+    rules = list(rules) if rules is not None else all_rules()
+    cache = ModuleCache()
+    module = cache.parse_source(source, path)
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(module))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def report_json(findings: Sequence[Finding],
+                baselined: Sequence[Finding] = (),
+                stale: Sequence[dict] = (),
+                errors: Optional[Dict[str, str]] = None) -> dict:
+    """Machine-readable report (bench.py embeds this as a `lint` phase)."""
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "unbaselined": [f.to_json() for f in findings],
+        "unbaselined_count": len(findings),
+        "baselined_count": len(baselined),
+        "stale_baseline_count": len(stale),
+        "by_rule": dict(sorted(by_rule.items())),
+        "parse_errors": dict(errors or {}),
+        "clean": not findings and not (errors or {}),
+    }

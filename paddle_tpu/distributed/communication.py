@@ -15,6 +15,7 @@ In-place semantics follow paddle: all_reduce/broadcast rebind tensor._data.
 """
 from __future__ import annotations
 
+import warnings
 from typing import List, Optional
 
 import jax
@@ -41,6 +42,11 @@ class ReduceOp:
     AVG = 4
 
 
+#: one-time flag: warn the first time the jax._src fast path breaks, so a
+#: jax upgrade that drops the private API is visible, not silent.
+_PRIVATE_PROBE_WARNED = False
+
+
 def _axis_in_scope(axis_name: str) -> bool:
     """True when `axis_name` is a live named axis (inside shard_map/pmap).
 
@@ -48,7 +54,9 @@ def _axis_in_scope(axis_name: str) -> bool:
     eager fallbacks go through _no_axis_identity_ok, which raises for any
     >1-rank group. The broad except around the private-API fast path is
     deliberate — on any jax._src drift we fall THROUGH to the public probe,
-    never out of the collective."""
+    never out of the collective — but the first such drift warns once so a
+    jax bump can never silently degrade this probe."""
+    global _PRIVATE_PROBE_WARNED
     try:
         from jax._src import core as jcore
 
@@ -56,8 +64,15 @@ def _axis_in_scope(axis_name: str) -> bool:
             frame = jcore.get_axis_env()
             if frame is not None:
                 return axis_name in frame.axis_sizes
-    except Exception:  # noqa: BLE001 — private API; fall through to public
-        pass
+    except Exception as e:  # noqa: BLE001 — private API; fall through to
+        # the public probe (never out of the collective), warning once
+        if not _PRIVATE_PROBE_WARNED:
+            _PRIVATE_PROBE_WARNED = True
+            warnings.warn(
+                f"jax._src axis-env probe failed ({type(e).__name__}: {e}); "
+                f"falling back to the public axis probe — check this jax "
+                f"version's private-API layout",
+                RuntimeWarning, stacklevel=2)
     try:
         axis_size = getattr(jax.lax, "axis_size", None)     # jax >= 0.5
         if axis_size is None:                               # jax 0.4.x:

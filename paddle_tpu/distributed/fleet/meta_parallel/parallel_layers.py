@@ -137,6 +137,10 @@ class RowParallelLinear(nn.Layer):
 def _constrain_last(t: Tensor, axis: Optional[str]):
     """with_sharding_constraint on the LAST dim of t (None = replicated);
     no-op outside jit/mesh contexts."""
+    if getattr(t, "_data", None) is None:
+        # static-graph Variable during program capture (no device value);
+        # the fleet passes apply sharding on the Program instead
+        return t
     try:
         from jax.sharding import PartitionSpec as P
 
@@ -146,7 +150,12 @@ def _constrain_last(t: Tensor, axis: Optional[str]):
         out._grad_node = t._grad_node
         out._out_index = t._out_index
         return out
-    except Exception:
+    except (ImportError, RuntimeError, ValueError, TypeError):
+        # no mesh at the call site (RuntimeError on this jax) or an axis
+        # name the mesh lacks — the documented no-op path. Deliberately
+        # NOT a broad except: AttributeError from jax API drift must
+        # propagate instead of silently dropping the sharding constraint
+        # (the PR 5 silent-degradation class).
         return t
 
 

@@ -58,7 +58,11 @@ def ring_flash_attention(q, k, v, group=None, causal: bool = False,
 
     try:
         n = _axis_size(name)
-    except (NameError, KeyError, Exception):
+    except (NameError, KeyError, TypeError, ValueError):
+        # no live sep axis (eager / outside shard_map) -> local-only.
+        # Deliberately NOT broad: an AttributeError from jax API
+        # drift in _axis_size must propagate, not silently shrink
+        # the ring to the local shard (the PR 5 wrong-result bug).
         n = 1
     if n == 1:
         out = _flash_block(qd, kd, vd, scale, causal, 0, 0, None)
@@ -148,7 +152,11 @@ def ulysses_attention(q, k, v, group=None, causal: bool = False,
     name = axis_name or (group.axis_name if group is not None else "sep")
     try:
         n = _axis_size(name)
-    except (NameError, KeyError, Exception):
+    except (NameError, KeyError, TypeError, ValueError):
+        # no live sep axis (eager / outside shard_map) -> local-only.
+        # Deliberately NOT broad: an AttributeError from jax API
+        # drift in _axis_size must propagate, not silently shrink
+        # the ring to the local shard (the PR 5 wrong-result bug).
         n = 1
     scale = scale if scale is not None else qd.shape[-1] ** -0.5
     if n == 1:

@@ -26,6 +26,10 @@ __all__ = [
 
 
 def _constrain_dim(t: Tensor, dim: int, axis):
+    if getattr(t, "_data", None) is None:
+        # static-graph Variable during program capture (no device value);
+        # the fleet passes apply sharding on the Program instead
+        return t
     try:
         from jax.sharding import PartitionSpec as P
 
@@ -36,7 +40,12 @@ def _constrain_dim(t: Tensor, dim: int, axis):
         out._grad_node = t._grad_node
         out._out_index = t._out_index
         return out
-    except Exception:
+    except (ImportError, RuntimeError, ValueError, TypeError):
+        # no mesh at the call site (RuntimeError on this jax) or an axis
+        # name the mesh lacks — the documented no-op path. Deliberately
+        # NOT a broad except: AttributeError from jax API drift must
+        # propagate instead of silently dropping the sharding constraint
+        # (the PR 5 silent-degradation class).
         return t
 
 

@@ -134,5 +134,7 @@ class TCPStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: BLE001 — interpreter teardown: the
+            # ctypes lib or socket may already be gone; raising in
+            # __del__ only prints noise to stderr
             pass

@@ -146,7 +146,10 @@ def generate(model, input_ids, max_new_tokens=32, temperature=1.0,
         # jitted steps are memoized on the model: jax's jit cache is keyed
         # by function identity, so fresh closures per call would recompile
         # every generate() invocation
-        cache_key = (b, prompt_len, total, float(temperature), int(top_k),
+        # key omissions are deliberate: `model` scopes the cache dict
+        # itself (model.__dict__), `seed` enters as the traced key arg,
+        # and num_beams>1 dispatched to _beam_generate above
+        cache_key = (b, prompt_len, total, float(temperature), int(top_k),  # noqa: JIT-CACHE-KEY — omitted params scoped/traced, see above
                      float(top_p), jnp.dtype(cache_dtype).name,
                      eos_token_id)
         jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
@@ -233,7 +236,9 @@ def _beam_generate(model, input_ids, max_new_tokens, num_beams,
         caches = init_caches(model, b * k, total, cache_dtype)
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
-        cache_key = ("beam", b, k, prompt_len, total,
+        # `model` scopes the cache dict itself; `length_penalty` is only
+        # used in the eager post-loop ranking, never inside the traced fns
+        cache_key = ("beam", b, k, prompt_len, total,  # noqa: JIT-CACHE-KEY — omitted params scoped/eager, see above
                      jnp.dtype(cache_dtype).name, eos)
         jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
         if cache_key not in jit_cache:

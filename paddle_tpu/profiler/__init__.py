@@ -152,7 +152,8 @@ class RecordEvent:
 
             self._annotation = jp.TraceAnnotation(self.name)
             self._annotation.__enter__()
-        except Exception:
+        except Exception:  # noqa: BLE001 — device annotation is optional;
+            # the host-side span still records either way
             self._annotation = None
         return self
 
@@ -348,7 +349,15 @@ class Profiler:
                     f"paddle_tpu_profile_{os.getpid()}_{self.step_num}")
                 jp.start_trace(self._device_trace_dir)
                 self._device_tracing = True
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — degrade to host-only,
+                # but LOUDLY: the user asked for a device trace, and a
+                # silent fall-through here is the PR 5 degradation shape
+                import warnings
+
+                warnings.warn(
+                    f"device trace unavailable ({type(e).__name__}: {e}); "
+                    f"profiler continues with host-side timing only",
+                    RuntimeWarning, stacklevel=2)
                 self._device_tracing = False
 
     def _disarm(self):
@@ -361,7 +370,8 @@ class Profiler:
                 import jax.profiler as jp
 
                 jp.stop_trace()
-            except Exception:
+            except Exception:  # noqa: BLE001 — stop is best-effort; the
+                # trace dir may hold a partial trace after a device fault
                 pass
             self._device_tracing = False
 

@@ -16,6 +16,8 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)),
 
 _lib = None
 _load_failed = False
+#: why the native sink is unavailable (diagnostic; see available())
+_load_error: Optional[str] = None
 _names: List[str] = []
 _name_ids = {}
 _lock = threading.Lock()
@@ -28,7 +30,7 @@ def available() -> bool:
 
 
 def _load():
-    global _lib, _load_failed, _offset
+    global _lib, _load_failed, _load_error, _offset
     if _lib is not None or _load_failed:
         return _lib
     try:
@@ -50,7 +52,9 @@ def _load():
         ns = lib.ht_now_ns()
         _offset = t0 - ns * 1e-9
         _lib = lib
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — compilation is optional by
+        # design (docstring); record WHY so callers can surface it
+        _load_error = f"{type(e).__name__}: {e}"
         _load_failed = True
     return _lib
 

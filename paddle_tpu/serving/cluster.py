@@ -484,7 +484,10 @@ class ServingCluster:
         any number of migrations."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if seed is None:
-            seed = int(np.random.randint(0, 2 ** 31 - 1))
+            # fresh entropy is drawn exactly once, at routing; the seed is
+            # journaled with the request, so migration/hedging REPLAY this
+            # value rather than redrawing
+            seed = int(np.random.randint(0, 2 ** 31 - 1))  # noqa: WALLCLOCK-IN-REPLAY — drawn once, journaled
         candidates = self._candidates(prompt)
         if not candidates:
             raise EngineOverloaded(

@@ -1260,7 +1260,7 @@ class ServingEngine:
         o = self._obs
         with RecordEvent("serving.host_drain"):
             toks, err = self._guarded_call(
-                "drain", lambda: np.asarray(jax.device_get(rec["emitted"])))
+                "drain", lambda: np.asarray(jax.device_get(rec["emitted"])))  # noqa: HOST-SYNC — THE one sync per decode block (PR 3 contract)
         if toks is None:
             # the block's tokens are unrecoverable: give back the
             # in-flight reservation and isolate exactly the block's

@@ -7,9 +7,9 @@ the north-star target (no published reference numbers exist; see BASELINE.md).
 
 Short-window design (round-3 postmortem: the TPU tunnel was up ~10 min in a
 10-hour session and the round's bench was a CPU fallback):
-- the child writes its best-so-far JSON to bench_partial.json after EVERY
-  phase, so a mid-run wedge still leaves a TPU number for the supervisor to
-  emit;
+- the child writes its best-so-far JSON to bench_trace/bench_partial.json
+  after EVERY phase, so a mid-run wedge still leaves a TPU number for the
+  supervisor to emit;
 - phase order front-loads signal: smoke matmul -> Pallas lowering gates
   (flash fwd/bwd, flash+dropout, fused norms — the round-3 hardware-gate
   debt) -> MFU at the round-2 config (batch 32 x seq 512) -> batch sweep ->
@@ -33,10 +33,11 @@ import numpy as np
 
 METRIC = "ernie1.0_pretrain_tokens_per_sec_per_chip"
 UNIT = "tokens/s/chip"
-PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "bench_partial.json")
+# all bench scratch (partial JSON, profiler trace) lives under
+# bench_trace/ — gitignored, so wedged runs never dirty the tree
 TRACE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench_trace")
+PARTIAL_PATH = os.path.join(TRACE_DIR, "bench_partial.json")
 
 PEAK_BF16_FLOPS = {
     # device_kind substring -> peak bf16 FLOP/s per chip
@@ -79,6 +80,7 @@ def _write_partial(obj: dict) -> None:
     obj.setdefault("detail", {})["phases_completed"] = \
         list(_PHASE_STATE["completed"])
     try:
+        os.makedirs(TRACE_DIR, exist_ok=True)
         with open(PARTIAL_PATH, "w") as f:
             json.dump(obj, f)
             f.write("\n")
@@ -127,6 +129,7 @@ def _phase_wedged(name: str, budget: float) -> None:
     base.setdefault("detail", {})["wedged_phase"] = name
     base["detail"]["phases_completed"] = list(st["completed"])
     try:
+        os.makedirs(TRACE_DIR, exist_ok=True)
         with open(PARTIAL_PATH, "w") as f:
             json.dump(base, f)
             f.write("\n")
@@ -231,12 +234,28 @@ def _run_gates(on_tpu: bool) -> dict:
         pos = jnp.asarray([3, 17, 33, 60], jnp.int32)
         np.asarray(satt._paged_decode_pallas(qq, kp, kp, pt, pos))
 
+    def ragged_paged():
+        # the unified mixed-step ragged paged-attention kernel: decode
+        # rows, a prefill-chunk run, and parked padding in one flat call
+        from paddle_tpu.serving import attention as satt
+
+        kvh, hd, ps, pages, maxp, rows, tt = 4, 128, 16, 16, 4, 4, 16
+        kp = jnp.asarray(rng.randn(kvh, pages, ps, hd), jnp.bfloat16)
+        qq = jnp.asarray(rng.randn(1, tt, 8, hd), jnp.bfloat16)
+        pt = jnp.asarray(rng.randint(1, pages, (rows, maxp)), jnp.int32)
+        pos = jnp.asarray(np.r_[[5, 17], np.arange(8, 14),
+                                np.full(8, maxp * ps)], jnp.int32)
+        rid = jnp.asarray(np.r_[[0, 1], np.full(6, 2), np.zeros(8)],
+                          jnp.int32)
+        np.asarray(satt._ragged_paged_pallas(qq, kp, kp, pt, pos, rid))
+
     gate("flash_fwd", flash_fwd)
     gate("flash_bwd", flash_bwd)
     gate("flash_dropout", flash_dropout)
     gate("fused_norms", norms)
     gate("ring_step", ring_step)
     gate("paged_decode", paged_decode)
+    gate("ragged_paged", ragged_paged)
     return gates
 
 
@@ -415,6 +434,36 @@ def _run_serving_chunked(on_tpu: bool) -> dict:
         return out
     except Exception as e:  # noqa: BLE001 — bench must degrade, not die
         _log(f"phase=serving_chunked: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
+def _run_serving_ragged(on_tpu: bool) -> dict:
+    """Unified ragged mixed-step phase: the chunked-prefill interference
+    workload re-run with the single flat Ragged-Paged-Attention
+    executable on vs off (both chunked) — bit-identical streams, with
+    the per-step launch count collapsing from one-per-chunk-plus-decode
+    to one. Non-fatal like the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_ragged_phase(model, cfg, on_tpu)
+        _log(f"phase=serving_ragged: dispatches/step "
+             f"{out['ragged_off']['dispatches_per_step']} -> "
+             f"{out['ragged_on']['dispatches_per_step']} "
+             f"({out['dispatches_per_step_reduction']}x), tok/s "
+             f"{out['ragged_off']['tok_s']} -> "
+             f"{out['ragged_on']['tok_s']}, stall p99 "
+             f"{out['ragged_off']['decode_stall_p99_ms']}ms -> "
+             f"{out['ragged_on']['decode_stall_p99_ms']}ms, "
+             f"{out['ragged_on']['ragged_executables']} ragged "
+             f"executable(s) over buckets {out['token_buckets']}, "
+             f"parity_ok={out['token_parity_ok']}")
+        if not out["token_parity_ok"]:
+            _log("phase=serving_ragged: WARN ragged-vs-chained token "
+                 "parity FAILED")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_ragged: FAIL {type(e).__name__}: {e}")
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
@@ -619,6 +668,14 @@ def _run_aot_gates() -> dict:
          abs_((4, 16, 16, 128), jnp.bfloat16),
          abs_((4, 4), jnp.int32), abs_((4,), jnp.int32))
 
+    gate("ragged_paged",
+         lambda qq, kp, pt, pos, rid: satt._ragged_paged_pallas(
+             qq, kp, kp, pt, pos, rid),
+         abs_((1, 16, 8, 128), jnp.bfloat16),
+         abs_((4, 16, 16, 128), jnp.bfloat16),
+         abs_((4, 4), jnp.int32), abs_((16,), jnp.int32),
+         abs_((16,), jnp.int32))
+
     pk._on_tpu = orig
     return gates
 
@@ -684,6 +741,10 @@ def bench_child() -> None:
     # chunked-prefill interference phase: stall-free batching on vs off
     _enter_phase("serving_chunked", 400.0)
     serving_chunked = _run_serving_chunked(on_tpu)
+
+    # ragged mixed-step phase: one flat executable per step vs chained
+    _enter_phase("serving_ragged", 400.0)
+    serving_ragged = _run_serving_ragged(on_tpu)
 
     # crash-recovery phase: supervisor kill/rebuild/re-admit parity
     _enter_phase("serving_recovery", 400.0)
@@ -827,6 +888,7 @@ def bench_child() -> None:
                 "serving_tp": serving_tp,
                 "serving_faults": serving_faults,
                 "serving_chunked": serving_chunked,
+                "serving_ragged": serving_ragged,
                 "serving_recovery": serving_recovery,
                 "serving_cluster": serving_cluster,
                 "lint": lint,

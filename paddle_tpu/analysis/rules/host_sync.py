@@ -7,13 +7,20 @@ block; a stray ``int(tokens[i])`` added in the scheduler would quietly
 serialize the async pipeline and show up only as a throughput regression
 three PRs later.
 
-Scope is intentionally narrow: the rule applies only to
-``serving/engine.py`` and ``serving/scheduler.py``, and within those
-only to functions *reachable from the hot roots* (`ServingEngine.step`,
-`Scheduler.schedule`) through same-module calls — the call graph is
-computed over the AST (``self.f()`` / bare ``f()`` edges), so a helper
-newly wired into the step path is covered automatically while cold
-paths (add_request, snapshot/restore, stats) stay out of scope.
+Scope is intentionally narrow: the rule applies only to the modules in
+``DEFAULT_HOT_MODULES`` — a path-suffix -> hot-roots mapping covering
+``serving/engine.py`` (`ServingEngine.step`), ``serving/scheduler.py``
+(`Scheduler.schedule`) and ``serving/ragged.py``
+(`build_ragged_inputs`, the flat-batch assembly that runs BETWEEN two
+dispatches of a ragged step) — and within those only to functions
+*reachable from the module's hot roots* through same-module calls: the
+call graph is computed over the AST (``self.f()`` / bare ``f()``
+edges), so a helper newly wired into the step path is covered
+automatically while cold paths (add_request, snapshot/restore, stats)
+stay out of scope. The mapping is the configuration surface:
+``HostSyncRule(hot_modules={...})`` swaps or extends it, so a project
+growing a new hot module declares it in one place instead of editing
+the rule.
 
 Fires on: ``.item()``, ``.tolist()``, ``.block_until_ready()``,
 ``np.asarray``/``np.array``/``np.copy``, ``jax.device_get``, and
@@ -26,12 +33,19 @@ The one *intentional* sync per decode block carries
 that it is explicit, audited, and unique.
 """
 import ast
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Dict, FrozenSet, Iterator, List, Mapping, Optional, \
+    Set, Tuple
 
 from ..core import Finding, ParsedModule, Rule, dotted_chain
 
-_HOT_FILES = ("serving/engine.py", "serving/scheduler.py")
-_HOT_ROOTS = {"step", "schedule"}
+# path suffix -> the functions whose same-module call graph IS that
+# module's hot path. This mapping is the rule's configuration surface:
+# pass `hot_modules` to HostSyncRule to swap or extend it.
+DEFAULT_HOT_MODULES: Dict[str, FrozenSet[str]] = {
+    "serving/engine.py": frozenset({"step"}),
+    "serving/scheduler.py": frozenset({"schedule"}),
+    "serving/ragged.py": frozenset({"build_ragged_inputs"}),
+}
 _SYNC_METHOD_TAILS = {"item", "tolist", "block_until_ready"}
 _SYNC_CHAINS = {
     ("np", "asarray"), ("np", "array"), ("np", "copy"),
@@ -116,14 +130,29 @@ def _sync_hit(node: ast.Call) -> Optional[str]:
 class HostSyncRule(Rule):
     name = "HOST-SYNC"
     description = ("device->host sync (.item()/np.asarray/device_get/"
-                   "scalar casts) inside the decode/step hot path of "
-                   "serving/engine.py and serving/scheduler.py")
+                   "scalar casts) inside the hot path of a traced "
+                   "serving module (see DEFAULT_HOT_MODULES)")
+
+    def __init__(self,
+                 hot_modules: Optional[Mapping[str, FrozenSet[str]]]
+                 = None):
+        self.hot_modules: Dict[str, FrozenSet[str]] = dict(
+            DEFAULT_HOT_MODULES if hot_modules is None else hot_modules)
+
+    def _roots_for(self, path: str) -> Set[str]:
+        norm = path.replace("\\", "/")
+        roots: Set[str] = set()
+        for suffix, names in self.hot_modules.items():
+            if norm.endswith(suffix):
+                roots |= set(names)
+        return roots
 
     def check(self, module: ParsedModule) -> Iterator[Finding]:
-        if not module.path.replace("\\", "/").endswith(_HOT_FILES):
+        roots = self._roots_for(module.path)
+        if not roots:
             return
         table = _function_table(module.tree)
-        hot = _reachable(table, _HOT_ROOTS)
+        hot = _reachable(table, roots)
         hits: List[Tuple[int, str]] = []
         for name in sorted(hot):
             for fn in table[name]:

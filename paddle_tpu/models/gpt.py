@@ -158,7 +158,9 @@ class GPTModel(nn.Layer):
                 sp = jnp.asarray(
                     start_pos._data if hasattr(start_pos, "_data")
                     else start_pos, jnp.int32)
-                if sp.ndim >= 1:  # ragged serving batch: per-row offsets
+                if sp.ndim == 2:  # flat ragged batch: (b, s) positions
+                    position_ids = Tensor(sp)
+                elif sp.ndim == 1:  # ragged serving batch: per-row offsets
                     position_ids = Tensor(
                         sp[:, None] + jnp.arange(s, dtype=jnp.int32)[None])
                 else:

@@ -37,7 +37,8 @@ from ..core.tensor import Tensor
 from .kv_cache import NULL_PAGE, PagedLayerCache, overflow_position
 
 __all__ = ["paged_attend", "paged_decode_attention",
-           "paged_decode_available", "advance_positions", "KERNEL_MODE"]
+           "paged_decode_available", "ragged_paged_attention",
+           "ragged_attention_available", "advance_positions", "KERNEL_MODE"]
 
 # "auto": Pallas kernel on TPU, jnp reference elsewhere; "off": always the
 # reference; "interpret": run the Pallas kernel in interpret mode (hermetic
@@ -89,9 +90,12 @@ def advance_positions(positions, live, max_pages: int,
 
 def _positions(start_pos, b: int, s: int) -> jnp.ndarray:
     """(b, s) int32 global positions for this step's tokens. `start_pos`
-    is a scalar (uniform prefill) or a (b,) vector (ragged decode)."""
+    is a scalar (uniform prefill), a (b,) vector (ragged decode), or a
+    (b, s) matrix that already IS the positions (flat ragged batch)."""
     start = start_pos._data if hasattr(start_pos, "_data") else start_pos
     start = jnp.asarray(start, jnp.int32)
+    if start.ndim == 2:
+        return start
     offs = jnp.arange(s, dtype=jnp.int32)
     if start.ndim == 0:
         return jnp.broadcast_to(start + offs, (b, s))
@@ -127,8 +131,16 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
     vd = (v._data if hasattr(v, "_data") else v).astype(vp.dtype)
     pos = _positions(start_pos, b, s)                # (b, s)
     page_idx = pos // ps
-    entries = jnp.take_along_axis(
-        page_table, jnp.clip(page_idx, 0, max_pages - 1), axis=1)
+    if cache.row_ids is not None:
+        # flat ragged batch (b == 1, s == T): token t writes through the
+        # page table ROW it belongs to, not batch row 0
+        pt_rows = page_table[cache.row_ids]          # (T, maxP)
+        entries = jnp.take_along_axis(
+            pt_rows, jnp.clip(page_idx[0], 0, max_pages - 1)[:, None],
+            axis=1)[:, 0][None]                      # (1, T)
+    else:
+        entries = jnp.take_along_axis(
+            page_table, jnp.clip(page_idx, 0, max_pages - 1), axis=1)
     # padding rows whose position overflows the table (suffix prefill:
     # offset + bucket may exceed max_pages * page_size) must land in the
     # null page — clipping the index instead would alias them onto the
@@ -139,11 +151,13 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
                       entries.reshape(-1), slots.reshape(-1))
     vp = _write_pages(vp, vd.reshape(b * s, *vd.shape[2:]),
                       entries.reshape(-1), slots.reshape(-1))
-    new_cache = PagedLayerCache(kp, vp, page_table)
+    new_cache = PagedLayerCache(kp, vp, page_table, cache.row_ids)
 
     raw_start = start_pos._data if hasattr(start_pos, "_data") else start_pos
     static_zero = isinstance(raw_start, int) and raw_start == 0
-    if s == 1:
+    if cache.row_ids is not None:
+        ctx = ragged_paged_attention(q, new_cache, pos, rep, bias=bias)
+    elif s == 1:
         ctx = paged_decode_attention(q, new_cache, pos[:, 0], rep,
                                      bias=bias)
     elif static_zero:
@@ -284,6 +298,83 @@ def _paged_decode_reference(q, cache, pos, rep, bias=None):
         q, Tensor(kf), Tensor(vf), attn_mask=Tensor(mask), is_causal=False)
 
 
+# ------------------------------------------------------ ragged flat batch
+
+def ragged_attention_available(page_size: int, head_dim: int) -> bool:
+    """Shape gates for the Pallas ragged kernel — identical to the decode
+    kernel's (same tile geometry, one more prefetched scalar array)."""
+    return paged_decode_available(page_size, head_dim)
+
+
+def ragged_paged_attention(q, cache: PagedLayerCache, pos, rep, bias=None):
+    """Flat ragged attention: ALL rows' tokens of a mixed prefill/decode
+    step ride one (1, T) sequence axis; `cache.row_ids[t]` names token
+    t's page-table row and `pos[0, t]` its global position (= its kv
+    length minus one). Decode rows contribute one token, prefill chunks a
+    contiguous run; padding tokens park at the table-overflow position
+    and attend nothing.
+
+    q: Tensor (1, T, heads, hd); pos: (1, T) int32. Returns ctx Tensor
+    (1, T, heads, hd).
+    """
+    hd = q.shape[-1]
+    use_kernel = (KERNEL_MODE != "off" and bias is None
+                  and ragged_attention_available(cache.page_size, hd)
+                  and (KERNEL_MODE == "interpret" or _on_tpu()))
+    if use_kernel:
+        _count_dispatch("ragged_pallas_interpret"
+                        if KERNEL_MODE == "interpret" else "ragged_pallas")
+        qd = q._data if hasattr(q, "_data") else q
+        out = _ragged_paged_pallas(qd, cache.k_pool, cache.v_pool,
+                                   cache.page_table, pos[0],
+                                   cache.row_ids,
+                                   interpret=KERNEL_MODE == "interpret")
+        return Tensor(out)
+    _count_dispatch("ragged_reference")
+    return _ragged_attention_reference(q, cache, pos, rep, bias)
+
+
+def _ragged_attention_reference(q, cache, pos, rep, bias=None):
+    """Per-token twin of `_paged_decode_reference`: gather each TOKEN's
+    page-table row into a contiguous (T, L, kvh, hd) view and run the
+    reference sdpa with the same per-token position mask — so a decode
+    row's token here is bit-for-bit the (b, 1) decode computation, and a
+    chunk's tokens match the chunked-prefill paged gather. Padding
+    tokens (position == table capacity) mask everything and produce
+    garbage rows the caller never reads."""
+    from ..nn import functional as F
+
+    if bias is not None:
+        raise NotImplementedError(
+            "ragged flat attention does not take an attention bias")
+    kp, vp, page_table = cache.k_pool, cache.v_pool, cache.page_table
+    rows = cache.row_ids                              # (T,)
+    ps = cache.page_size
+    t = q.shape[1]
+    length = page_table.shape[1] * ps
+    pt = page_table[rows]                             # (T, maxP)
+
+    def gather(pool):
+        g = pool[:, pt]                    # (kvh, T, maxP, pgsz, hd)
+        kvh, _, mp, _, hd = g.shape
+        return jnp.transpose(g, (1, 2, 3, 0, 4)).reshape(
+            t, mp * ps, kvh, hd)
+
+    kf = _expand_kv(gather(kp), rep)
+    vf = _expand_kv(gather(vp), rep)
+    qd = q._data if hasattr(q, "_data") else q
+    qt = Tensor(qd[0][:, None])                       # (T, 1, heads, hd)
+    allowed = (jnp.arange(length, dtype=jnp.int32)[None, :]
+               <= pos[0][:, None])                    # (T, L)
+    mask = jnp.where(allowed, 0.0, -1e9).astype(
+        jnp.float32)[:, None, None, :]                # (T, 1, 1, L)
+    ctx = F.scaled_dot_product_attention(
+        qt, Tensor(kf), Tensor(vf), attn_mask=Tensor(mask),
+        is_causal=False)
+    cd = ctx._data if hasattr(ctx, "_data") else ctx
+    return Tensor(cd[:, 0][None])                     # (1, T, heads, hd)
+
+
 # ------------------------------------------------------- Pallas decode path
 
 def _round_up(x: int, m: int) -> int:
@@ -388,3 +479,109 @@ def _paged_decode_pallas(q, k_pool, v_pool, page_table, pos,
         interpret=interpret,
     )(page_table.astype(jnp.int32), pos.astype(jnp.int32), qg, kp, vp)
     return out[:, :, :rep, :hd].reshape(b, 1, heads, hd)
+
+
+# ------------------------------------------------------- Pallas ragged path
+
+def _ragged_attend_kernel(pt_ref, pos_ref, row_ref, q_ref, k_ref, v_ref,
+                          o_ref, acc_ref, m_ref, l_ref, *, ps, scale,
+                          n_pages):
+    """Grid (token, kv_head, page): the decode kernel's flash loop with the
+    batch axis replaced by a flat TOKEN axis — the BlockSpec index map
+    gathers page `pi` of token t's OWN page-table row (row_ref, scalar-
+    prefetched alongside the table). Pages wholly past the token's
+    position are skipped splash-style, and tokens parked at the table
+    capacity (flat-batch padding) skip every page and emit zeros."""
+    from jax.experimental import pallas as pl
+
+    t_ = pl.program_id(0)
+    pi = pl.program_id(2)
+    pos = pos_ref[t_]
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        s = jax.lax.dot_general(
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
+        cols = pi * ps + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols <= pos, s, -jnp.inf)
+        m_prev = m_ref[...]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # same all-masked guard as the decode kernel: no jnp.isfinite
+        # (no Mosaic lowering on some jax versions)
+        m_safe = jnp.where(m_cur == -jnp.inf, 0.0, m_cur)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.exp(m_prev - m_safe)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = m_cur
+        vblk = v_ref[0, 0]
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+
+    # padding tokens sit exactly AT the table capacity (n_pages * ps), so
+    # the second clause skips all their pages; real tokens always sit
+    # below it
+    pl.when((pi * ps <= pos) & (pos < n_pages * ps))(_compute)
+
+    @pl.when(pi == n_pages - 1)
+    def _done():
+        l_fin = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l_fin).astype(o_ref.dtype)
+
+
+def _ragged_paged_pallas(q, k_pool, v_pool, page_table, pos, row_ids,
+                         interpret=False):
+    """q: (1, T, heads, hd); pools: (kvh, P, ps, hd); page_table:
+    (B, maxP) i32; pos/row_ids: (T,) i32. Returns (1, T, heads, hd)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, t, heads, hd = q.shape
+    kvh, _, ps, _ = k_pool.shape
+    rep = heads // kvh
+    max_pages = page_table.shape[1]
+    scale = 1.0 / (hd ** 0.5)
+
+    d_p = _round_up(hd, 128)
+    g_p = _round_up(rep, 8)
+    # (T, kvh, G, hd): q head h*rep + g attends kv head h, exactly the
+    # decode kernel's grouping with tokens in place of batch rows
+    qg = q.reshape(t, kvh, rep, hd)
+    qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_p - rep), (0, d_p - hd)))
+    kp = jnp.pad(k_pool, ((0, 0), (0, 0), (0, 0), (0, d_p - hd)))
+    vp = jnp.pad(v_pool, ((0, 0), (0, 0), (0, 0), (0, d_p - hd)))
+
+    q_spec = pl.BlockSpec((1, 1, g_p, d_p),
+                          lambda t_, h_, pi, pt, ps_, rw: (t_, h_, 0, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, ps, d_p),
+        lambda t_, h_, pi, pt, ps_, rw: (h_, pt[rw[t_], pi], 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(t, kvh, max_pages),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((g_p, d_p), jnp.float32),
+            pltpu.VMEM((g_p, 1), jnp.float32),
+            pltpu.VMEM((g_p, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_ragged_attend_kernel, ps=ps, scale=scale,
+                          n_pages=max_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, kvh, g_p, d_p), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), pos.astype(jnp.int32),
+      row_ids.astype(jnp.int32), qg, kp, vp)
+    return out[:, :, :rep, :hd].reshape(1, t, heads, hd)

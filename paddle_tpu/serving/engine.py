@@ -75,6 +75,8 @@ from .attention import advance_positions
 from .kv_cache import (PagedKVCache, PagedLayerCache, overflow_position,
                        pages_for)
 from .prefix_cache import PrefixCache
+from .ragged import build_ragged_inputs
+from .ragged import token_buckets as ragged_token_buckets
 from .recovery import EngineSnapshot, RequestSnapshot, replay_key_state
 from .resilience import TERMINAL_STATUSES, is_fatal, is_transient
 from .scheduler import (Request, SamplingParams, Scheduler,
@@ -145,7 +147,7 @@ class ServingObs:
     (tests/test_serving.py pins that with a raise-on-touch guard)."""
 
     FAMILIES = ("prefill", "prefill_offset", "prefill_chunked", "decode",
-                "sample")
+                "ragged", "sample")
 
     def __init__(self, registry: MetricsRegistry):
         self.registry = registry
@@ -157,10 +159,19 @@ class ServingObs:
                                 "chunked-prefill chunk dispatches")
         self.decode_steps = c("serving_decode_steps_total",
                               "fused decode-block dispatches")
+        self.ragged_steps = c("serving_ragged_steps_total",
+                              "flat ragged mixed-step dispatches (one "
+                              "executable carrying the step's decode "
+                              "rows AND prefill chunks)")
         self.tokens = c("serving_tokens_generated_total",
                         "tokens emitted to the host")
         self.host_syncs = c("serving_host_syncs_total",
                             "device->host sync points")
+        self.dispatches = c("serving_dispatches_total",
+                            "device program launches of any family "
+                            "(prefill, chunk, decode block, or ragged "
+                            "step) — the per-step launch cost the "
+                            "ragged executable collapses to one")
         self.preemptions = c("serving_preemptions_total",
                              "requests preempted and requeued")
         self.prefill_seconds = c("serving_prefill_seconds_total",
@@ -286,6 +297,7 @@ class ServingEngine:
                  enable_chunked_prefill: bool = False,
                  prefill_chunk_tokens: int = 256,
                  max_num_batched_tokens: Optional[int] = None,
+                 enable_ragged_step: bool = True,
                  enable_metrics: bool = True,
                  metrics: Optional[MetricsRegistry] = None,
                  max_waiting: Optional[int] = None,
@@ -349,9 +361,22 @@ class ServingEngine:
                     f"max_num_batched_tokens ({max_num_batched_tokens}) "
                     "must be >= prefill_chunk_tokens "
                     f"({self.prefill_chunk_tokens})")
+            # ragged mixed steps (on by default under chunking): a step
+            # that carries chunk work dispatches ONE flat executable —
+            # decode rows and chunks share it — keyed on a small set of
+            # total-token buckets, instead of the decode block plus one
+            # dispatch per chunk. `enable_ragged_step=False` keeps the
+            # PR 6 chained pipeline (the bench's comparison baseline)
+            self.enable_ragged_step = bool(enable_ragged_step)
+            self.token_buckets = (
+                ragged_token_buckets(max_batch_size,
+                                     self.max_num_batched_tokens)
+                if self.enable_ragged_step else None)
         else:
             self.prefill_chunk_tokens = None
             self.max_num_batched_tokens = None
+            self.enable_ragged_step = False
+            self.token_buckets = None
         if num_pages is None:
             # worst case every slot runs a full-length sequence, +1 null
             num_pages = max_batch_size * self.max_pages_per_seq + 1
@@ -428,7 +453,8 @@ class ServingEngine:
                                    prefill_chunk_tokens=
                                    self.prefill_chunk_tokens,
                                    max_num_batched_tokens=
-                                   self.max_num_batched_tokens)
+                                   self.max_num_batched_tokens,
+                                   ragged_steps=self.enable_ragged_step)
         self.params, self.buffers = extract_state(model)
         if self._tp is not None:
             self.params = self._tp.shard_params(self.params)
@@ -463,7 +489,8 @@ class ServingEngine:
         # so it counts the (now extinct) standalone sampler dispatches
         self._exec_shapes: Dict[str, set] = {
             "prefill": set(), "prefill_offset": set(),
-            "prefill_chunked": set(), "decode": set(), "sample": set()}
+            "prefill_chunked": set(), "decode": set(), "ragged": set(),
+            "sample": set()}
         # measure this sub-mesh's all-reduce latency ONCE at construction
         # (a few samples of the decode-step payload shape) — blocking on
         # a probe per step would measure device-queue time, not the
@@ -715,6 +742,8 @@ class ServingEngine:
             return spilled + self._prefill(decision.prefill)
         if decision.kind == "decode":
             return spilled + self._decode(decision.decode)
+        if decision.kind == "ragged":
+            return spilled + self._ragged_step(decision)
         if decision.kind == "mixed":
             return spilled + self._mixed_step(decision)
         return spilled + self._drain_pending()
@@ -950,6 +979,7 @@ class ServingEngine:
         prev_t = req.last_token_t            # set => this is a re-prefill
         if o is not None:
             o.prefill_steps.inc()
+            o.dispatches.inc()
             o.host_syncs.inc()
             o.prefill_seconds.inc(now - t0)
             o.lifecycle.span(req.request_id, "prefill", t0, now)
@@ -1053,6 +1083,7 @@ class ServingEngine:
         o = self._obs
         if o is not None:
             o.prefill_chunks.inc()
+            o.dispatches.inc()
             o.prefill_seconds.inc(now - t0)
             # profiler-only spans for intermediate chunks (retained
             # lifecycle lists must not grow per chunk); the final chunk
@@ -1069,6 +1100,207 @@ class ServingEngine:
         events = [self._emit(req, token, now)]
         if o is not None and prev_t is not None:
             o.inter_token.observe(max(now - prev_t, 0.0))
+        return events
+
+    # ---------------------------------------------------------- ragged step
+    def _ragged_jit(self, t_bucket: int):
+        """ONE executable for a whole mixed step, keyed on the flat
+        token bucket: iteration 0 is a single flat (1, T) forward
+        carrying every row's input tokens — each decode row's one token
+        AND every prefill chunk's extent, routed through their own
+        page-table rows by the ragged attention path — followed by the
+        decode block's usual (horizon-1)-iteration lax.scan over the
+        decode rows. Sampling/EOS/budget masking after the flat forward
+        is the decode body's own arithmetic on per-row gathers, so
+        decode streams are bit-identical to the chained block; a final
+        chunk is a row with an emit budget of 1 (its sampled first
+        token, one key split, then it parks), an intermediate chunk a
+        row with budget 0 (writes K/V, emits PAD, keeps its key).
+        Per-row key-state selection happens IN the executable
+        (scan-carried for decode rows, the iteration-0 split for final
+        chunks, the untouched input for everything else), so the drain's
+        blanket key adoption stays correct for every row class."""
+        tp = self._tp
+        key = (("ragged", t_bucket, self.decode_horizon,
+                self.max_batch_size, self.page_size)
+               + (tp.jit_key if tp is not None else ()))
+        if key not in self._jit_cache:
+            model = self.model if tp is None else tp.shard_model
+            page_size = self.page_size
+            horizon = self.decode_horizon
+
+            def ragged_block(params, buffers, flat_ids, pools,
+                             page_tables, flat_pos, row_ids, last_idx,
+                             tokens, positions, key_data, temps, top_ks,
+                             top_ps, eos_ids, remaining, decode_mask,
+                             final_mask):
+                max_pages = page_tables.shape[1]
+                key_in = key_data
+                views = [PagedLayerCache(kp, vp, page_tables, row_ids)
+                         for kp, vp in pools]
+                (logits, new_views), _ = call_functional(
+                    model, params, buffers, (Tensor(flat_ids),),
+                    kwargs={"caches": views, "start_pos": flat_pos},
+                    training=False)
+                pools = [(v.k_pool, v.v_pool) for v in new_views]
+                # iteration-0 postlude == the decode body's arithmetic,
+                # with each row's logits gathered from its last flat
+                # token
+                key_data, subs = _split_rows(key_data)
+                key_split1 = key_data
+                nxt = _sample_batch(logits[0, last_idx], subs, temps,
+                                    top_ks, top_ps).astype(jnp.int32)
+                alive = remaining > 0
+                hit_eos = alive & (eos_ids >= 0) & (nxt == eos_ids)
+                emit0 = jnp.where(alive, nxt, jnp.int32(PAD_TOKEN))
+                remaining = jnp.where(alive, remaining - 1, remaining)
+                remaining = jnp.where(hit_eos, jnp.int32(0), remaining)
+                tokens = jnp.where(alive, nxt, tokens)
+                positions = advance_positions(
+                    positions, remaining > 0, max_pages, page_size)
+
+                def body(carry, _):
+                    tokens, pools, positions, key_data, remaining = carry
+                    views = [PagedLayerCache(kp, vp, page_tables)
+                             for kp, vp in pools]
+                    (logits, new_views), _ = call_functional(
+                        model, params, buffers, (Tensor(tokens[:, None]),),
+                        kwargs={"caches": views, "start_pos": positions},
+                        training=False)
+                    pools = [(v.k_pool, v.v_pool) for v in new_views]
+                    key_data, subs = _split_rows(key_data)
+                    nxt = _sample_batch(logits[:, 0], subs, temps,
+                                        top_ks, top_ps).astype(jnp.int32)
+                    alive = remaining > 0
+                    hit_eos = alive & (eos_ids >= 0) & (nxt == eos_ids)
+                    emit = jnp.where(alive, nxt, jnp.int32(PAD_TOKEN))
+                    remaining = jnp.where(alive, remaining - 1, remaining)
+                    remaining = jnp.where(hit_eos, jnp.int32(0), remaining)
+                    tokens = jnp.where(alive, nxt, tokens)
+                    positions = advance_positions(
+                        positions, remaining > 0, max_pages, page_size)
+                    return (tokens, pools, positions, key_data,
+                            remaining), emit
+
+                carry = (tokens, pools, positions, key_data, remaining)
+                (tokens, pools, positions, key_data, remaining), rest = \
+                    jax.lax.scan(body, carry, None, length=horizon - 1)
+                emitted = jnp.concatenate(
+                    [emit0[:, None], jnp.transpose(rest)], axis=1)
+                key_out = jnp.where(
+                    decode_mask[:, None], key_data,
+                    jnp.where(final_mask[:, None], key_split1, key_in))
+                return emitted, pools, key_out
+
+            if tp is not None:
+                ragged_block = tp.wrap_ragged_exec(ragged_block)
+            self._jit_cache[key] = jax.jit(ragged_block,
+                                           donate_argnums=(3,))
+        return self._jit_cache[key]
+
+    def _ragged_step(self, decision) -> List[Tuple[int, int]]:
+        """One flat ragged step: the whole mixed step — the decode
+        rows' horizon block AND every scheduled chunk — is a single
+        jitted dispatch (N+1 chained dispatches before). Flat inputs
+        are built from host request state, so any pending block drains
+        FIRST (a ragged step never chains on device carries); async
+        overlap is preserved in the other direction — the record this
+        step leaves behind drains under the next step's device time.
+        A final chunk's sampled token therefore surfaces at the next
+        drain instead of synchronously, one step later than the chained
+        path; stream CONTENT is unchanged."""
+        events = self._drain_pending()
+        decode = [r for r in decision.decode if r.status == "running"]
+        chunks = [t for t in decision.chunks
+                  if t.req.status == "running"
+                  and t.start == t.req.num_computed_tokens]
+        if not chunks:
+            # every chunk went stale (finalized/preempted during the
+            # drain): fall through to the plain decode pipeline
+            return events + (self._decode(decode) if decode else [])
+        batch = build_ragged_inputs(
+            decode, chunks, buckets=self.token_buckets,
+            max_batch=self.max_batch_size, horizon=self.decode_horizon,
+            page_size=self.page_size, max_pages=self.max_pages_per_seq)
+        if batch is None:
+            return events
+        self._note_exec("ragged",
+                        (batch.t_bucket, self.max_batch_size,
+                         self.decode_horizon, self.cache.num_pages,
+                         self.max_pages_per_seq))
+        page_tables = self.cache.page_table_array(
+            batch.page_lists, self.max_pages_per_seq)
+        kds = [self._key_state[r.request_id] for r in batch.reqs]
+        kds.extend([jnp.zeros((2,), jnp.uint32)]
+                   * (self.max_batch_size - len(batch.reqs)))
+        key_data = jnp.stack(kds)
+        rids = tuple(r.request_id for r in batch.reqs)
+
+        def dispatch():
+            out = self._ragged_jit(batch.t_bucket)(
+                self.params, self.buffers, jnp.asarray(batch.flat_ids),
+                self.cache.pools, page_tables,
+                jnp.asarray(batch.flat_pos), jnp.asarray(batch.row_ids),
+                jnp.asarray(batch.last_idx), jnp.asarray(batch.tokens),
+                jnp.asarray(batch.positions), key_data,
+                jnp.asarray(batch.temps), jnp.asarray(batch.top_ks),
+                jnp.asarray(batch.top_ps), jnp.asarray(batch.eos_ids),
+                jnp.asarray(batch.remaining),
+                jnp.asarray(batch.decode_mask),
+                jnp.asarray(batch.final_mask))
+            self.cache.pools = out[1]
+            return out
+
+        t0 = time.perf_counter()
+        with RecordEvent("serving.ragged_step"):
+            out, err = self._guarded_call("dispatch", dispatch)
+        if out is None:
+            # one dispatch carries every row, so a fault implicates the
+            # whole step's requests — coarser than the chained path's
+            # per-site isolation, the price of sharing one executable
+            self._quarantine(
+                [r for r in batch.reqs if r.status == "running"], err,
+                "ragged")
+            return events
+        emitted, pools, key_out = out
+        for req, n in zip(batch.reqs, batch.incr):
+            req.inflight += n
+        now = time.perf_counter()
+        o = self._obs
+        for task in chunks:
+            req = task.req
+            req.num_computed_tokens = task.start + task.length
+            if o is not None:
+                o.prefill_chunks.inc()
+                o.lifecycle.span(req.request_id, "prefill", t0, now,
+                                 retain=task.is_final)
+            if task.is_final:
+                # pages are complete once this dispatch lands; later
+                # dispatches ordering behind it through the donated
+                # pools may share them immediately
+                if self.prefix_cache is not None:
+                    self.prefix_cache.insert(req.prompt, req.pages)
+                if o is not None:
+                    o.prefill_steps.inc()
+        if o is not None:
+            o.ragged_steps.inc()
+            o.dispatches.inc()
+            if decode:
+                o.decode_steps.inc()
+                if self._last_decode_dispatch_t is not None:
+                    o.decode_stall.observe(
+                        max(t0 - self._last_decode_dispatch_t, 0.0))
+        if decode:
+            self._last_decode_dispatch_t = t0
+        if decode or any(t.is_final for t in chunks):
+            self._pending = {
+                "kind": "ragged", "rids": rids, "reqs": list(batch.reqs),
+                "incr": list(batch.incr), "emitted": emitted,
+                "key_data": key_out, "t0": t0,
+            }
+        # else: intermediate chunks only — nothing can emit and no key
+        # state moved, so dropping the record outright saves a drain
+        # (and its host sync) that would deliver zero tokens
         return events
 
     # --------------------------------------------------------------- decode
@@ -1124,23 +1356,37 @@ class ServingEngine:
                                            donate_argnums=(3,))
         return self._jit_cache[key]
 
+    def _decode_rows(self, n: int) -> int:
+        """Dispatched decode row count: the next power of two >= n,
+        capped at max_batch_size — a 2-request batch stops paying a
+        full max_batch-row step. Chained blocks stay consistent for
+        free: chaining requires identical rids, hence identical n."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_batch_size)
+
     def _decode(self, reqs: Sequence[Request]) -> List[Tuple[int, int]]:
         reqs = [r for r in reqs if r.status == "running"]
         if not reqs:
             return self._drain_pending()
-        b, h = self.max_batch_size, self.decode_horizon
+        h = self.decode_horizon
         rids = tuple(r.request_id for r in reqs)
         events_prev: List[Tuple[int, int]] = []
         prev = self._pending
-        if prev is not None and prev["rids"] != rids:
-            # batch composition changed (admission/finish/preemption):
-            # the chained carries no longer line up — sync and go fresh
+        if prev is not None and (prev.get("kind", "decode") != "decode"
+                                 or prev["rids"] != rids):
+            # batch composition changed (admission/finish/preemption),
+            # or the pending record is a ragged step (its carries are
+            # per-ROW-class and must never seed a decode chain): sync
+            # and go fresh
             events_prev = self._drain_pending()
             reqs = [r for r in reqs if r.status == "running"]
             if not reqs:
                 return events_prev
             rids = tuple(r.request_id for r in reqs)
             prev = None
+        b = self._decode_rows(len(reqs))
         self._note_exec(
             "decode", (b, h, self.cache.num_pages, self.max_pages_per_seq))
         page_lists: List[Sequence[int]] = [()] * b
@@ -1220,6 +1466,7 @@ class ServingEngine:
             req.inflight += n
         if self._obs is not None:
             self._obs.decode_steps.inc()
+            self._obs.dispatches.inc()
             if self._last_decode_dispatch_t is not None:
                 # dispatch-to-dispatch gap while requests were running:
                 # whatever kept the engine away from decode (a prefill,
@@ -1228,6 +1475,7 @@ class ServingEngine:
                     max(t0 - self._last_decode_dispatch_t, 0.0))
         self._last_decode_dispatch_t = t0
         self._pending = {
+            "kind": "decode",
             "rids": rids, "reqs": list(reqs), "incr": incr,
             "emitted": emitted, "tokens": tokens, "positions": positions,
             "key_data": key_data, "remaining": remaining, "knobs": knobs,
@@ -1617,6 +1865,8 @@ class ServingEngine:
                 "prefill_steps": int(o.prefill_steps.value),
                 "prefill_chunks": int(o.prefill_chunks.value),
                 "decode_steps": int(o.decode_steps.value),
+                "ragged_steps": int(o.ragged_steps.value),
+                "dispatches": int(o.dispatches.value),
                 "tokens_generated": int(o.tokens.value),
                 "prefill_time_s": float(o.prefill_seconds.value),
                 "decode_time_s": float(o.decode_seconds.value),
@@ -1626,7 +1876,7 @@ class ServingEngine:
         else:
             s = {
                 "prefill_steps": 0, "prefill_chunks": 0,
-                "decode_steps": 0,
+                "decode_steps": 0, "ragged_steps": 0, "dispatches": 0,
                 "tokens_generated": 0, "prefill_time_s": 0.0,
                 "decode_time_s": 0.0,
                 "preemptions": sum(r.preemptions

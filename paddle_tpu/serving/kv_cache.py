@@ -228,18 +228,29 @@ class PagedLayerCache:
                    per-step pool transpose
     page_table:    (B, max_pages) int32 — logical page j of row i lives in
                    physical page page_table[i, j] (0 = null page padding)
+    row_ids:       optional (T,) int32 — ragged flat-batch mode: the step
+                   carries all rows' tokens in ONE (1, T) sequence axis and
+                   row_ids[t] names the page-table row token t belongs to.
+                   None (the default) keeps the classic one-row-per-batch-
+                   entry layout.
     """
 
     k_pool: jnp.ndarray
     v_pool: jnp.ndarray
     page_table: jnp.ndarray
+    row_ids: Optional[jnp.ndarray] = None
 
     @property
     def page_size(self) -> int:
         return self.k_pool.shape[2]
 
     def tree_flatten(self):
-        return (self.k_pool, self.v_pool, self.page_table), None
+        # keep the 3-child structure (and treedef equality) of every
+        # existing executable when row_ids is absent
+        if self.row_ids is None:
+            return (self.k_pool, self.v_pool, self.page_table), None
+        return (self.k_pool, self.v_pool, self.page_table,
+                self.row_ids), True
 
     @classmethod
     def tree_unflatten(cls, aux, children):

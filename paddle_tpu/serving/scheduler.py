@@ -190,11 +190,19 @@ class ChunkTask:
 @dataclasses.dataclass
 class ScheduleDecision:
     # "prefill" | "decode" | "idle" classic; "mixed" when chunked prefill
-    # is on — decode batch plus zero or more prefill chunks in ONE step
+    # is on with `ragged_steps=False` — decode batch plus zero or more
+    # prefill chunks chained one dispatch each; "ragged" when
+    # `ragged_steps=True` and chunk work exists — the SAME rows, but the
+    # engine packs them into one flat batch and dispatches a single
+    # ragged executable (decode rows contribute one token each, chunks
+    # their extent; `flat_tokens` is the flat token count before bucket
+    # padding). A ragged scheduler still says "decode" on chunk-free
+    # steps so pure decode keeps the chained-block pipeline.
     kind: str
     prefill: Optional[Request] = None
     decode: Sequence[Request] = ()
     chunks: Sequence[ChunkTask] = ()
+    flat_tokens: int = 0
 
 
 class Scheduler:
@@ -206,7 +214,8 @@ class Scheduler:
                  max_preemptions: Optional[int] = None,
                  max_prefill_tokens: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
-                 max_num_batched_tokens: Optional[int] = None):
+                 max_num_batched_tokens: Optional[int] = None,
+                 ragged_steps: bool = False):
         self.allocator = allocator
         self.page_size = page_size
         self.max_batch_size = max_batch_size
@@ -235,6 +244,13 @@ class Scheduler:
         # each chunk charges the full padded chunk width — the honest
         # compute cost of the fixed-shape chunk executable
         self.max_num_batched_tokens = max_num_batched_tokens
+        # ragged steps: chunked-prefill steps that carry chunk work come
+        # back as ONE flat kind="ragged" decision (the engine dispatches
+        # a single ragged executable) instead of kind="mixed"'s
+        # decode-then-chunks dispatch chain. Row selection, budget
+        # charging and page reservation are IDENTICAL either way — only
+        # the decision kind (and therefore the dispatch shape) changes
+        self.ragged_steps = bool(ragged_steps)
         # called once per _ensure_decode_pages on pool exhaustion, before
         # any preemption: the engine drains its in-flight decode block so
         # (a) device-finished requests release their pages and (b) a
@@ -586,9 +602,19 @@ class Scheduler:
         decode = [r for r in decode
                   if r.status == "running" and r.prefill_done]
         chunks = [t for t in chunks if t.req.status == "running"]
-        if decode or chunks:
+        flat = len(decode) + sum(t.length for t in chunks)
+        if self.ragged_steps:
+            # one flat decision when chunk work exists; chunk-free steps
+            # stay kind="decode" so pure decode keeps the chained-block
+            # pipeline (and its zero-host-sync carry reuse)
+            if chunks:
+                return ScheduleDecision(kind="ragged", decode=decode,
+                                        chunks=chunks, flat_tokens=flat)
+            if decode:
+                return ScheduleDecision(kind="decode", decode=decode)
+        elif decode or chunks:
             return ScheduleDecision(kind="mixed", decode=decode,
-                                    chunks=chunks)
+                                    chunks=chunks, flat_tokens=flat)
         self._check_head_fits()
         return ScheduleDecision(kind="idle")
 

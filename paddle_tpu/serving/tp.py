@@ -307,6 +307,26 @@ class TPContext:
                 check_rep=False)(params, buffers, tokens, pools, *rest)
         return wrapped
 
+    def wrap_ragged_exec(self, fn):
+        """shard_map the one-dispatch ragged mixed step
+        `(params, buffers, flat_ids, pools, *rest) ->
+        (emitted, pools, key_out)` — same placement contract as the
+        other families: the flat token buffer, page tables, row ids and
+        every per-row array are replicated, the KV pools kv-head-
+        sharded, and the emitted block + key state are computed from
+        replicated logits on every shard."""
+        pool_specs = self._pool_specs()
+        param_specs, mesh = self.param_specs, self.mesh
+
+        def wrapped(params, buffers, flat_ids, pools, *rest):
+            return _shard_map(
+                fn, mesh=mesh,
+                in_specs=(param_specs, self._repl_like(buffers), P(),
+                          pool_specs) + tuple(P() for _ in rest),
+                out_specs=(P(), pool_specs, P()),
+                check_rep=False)(params, buffers, flat_ids, pools, *rest)
+        return wrapped
+
     # -------------------------------------------------------- observability
     def collective_seconds(self, samples: int = 3, rows: int = 1
                            ) -> List[float]:

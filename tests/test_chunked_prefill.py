@@ -207,13 +207,17 @@ class TestChunkedScheduler:
 
 class TestChunkedParity:
     def test_staggered_parity_and_single_executable(self):
-        """THE acceptance gate: bit-identical streams, and ONE chunked
-        executable where the unchunked engine burned one prefill
-        executable per touched bucket."""
+        """THE acceptance gate: bit-identical streams, and a bounded
+        executable count where the unchunked engine burned one prefill
+        executable per touched bucket. Ragged steps are ON by default
+        under chunking, so chunk work never even compiles the chained
+        chunked-prefill executable — every chunk rides the flat ragged
+        step, itself capped at one executable per token bucket."""
         ref, got, ref_eng, ch_eng = _canonical_pair()
         assert got == ref
         cc = ch_eng.compile_counts()
-        assert cc["prefill_chunked"] == 1
+        assert cc["prefill_chunked"] == 0
+        assert 1 <= cc["ragged"] <= len(ch_eng.token_buckets)
         assert cc["prefill"] == 0 and cc["prefill_offset"] == 0
         assert ref_eng.compile_counts()["prefill"] >= 2   # per-bucket
         assert ch_eng.cache.allocator.num_used == 0
@@ -316,11 +320,15 @@ class TestChunkedResilience:
         eng.scheduler.check_consistency()
 
     def test_fault_mid_chunk_quarantines_only_that_request(self):
+        # per-chunk fault isolation is a property of the CHAINED
+        # pipeline (each chunk is its own dispatch): pin it with the
+        # ragged knob off
         # dispatch #3 is the long prompt's SECOND chunk (its first
         # already landed), so the quarantine is genuinely mid-prefill
         fi = FaultInjector(seed=7).fail_at("dispatch", 3,
                                            transient=False)
-        eng = _engine(chunk=8, fault_injector=fi, retry_backoff_s=0.0)
+        eng = _engine(chunk=8, fault_injector=fi, retry_backoff_s=0.0,
+                      enable_ragged_step=False)
         short = eng.add_request(_prompts(43, (6,))[0], max_new_tokens=6)
         long = eng.add_request(_prompts(47, (32,))[0], max_new_tokens=6)
         out = eng.run()
@@ -330,6 +338,31 @@ class TestChunkedResilience:
         assert len(out[short]) == 12
         assert eng.cache.allocator.num_used == 0
         eng.scheduler.check_consistency()
+
+    def test_fault_in_ragged_step_quarantines_the_step_rows(self):
+        """One ragged dispatch carries EVERY row of the step, so a fault
+        implicates them all — coarser than the chained path's per-chunk
+        isolation (the documented price of sharing one executable). The
+        engine itself survives: pages drain and later arrivals serve."""
+        # dispatch 0 is the admission step (short's final chunk + long's
+        # first chunk); dispatch 1 (0-based fail_at) is the first step
+        # carrying BOTH a decode row (short) and a prefill chunk (long)
+        fi = FaultInjector(seed=7).fail_at("dispatch", 1,
+                                           transient=False)
+        eng = _engine(chunk=8, fault_injector=fi, retry_backoff_s=0.0)
+        short = eng.add_request(_prompts(43, (6,))[0], max_new_tokens=6)
+        long = eng.add_request(_prompts(47, (32,))[0], max_new_tokens=6)
+        eng.run()
+        assert eng.status(short)[0] == "failed"
+        assert "ragged" in eng.status(short)[1]
+        assert eng.status(long)[0] == "failed"
+        assert "ragged" in eng.status(long)[1]
+        assert eng.cache.allocator.num_used == 0
+        eng.scheduler.check_consistency()
+        late = eng.add_request(_prompts(59, (9,))[0], max_new_tokens=4)
+        out = eng.run()
+        assert eng.status(late)[0] == "finished"
+        assert len(out[late]) == 9 + 4
 
     def test_transient_fault_mid_chunk_is_retried(self):
         fi = FaultInjector(seed=7).fail_at("dispatch", 2, transient=True)
@@ -389,13 +422,24 @@ class TestChunkedMatrix:
         assert eng.cache.allocator.num_used == 0
 
     def test_compile_count_invariant_over_length_sweep(self):
-        """One chunked executable across prompts spanning every bucket
-        the unchunked engine would touch (16/32/64/128)."""
-        eng = _engine(chunk=16, max_seq_len=128)
-        for i, n in enumerate((3, 17, 40, 100)):
-            eng.add_request(_prompts(61 + i, (n,))[0], max_new_tokens=4)
-        eng.run()
-        cc = eng.compile_counts()
-        assert cc["prefill_chunked"] == 1
+        """Bounded executables across prompts spanning every bucket the
+        unchunked engine would touch (16/32/64/128): the ragged engine
+        compiles at most one executable per token bucket; with the knob
+        off the chained pipeline still compiles its ONE chunked
+        executable."""
+        def sweep(**kw):
+            eng = _engine(chunk=16, max_seq_len=128, **kw)
+            for i, n in enumerate((3, 17, 40, 100)):
+                eng.add_request(_prompts(61 + i, (n,))[0],
+                                max_new_tokens=4)
+            eng.run()
+            assert eng.cache.allocator.num_used == 0
+            return eng, eng.compile_counts()
+
+        eng, cc = sweep()
+        assert 1 <= cc["ragged"] <= len(eng.token_buckets)
+        assert cc["prefill_chunked"] == 0
         assert cc["prefill"] == 0 and cc["prefill_offset"] == 0
-        assert eng.cache.allocator.num_used == 0
+        _, cc = sweep(enable_ragged_step=False)
+        assert cc["prefill_chunked"] == 1 and cc["ragged"] == 0
+        assert cc["prefill"] == 0 and cc["prefill_offset"] == 0

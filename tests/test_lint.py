@@ -428,6 +428,46 @@ class TestHostSync:
             """, path=_HOT, rule="HOST-SYNC")
         assert findings == []
 
+    def test_ragged_module_covered_by_default(self):
+        """serving/ragged.py runs between two dispatches of a ragged
+        step: its builder is a default hot root, its cold helpers are
+        not."""
+        findings = run("""
+            import numpy as np
+
+            def build_ragged_inputs(decode, chunks):
+                return np.asarray(decode)
+
+            def describe(batch):
+                return batch.tokens.item()
+            """, path="paddle_tpu/serving/ragged.py", rule="HOST-SYNC")
+        assert len(findings) == 1
+        assert "build_ragged_inputs" in findings[0].message
+
+    def test_hot_modules_mapping_is_configurable(self):
+        """The traced-module list is constructor state, not a hardcoded
+        constant: a custom mapping REPLACES the default roots."""
+        served = """
+            class Engine:
+                def serve(self):
+                    return self.tokens.item()
+            """
+        stepped = """
+            class Engine:
+                def step(self):
+                    return self.tokens.item()
+            """
+        # default map: `serve` is not a hot root anywhere
+        assert run(served, path=_HOT, rule="HOST-SYNC") == []
+        custom = type(analysis.get_rule("HOST-SYNC"))(
+            hot_modules={"serving/engine.py": frozenset({"serve"})})
+        hits = analysis.run_source(textwrap.dedent(served), path=_HOT,
+                                   rules=[custom])
+        assert len(hits) == 1 and "serve" in hits[0].message
+        # the override replaces the default wholesale: step went cold
+        assert analysis.run_source(textwrap.dedent(stepped), path=_HOT,
+                                   rules=[custom]) == []
+
 
 # ---------------------------------------------------------------------------
 # WALLCLOCK-IN-REPLAY

@@ -763,7 +763,7 @@ class TestDecodeHorizon:
     def test_horizon_matrix_token_parity(self):
         """THE acceptance gate: horizons 1/4/8 under staggered arrivals
         all emit exactly the sequential-generate tokens (and therefore
-        match each other), with ONE fused decode executable each and no
+        match each other), with pow2-bucketed decode executables and no
         standalone sampler dispatch."""
         model = _llama()
         rng = np.random.RandomState(31)
@@ -776,7 +776,10 @@ class TestDecodeHorizon:
             assert outs == refs, f"horizon {h} diverged from generate"
             outs_by_h[h] = outs
             counts = eng.compile_counts()
-            assert counts["decode"] == 1, counts
+            # decode rows are padded to pow2 widths (1/2/4 at
+            # max_batch 4), so staggered batch sizes share at most
+            # log2(max_batch)+1 executables instead of one per size
+            assert 1 <= counts["decode"] <= 3, counts
             assert counts["sample"] == 0, counts   # sampling is fused
             assert eng.cache.allocator.num_used == 0
         assert outs_by_h[1] == outs_by_h[4] == outs_by_h[8]
@@ -971,12 +974,13 @@ class TestServingObservability:
             fam: eng.metrics.get("serving_jit_compile_misses_total",
                                  {"family": fam}).value
             for fam in ("prefill", "prefill_offset", "prefill_chunked",
-                        "decode", "sample")}
+                        "decode", "ragged", "sample")}
         assert counts["prefill"] == reg_counts["prefill"] == 1
         assert counts["decode"] == reg_counts["decode"] == 1
         assert counts["sample"] == reg_counts["sample"] == 0
         assert counts["prefill_chunked"] == \
             reg_counts["prefill_chunked"] == 0     # chunking off
+        assert counts["ragged"] == reg_counts["ragged"] == 0
         # dedup sets and registry counters stay in lockstep
         assert {f: len(s) for f, s in eng._exec_shapes.items()} == \
             reg_counts

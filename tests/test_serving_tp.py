@@ -129,6 +129,17 @@ class TestTokenParity:
         assert got == want
         assert eng.prefix_cache.stats()["hit_tokens"] >= len(_SHARED)
 
+    def test_ragged_chunked_parity_tp2(self):
+        """Chunked prefill defaults to the ragged mixed-step executable;
+        at tp=2 that one flat program runs under shard_map (replicated
+        flat ids, sharded pools) and must stay bit-identical."""
+        kw = dict(enable_chunked_prefill=True, prefill_chunk_tokens=8)
+        _, want = _staggered(_llama(), seeded=True, **kw)
+        eng, got = _staggered(_llama(), seeded=True, tp_size=2, **kw)
+        assert got == want
+        cc = eng.compile_counts()
+        assert cc["ragged"] >= 1 and cc["prefill_chunked"] == 0
+
     @pytest.mark.slow
     @pytest.mark.parametrize("chunked", [False, True])
     @pytest.mark.parametrize("horizon", [1, 8])

@@ -525,6 +525,33 @@ def _run_serving_cluster(on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
+def _run_serving_slo(on_tpu: bool) -> dict:
+    """Observability v2 phase: goodput vs raw throughput under two SLO
+    classes on mixed load, recorder overhead at typical ring sizes, and
+    the post-mortem bundle a seeded `device_lost` kill leaves behind.
+    Non-fatal like the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_slo_phase(model, cfg, on_tpu)
+        worst = max(r["overhead"] for r in out["recorder_ring"].values())
+        _log(f"phase=serving_slo: goodput {out['goodput_tokens']}/"
+             f"{out['tokens_generated']} tokens "
+             f"({out['goodput_fraction']}), interactive ttft attainment "
+             f"{out['slo']['interactive']['attainment_ttft']}, recorder "
+             f"{out['record_ns_per_event']}ns/event "
+             f"(worst ring overhead {worst}x), postmortem "
+             f"events={out['postmortem']['events_in_bundle']} "
+             f"complete={out['postmortem']['has_fault_and_dead']}")
+        if not out["postmortem"]["has_fault_and_dead"]:
+            _log("phase=serving_slo: WARN death bundle missing "
+                 "fault/dead events")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_slo: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def make_train_step(model, opt):
     """The bench train step (fwd + MLM loss + grad + Adam, bf16 autocast).
 
@@ -753,6 +780,10 @@ def bench_child() -> None:
     # replicated-cluster phase: replica kill, migration, affinity payoff
     _enter_phase("serving_cluster", 400.0)
     serving_cluster = _run_serving_cluster(on_tpu)
+
+    # observability v2 phase: SLO goodput, recorder cost, death bundle
+    _enter_phase("serving_slo", 400.0)
+    serving_slo = _run_serving_slo(on_tpu)
     _enter_phase("build")
 
     if on_tpu:
@@ -891,6 +922,7 @@ def bench_child() -> None:
                 "serving_ragged": serving_ragged,
                 "serving_recovery": serving_recovery,
                 "serving_cluster": serving_cluster,
+                "serving_slo": serving_slo,
                 "lint": lint,
                 "observability": _obs_snapshot(),
             },

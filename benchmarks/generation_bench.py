@@ -784,5 +784,143 @@ def serving_ragged_phase(model, cfg, on_tpu):
     }
 
 
+def serving_slo_phase(model, cfg, on_tpu):
+    """Observability v2 cost + signal (ISSUE 13): the mixed-load
+    workload runs with two SLO classes registered — a tight
+    `interactive` class and a loose `batch` class — and reports goodput
+    (tokens delivered within their class target) NEXT TO raw throughput,
+    per-class attainment, and the step-phase breakdown. Then the same
+    workload re-runs with a flight recorder at typical ring sizes to
+    price the always-on forensic layer (plus a direct ns/record
+    microbench — the ring is a deque append, capacity must not matter).
+    Finally a supervised engine is killed by a seeded `device_lost`
+    fatal and the phase reports the post-mortem bundle the death left
+    behind."""
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.observability import FlightRecorder, SloClass
+    from paddle_tpu.serving import (EngineDead, EngineSupervisor,
+                                    FaultInjector, RequestJournal,
+                                    ServingEngine)
+
+    rng = np.random.RandomState(37)
+    page_size = 16 if on_tpu else 8
+    max_seq = min(cfg.max_position_embeddings, 512 if on_tpu else 96)
+    n_req, new_tokens = 6, 24
+    prompts = [rng.randint(0, cfg.vocab_size, (6 + 3 * i,)).tolist()
+               for i in range(n_req)]
+    # tight interactive targets a tiny CPU model will partly MISS (that
+    # is the point: goodput < throughput is the signal) vs loose batch
+    # targets it always meets
+    classes = [SloClass("interactive", ttft_target_s=0.05,
+                        tpot_target_s=0.002),
+               SloClass("batch", ttft_target_s=30.0, tpot_target_s=1.0)]
+
+    def build(recorder=None, fi=None, postmortem_dir=None):
+        return ServingEngine(model, page_size=page_size,
+                             max_batch_size=4, max_seq_len=max_seq,
+                             decode_horizon=4, retry_backoff_s=0.0,
+                             slo_classes=classes, flight_recorder=recorder,
+                             fault_injector=fi,
+                             postmortem_dir=postmortem_dir)
+
+    def submit(eng):
+        rids = []
+        for i, p in enumerate(prompts):
+            slo = (None if i == n_req - 1       # one classless rider
+                   else "interactive" if i % 2 == 0 else "batch")
+            rids.append(eng.add_request(p, max_new_tokens=new_tokens,
+                                        slo_class=slo))
+        return rids
+
+    # warm compiles outside every timed region
+    weng = build()
+    submit(weng)
+    weng.run()
+
+    # ---- goodput vs raw throughput under mixed SLO load (no recorder)
+    eng = build()
+    submit(eng)
+    t0 = time.perf_counter()
+    eng.run()
+    wall_base = time.perf_counter() - t0
+    st = eng.stats()
+    per_class = {
+        name: {
+            "goodput_tokens": row["goodput_tokens"],
+            "attainment_ttft": round(row["attainment"]["ttft"], 4),
+            "attainment_tpot": round(row["attainment"]["tpot"], 4),
+            "lifetime_tpot_p95_ms": round(
+                row["lifetime"]["tpot"]["p95"] * 1000, 3),
+        }
+        for name, row in st["slo"].items()
+    }
+    breakdown = {
+        phase: {"count": row["count"],
+                "p95_ms": round(row["p95"] * 1000, 3)}
+        for phase, row in st["step_breakdown"].items()
+    }
+
+    # ---- recorder overhead at typical ring sizes (same workload)
+    ring = {}
+    for cap in (64, 256, 1024):
+        rec = FlightRecorder(capacity=cap)
+        e2 = build(recorder=rec)
+        submit(e2)
+        t0 = time.perf_counter()
+        e2.run()
+        wall = time.perf_counter() - t0
+        ring[cap] = {
+            "wall_ms": round(wall * 1000, 2),
+            "overhead": round(wall / max(wall_base, 1e-9), 3),
+            "events_recorded": rec.total_recorded,
+        }
+    rec = FlightRecorder(capacity=256)
+    n_ev = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n_ev):
+        rec.record("dispatch", family="decode", rows=4, horizon=4)
+    record_ns = (time.perf_counter() - t0) / n_ev * 1e9
+
+    # ---- post-mortem bundle off a seeded device_lost kill
+    dump_dir = tempfile.mkdtemp(prefix="paddle_tpu_slo_bench_")
+    dead_rec = FlightRecorder(capacity=512)
+    fi = FaultInjector().fail_at("device_lost", 3)
+    sup = EngineSupervisor(
+        lambda: build(recorder=dead_rec, fi=fi,
+                      postmortem_dir=dump_dir),
+        journal=RequestJournal(), max_restarts=0)
+    for p in prompts[:3]:
+        sup.add_request(p, max_new_tokens=8)
+    died = False
+    try:
+        sup.run()
+    except EngineDead:
+        died = True
+    bundle = sup.postmortem or {}
+    kinds = [e["kind"] for e in bundle.get("events", ())]
+    return {
+        "requests": n_req, "new_tokens": new_tokens,
+        "wall_ms": round(wall_base * 1000, 2),
+        "tokens_generated": st["tokens_generated"],
+        "goodput_tokens": st["goodput_tokens"],
+        "goodput_fraction": round(
+            st["goodput_tokens"] / max(st["tokens_generated"], 1), 4),
+        "slo": per_class,
+        "step_breakdown": breakdown,
+        "recorder_ring": ring,
+        "record_ns_per_event": round(record_ns, 1),
+        "postmortem": {
+            "engine_died": died,
+            "bundle_path": sup.postmortem_path,
+            "events_in_bundle": len(kinds),
+            "has_fault_and_dead": ("fault" in kinds and "dead" in kinds),
+        },
+    }
+
+
 if __name__ == "__main__":
     main()

@@ -45,6 +45,13 @@ DEFAULT_HOT_MODULES: Dict[str, FrozenSet[str]] = {
     "serving/engine.py": frozenset({"step"}),
     "serving/scheduler.py": frozenset({"schedule"}),
     "serving/ragged.py": frozenset({"build_ragged_inputs"}),
+    # ISSUE 13: the SLO tracker's per-token hooks and the flight
+    # recorder's ring append run inside the engine's step/drain path —
+    # a stray device read there would stall the pipeline exactly like
+    # one in the scheduler
+    "observability/slo.py": frozenset(
+        {"first_token", "decode_tokens", "step_tick"}),
+    "observability/flight_recorder.py": frozenset({"record"}),
 }
 _SYNC_METHOD_TAILS = {"item", "tolist", "block_until_ready"}
 _SYNC_CHAINS = {

@@ -10,7 +10,13 @@ Three pieces:
 - `export`: Prometheus text exposition + JSON snapshot round-trip;
 - `lifecycle`: `LifecycleTracker` — per-request spans
   (`serving.request[<rid>].<stage>`) folded into the
-  paddle_tpu.profiler chrome-trace host tracer.
+  paddle_tpu.profiler chrome-trace host tracer;
+- `slo`: `SloClass`/`SloTracker` — per-request-class TTFT/TPOT targets,
+  goodput counting and sliding-window attainment gauges over the
+  existing log-bucket histograms (windowed bucket deltas);
+- `flight_recorder`: `FlightRecorder` — bounded ring of control-plane
+  events plus JSON post-mortem bundles dumped on engine death /
+  quarantine (`tools/postmortem.py` renders them).
 
 `global_registry()` is the process-wide registry for library-level
 signals (e.g. trace-time paged-attention dispatch counts); each
@@ -23,13 +29,18 @@ import threading
 from typing import Optional
 
 from .export import registry_from_snapshot, to_prometheus  # noqa: F401
+from .flight_recorder import FlightRecorder, build_postmortem, \
+    dump_postmortem  # noqa: F401
 from .lifecycle import LifecycleTracker  # noqa: F401
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
+from .slo import HistogramWindow, SloClass, SloTracker  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "LifecycleTracker", "to_prometheus", "registry_from_snapshot",
     "global_registry",
+    "SloClass", "SloTracker", "HistogramWindow",
+    "FlightRecorder", "build_postmortem", "dump_postmortem",
 ]
 
 _GLOBAL: Optional[MetricsRegistry] = None

@@ -77,7 +77,7 @@ from .recovery import (  # noqa: F401
 )
 from .resilience import (  # noqa: F401
     EngineDead, EngineOverloaded, FaultInjector, InjectedFault,
-    TERMINAL_STATUSES, is_fatal, is_transient,
+    TERMINAL_STATUSES, describe_fault, is_fatal, is_transient,
 )
 from .scheduler import (  # noqa: F401
     ChunkTask, Request, SamplingParams, ScheduleDecision, Scheduler,
@@ -106,7 +106,7 @@ __all__ = [
     "PagedKVCache", "PagedLayerCache", "BlockAllocator",
     "PrefixCache", "PrefixNode",
     "EngineDead", "EngineOverloaded", "FaultInjector", "InjectedFault",
-    "TERMINAL_STATUSES", "is_fatal", "is_transient",
+    "TERMINAL_STATUSES", "describe_fault", "is_fatal", "is_transient",
     "RequestJournal", "EngineSnapshot", "RequestSnapshot",
     "EngineSupervisor", "replay_key_state",
     "Scheduler", "ScheduleDecision", "ChunkTask", "Request",

@@ -189,7 +189,8 @@ class ServingCluster:
                  journal_paths: Optional[Sequence[str]] = None,
                  clock: Callable[[], float] = time.perf_counter,
                  tp_size: int = 1,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 postmortem_dir: Optional[str] = None):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
         if placement not in ("load", "round_robin"):
@@ -293,6 +294,12 @@ class ServingCluster:
         self._rr = 0                   # round-robin cursor
         self._step_count = 0
         self.dead_replicas = 0
+        # forensics (ISSUE 13): replica deaths dump a post-mortem bundle
+        # here — the supervisor's bundle refreshed with the migration
+        # events the cluster appended to the dead engine's ring. None =
+        # bundles stay in memory (rep.supervisor.postmortem).
+        self.postmortem_dir = postmortem_dir
+        self.postmortem_paths: List[str] = []
 
     # ------------------------------------------------------------ metrics
     def _init_metrics(self) -> None:
@@ -848,6 +855,41 @@ class ServingCluster:
         t1 = time.perf_counter()
         if migrated and self._m_migration_s is not None:
             self._m_migration_s.observe(t1 - t0)
+        self._dump_death_postmortem(rep, exc, migrated)
+
+    def _dump_death_postmortem(self, rep: ReplicaHandle, exc: EngineDead,
+                               migrated: int) -> None:
+        """Finish the dead replica's forensics: the supervisor built its
+        bundle BEFORE the migration loop ran, so refresh the event list
+        from the (still-alive) ring — which now carries the migrate
+        events — fold in the cluster's view, and write the bundle when a
+        `postmortem_dir` is configured. Guarded end to end: forensics
+        must never turn a survived failover into a crash."""
+        try:
+            sup = rep.supervisor
+            bundle = getattr(sup, "postmortem", None)
+            if bundle is None:
+                return
+            recorder = getattr(sup, "_dead_recorder", None)
+            if recorder is not None:
+                bundle["events"] = recorder.events()
+                bundle["events_total"] = recorder.total_recorded
+            bundle.setdefault("info", {})["cluster"] = {
+                "replica": rep.index,
+                "dead_replicas": self.dead_replicas,
+                "migrated": migrated,
+                "error": str(exc),
+            }
+            if self.postmortem_dir is not None:
+                from ..observability import dump_postmortem
+
+                path = dump_postmortem(
+                    bundle, self.postmortem_dir,
+                    prefix=f"postmortem-r{rep.index}")
+                self.postmortem_paths.append(path)
+                sup.postmortem_path = path
+        except Exception:  # noqa: BLE001 — forensics must not kill failover
+            pass
 
     def _migrate_one(self, rep: ReplicaHandle, erid: int,
                      reason: str) -> None:
@@ -914,6 +956,14 @@ class ServingCluster:
                 len(rec.prompt) + len(rec.delivered))
         if self.prefix_affinity:
             self._note_affinity(rec.prompt, target.index)
+        recorder = getattr(rep.supervisor, "_dead_recorder", None)
+        if recorder is not None:
+            # append to the DEAD replica's ring: its post-mortem bundle
+            # then shows the fatal fault, the death, and where every
+            # casualty went — the full story in one timeline
+            recorder.record("migrate", rid=crid, src=rep.index,
+                            dst=target.index, new_rid=new_rid,
+                            delivered=len(rec.delivered))
         t1 = time.perf_counter()
         add_host_span(
             f"serving.cluster.migrate[{crid}]"
@@ -1072,6 +1122,54 @@ class ServingCluster:
                  "stats": rep.supervisor.stats()}
                 for rep in self.replicas],
             "requests": requests,
+        }
+
+    def telemetry(self) -> Dict[str, object]:
+        """One cluster-wide metric view (ISSUE 13): every live replica's
+        engine registry merged with the cluster's own registry into a
+        single replica-labelled snapshot plus its Prometheus text
+        exposition — the scrape endpoint a deployment exports, instead
+        of N per-replica registries.
+
+        Every merged series gains a ``replica`` label: the engine
+        registries are tagged with their replica index, the cluster
+        registry with ``cluster``. ``setdefault`` (never overwrite)
+        keeps the cluster's own per-replica gauges — which already
+        carry a ``replica`` label — intact. Engines that share the
+        cluster registry (``metrics=cluster.metrics`` factories) are
+        skipped so their series never double-count."""
+        from ..observability import registry_from_snapshot, to_prometheus
+
+        merged: List[dict] = []
+
+        def fold(registry, tag: str) -> None:
+            for d in registry.snapshot()["metrics"]:
+                d = dict(d)
+                labels = dict(d.get("labels") or {})
+                labels.setdefault("replica", tag)
+                d["labels"] = labels
+                merged.append(d)
+
+        if self.metrics is not None:
+            fold(self.metrics, "cluster")
+        for rep in self.replicas:
+            eng = rep.supervisor.engine
+            if eng is None or eng.metrics is None \
+                    or eng.metrics is self.metrics:
+                continue
+            fold(eng.metrics, str(rep.index))
+        registry = registry_from_snapshot({"metrics": merged})
+        return {
+            "replicas": [
+                {"index": rep.index, "health": rep.health,
+                 "alive": rep.supervisor.engine is not None,
+                 "restarts": len(rep.supervisor.restarts),
+                 "postmortem": rep.supervisor.postmortem_path}
+                for rep in self.replicas],
+            "dead_replicas": self.dead_replicas,
+            "metrics": registry.snapshot(),
+            "prometheus": to_prometheus(registry),
+            "postmortems": list(self.postmortem_paths),
         }
 
     def close(self) -> None:

@@ -70,6 +70,9 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..jit.functional import call_functional, extract_state
 from ..observability import Histogram, LifecycleTracker, MetricsRegistry
+from ..observability.flight_recorder import (
+    build_postmortem as _build_bundle, dump_postmortem as _dump_bundle)
+from ..observability.slo import SloTracker
 from ..profiler import RecordEvent
 from .attention import advance_positions
 from .kv_cache import (PagedKVCache, PagedLayerCache, overflow_position,
@@ -78,7 +81,8 @@ from .prefix_cache import PrefixCache
 from .ragged import build_ragged_inputs
 from .ragged import token_buckets as ragged_token_buckets
 from .recovery import EngineSnapshot, RequestSnapshot, replay_key_state
-from .resilience import TERMINAL_STATUSES, is_fatal, is_transient
+from .resilience import (TERMINAL_STATUSES, describe_fault, is_fatal,
+                         is_transient)
 from .scheduler import (Request, SamplingParams, Scheduler,
                         reserve_request_ids)
 
@@ -213,6 +217,23 @@ class ServingObs:
         self.parked_total = c("serving_requests_parked_total",
                               "preemption-storm guard trips (victim "
                               "requeued at the back of the queue)")
+        # step-phase breakdown (ISSUE 13): wall time per step split into
+        # schedule (policy + page reservation), assemble (host-side batch
+        # packing: buckets, tables, padding), dispatch (jitted launch
+        # until control returns to the host — async, so this is NOT
+        # device time) and drain (the ONE host sync pulling tokens back).
+        # device_residency estimates device occupancy as dispatch-time to
+        # drain-time of the same block — the denominator ROADMAP 5's
+        # overlap fraction needs.
+        self.step_phase = {
+            phase: h("serving_step_phase_seconds",
+                     "per-step wall time by phase (schedule / assemble "
+                     "/ dispatch / drain)", labels={"phase": phase})
+            for phase in ("schedule", "assemble", "dispatch", "drain")}
+        self.device_residency = h(
+            "serving_device_residency_seconds",
+            "dispatch-to-drain wall per block: how long work was "
+            "resident on the device side of the async overlap")
         self.queue_waiting = g("serving_queue_depth",
                                "scheduler queue depth",
                                labels={"state": "waiting"})
@@ -307,7 +328,11 @@ class ServingEngine:
                  retry_backoff_s: float = 0.02,
                  journal=None,
                  tp_size: int = 1,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 slo_classes: Optional[Sequence] = None,
+                 slo_refresh_every: int = 64,
+                 flight_recorder=None,
+                 postmortem_dir: Optional[str] = None):
         from ..models.generation import _config_of
 
         self.model = model
@@ -397,6 +422,29 @@ class ServingEngine:
             self._obs.bind_tp(self.tp_size)
         if self.metrics is not None:
             self.cache.allocator.bind_metrics(self.metrics)
+        # SLO accounting (ISSUE 13): per-request-class TTFT/TPOT targets
+        # feeding windowed attainment gauges + a goodput counter. Rides
+        # on the metrics registry, so it requires one; with no classes
+        # registered the engine holds None and executes zero SLO code
+        # (raise-on-touch pinned, like enable_metrics=False).
+        if slo_classes:
+            if self.metrics is None:
+                raise ValueError(
+                    "slo_classes requires metrics (SLO accounting lives "
+                    "in the registry); drop enable_metrics=False")
+            self._slo = SloTracker(self.metrics, slo_classes,
+                                   refresh_every=slo_refresh_every)
+        else:
+            self._slo = None
+        # flight recorder (ISSUE 13): bounded ring of control-plane
+        # events. None = the engine executes no recorder code at all.
+        # Independent of metrics — forensics work even on a metrics-off
+        # engine, and vice versa.
+        self._recorder = flight_recorder
+        # where quarantine/death post-mortem bundles land; None = build
+        # bundles only on explicit dump_postmortem(directory=...) calls
+        self._postmortem_dir = postmortem_dir
+        self.last_postmortem_path: Optional[str] = None
         # automatic prefix caching (full-page granularity, LRU eviction):
         # finished/prefilled prompts leave their full pages in a radix
         # tree; a later prompt sharing a page-aligned prefix reuses them
@@ -443,6 +491,7 @@ class ServingEngine:
                                    decode_horizon=self.decode_horizon,
                                    drain_hook=self._drain_for_scheduler,
                                    obs=self._obs,
+                                   recorder=flight_recorder,
                                    max_waiting=max_waiting,
                                    max_preemptions=max_preemptions,
                                    # chunked prefill handles any folded
@@ -505,7 +554,8 @@ class ServingEngine:
                     temperature: float = 0.0, top_k: int = 0,
                     top_p: float = 1.0, seed: Optional[int] = None,
                     eos_token_id: Optional[int] = None,
-                    deadline_s: Optional[float] = None) -> int:
+                    deadline_s: Optional[float] = None,
+                    slo_class: Optional[str] = None) -> int:
         """Queue one prompt; returns a request id. Non-blocking — the
         request runs as `step()`/`stream()` turn the crank. ALL
         validation happens up front: a rejected request leaves no trace
@@ -514,12 +564,19 @@ class ServingEngine:
         (`max_waiting`) is full. `deadline_s` bounds the request's TOTAL
         latency from arrival: past it, a waiting request is expired
         before admission and a running one is cancelled at the next
-        block boundary (terminal status "expired" either way)."""
+        block boundary (terminal status "expired" either way).
+        `slo_class` opts the request into per-class SLO accounting; it
+        must name a class registered via the engine's `slo_classes=`."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0 (got {deadline_s})")
+        if slo_class is not None and (
+                self._slo is None or not self._slo.has_class(slo_class)):
+            raise ValueError(
+                f"unknown SLO class {slo_class!r}; register it via "
+                "ServingEngine(slo_classes=[SloClass(...)])")
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -538,7 +595,7 @@ class ServingEngine:
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       sampling=SamplingParams(temperature, top_k, top_p,
                                               seed),
-                      eos_token_id=eos_token_id)
+                      eos_token_id=eos_token_id, slo_class=slo_class)
         if deadline_s is not None:
             req.deadline_t = req.arrival_t + deadline_s
         # scheduler.add validates the page budget and the bounded queue
@@ -664,6 +721,9 @@ class ServingEngine:
             return fn(), None
         except Exception as e:  # noqa: BLE001 — isolation boundary
             self.fault_events += 1
+            if self._recorder is not None:
+                self._recorder.record("fault", site=site,
+                                      error=str(e), **describe_fault(e))
             if is_fatal(e):
                 raise
             if not is_transient(e):
@@ -678,6 +738,10 @@ class ServingEngine:
                 return fn(), None
             except Exception as e2:  # noqa: BLE001
                 self.fault_events += 1
+                if self._recorder is not None:
+                    self._recorder.record("fault", site=site, retry=True,
+                                          error=str(e2),
+                                          **describe_fault(e2))
                 if is_fatal(e2):
                     raise
                 return None, e2
@@ -693,6 +757,9 @@ class ServingEngine:
         its writes target pages being released)."""
         err = f"{site}: {type(exc).__name__}: {exc}"
         rids = {r.request_id for r in reqs}
+        if self._recorder is not None:
+            self._recorder.record("quarantine", site=site, error=err,
+                                  rids=sorted(rids))
         if self._pending is not None \
                 and rids & set(self._pending["rids"]):
             rec, self._pending = self._pending, None
@@ -702,6 +769,14 @@ class ServingEngine:
             if req.status not in TERMINAL_STATUSES:
                 self._finalize(req, "failed", error=err)
         self.scheduler.check_consistency()
+        if self._postmortem_dir is not None:
+            # a quarantine is a casualty worth forensics even though the
+            # engine survives: dump a bundle, but never let the dump
+            # itself take the engine down
+            try:
+                self.dump_postmortem(f"quarantine-{site}")
+            except Exception:  # noqa: BLE001 — forensics must not kill
+                pass
 
     # ---------------------------------------------------------------- steps
     def step(self) -> List[Tuple[int, int]]:
@@ -719,12 +794,18 @@ class ServingEngine:
         if fi is not None:
             try:
                 fi.check("device_lost")
-            except Exception:
+            except Exception as e:
                 self.fault_events += 1
+                if self._recorder is not None:
+                    self._recorder.record("fault", site="device_lost",
+                                          error=str(e),
+                                          **describe_fault(e))
                 raise
         events = self._step_impl()
         if self._journal is not None and events:
             self._journal_delivery(events)
+        if self._slo is not None:
+            self._slo.step_tick()
         return events
 
     def _step_impl(self) -> List[Tuple[int, int]]:
@@ -736,7 +817,17 @@ class ServingEngine:
             # stretch where every running request is still mid-prefill
             # with nobody decode-ready — resets the gap clock
             self._last_decode_dispatch_t = None
+        t_sched = time.perf_counter()
         decision = self.scheduler.schedule()   # drain_hook may spill here
+        if self._obs is not None:
+            self._obs.step_phase["schedule"].observe(
+                time.perf_counter() - t_sched)
+        if self._recorder is not None:
+            self._recorder.record(
+                "schedule", decision=decision.kind,
+                prefill=(decision.prefill.request_id
+                         if decision.prefill is not None else None),
+                decode=len(decision.decode), chunks=len(decision.chunks))
         spilled, self._spill = self._spill, []
         if decision.kind == "prefill":
             return spilled + self._prefill(decision.prefill)
@@ -916,8 +1007,11 @@ class ServingEngine:
         if req.first_token_t is None:
             req.first_token_t = now
             if o is not None:
-                o.ttft.observe(max(now - req.arrival_t, 0.0))
+                ttft = max(now - req.arrival_t, 0.0)
+                o.ttft.observe(ttft)
                 o.lifecycle.point(req.request_id, "first_token", now)
+                if self._slo is not None:
+                    self._slo.first_token(req.slo_class, ttft)
         req.last_token_t = now
         if req.is_done():
             req.finish_t = now
@@ -928,6 +1022,7 @@ class ServingEngine:
         # prefix-cache hit: only the uncached suffix runs through the
         # model (bucketed on the SUFFIX length, so a long shared prompt
         # with a short question prefills in the smallest bucket)
+        t_in = time.perf_counter()
         n_cached = req.cached_tokens
         suffix = req.prompt[n_cached:]
         bucket = self._bucket_for(len(suffix))
@@ -961,6 +1056,9 @@ class ServingEngine:
             return int(np.asarray(tok)[0])
 
         t0 = time.perf_counter()
+        if self._recorder is not None:
+            self._recorder.record("dispatch", family=family,
+                                  rid=req.request_id, tokens=len(suffix))
         with RecordEvent("serving.prefill"):
             token, err = self._guarded_call("dispatch", dispatch)
         if token is None:
@@ -983,11 +1081,18 @@ class ServingEngine:
             o.host_syncs.inc()
             o.prefill_seconds.inc(now - t0)
             o.lifecycle.span(req.request_id, "prefill", t0, now)
+            o.step_phase["assemble"].observe(t0 - t_in)
+            # prefill's drain is fused into the dispatch (the sampled
+            # token syncs inside it), so the whole span lands here
+            o.step_phase["dispatch"].observe(now - t0)
         events = [self._emit(req, token, now)]
         if o is not None and prev_t is not None:
             # requeued request: the gap since its last pre-preemption
             # token is honest inter-token latency
-            o.inter_token.observe(max(now - prev_t, 0.0))
+            gap = max(now - prev_t, 0.0)
+            o.inter_token.observe(gap)
+            if self._slo is not None:
+                self._slo.decode_tokens(req.slo_class, gap, 1)
         return events
 
     # ------------------------------------------------------ chunked prefill
@@ -1040,6 +1145,7 @@ class ServingEngine:
         padding past the prompt is overwritten by the first decode
         steps, and positions past the page table's capacity route to
         the null page."""
+        t_in = time.perf_counter()
         req, start, n = task.req, task.start, task.length
         rid = req.request_id
         chunk = self.prefill_chunk_tokens
@@ -1069,6 +1175,9 @@ class ServingEngine:
             return int(np.asarray(tok)[0])
 
         t0 = time.perf_counter()
+        if self._recorder is not None:
+            self._recorder.record("dispatch", family="prefill_chunk",
+                                  rid=rid, tokens=n, final=final)
         with RecordEvent("serving.prefill_chunk"):
             token, err = self._guarded_call("dispatch", dispatch)
         if token is None:
@@ -1089,6 +1198,8 @@ class ServingEngine:
             # lifecycle lists must not grow per chunk); the final chunk
             # is the retained "prefill" stage
             o.lifecycle.span(rid, "prefill", t0, now, retain=final)
+            o.step_phase["assemble"].observe(t0 - t_in)
+            o.step_phase["dispatch"].observe(now - t0)
         if not final:
             return []
         if self.prefix_cache is not None:
@@ -1099,7 +1210,10 @@ class ServingEngine:
             o.host_syncs.inc()
         events = [self._emit(req, token, now)]
         if o is not None and prev_t is not None:
-            o.inter_token.observe(max(now - prev_t, 0.0))
+            gap = max(now - prev_t, 0.0)
+            o.inter_token.observe(gap)
+            if self._slo is not None:
+                self._slo.decode_tokens(req.slo_class, gap, 1)
         return events
 
     # ---------------------------------------------------------- ragged step
@@ -1210,6 +1324,7 @@ class ServingEngine:
         drain instead of synchronously, one step later than the chained
         path; stream CONTENT is unchanged."""
         events = self._drain_pending()
+        t_in = time.perf_counter()      # assemble starts after the drain
         decode = [r for r in decision.decode if r.status == "running"]
         chunks = [t for t in decision.chunks
                   if t.req.status == "running"
@@ -1252,6 +1367,11 @@ class ServingEngine:
             return out
 
         t0 = time.perf_counter()
+        if self._recorder is not None:
+            self._recorder.record("dispatch", family="ragged",
+                                  rows=len(batch.reqs),
+                                  decode=len(decode), chunks=len(chunks),
+                                  t_bucket=batch.t_bucket)
         with RecordEvent("serving.ragged_step"):
             out, err = self._guarded_call("dispatch", dispatch)
         if out is None:
@@ -1285,6 +1405,8 @@ class ServingEngine:
         if o is not None:
             o.ragged_steps.inc()
             o.dispatches.inc()
+            o.step_phase["assemble"].observe(t0 - t_in)
+            o.step_phase["dispatch"].observe(now - t0)
             if decode:
                 o.decode_steps.inc()
                 if self._last_decode_dispatch_t is not None:
@@ -1367,6 +1489,7 @@ class ServingEngine:
         return min(b, self.max_batch_size)
 
     def _decode(self, reqs: Sequence[Request]) -> List[Tuple[int, int]]:
+        t_in = time.perf_counter()
         reqs = [r for r in reqs if r.status == "running"]
         if not reqs:
             return self._drain_pending()
@@ -1449,6 +1572,9 @@ class ServingEngine:
             return out
 
         t0 = time.perf_counter()
+        if self._recorder is not None:
+            self._recorder.record("dispatch", family="decode",
+                                  rows=len(reqs), horizon=h)
         with RecordEvent("serving.decode_block"):
             out, err = self._guarded_call("dispatch", dispatch)
         if out is None:
@@ -1465,6 +1591,9 @@ class ServingEngine:
         for req, n in zip(reqs, incr):
             req.inflight += n
         if self._obs is not None:
+            t1 = time.perf_counter()
+            self._obs.step_phase["assemble"].observe(t0 - t_in)
+            self._obs.step_phase["dispatch"].observe(t1 - t0)
             self._obs.decode_steps.inc()
             self._obs.dispatches.inc()
             if self._last_decode_dispatch_t is not None:
@@ -1506,6 +1635,7 @@ class ServingEngine:
         past-the-end steps to PAD), finish requests, refresh per-request
         key state from the block's device carries."""
         o = self._obs
+        t_in = time.perf_counter()
         with RecordEvent("serving.host_drain"):
             toks, err = self._guarded_call(
                 "drain", lambda: np.asarray(jax.device_get(rec["emitted"])))  # noqa: HOST-SYNC — THE one sync per decode block (PR 3 contract)
@@ -1552,10 +1682,23 @@ class ServingEngine:
                     per_tok = max(now - prev_t, 0.0) / k
                     for _ in range(k):
                         o.inter_token.observe(per_tok)
+                    if self._slo is not None:
+                        self._slo.decode_tokens(req.slo_class, per_tok, k)
         # decode wall time without double-counting overlapped block spans
         start = max(rec["t0"], self._last_drain_t)
         if o is not None:
             o.decode_seconds.inc(max(now - start, 0.0))
+            o.step_phase["drain"].observe(now - t_in)
+            # dispatch-to-drain span of THIS block: how long its work
+            # was resident device-side (the async overlap means host
+            # wall and device wall differ — this is the device-side
+            # estimate ROADMAP 5's overlap fraction divides by)
+            o.device_residency.observe(max(now - rec["t0"], 0.0))
+        if self._recorder is not None:
+            self._recorder.record("drain",
+                                  family=rec.get("kind", "decode"),
+                                  rows=len(rec["reqs"]),
+                                  tokens=len(events))
         self._last_drain_t = now
         return events
 
@@ -1768,7 +1911,8 @@ class ServingEngine:
                       eos_token_id: Optional[int] = None,
                       deadline_wall: Optional[float] = None,
                       key_splits: int = 0,
-                      request_id: Optional[int] = None) -> int:
+                      request_id: Optional[int] = None,
+                      slo_class: Optional[str] = None) -> int:
         """Re-admit another engine's in-flight request into THIS engine
         while it keeps serving — the cluster's migration/hedging
         primitive. `restore()` demands a fresh engine (it rebuilds a
@@ -1791,6 +1935,13 @@ class ServingEngine:
         delivered = [int(t) for t in delivered]
         if not prompt:
             raise ValueError("empty prompt")
+        if slo_class is not None and (
+                self._slo is None or not self._slo.has_class(slo_class)):
+            # a migrated request's class may not exist on the adopting
+            # replica; dropping to class-less beats rejecting the
+            # migration, but an explicit unknown class is caller error
+            raise ValueError(
+                f"unknown SLO class {slo_class!r} on adopting engine")
         remaining = max_new_tokens - len(delivered)
         if remaining < 1:
             raise ValueError(
@@ -1814,7 +1965,7 @@ class ServingEngine:
         req = Request(prompt=folded, max_new_tokens=remaining,
                       sampling=SamplingParams(temperature, top_k, top_p,
                                               seed),
-                      eos_token_id=eos_token_id,
+                      eos_token_id=eos_token_id, slo_class=slo_class,
                       **({"request_id": request_id}
                          if request_id is not None else {}))
         rid = req.request_id
@@ -1848,6 +1999,10 @@ class ServingEngine:
             self._deadlined.add(rid)
         if self._obs is not None:
             self._obs.lifecycle.point(rid, "adopted")
+        if self._recorder is not None:
+            self._recorder.record("adopt", rid=rid,
+                                  delivered=len(delivered),
+                                  remaining=remaining)
         return rid
 
     # -------------------------------------------------------------- metrics
@@ -1916,6 +2071,36 @@ class ServingEngine:
             "decode_stall": (o.decode_stall.summary() if o is not None
                              else Histogram.empty_summary()),
         }
+        # step-phase breakdown (ISSUE 13): where a step's wall time goes
+        # — scheduling, host-side batch assembly, the jitted launch, and
+        # THE host sync — plus the dispatch-to-drain device-residency
+        # estimate (ROADMAP 5's overlap-fraction denominator)
+        if o is not None:
+            s["step_breakdown"] = {
+                "schedule": o.step_phase["schedule"].summary(),
+                "assemble": o.step_phase["assemble"].summary(),
+                "dispatch": o.step_phase["dispatch"].summary(),
+                "drain": o.step_phase["drain"].summary(),
+                "device_residency": o.device_residency.summary(),
+            }
+        else:
+            s["step_breakdown"] = {
+                "schedule": Histogram.empty_summary(),
+                "assemble": Histogram.empty_summary(),
+                "dispatch": Histogram.empty_summary(),
+                "drain": Histogram.empty_summary(),
+                "device_residency": Histogram.empty_summary(),
+            }
+        # SLO/goodput (ISSUE 13): per-class targets, windowed TTFT/TPOT
+        # percentiles and attainment, plus the all-class goodput counter
+        # next to raw tokens_generated
+        if self._slo is not None:
+            self._slo.refresh(advance=False)
+            s["slo"] = self._slo.summary()
+            s["goodput_tokens"] = self._slo.goodput_tokens
+        else:
+            s["slo"] = {}
+            s["goodput_tokens"] = 0
         s["prefill_chunk_tokens"] = self.prefill_chunk_tokens
         s["max_num_batched_tokens"] = self.max_num_batched_tokens
         if self.prefix_cache is not None:
@@ -1930,9 +2115,39 @@ class ServingEngine:
                 "tokens": len(req.generated),
                 "preemptions": req.preemptions,
                 "status": req.status,
+                "slo_class": req.slo_class,
             }
         s["requests"] = per_req
         return s
+
+    # ------------------------------------------------------------ forensics
+    def build_postmortem(self, reason: str,
+                         info: Optional[Dict[str, object]] = None
+                         ) -> Dict[str, object]:
+        """Assemble (but do not write) a post-mortem bundle from this
+        engine's recorder ring, metrics registry, request table and
+        journal tail. Works with any subset of those attached — a
+        recorder-less engine still gets metrics + request rows."""
+        return _build_bundle(reason, recorder=self._recorder,
+                             registry=self.metrics,
+                             requests=self.requests.values(),
+                             journal=self._journal, info=info)
+
+    def dump_postmortem(self, reason: str,
+                        directory: Optional[str] = None,
+                        info: Optional[Dict[str, object]] = None) -> str:
+        """Build a bundle and write it to ``directory`` (default: the
+        engine's ``postmortem_dir``). Returns the path, also stashed on
+        ``last_postmortem_path``."""
+        directory = directory or self._postmortem_dir
+        if directory is None:
+            raise ValueError(
+                "no directory: pass one or set postmortem_dir= on the "
+                "engine")
+        path = _dump_bundle(self.build_postmortem(reason, info=info),
+                            directory)
+        self.last_postmortem_path = path
+        return path
 
     def compile_counts(self) -> Dict[str, int]:
         """Distinct executables THIS engine's step stream needs, i.e. its

@@ -464,6 +464,15 @@ class EngineSupervisor:
         # drive-the-engine entry point raises EngineDead, while
         # status/output/stats keep answering from the journal
         self.dead_reason: Optional[str] = None
+        # forensics (ISSUE 13): the EngineDead path builds a post-mortem
+        # bundle from the dying engine BEFORE dropping it — stashed here
+        # (and written to the engine's postmortem_dir when it has one)
+        # so a ServingCluster can fold migration events in and re-dump.
+        # `_dead_recorder` keeps the dead engine's flight-recorder ring
+        # reachable after `self.engine = None`.
+        self.postmortem: Optional[dict] = None
+        self.postmortem_path: Optional[str] = None
+        self._dead_recorder = None
         # test/ops hook: called between snapshot and re-admission, the
         # window where a concurrent control-plane cancel() must still win
         self._mid_restore_hook: Optional[Callable] = None
@@ -639,6 +648,33 @@ class EngineSupervisor:
             # what a ServingCluster replays to migrate the survivors.
             self.dead_reason = (
                 f"{reason}" + (f": {exc}" if exc else ""))
+            # forensics BEFORE the engine object is dropped: record the
+            # death in the ring, build the bundle, keep the ring alive
+            # for the cluster to append migration events, and dump if a
+            # postmortem_dir is configured. All duck-typed and guarded —
+            # forensics must never mask the EngineDead raise.
+            old = self.engine
+            try:
+                rec = getattr(old, "_recorder", None)
+                if rec is not None:
+                    rec.record("dead", reason=reason,
+                               error=(str(exc) if exc else None),
+                               restarts=len(self.restarts))
+                self._dead_recorder = rec
+                build = getattr(old, "build_postmortem", None)
+                if build is not None:
+                    self.postmortem = build(
+                        f"dead-{reason}",
+                        info={"restarts": list(self.restarts),
+                              "dead_reason": self.dead_reason})
+                if (self.postmortem is not None
+                        and getattr(old, "_postmortem_dir", None)):
+                    from ..observability.flight_recorder import \
+                        dump_postmortem
+                    self.postmortem_path = dump_postmortem(
+                        self.postmortem, old._postmortem_dir)
+            except Exception:  # noqa: BLE001 — forensics must not mask death
+                pass
             self.engine = None
             raise EngineDead(
                 f"engine restarted {len(self.restarts)} times "
@@ -680,6 +716,13 @@ class EngineSupervisor:
         self.journal.restart(epoch, reason, t1 - t0,
                              readmitted=len(readmitted),
                              replayed_tokens=replayed)
+        rec = getattr(new, "_recorder", None)
+        if rec is not None:
+            # factories that share one FlightRecorder across rebuilds
+            # (the journal discipline) get a continuous ring with the
+            # restart marked in-line
+            rec.record("restart", epoch=epoch, reason=reason,
+                       readmitted=len(readmitted))
         # chrome-trace marker: trace_summary renders this span as a
         # `-- restart #k --` divider inside request timelines
         add_host_span(f"serving.recovery[{epoch}].{reason}", t0, t1,

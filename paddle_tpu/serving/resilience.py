@@ -50,7 +50,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "EngineDead", "EngineOverloaded", "FaultInjector", "InjectedFault",
-    "TERMINAL_STATUSES", "is_fatal", "is_transient",
+    "TERMINAL_STATUSES", "describe_fault", "is_fatal", "is_transient",
 ]
 
 # every way a request's lifecycle can end; `Request.status` lands on
@@ -132,6 +132,17 @@ def is_fatal(exc: BaseException) -> bool:
     quarantined — they escalate to the EngineSupervisor's
     snapshot/rebuild/re-admit path (recovery.py)."""
     return bool(getattr(exc, "fatal", False))
+
+
+def describe_fault(exc: BaseException) -> Dict[str, object]:
+    """Small JSON-able classification of a fault for telemetry payloads
+    (flight-recorder events, post-mortem bundles): exception type name
+    plus its position in the transient/persistent/fatal taxonomy."""
+    return {
+        "exc": type(exc).__name__,
+        "transient": is_transient(exc),
+        "fatal": is_fatal(exc),
+    }
 
 
 class FaultInjector:

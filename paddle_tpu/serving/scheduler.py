@@ -135,6 +135,11 @@ class Request:
     # the decode batch and its page charge covers exactly its computed
     # tokens (the final chunk charges through the first decode block)
     num_computed_tokens: int = 0
+    # SLO class name (observability/slo.py), or None when the request
+    # opted out of SLO accounting. Validated against the engine's
+    # registered classes at add_request time; the scheduler never reads
+    # it — it rides along for the engine's latency observation sites
+    slo_class: Optional[str] = None
 
     # metrics (perf_counter timestamps, filled by the engine)
     arrival_t: float = dataclasses.field(default_factory=time.perf_counter)
@@ -209,7 +214,7 @@ class Scheduler:
     def __init__(self, allocator: BlockAllocator, page_size: int,
                  max_batch_size: int, max_pages_per_seq: int,
                  prefix_cache=None, decode_horizon: int = 1,
-                 drain_hook=None, obs=None,
+                 drain_hook=None, obs=None, recorder=None,
                  max_waiting: Optional[int] = None,
                  max_preemptions: Optional[int] = None,
                  max_prefill_tokens: Optional[int] = None,
@@ -260,6 +265,11 @@ class Scheduler:
         # for enqueue/admit/preempt/finish, preemption counter, per-step
         # queue-depth + page-pool gauges). None = zero metrics work.
         self.obs = obs
+        # flight recorder (observability/flight_recorder.py): terminal
+        # and preemption events append to the bounded ring. None = the
+        # scheduler executes no recorder code at all (raise-on-touch
+        # pinned in tests/test_observability_v2.py)
+        self.recorder = recorder
         self.waiting: List[Request] = []
         self.running: List[Request] = []
 
@@ -296,6 +306,10 @@ class Scheduler:
             self.running.remove(req)
         if self.obs is not None:
             self.obs.finished(req)
+        if self.recorder is not None:
+            self.recorder.record("terminal", rid=req.request_id,
+                                 status="finished",
+                                 generated=len(req.generated))
 
     def finalize(self, req: Request, status: str,
                  error: Optional[str] = None) -> bool:
@@ -323,6 +337,9 @@ class Scheduler:
             self.waiting.remove(req)
         if self.obs is not None:
             self.obs.terminal(req, status)
+        if self.recorder is not None:
+            self.recorder.record("terminal", rid=req.request_id,
+                                 status=status, error=error)
         return True
 
     def has_work(self) -> bool:
@@ -467,6 +484,10 @@ class Scheduler:
             self.waiting.insert(0, victim)
         if self.obs is not None:
             self.obs.preempted(victim)
+        if self.recorder is not None:
+            self.recorder.record("preempt", rid=victim.request_id,
+                                 parked=victim.parked,
+                                 preemptions=victim.preemptions)
 
     def _ensure_decode_pages(self) -> None:
         """Copy-on-extend, one decode BLOCK at a time: every running

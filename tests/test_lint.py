@@ -444,6 +444,58 @@ class TestHostSync:
         assert len(findings) == 1
         assert "build_ragged_inputs" in findings[0].message
 
+    def test_observability_hot_hooks_covered_by_default(self):
+        """ISSUE 13: the SLO tracker's per-token hooks and the flight
+        recorder's ring append run inside the engine's step/drain path,
+        so DEFAULT_HOT_MODULES traces them — an injected sync fires,
+        and their cold paths (refresh, events) stay out of scope."""
+        findings = run("""
+            import numpy as np
+
+            class SloTracker:
+                def first_token(self, cls, ttft):
+                    self._observe(ttft)
+
+                def decode_tokens(self, cls, per_tok, k):
+                    return int(per_tok.item())
+
+                def step_tick(self):
+                    pass
+
+                def _observe(self, v):
+                    return np.asarray(v)
+
+                def refresh(self):
+                    return self.window.tolist()
+            """, path="paddle_tpu/observability/slo.py",
+            rule="HOST-SYNC")
+        hit_fns = sorted(set(
+            f.message.split("hot-path function `")[1].split("`")[0]
+            for f in findings))
+        assert hit_fns == ["_observe", "decode_tokens"]   # refresh cold
+
+        findings = run("""
+            class FlightRecorder:
+                def record(self, kind, **payload):
+                    self._ring.append((self._clock(), kind, payload))
+
+                def events(self):
+                    return [e.tolist() for e in self._ring]
+            """, path="paddle_tpu/observability/flight_recorder.py",
+            rule="HOST-SYNC")
+        assert findings == []             # the real shape: sync-free
+
+        findings = run("""
+            import numpy as np
+
+            class FlightRecorder:
+                def record(self, kind, **payload):
+                    self._ring.append(np.asarray(payload["tokens"]))
+            """, path="paddle_tpu/observability/flight_recorder.py",
+            rule="HOST-SYNC")
+        assert len(findings) == 1
+        assert "record" in findings[0].message
+
     def test_hot_modules_mapping_is_configurable(self):
         """The traced-module list is constructor state, not a hardcoded
         constant: a custom mapping REPLACES the default roots."""

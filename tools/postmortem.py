@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Render a flight-recorder post-mortem bundle (ISSUE 13).
+
+A `paddle_tpu.postmortem/v1` bundle is the JSON file the serving stack
+dumps when an engine is quarantined or a replica dies: the flight
+recorder's last-N control-plane events, a metrics snapshot, the
+per-request status table and the journal tail (counts only — the bundle
+never carries token values; the RequestJournal owns exactly-once token
+state). This tool turns one into the story a human reads first:
+
+- the event timeline, relative to the first retained event, with the
+  trace_summary conventions — `!!` for faults/quarantines/death, `>>`
+  for migrations, `~` for restarts/adoptions;
+- a casualty summary: how every request ended, failures flagged;
+- the final metrics that matter at 3am (tokens, goodput, SLO
+  attainment, restarts, step-phase p95s).
+
+Usage:
+    python tools/postmortem.py BUNDLE.json [--events N] [--metrics]
+
+Standalone on purpose (json/argparse only): point it at a bundle from
+any machine without installing the framework.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+# trace_summary's convention: these terminal statuses are casualties
+BAD_TERMINALS = ("failed", "expired", "shed")
+
+# event kinds worth shouting about in the timeline
+_ALARM_KINDS = {"fault", "quarantine", "dead"}
+_MOVE_KINDS = {"migrate"}
+_RECOVER_KINDS = {"restart", "adopt"}
+
+
+def load_bundle(path: str) -> dict:
+    with open(path) as f:
+        bundle = json.load(f)
+    schema = bundle.get("schema", "")
+    if not schema.startswith("paddle_tpu.postmortem/"):
+        raise SystemExit(
+            f"{path}: not a paddle_tpu post-mortem bundle "
+            f"(schema={schema!r})")
+    return bundle
+
+
+def _event_detail(ev: dict) -> str:
+    """One-line payload rendering, keyed on the event kind."""
+    kind = ev.get("kind")
+    if kind == "schedule":
+        parts = [str(ev.get("decision", "?"))]
+        for k in ("prefill", "decode", "chunks"):
+            if ev.get(k) not in (None, 0):
+                parts.append(f"{k}={ev[k]}")
+        return " ".join(parts)
+    if kind == "dispatch":
+        bits = [str(ev.get("family", "?"))]
+        for k in ("rid", "rows", "tokens", "horizon", "t_bucket",
+                  "decode", "chunks"):
+            if k in ev and ev[k] is not None:
+                bits.append(f"{k}={ev[k]}")
+        return " ".join(bits)
+    if kind == "drain":
+        return (f"{ev.get('family', '?')} rows={ev.get('rows', '?')} "
+                f"tokens={ev.get('tokens', '?')}")
+    if kind == "fault":
+        tag = ("FATAL" if ev.get("fatal")
+               else "transient" if ev.get("transient") else "persistent")
+        retry = " (retry)" if ev.get("retry") else ""
+        return (f"{tag} at {ev.get('site', '?')}{retry}: "
+                f"{ev.get('error', '?')}")
+    if kind == "quarantine":
+        rids = ",".join(str(r) for r in ev.get("rids", ()))
+        return f"site={ev.get('site', '?')} requests [{rids}]"
+    if kind == "preempt":
+        parked = " PARKED" if ev.get("parked") else ""
+        return (f"request {ev.get('rid', '?')} "
+                f"(#{ev.get('preemptions', '?')}){parked}")
+    if kind == "terminal":
+        err = f": {ev['error']}" if ev.get("error") else ""
+        return f"request {ev.get('rid', '?')} -> {ev.get('status')}{err}"
+    if kind == "restart":
+        return (f"epoch {ev.get('epoch', '?')} ({ev.get('reason', '?')}) "
+                f"readmitted={ev.get('readmitted', '?')}")
+    if kind == "dead":
+        return (f"reason={ev.get('reason', '?')} after "
+                f"{ev.get('restarts', '?')} restart(s): "
+                f"{ev.get('error')}")
+    if kind == "migrate":
+        return (f"request {ev.get('rid', '?')} "
+                f"r{ev.get('src', '?')}->r{ev.get('dst', '?')} "
+                f"as {ev.get('new_rid', '?')} "
+                f"({ev.get('delivered', '?')} tokens delivered)")
+    if kind == "adopt":
+        return (f"request {ev.get('rid', '?')} "
+                f"delivered={ev.get('delivered', '?')} "
+                f"remaining={ev.get('remaining', '?')}")
+    skip = {"seq", "t", "kind"}
+    return " ".join(f"{k}={v}" for k, v in ev.items() if k not in skip)
+
+
+def format_events(events: List[dict], events_total: int,
+                  capacity: int, last: Optional[int] = None) -> str:
+    if not events:
+        return "  (empty ring — the recorder saw no events)"
+    shown = events[-last:] if last else events
+    t0 = shown[0].get("t", 0.0)
+    lines = []
+    dropped = events_total - len(events)
+    if dropped > 0:
+        lines.append(f"  ... {dropped} earlier event(s) evicted "
+                     f"(ring capacity {capacity})")
+    if len(shown) < len(events):
+        lines.append(f"  ... {len(events) - len(shown)} retained "
+                     "event(s) elided (--events)")
+    for ev in shown:
+        mark = ("!!" if ev.get("kind") in _ALARM_KINDS
+                else ">>" if ev.get("kind") in _MOVE_KINDS
+                else " ~" if ev.get("kind") in _RECOVER_KINDS
+                else "  ")
+        dt = (ev.get("t", t0) - t0) * 1e3
+        lines.append(f"  {mark} +{dt:10.3f} ms  #{ev.get('seq', '?'):<6}"
+                     f"{ev.get('kind', '?'):<11}{_event_detail(ev)}")
+    return "\n".join(lines)
+
+
+def format_requests(rows: List[dict]) -> str:
+    if not rows:
+        return "  (no requests registered on the engine)"
+    lines = []
+    counts: Dict[str, int] = {}
+    for r in sorted(rows, key=lambda r: r.get("request_id", 0)):
+        status = r.get("status", "?")
+        counts[status] = counts.get(status, 0) + 1
+        mark = " !!" if status in BAD_TERMINALS else ""
+        slo = (f" slo={r['slo_class']}" if r.get("slo_class") else "")
+        err = f"  ({r['error']})" if r.get("error") else ""
+        lines.append(
+            f"  request {r.get('request_id', '?'):<6}{status:<11}"
+            f"{r.get('generated', 0):>5} tok  "
+            f"{r.get('preemptions', 0)} preempt{slo}{err}{mark}")
+    summary = ", ".join(f"{n} {st}" for st, n in sorted(counts.items()))
+    bad = sum(counts.get(s, 0) for s in BAD_TERMINALS)
+    lines.append("")
+    lines.append(f"  {len(rows)} request(s): {summary}")
+    if bad:
+        lines.append(f"  !! {bad} of {len(rows)} did not finish")
+    return "\n".join(lines)
+
+
+def _metric_rows(snapshot: Optional[dict]) -> List[dict]:
+    if not snapshot:
+        return []
+    return list(snapshot.get("metrics", ()))
+
+
+def format_key_metrics(snapshot: Optional[dict]) -> str:
+    """The final registry values worth reading first; `--metrics` dumps
+    the full snapshot instead."""
+    rows = _metric_rows(snapshot)
+    if not rows:
+        return "  (no metrics snapshot in this bundle)"
+    lines = []
+
+    def label_str(d):
+        labels = d.get("labels") or {}
+        return ("{" + ",".join(f"{k}={v}" for k, v in
+                               sorted(labels.items())) + "}"
+                if labels else "")
+
+    wanted_values = (
+        "serving_tokens_generated_total",
+        "serving_slo_goodput_tokens_total",
+        "serving_slo_attainment",
+        "serving_requests_terminated_total",
+        "serving_engine_restarts_total",
+        "serving_preemptions_total",
+        "serving_transient_retries_total",
+        "serving_cluster_replica_deaths_total",
+        "serving_cluster_migrations_total",
+    )
+    for d in rows:
+        if d.get("name") in wanted_values and "value" in d:
+            v = d["value"]
+            v = f"{v:g}" if isinstance(v, float) else str(v)
+            lines.append(
+                f"  {d['name'] + label_str(d):<58}{v:>10}")
+    # step-phase p95s from the raw histogram rows, if present
+    for d in rows:
+        if d.get("name") in ("serving_step_phase_seconds",
+                             "serving_device_residency_seconds") \
+                and d.get("count"):
+            mean = d["sum"] / d["count"] if d["count"] else 0.0
+            lines.append(
+                f"  {d['name'] + label_str(d):<58}"
+                f"{d['count']:>6} obs  mean {mean * 1e3:8.3f} ms")
+    return "\n".join(lines) if lines else "  (no serving metrics found)"
+
+
+def format_journal_tail(tail: List[dict]) -> str:
+    if not tail:
+        return "  (no journal attached)"
+    lines = []
+    for r in tail:
+        status = r.get("status") or "live"
+        mark = " !!" if status in BAD_TERMINALS else ""
+        err = f"  ({r['error']})" if r.get("error") else ""
+        lines.append(f"  request {r.get('request_id', '?'):<6}"
+                     f"{status:<11}"
+                     f"{r.get('delivered_tokens') or 0:>5} delivered"
+                     f"{err}{mark}")
+    return "\n".join(lines)
+
+
+def render(bundle: dict, last_events: Optional[int] = None,
+           full_metrics: bool = False) -> str:
+    out = []
+    when = bundle.get("unix_time")
+    stamp = (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(when))
+             if when else "?")
+    out.append(f"post-mortem: {bundle.get('reason', '?')}")
+    out.append(f"schema {bundle.get('schema')}   dumped {stamp}")
+    info = bundle.get("info") or {}
+    if info:
+        out.append("info: " + json.dumps(info, sort_keys=True))
+    out.append("")
+    events = bundle.get("events") or []
+    out.append(f"event timeline ({len(events)} retained of "
+               f"{bundle.get('events_total', len(events))} recorded, "
+               f"ring capacity {bundle.get('ring_capacity', '?')}):")
+    out.append(format_events(events,
+                             bundle.get("events_total", len(events)),
+                             bundle.get("ring_capacity", 0),
+                             last=last_events))
+    out.append("")
+    out.append("requests:")
+    out.append(format_requests(bundle.get("requests") or []))
+    out.append("")
+    out.append("journal tail (token COUNTS only — the journal owns "
+               "token state):")
+    out.append(format_journal_tail(bundle.get("journal_tail") or []))
+    out.append("")
+    if full_metrics:
+        out.append("metrics snapshot:")
+        out.append(json.dumps(bundle.get("metrics"), indent=1,
+                              sort_keys=True))
+    else:
+        out.append("final metrics (--metrics for the full snapshot):")
+        out.append(format_key_metrics(bundle.get("metrics")))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render a paddle_tpu flight-recorder post-mortem "
+                    "bundle (event timeline, casualty summary, final "
+                    "metrics)")
+    ap.add_argument("bundle", help="postmortem-*.json path")
+    ap.add_argument("--events", type=int, default=None,
+                    help="show only the last N timeline events")
+    ap.add_argument("--metrics", action="store_true",
+                    help="dump the full metrics snapshot instead of "
+                         "the key-metrics digest")
+    args = ap.parse_args(argv)
+    print(render(load_bundle(args.bundle), last_events=args.events,
+                 full_metrics=args.metrics))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,7 +40,9 @@ machine without installing the framework.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import re
 import sys
 from typing import Dict, List, Tuple
@@ -323,6 +325,17 @@ def main(argv=None) -> int:
                               restarts=recovery_epochs(events),
                               moves=cluster_moves(events),
                               tags=request_tags(events)))
+    # flight-recorder post-mortem bundles dumped next to the trace (an
+    # engine quarantine or a replica death during this run): point at
+    # them — tools/postmortem.py renders the full story
+    run_dir = os.path.dirname(os.path.abspath(args.trace))
+    dumps = sorted(glob.glob(os.path.join(run_dir, "postmortem*.json")))
+    if dumps:
+        print()
+        print(f"!! {len(dumps)} post-mortem bundle(s) in this run:")
+        for p in dumps:
+            print(f"   {p}")
+        print("   render with: python tools/postmortem.py <bundle.json>")
     return 0
 
 

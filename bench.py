@@ -315,10 +315,14 @@ def _run_lint() -> dict:
             "baselined": report["baselined_count"],
             "stale_baseline": report["stale_baseline_count"],
             "by_rule": report["by_rule"],
+            # v2 is flow-aware and project-wide: the sweep's wall time is
+            # itself a tracked budget (< 3 s on CPU, tests/test_lint_v2.py)
+            "sweep_seconds": report.get("sweep_seconds"),
         }
         _log(f"phase=lint: {'clean' if out['clean'] else 'DIRTY'} "
              f"({out['unbaselined']} unbaselined, "
-             f"{out['baselined']} baselined)")
+             f"{out['baselined']} baselined, "
+             f"sweep {out['sweep_seconds']}s)")
         return out
     except Exception as e:  # noqa: BLE001 — bench must degrade, not die
         _log(f"phase=lint: FAIL {type(e).__name__}: {e}")

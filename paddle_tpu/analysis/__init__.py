@@ -25,12 +25,23 @@ from .core import (  # noqa: F401
     Rule,
 )
 from .baseline import Baseline, load_baseline  # noqa: F401
-from .runner import iter_python_files, run_paths, run_source  # noqa: F401
+from .callgraph import CallGraph, FuncKey, FuncNode, Project  # noqa: F401
+from .dataflow import FunctionDataflow, PerTarget, Summarizer  # noqa: F401
+from .runner import (  # noqa: F401
+    iter_python_files,
+    report_json,
+    report_sarif,
+    run_paths,
+    run_source,
+)
 from .rules import all_rules, get_rule  # noqa: F401
 
 __all__ = [
     "Finding", "ModuleCache", "ParsedModule", "Rule",
     "Baseline", "load_baseline",
+    "CallGraph", "FuncKey", "FuncNode", "Project",
+    "FunctionDataflow", "PerTarget", "Summarizer",
     "iter_python_files", "run_paths", "run_source",
+    "report_json", "report_sarif",
     "all_rules", "get_rule",
 ]

@@ -66,6 +66,27 @@ class Baseline:
             if prev is not None and prev.get("reason"):
                 entry["reason"] = prev["reason"]
 
+    def adopt_missing_from(self, old: "Baseline") -> List[dict]:
+        """Copy over `old` entries absent here — `--baseline-update`
+        without `--prune-stale` preserves stale debt instead of
+        silently dropping it (deleting an entry is an explicit act).
+        Returns what was adopted."""
+        adopted: List[dict] = []
+        for fp, entry in old.entries.items():
+            if fp not in self.entries:
+                self.entries[fp] = dict(entry)
+                adopted.append(self.entries[fp])
+        return adopted
+
+    def prune_stale(self, findings: Iterable[Finding]) -> List[dict]:
+        """Delete entries whose finding no longer occurs and return
+        them (the CLI prints each — pruning is loud, never silent)."""
+        live = {f.fingerprint for f in findings}
+        pruned = [e for fp, e in self.entries.items() if fp not in live]
+        for e in pruned:
+            del self.entries[e["fingerprint"]]
+        return pruned
+
     def dump(self, path: str) -> None:
         ordered = sorted(self.entries.values(),
                          key=lambda e: (e["path"], e["rule"], e["line"]))

@@ -67,7 +67,17 @@ class ParsedModule:
         self.lines: List[str] = source.splitlines()
         self.tree: ast.AST = ast.parse(source, filename=path)
         self._noqa: Optional[Dict[int, Optional[Set[str]]]] = None
+        self._noqa_reasons: Dict[int, str] = {}
         self._jax_aliases: Optional[Set[str]] = None
+        self._nodes: Optional[List[ast.AST]] = None
+
+    def nodes(self) -> List[ast.AST]:
+        """Every AST node, in ``ast.walk`` order, computed once — a
+        full sweep runs ~10 rules over each module and a fresh walk per
+        rule is the single biggest cost of the whole sweep."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
 
     # -- suppression -------------------------------------------------------
     @property
@@ -87,6 +97,10 @@ class ParsedModule:
                     m = _NOQA_RE.search(tok.string)
                     if not m:
                         continue
+                    tail = tok.string[m.end():].strip()
+                    tail = tail.lstrip("—-–: ").strip()
+                    prev_tail = self._noqa_reasons.get(tok.start[0], "")
+                    self._noqa_reasons[tok.start[0]] = prev_tail or tail
                     codes = m.group("codes")
                     if codes is None:
                         self._noqa[tok.start[0]] = None
@@ -115,6 +129,17 @@ class ParsedModule:
         wanted = {c.upper() for c in codes}
         return bool(entry & wanted)
 
+    def noqa_reason(self, line: int) -> Optional[str]:
+        """The free-form reason tail of the noqa on `line`: None when
+        the line carries no noqa at all, "" when it carries a bare or
+        reasonless one. Rules that *mandate* reasoned suppressions
+        (COLLECTIVE-MESH's check_rep=False contract) distinguish the
+        two: a reasonless noqa is itself the finding."""
+        self.noqa  # force the tokenize pass
+        if line not in (self._noqa or {}):
+            return None
+        return self._noqa_reasons.get(line, "")
+
     # -- jax alias tracking ------------------------------------------------
     @property
     def jax_aliases(self) -> Set[str]:
@@ -123,7 +148,7 @@ class ParsedModule:
         a call chain may start from and still be "a jax API call"."""
         if self._jax_aliases is None:
             names: Set[str] = {"jax", "lax"}
-            for node in ast.walk(self.tree):
+            for node in self.nodes():
                 if isinstance(node, ast.Import):
                     for a in node.names:
                         if a.name == "jax" or a.name.startswith("jax."):
@@ -190,6 +215,15 @@ class Rule:
 
     def check(self, module: ParsedModule) -> Iterator[Finding]:
         raise NotImplementedError
+
+    def project_check(self, module: ParsedModule,
+                      project) -> Iterator[Finding]:
+        """v2 entry point: like `check` but with the whole Project
+        (parsed-module set + call graph, see callgraph.Project) in
+        scope. The runner always calls this; the default delegates so
+        single-module rules never notice. `project` is untyped here
+        only to keep core.py import-free of callgraph.py."""
+        return self.check(module)
 
     # -- helpers for subclasses -------------------------------------------
     def findings(self, module: ParsedModule,
@@ -275,26 +309,37 @@ def _decorator_is_jit(dec: ast.AST) -> bool:
 def traced_functions(module: ParsedModule) -> List[FunctionInfo]:
     """Functions that get traced by jax: jit-decorated, or defined and
     then passed (by name or inline) to a trace entry point like
-    jax.jit / lax.scan / shard_map within the enclosing scope."""
+    jax.jit / lax.scan / shard_map within the enclosing scope.
+
+    Memoized per module (several rules ask; the parent map alone is an
+    O(module) walk)."""
+    cached = getattr(module, "_traced_functions", None)
+    if cached is not None:
+        return list(cached)
     out: List[FunctionInfo] = []
+    # one walk collects everything (parent edges, defs, calls) — the
+    # tree is visited once, not three times
     parents: Dict[ast.AST, ast.AST] = {}
-    for node in ast.walk(module.tree):
+    all_defs: List[ast.AST] = []
+    calls: List[ast.Call] = []
+    for node in module.nodes():
         for child in ast.iter_child_nodes(node):
             parents[child] = node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            all_defs.append(node)
+        elif isinstance(node, ast.Call):
+            calls.append(node)
 
     defs: Dict[Tuple[int, str], ast.AST] = {}
-    for node in ast.walk(module.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if any(_decorator_is_jit(d) for d in node.decorator_list):
-                out.append(FunctionInfo(node, node.name, parents.get(node),
-                                        traced_via="decorator"))
-            else:
-                defs[(id(parents.get(node)), node.name)] = node
+    for node in all_defs:
+        if any(_decorator_is_jit(d) for d in node.decorator_list):
+            out.append(FunctionInfo(node, node.name, parents.get(node),
+                                    traced_via="decorator"))
+        else:
+            defs[(id(parents.get(node)), node.name)] = node
 
     traced_ids = {id(fi.node) for fi in out}
-    for node in ast.walk(module.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in calls:
         chain = call_chain(node)
         if chain is None or chain[-1] not in _TRACE_ENTRY_TAILS:
             continue
@@ -313,4 +358,5 @@ def traced_functions(module: ParsedModule) -> List[FunctionInfo]:
                 name = getattr(target, "name", "<lambda>")
                 out.append(FunctionInfo(target, name, parents.get(target),
                                         traced_via=f"passed to {'.'.join(chain)}"))
-    return out
+    module._traced_functions = out
+    return list(out)

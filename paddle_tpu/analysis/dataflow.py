@@ -1,0 +1,412 @@
+"""Forward dataflow/taint framework the v2 rules declare transfers on.
+
+One abstraction, shared by DONATED-REUSE, KEY-REUSE and
+METRIC-CARDINALITY: a *path-insensitive forward walk* over one function
+body, carrying an environment that maps dotted chains ("x",
+"self.cache.pools") to frozensets of abstract tokens. Rules subclass
+:class:`FunctionDataflow` and override the transfer hooks
+(``call_result``, ``on_load``, ``on_store``, ``loop_value``, ...);
+the driver owns statement ordering, branch merge (key-wise union),
+bounded loop passes, try/except joins and comprehension scopes.
+
+Design points, all deliberate:
+
+  * **Path-insensitive.** ``if``/``try`` branches execute on copies and
+    merge by union — a token donated (or consumed) in either branch is
+    donated afterwards. No boolean reasoning, no feasibility checks.
+  * **Bounded loops.** Loop bodies run ``loop_passes`` times (default 2
+    — enough to see loop-carried bindings) and merge with the
+    zero-iteration path. Rules that model per-iteration freshness
+    (KEY-REUSE) drop to one pass and use :meth:`loop_region` instead.
+  * **Bounded interprocedural depth.** :class:`Summarizer` memoizes
+    per-function summaries along the project call graph with a depth
+    cap and cycle guard; summaries flow through calls and returns but
+    never emit findings themselves — findings always anchor in the
+    function being checked.
+  * **Environment keys starting with "#"** are rule-private path state
+    (e.g. the donated-token or consumed-key sets); they merge exactly
+    like bindings.
+
+Pure stdlib; never imports jax.
+"""
+import ast
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import dotted_chain
+
+Value = frozenset
+EMPTY: Value = frozenset()
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class PerTarget:
+    """A call result that yields a *distinct* token per unpack target:
+    ``k1, k2 = jax.random.split(key)`` must not alias k1 and k2."""
+
+    def __init__(self, make: Callable[[Any], Value]):
+        self.make = make  # make(i) -> Value; i is an index or "*"
+
+    def collapse(self) -> Value:
+        return self.make("*")
+
+
+def _collapse(v) -> Value:
+    return v.collapse() if isinstance(v, PerTarget) else v
+
+
+class FunctionDataflow:
+    """Subclass, override hooks, then ``run(fn)`` one function at a time."""
+
+    loop_passes = 2
+
+    def __init__(self, module, project=None):
+        self.module = module
+        self.project = project
+        self._loops: List[int] = []
+        self.return_value: Value = EMPTY
+
+    # -- transfer hooks (rules override) -----------------------------------
+    def initial_env(self, fn) -> Dict[str, Value]:
+        return {}
+
+    def call_result(self, call: ast.Call, chain: Optional[List[str]],
+                    func_value: Value, arg_values: List[Value],
+                    kw_values: Dict[Optional[str], Value], env):
+        """Abstract result of a call. None = opaque (EMPTY)."""
+        return None
+
+    def on_load(self, chain: str, node: ast.AST, env) -> None:
+        pass
+
+    def on_store(self, chain: str, node: ast.AST, env) -> None:
+        pass
+
+    def on_subscript_store(self, chain: str, node: ast.AST, env) -> None:
+        """``base[...] = v`` — a *use* of base, not a rebinding."""
+        self.on_load(chain, node, env)
+
+    def loop_value(self, target: ast.AST, iter_node: ast.expr,
+                   iter_value: Value, env) -> Value:
+        return iter_value
+
+    def subscript_value(self, node: ast.Subscript, base: Value,
+                        env) -> Value:
+        return base  # indexing propagates by default
+
+    def fstring_value(self, node: ast.JoinedStr, parts: List[Value],
+                      env) -> Value:
+        out = EMPTY
+        for p in parts:
+            out |= p
+        return out
+
+    # -- loop region helpers ----------------------------------------------
+    def loop_region(self) -> Tuple[int, ...]:
+        """Identity of the enclosing loop/comprehension nest — lets a
+        rule tell 'token made inside this loop' from 'made outside'."""
+        return tuple(self._loops)
+
+    # -- driver ------------------------------------------------------------
+    def run(self, fn) -> Dict[str, Value]:
+        self.return_value = EMPTY
+        self._loops = []
+        env: Dict[str, Value] = dict(self.initial_env(fn))
+        if isinstance(fn, _FUNC_DEFS + (ast.Module,)):
+            body = fn.body
+        else:
+            body = [fn]
+        self.exec_block(body, env)
+        return env
+
+    def exec_block(self, stmts: Sequence[ast.stmt], env) -> None:
+        for stmt in stmts:
+            self.exec_stmt(stmt, env)
+
+    def _merge_into(self, env, others: Sequence[Dict[str, Value]]) -> None:
+        for other in others:
+            for k, v in other.items():
+                env[k] = env.get(k, EMPTY) | v
+
+    def exec_stmt(self, stmt: ast.stmt, env) -> None:
+        if isinstance(stmt, ast.Assign):
+            self._exec_assign(stmt.targets, stmt.value, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._exec_assign([stmt.target], stmt.value, env)
+        elif isinstance(stmt, ast.AugAssign):
+            old = self.eval(stmt.target, env)  # read...
+            new = self.eval(stmt.value, env)
+            self.assign(stmt.target, old | _collapse(new), env)  # ...modify
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value, env)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.return_value = self.return_value | _collapse(
+                    self.eval(stmt.value, env))
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test, env)
+            e1, e2 = dict(env), dict(env)
+            self.exec_block(stmt.body, e1)
+            self.exec_block(stmt.orelse, e2)
+            env.clear()
+            self._merge_into(env, [e1, e2])
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            itv = self.eval(stmt.iter, env)
+            self._loops.append(id(stmt))
+            for _ in range(max(1, self.loop_passes)):
+                bound = self.loop_value(stmt.target, stmt.iter, itv, env)
+                self.assign(stmt.target, bound, env)
+                self.exec_block(stmt.body, env)
+            self._loops.pop()
+            self.exec_block(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            self._loops.append(id(stmt))
+            for _ in range(max(1, self.loop_passes)):
+                self.eval(stmt.test, env)
+                self.exec_block(stmt.body, env)
+            self._loops.pop()
+            self.exec_block(stmt.orelse, env)
+        elif isinstance(stmt, ast.Try):
+            pre = dict(env)
+            self.exec_block(stmt.body, env)
+            handler_envs = []
+            for handler in stmt.handlers:
+                # a handler may run from any point in the body: join of
+                # pre-body and post-body state
+                he = dict(env)
+                self._merge_into(he, [pre])
+                if handler.name:
+                    he[handler.name] = EMPTY
+                self.exec_block(handler.body, he)
+                handler_envs.append(he)
+            self.exec_block(stmt.orelse, env)
+            self._merge_into(env, handler_envs)
+            self.exec_block(stmt.finalbody, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                v = self.eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, v, env)
+            self.exec_block(stmt.body, env)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self.eval(stmt.exc, env)
+        elif isinstance(stmt, ast.Assert):
+            self.eval(stmt.test, env)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                chain = dotted_chain(t)
+                if chain is not None:
+                    env.pop(".".join(chain), None)
+        elif isinstance(stmt, _FUNC_DEFS + (ast.ClassDef,)):
+            env[stmt.name] = EMPTY  # nested defs analyzed separately
+        else:
+            # unknown statement kind: evaluate child expressions,
+            # execute child statement lists in place (no branch copy)
+            for field_value in ast.iter_child_nodes(stmt):
+                if isinstance(field_value, ast.expr):
+                    self.eval(field_value, env)
+                elif isinstance(field_value, ast.stmt):
+                    self.exec_stmt(field_value, env)
+
+    def _exec_assign(self, targets, value_node: ast.expr, env) -> None:
+        if (len(targets) == 1
+                and isinstance(targets[0], (ast.Tuple, ast.List))
+                and isinstance(value_node, (ast.Tuple, ast.List))
+                and len(targets[0].elts) == len(value_node.elts)
+                and not any(isinstance(e, ast.Starred)
+                            for e in targets[0].elts)):
+            vals = [self.eval(e, env) for e in value_node.elts]
+            for t, v in zip(targets[0].elts, vals):
+                self.assign(t, v, env)
+            return
+        v = self.eval_raw(value_node, env)
+        for t in targets:
+            self.assign(t, v, env)
+
+    def assign(self, target: ast.AST, value, env) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for i, elt in enumerate(target.elts):
+                if isinstance(value, PerTarget):
+                    ev = value.make("*" if isinstance(elt, ast.Starred)
+                                    else i)
+                else:
+                    ev = value
+                self.assign(elt.value if isinstance(elt, ast.Starred)
+                            else elt, ev, env)
+            return
+        value = _collapse(value)
+        if isinstance(target, ast.Subscript):
+            chain = dotted_chain(target.value)
+            self.eval(target.slice, env)
+            if chain is not None:
+                self.on_subscript_store(".".join(chain), target, env)
+            else:
+                self.eval(target.value, env)
+            return
+        chain = dotted_chain(target)
+        if chain is None:
+            if isinstance(target, ast.Attribute):
+                self.eval(target.value, env)
+            return
+        s = ".".join(chain)
+        self.on_store(s, target, env)
+        env[s] = value
+        prefix = s + "."
+        for k in [k for k in env if k.startswith(prefix)]:
+            del env[k]  # rebinding a base invalidates tracked extensions
+
+    # -- expression evaluation ---------------------------------------------
+    def eval(self, node: Optional[ast.expr], env) -> Value:
+        return _collapse(self.eval_raw(node, env))
+
+    def eval_raw(self, node: Optional[ast.expr], env):
+        if node is None or isinstance(node, ast.Constant):
+            return EMPTY
+        chain = dotted_chain(node)
+        if chain is not None:
+            s = ".".join(chain)
+            self.on_load(s, node, env)
+            return env.get(s, EMPTY)
+        if isinstance(node, ast.Attribute):
+            return self.eval(node.value, env)  # value().attr: propagate
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value, env)
+            self.eval(node.slice, env)
+            return self.subscript_value(node, base, env)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, (ast.BinOp,)):
+            return self.eval(node.left, env) | self.eval(node.right, env)
+        if isinstance(node, ast.BoolOp):
+            out = EMPTY
+            for v in node.values:
+                out |= self.eval(v, env)
+            return out
+        if isinstance(node, ast.Compare):
+            out = self.eval(node.left, env)
+            for c in node.comparators:
+                out |= self.eval(c, env)
+            return out
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = EMPTY
+            for e in node.elts:
+                out |= self.eval(e, env)
+            return out
+        if isinstance(node, ast.Dict):
+            out = EMPTY
+            for k in node.keys:
+                if k is not None:
+                    out |= self.eval(k, env)
+            for v in node.values:
+                out |= self.eval(v, env)
+            return out
+        if isinstance(node, ast.JoinedStr):
+            parts = [self.eval(v.value, env) for v in node.values
+                     if isinstance(v, ast.FormattedValue)]
+            return self.fstring_value(node, parts, env)
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test, env)
+            return self.eval(node.body, env) | self.eval(node.orelse, env)
+        if isinstance(node, ast.Lambda):
+            return EMPTY  # opaque: lambda bodies are not executed here
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return self._eval_comprehension(node, env)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Yield):
+            return self.eval(node.value, env) if node.value else EMPTY
+        if isinstance(node, ast.NamedExpr):
+            v = self.eval(node.value, env)
+            self.assign(node.target, v, env)
+            return v
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self.eval(part, env)
+            return EMPTY
+        out = EMPTY
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                out |= self.eval(child, env)
+        return out
+
+    def _eval_call(self, node: ast.Call, env):
+        chain = dotted_chain(node.func)
+        if chain is not None:
+            s = ".".join(chain)
+            self.on_load(s, node.func, env)
+            func_value = env.get(s, EMPTY)
+        else:
+            func_value = self.eval(node.func, env)
+        arg_values = [self.eval(a, env) for a in node.args]
+        kw_values = {kw.arg: self.eval(kw.value, env)
+                     for kw in node.keywords}
+        r = self.call_result(node, chain, func_value, arg_values,
+                             kw_values, env)
+        return EMPTY if r is None else r
+
+    def _eval_comprehension(self, node, env):
+        scratch = dict(env)
+        self._loops.append(id(node))
+        try:
+            for gen in node.generators:
+                itv = self.eval(gen.iter, scratch)
+                bound = self.loop_value(gen.target, gen.iter, itv, scratch)
+                self.assign(gen.target, bound, scratch)
+                for cond in gen.ifs:
+                    self.eval(cond, scratch)
+            if isinstance(node, ast.DictComp):
+                return (self.eval(node.key, scratch)
+                        | self.eval(node.value, scratch))
+            return self.eval(node.elt, scratch)
+        finally:
+            self._loops.pop()
+
+
+class Summarizer:
+    """Memoized bounded-depth function summaries along the call graph.
+
+    ``compute(key, depth)`` builds one summary and may recurse into
+    callees via ``self.get(child_key, depth + 1)``; beyond ``max_depth``
+    — or when a cycle re-enters a summary under construction — the
+    ``default`` is returned instead. That bounds total work and makes
+    recursion (direct or mutual) terminate with the conservative answer.
+    """
+
+    def __init__(self, compute: Callable[[Any, int], Any],
+                 default=None, max_depth: int = 4):
+        self._compute = compute
+        self.default = default
+        self.max_depth = max_depth
+        self._memo: Dict[Any, Any] = {}
+        self._in_progress: Set[Any] = set()
+
+    def get(self, key, depth: int = 0):
+        if depth > self.max_depth or key in self._in_progress:
+            return self.default
+        if key in self._memo:
+            return self._memo[key]
+        self._in_progress.add(key)
+        try:
+            out = self._compute(key, depth)
+        finally:
+            self._in_progress.discard(key)
+        self._memo[key] = out
+        return out
+
+
+def function_defs(tree):
+    """Every def in a module, nested ones included — rules analyze each
+    as its own frame (the engine's `dispatch()` closures must be seen).
+    Accepts an AST or a ParsedModule (reuses its cached node list)."""
+    walker = tree.nodes() if hasattr(tree, "nodes") else ast.walk(tree)
+    for node in walker:
+        if isinstance(node, _FUNC_DEFS):
+            yield node

@@ -8,6 +8,11 @@ from .traced_branch import TracedBranchRule
 from .host_sync import HostSyncRule
 from .wallclock_replay import WallclockInReplayRule
 from .jit_cache_key import JitCacheKeyRule
+from .donated_reuse import DonatedReuseRule
+from .key_reuse import KeyReuseRule
+from .collective_mesh import CollectiveMeshRule
+from .metric_cardinality import MetricCardinalityRule
+from .state_revert import StateRevertRule
 
 _RULES: List[Rule] = [
     SwallowedApiRule(),
@@ -16,6 +21,12 @@ _RULES: List[Rule] = [
     HostSyncRule(),
     WallclockInReplayRule(),
     JitCacheKeyRule(),
+    # the v2 serving-contract pack (project call graph + dataflow)
+    DonatedReuseRule(),
+    KeyReuseRule(),
+    CollectiveMeshRule(),
+    MetricCardinalityRule(),
+    StateRevertRule(),
 ]
 
 

@@ -13,9 +13,11 @@ Scope is intentionally narrow: the rule applies only to the modules in
 (`Scheduler.schedule`) and ``serving/ragged.py``
 (`build_ragged_inputs`, the flat-batch assembly that runs BETWEEN two
 dispatches of a ragged step) — and within those only to functions
-*reachable from the module's hot roots* through same-module calls: the
-call graph is computed over the AST (``self.f()`` / bare ``f()``
-edges), so a helper newly wired into the step path is covered
+*reachable from the module's hot roots* through same-module calls.
+Since v2 the reachability query lives on the shared project call graph
+(``callgraph.CallGraph.reachable_names``) instead of a private table —
+same contract (``self.f()`` / bare ``f()`` edges, name-level, same
+module only), so a helper newly wired into the step path is covered
 automatically while cold paths (add_request, snapshot/restore, stats)
 stay out of scope. The mapping is the configuration surface:
 ``HostSyncRule(hot_modules={...})`` swaps or extends it, so a project
@@ -60,48 +62,6 @@ _SYNC_CHAINS = {
     ("jax", "device_get"),
 }
 _CAST_FUNCS = {"int", "float", "bool"}
-
-
-def _function_table(tree: ast.AST) -> Dict[str, List[ast.AST]]:
-    """name -> def nodes (methods of any class and free functions alike;
-    the serving modules have no colliding hot names)."""
-    table: Dict[str, List[ast.AST]] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            table.setdefault(node.name, []).append(node)
-    return table
-
-
-def _called_names(fn: ast.AST) -> Set[str]:
-    """Names invoked as `self.f(...)`, `cls.f(...)` or `f(...)` in fn."""
-    out: Set[str] = set()
-    for node in ast.walk(fn):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if isinstance(f, ast.Name):
-            out.add(f.id)
-        elif (isinstance(f, ast.Attribute)
-              and isinstance(f.value, ast.Name)
-              and f.value.id in {"self", "cls"}):
-            out.add(f.attr)
-    return out
-
-
-def _reachable(table: Dict[str, List[ast.AST]],
-               roots: Set[str]) -> Set[str]:
-    seen: Set[str] = set()
-    frontier = [r for r in roots if r in table]
-    while frontier:
-        name = frontier.pop()
-        if name in seen:
-            continue
-        seen.add(name)
-        for fn in table[name]:
-            for callee in _called_names(fn):
-                if callee in table and callee not in seen:
-                    frontier.append(callee)
-    return seen
 
 
 def _walk_own(fn: ast.AST) -> Iterator[ast.AST]:
@@ -155,15 +115,20 @@ class HostSyncRule(Rule):
         return roots
 
     def check(self, module: ParsedModule) -> Iterator[Finding]:
+        from ..callgraph import Project
+        return self.project_check(module, Project.single(module))
+
+    def project_check(self, module: ParsedModule,
+                      project) -> Iterator[Finding]:
         roots = self._roots_for(module.path)
         if not roots:
             return
-        table = _function_table(module.tree)
-        hot = _reachable(table, roots)
+        graph = project.callgraph
+        hot = graph.reachable_names(module.path, roots)
         hits: List[Tuple[int, str]] = []
         for name in sorted(hot):
-            for fn in table[name]:
-                for node in _walk_own(fn):
+            for fn in graph.by_name(module.path)[name]:
+                for node in _walk_own(fn.node):
                     if isinstance(node, ast.Call):
                         what = _sync_hit(node)
                         if what is not None:

@@ -79,7 +79,7 @@ class JitCacheKeyRule(Rule):
 
     def check(self, module: ParsedModule) -> Iterator[Finding]:
         hits: List[Tuple[int, str]] = []
-        for fn in ast.walk(module.tree):
+        for fn in module.nodes():
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if not _has_jit_call(fn):

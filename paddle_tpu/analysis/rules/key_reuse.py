@@ -1,0 +1,294 @@
+"""KEY-REUSE — one PRNG key value reaching two jax.random consumers.
+
+The serving replay contract (``recovery.replay_key_state``) is that the
+engine's key chain advances by *exactly one split per consumption*: the
+journal records how many times to re-split on restore. Consuming the
+same key twice — two samplers sharing a key, or a loop body sampling
+with a key split outside the loop — produces correlated draws live and
+an unreproducible divergence on replay. The engine's own idiom is
+always ``key = jax.random.split(key)[0]`` / ``_split_rows`` rebinds.
+
+Detection, on the v2 dataflow walk (one pass per loop body is replaced
+by two: the second pass is what exposes loop-carried reuse):
+
+  * every evaluation of a *producer* (``PRNGKey``/``key``/
+    ``wrap_key_data``/``split``/``fold_in``/``clone``) yields fresh
+    tokens — per evaluation, and per unpack target, so
+    ``k1, k2 = split(key)`` never aliases;
+  * every *consumer* (the samplers, plus split/fold_in themselves —
+    deriving twice from one key is the same hazard; ``fold_in`` with
+    *non-constant* data is exempt, it derives a distinct stream per
+    evaluation) consumes the tokens of its first argument: a token
+    consumed twice fires. The
+    same call site consuming one token twice (the two loop passes) is
+    the loop variant of the message;
+  * an untracked chain consumed for the first time becomes its own
+    token (parameters need no name heuristics);
+  * a key passed to an *unresolvable* non-jax call escapes — tracking
+    stops, no finding (conservative silence);
+  * a key passed to a call the project call graph CAN resolve applies
+    that callee's bounded-depth summary (which params it consumes,
+    whether it returns fresh keys) — this is the propagation "through
+    calls and returns along the call graph" that makes
+    ``key_data, subs = _split_rows(key_data)`` clean without
+    special-casing the engine.
+"""
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, ParsedModule, Rule, dotted_chain
+from ..dataflow import EMPTY, FunctionDataflow, PerTarget, Summarizer, \
+    function_defs
+
+_USED = "#used"        # frozenset of (token, site) consumption records
+_ESCAPED = "#escaped"  # frozenset of tokens handed to unknown code
+
+_PRODUCERS = {"PRNGKey", "key", "wrap_key_data", "split", "fold_in",
+              "clone"}
+# producers double as consumers: split/fold_in advance the chain
+_CONSUMERS = {"normal", "uniform", "categorical", "bernoulli", "gumbel",
+              "truncated_normal", "randint", "permutation", "choice",
+              "bits", "exponential", "laplace", "logistic", "beta",
+              "gamma", "poisson", "dirichlet", "cauchy", "rademacher",
+              "split", "fold_in"}
+_WRAPPERS = {"vmap", "pmap"}  # jax.vmap(jax.random.split)(keys, ...)
+
+
+def _random_tail(chain: Optional[List[str]],
+                 aliases: Set[str]) -> Optional[str]:
+    """'jax.random.split' / 'random.split' / bare 'split' (from-import)
+    -> 'split'; None when the chain is not a jax.random call."""
+    if not chain or chain[0] not in aliases:
+        return None
+    tail = chain[-1]
+    if tail not in _PRODUCERS and tail not in _CONSUMERS:
+        return None
+    if len(chain) == 1 or "random" in chain[:-1]:
+        return tail
+    return None
+
+
+class _Flow(FunctionDataflow):
+    loop_passes = 2  # the second pass exposes loop-carried reuse
+
+    def __init__(self, module, project, summaries: Optional[Summarizer],
+                 collect: bool = True, depth: int = 0):
+        super().__init__(module, project)
+        self._summaries = summaries
+        self._collect = collect
+        self._depth = depth
+        self._counter = 0
+        self.hits: List[Tuple[int, str]] = []
+        self._fired: Set[Tuple[int, object]] = set()
+        self.consumed_params: Set[int] = set()
+
+    # -- token helpers ------------------------------------------------------
+    def _fresh(self, tag: str = "k") -> FrozenSet:
+        self._counter += 1
+        return frozenset({(tag, self._counter)})
+
+    def loop_value(self, target, iter_node, iter_value, env):
+        # a loop target is a different element (a different key) each
+        # iteration: always a fresh token, never the iterable's own
+        return self._fresh("elem")
+
+    def subscript_value(self, node, base, env):
+        # keys[i] picks one element: fresh per evaluation when the base
+        # is a tracked key array, untracked otherwise
+        if base - env.get(_ESCAPED, EMPTY):
+            return self._fresh("elem")
+        return EMPTY
+
+    # -- consumption --------------------------------------------------------
+    def _consume(self, arg: Optional[ast.expr], value, call: ast.Call,
+                 env, via: str = "") -> None:
+        site = (call.lineno, call.col_offset)
+        tokens = set(value)
+        if not tokens and arg is not None:
+            chain = dotted_chain(arg)
+            if chain is None:
+                return
+            s = ".".join(chain)
+            tok = ("named", s)
+            env[s] = frozenset({tok})
+            tokens = {tok}
+        escaped = env.get(_ESCAPED, EMPTY)
+        used = env.get(_USED, EMPTY)
+        expr = _expr_text(arg)
+        for tok in tokens:
+            if tok in escaped:
+                continue
+            if tok[0] == "param":
+                self.consumed_params.add(tok[1])
+            prior = {s for (t, s) in used if t == tok}
+            if prior:
+                self._fire(call, expr, via,
+                           in_loop=site in prior)
+            used = used | {(tok, site)}
+        env[_USED] = used
+
+    def _fire(self, call: ast.Call, expr: str, via: str,
+              in_loop: bool) -> None:
+        key = (call.lineno, expr)
+        if not self._collect or key in self._fired:
+            return
+        self._fired.add(key)
+        how = ("consumed on every loop iteration without a "
+               "per-iteration split" if in_loop else
+               "reaching a second jax.random consumer")
+        through = f" (via `{via}`)" if via else ""
+        self.hits.append((call.lineno, (
+            f"PRNG key `{expr}` {how}{through} — replay determinism "
+            f"(recovery.replay_key_state) needs one split per "
+            f"consumption; derive a fresh key first "
+            f"(`key = jax.random.split(key)[0]` / `fold_in`) or "
+            f"annotate `# noqa: KEY-REUSE — <reason>`")))
+
+    def _escape(self, values, env) -> None:
+        tokens = EMPTY
+        for v in values:
+            tokens |= v
+        if tokens:
+            env[_ESCAPED] = env.get(_ESCAPED, EMPTY) | tokens
+
+    # -- transfer -----------------------------------------------------------
+    def call_result(self, call, chain, func_value, arg_values,
+                    kw_values, env):
+        aliases = self.module.jax_aliases
+        tail = _random_tail(chain, aliases)
+        if (tail is None and chain is not None
+                and chain[-1] in _WRAPPERS and chain[0] in aliases
+                and call.args):
+            # jax.vmap(jax.random.split): the *outer* call consumes
+            inner = dotted_chain(call.args[0])
+            wrapped = _random_tail(inner, aliases)
+            if wrapped is not None:
+                return frozenset({("vmapped", wrapped)})
+        if func_value and any(t[0] == "vmapped" for t in func_value):
+            wrapped = next(t[1] for t in func_value if t[0] == "vmapped")
+            first = call.args[0] if call.args else None
+            self._consume(first, arg_values[0] if arg_values else EMPTY,
+                          call, env)
+            if wrapped in _PRODUCERS:
+                return PerTarget(lambda i, f=self._fresh: f())
+            return None
+        if tail is not None:
+            first = call.args[0] if call.args else None
+            fv = arg_values[0] if arg_values else kw_values.get("key", EMPTY)
+            if first is None:
+                for kw in call.keywords:
+                    if kw.arg == "key":
+                        first = kw.value
+            # fold_in with non-constant data derives a distinct stream
+            # per evaluation (the per-iteration idiom this rule's own
+            # fix message recommends) — it does not consume the key;
+            # fold_in with a *constant* is just split by another name
+            derives = (tail == "fold_in" and len(call.args) > 1
+                       and not isinstance(call.args[1], ast.Constant))
+            if tail in _CONSUMERS and not derives:
+                self._consume(first, fv, call, env)
+            if tail in _PRODUCERS:
+                c = self._counter
+                self._counter += len(call.args) + 8
+                return PerTarget(
+                    lambda i, c=c: frozenset({("k", c, i)}))
+            return None
+        if chain is None:
+            self._escape(arg_values, env)
+            self._escape(kw_values.values(), env)
+            return None
+        # non-random call: project-resolvable callees apply their
+        # summary; jax/numpy device ops are silent passthroughs;
+        # anything unknown makes its arguments escape
+        summary = self._summary_for(chain)
+        if summary is not None:
+            consumes, returns_fresh = summary
+            name = ".".join(chain)
+            for i in sorted(consumes):
+                if i < len(call.args):
+                    self._consume(call.args[i], arg_values[i], call,
+                                  env, via=name)
+            if returns_fresh:
+                return PerTarget(lambda i, f=self._fresh: f())
+            return None
+        if chain[0] in aliases or chain[0] in {"jnp", "np", "numpy"}:
+            return None  # device/array op: neither consumes nor escapes
+        self._escape(arg_values, env)
+        self._escape(kw_values.values(), env)
+        return None
+
+    def _summary_for(self, chain) -> Optional[Tuple[FrozenSet[int], bool]]:
+        if self._summaries is None or self.project is None:
+            return None
+        graph = self.project.callgraph
+        targets = graph.resolve_chain(self.module.path, list(chain))
+        if len(targets) != 1:
+            return None  # ambiguous dispatch: stay conservative
+        return self._summaries.get(targets[0], self._depth + 1)
+
+
+def _expr_text(arg: Optional[ast.expr]) -> str:
+    if arg is None:
+        return "<key>"
+    chain = dotted_chain(arg)
+    if chain is not None:
+        return ".".join(chain)
+    try:
+        return ast.unparse(arg)
+    except Exception:  # noqa: BLE001 — display-only fallback
+        return "<key>"
+
+
+class KeyReuseRule(Rule):
+    name = "KEY-REUSE"
+    description = ("same PRNG key consumed by two jax.random calls "
+                   "(or every iteration of a loop) without an "
+                   "intervening split/fold_in — breaks replay "
+                   "determinism")
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        from ..callgraph import Project
+        return self.project_check(module, Project.single(module))
+
+    def project_check(self, module: ParsedModule,
+                      project) -> Iterator[Finding]:
+        # every producer/consumer lives under jax.random, so a module
+        # that never says "random" (even in an import) cannot fire —
+        # skip the dataflow walk entirely
+        if "random" not in module.source:
+            return
+        # one summarizer per sweep: callee summaries are module-local
+        # facts, so modules sharing helpers share the memo
+        summaries = project.scratch.get("key-reuse-summaries")
+        if summaries is None:
+            summaries = Summarizer(
+                compute=lambda fn, depth: self._summarize(
+                    fn, project, summaries, depth),
+                default=None)
+            project.scratch["key-reuse-summaries"] = summaries
+
+        hits: List[Tuple[int, str]] = []
+        for fn in function_defs(module):
+            flow = _Flow(module, project, summaries)
+            flow.run(fn)
+            hits.extend(flow.hits)
+        hits.sort()
+        yield from self.findings(module, hits)
+
+    def _summarize(self, fn_node, project, summaries, depth):
+        """(consumed param indices, returns fresh keys) for one callee.
+        Depth-capped by the Summarizer; cycles return the default
+        (None = treated as unresolvable, arguments escape)."""
+        mod = project.module(fn_node.key.path)
+        if mod is None:
+            return None
+        flow = _Flow(mod, project, summaries, collect=False, depth=depth)
+        args = fn_node.node.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        env = {p: frozenset({("param", i)})
+               for i, p in enumerate(params)}
+        flow.initial_env = lambda _fn, _env=env: dict(_env)
+        flow.run(fn_node.node)
+        returns_fresh = any(t[0] in {"k", "elem"}
+                            for t in flow.return_value)
+        return frozenset(flow.consumed_params), returns_fresh

@@ -1,0 +1,195 @@
+"""METRIC-CARDINALITY — metric label values must be bounded enums.
+
+The observability plane (PR 8/12/13) keys every Counter/Gauge/Histogram
+timeseries by its label dict. A label value derived from a request id,
+a loop counter, or an interpolated f-string mints one timeseries per
+*value* — the registry grows without bound, scrapes slow down, and the
+flight-recorder ring fills with registry churn instead of signal. The
+bounded idiom is everywhere in the tree: label values looped from
+literal tuples (``FAMILIES``, status/phase lists) or taken from a
+fixed class enum.
+
+Detection rides the v2 dataflow walk:
+
+  * a *sink* is any call carrying a ``labels=...`` keyword whose value
+    is a dict literal — inline, or bound to a name earlier in the
+    function (``lab = {...}; registry.counter(..., labels=lab)``);
+  * each label *value expression* is judged against the current
+    environment: f-strings with interpolations taint; names/attributes
+    that look like request/session/trace ids taint; loop and
+    comprehension variables taint **only** when the iterable is
+    ``range(...)``/``enumerate(range(...))`` (a counter, unbounded by
+    construction) — literal tuples stay clean, and *unknown* iterables
+    stay clean too (conservative silence: ``for cls in self.classes``
+    is the SLO tracker's bounded enum);
+  * ``str()``/``repr()``/``format()``/``int()`` and string
+    concatenation/formatting propagate taint.
+"""
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, ParsedModule, Rule, dotted_chain
+from ..dataflow import EMPTY, FunctionDataflow, function_defs
+
+_SINK_KW = "labels"
+_ID_NAME_RE = re.compile(
+    r"(?:^|_)(?:request_?id|req_?id|rid|uid|user_?id|session_?id|"
+    r"trace_?id|span_?id|correlation_?id)$", re.IGNORECASE)
+_PROPAGATE = {"str", "repr", "format", "int", "hex", "oct"}
+
+
+def _dict_node(expr: ast.expr, env) -> Optional[ast.Dict]:
+    if isinstance(expr, ast.Dict):
+        return expr
+    chain = dotted_chain(expr)
+    if chain is not None:
+        for tok in env.get(".".join(chain), EMPTY):
+            if isinstance(tok, tuple) and tok[0] == "dict":
+                return tok[1].node
+    return None
+
+
+class _Flow(FunctionDataflow):
+    def __init__(self, module, project):
+        super().__init__(module, project)
+        self.hits: List[Tuple[int, str]] = []
+        self._fired: Set[Tuple[int, str]] = set()
+        self._dicts: Dict[int, ast.Dict] = {}
+
+    # -- taints -------------------------------------------------------------
+    def loop_value(self, target, iter_node, iter_value, env):
+        if self._iter_is_counter(iter_node):
+            return frozenset({("taint", "a loop variable over range(...)")})
+        return EMPTY  # literal tuples and unknown enums: clean
+
+    def _iter_is_counter(self, iter_node: ast.expr) -> bool:
+        if not isinstance(iter_node, ast.Call):
+            return False
+        chain = dotted_chain(iter_node.func)
+        if chain is None:
+            return False
+        if chain[-1] == "range":
+            return True
+        if chain[-1] == "enumerate" and iter_node.args:
+            return self._iter_is_counter(iter_node.args[0])
+        return False
+
+    def fstring_value(self, node, parts, env):
+        tainted = any(not isinstance(v.value, ast.Constant)
+                      for v in node.values
+                      if isinstance(v, ast.FormattedValue))
+        out = EMPTY
+        for p in parts:
+            out |= p
+        if tainted:
+            out = out | {("taint", "an interpolated f-string")}
+        return out
+
+    # -- dict-literal tracking & sinks --------------------------------------
+    def eval_raw(self, node, env):
+        if isinstance(node, ast.Dict):
+            super().eval_raw(node, env)  # evaluate children for effects
+            return frozenset({("dict", _Hashable(node))})
+        return super().eval_raw(node, env)
+
+    def call_result(self, call, chain, func_value, arg_values,
+                    kw_values, env):
+        for kw in call.keywords:
+            if kw.arg != _SINK_KW:
+                continue
+            d = _dict_node(kw.value, env)
+            if d is not None:
+                self._judge(call, d, env)
+        return None
+
+    def _judge(self, call: ast.Call, d: ast.Dict, env) -> None:
+        for key_node, value_node in zip(d.keys, d.values):
+            label = (repr(key_node.value)
+                     if isinstance(key_node, ast.Constant) else "<label>")
+            why = self._taint_of(value_node, env)
+            if why is None:
+                continue
+            fire_key = (call.lineno, label)
+            if fire_key in self._fired:
+                continue
+            self._fired.add(fire_key)
+            self.hits.append((call.lineno, (
+                f"metric label {label} takes a value derived from "
+                f"{why} — one timeseries per value is unbounded "
+                f"registry growth; use a bounded enum (the FAMILIES/"
+                f"status-list idiom) or annotate "
+                f"`# noqa: METRIC-CARDINALITY — <why bounded>`")))
+
+    def _taint_of(self, node: ast.expr, env) -> Optional[str]:
+        if isinstance(node, ast.Constant):
+            return None
+        if isinstance(node, ast.JoinedStr):
+            if any(not isinstance(v.value, ast.Constant)
+                   for v in node.values
+                   if isinstance(v, ast.FormattedValue)):
+                return "an interpolated f-string"
+            return None
+        chain = dotted_chain(node)
+        if chain is not None:
+            if _ID_NAME_RE.search(chain[-1]):
+                return f"the request-id-like name `{'.'.join(chain)}`"
+            for tok in env.get(".".join(chain), EMPTY):
+                if tok[0] == "taint":
+                    return tok[1]
+            return None
+        if isinstance(node, ast.Call):
+            fchain = dotted_chain(node.func)
+            if fchain is not None and fchain[-1] in _PROPAGATE:
+                for arg in node.args:
+                    why = self._taint_of(arg, env)
+                    if why is not None:
+                        return why
+            return None
+        if isinstance(node, ast.BinOp):  # "%s" % rid, "r" + str(i)
+            return (self._taint_of(node.left, env)
+                    or self._taint_of(node.right, env))
+        if isinstance(node, ast.IfExp):
+            return (self._taint_of(node.body, env)
+                    or self._taint_of(node.orelse, env))
+        return None
+
+
+class _Hashable:
+    """Wrap an AST node so it can live inside a frozenset token."""
+
+    __slots__ = ("node",)
+
+    def __init__(self, node):
+        self.node = node
+
+    def __hash__(self):
+        return id(self.node)
+
+    def __eq__(self, other):
+        return isinstance(other, _Hashable) and other.node is self.node
+
+
+class MetricCardinalityRule(Rule):
+    name = "METRIC-CARDINALITY"
+    description = ("metric label value derived from a request id, "
+                   "range() loop variable or interpolated f-string — "
+                   "unbounded timeseries cardinality")
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        from ..callgraph import Project
+        return self.project_check(module, Project.single(module))
+
+    def project_check(self, module: ParsedModule,
+                      project) -> Iterator[Finding]:
+        # the only sink is a `labels=` keyword: no text, no sink
+        if "labels" not in module.source:
+            return
+        hits: List[Tuple[int, str]] = []
+        frames = [module.tree] + list(function_defs(module))
+        for frame in frames:
+            flow = _Flow(module, project)
+            flow.run(frame)
+            hits.extend(flow.hits)
+        hits.sort()
+        yield from self.findings(module, hits)

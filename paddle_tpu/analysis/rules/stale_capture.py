@@ -44,7 +44,7 @@ class StaleCaptureRule(Rule):
 
     def check(self, module: ParsedModule) -> Iterator[Finding]:
         hits: List[Tuple[int, str]] = []
-        for node in ast.walk(module.tree):
+        for node in module.nodes():
             if isinstance(node, ast.Compare):
                 sides = [node.left] + list(node.comparators)
                 if any(_is_id_call(s) for s in sides) and any(

@@ -1,0 +1,162 @@
+"""STATE-REVERT — accounting mutated before a guarded dispatch must be
+reverted on the failure path.
+
+PR 6's shipped bug class: the scheduler charged accounting state
+(``req.num_computed_tokens``, page charges, refcounts) *before* the
+dispatch it paid for, and a quarantined fault (PR 7's
+``_guarded_call`` isolation) left the books charged for work that
+never happened — same-step preemption then served garbage tokens from
+pages the accounting said were live. The engine's repaired idiom is
+either mutate-after-success or an explicit revert in the failure
+branch::
+
+    token, err = self._guarded_call("dispatch", dispatch)
+    if token is None:
+        req.inflight = max(req.inflight - rec["incr"][i], 0)  # revert
+
+The rule is structural, per function:
+
+  * scope: functions that call ``*._guarded_call`` (the repo's one
+    failure-isolation chokepoint);
+  * a *charge* is an Assign/AugAssign whose target is an attribute in
+    the accounting set (``num_computed_tokens``, ``inflight``,
+    ``refcount(s)``, ``num_pages``, ``charged_pages``) textually
+    before the first guarded call of the function;
+  * a *revert* is a mutation of the **same attribute** after the
+    guarded call inside a failure branch — an ``if`` whose test
+    compares against ``None`` (the ``(result, err)`` protocol) or an
+    ``except`` handler;
+  * a charge with no matching revert fires at the charge line.
+
+Nested defs are separate scopes (a ``dispatch()`` closure that only
+reads state does not charge anything).
+"""
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, ParsedModule, Rule, dotted_chain
+from ..dataflow import function_defs
+
+_ACCOUNTING = {"num_computed_tokens", "inflight", "refcount", "refcounts",
+               "num_pages", "charged_pages", "pages_charged"}
+
+
+def _own_stmts(fn: ast.AST) -> Iterator[ast.AST]:
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _mutated_attr(node: ast.AST) -> Optional[Tuple[int, str]]:
+    """(line, attr) when `node` assigns/augments an accounting attr."""
+    targets: List[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, ast.AugAssign):
+        targets = [node.target]
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        targets = [node.target]
+    for t in targets:
+        if isinstance(t, ast.Attribute) and t.attr in _ACCOUNTING:
+            return node.lineno, t.attr
+        if isinstance(t, ast.Subscript) \
+                and isinstance(t.value, ast.Attribute) \
+                and t.value.attr in _ACCOUNTING:
+            return node.lineno, t.value.attr
+    return None
+
+
+def _is_guarded_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = dotted_chain(node.func)
+    return chain is not None and chain[-1] == "_guarded_call"
+
+
+def _test_mentions_none(test: ast.expr) -> bool:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Compare):
+            operands = [sub.left] + list(sub.comparators)
+            if any(isinstance(o, ast.Constant) and o.value is None
+                   for o in operands):
+                return True
+    return False
+
+
+def _reverted_attrs_after(fn: ast.AST, guard_line: int) -> Set[str]:
+    """Accounting attrs mutated inside a failure branch after the
+    guarded call: an `if ... is (not) None` body/orelse, or an except
+    handler."""
+    reverted: Set[str] = set()
+
+    def collect(stmts) -> None:
+        for node in stmts:
+            for sub in ast.walk(node):
+                hit = _mutated_attr(sub)
+                if hit is not None:
+                    reverted.add(hit[1])
+
+    for node in _own_stmts(fn):
+        if isinstance(node, ast.Try):
+            # the `try:` line precedes a guard inside its body, but the
+            # handlers still run after it — gate on the handler's line
+            for handler in node.handlers:
+                if handler.lineno >= guard_line:
+                    collect(handler.body)
+            continue
+        if getattr(node, "lineno", 0) < guard_line:
+            continue
+        if isinstance(node, ast.If) and _test_mentions_none(node.test):
+            collect(node.body)
+            collect(node.orelse)
+    return reverted
+
+
+class StateRevertRule(Rule):
+    name = "STATE-REVERT"
+    description = ("accounting state (num_computed_tokens/inflight/"
+                   "refcounts/page charges) mutated before a "
+                   "_guarded_call dispatch with no revert on the "
+                   "failure path")
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        # the only trigger is a `*._guarded_call(...)` call site
+        if "_guarded_call" not in module.source:
+            return
+        hits: List[Tuple[int, str]] = []
+        for fn in function_defs(module):
+            first_guard: Optional[int] = None
+            for node in _own_stmts(fn):
+                if _is_guarded_call(node):
+                    line = node.lineno
+                    if first_guard is None or line < first_guard:
+                        first_guard = line
+            if first_guard is None:
+                continue
+            charges = []
+            for node in _own_stmts(fn):
+                hit = _mutated_attr(node)
+                if hit is not None and hit[0] < first_guard:
+                    charges.append(hit)
+            if not charges:
+                continue
+            reverted = _reverted_attrs_after(fn, first_guard)
+            for line, attr in sorted(set(charges)):
+                if attr in reverted:
+                    continue
+                hits.append((line, (
+                    f"accounting attribute `{attr}` is charged before "
+                    f"the `_guarded_call` dispatch on line "
+                    f"{first_guard} and never reverted on the failure "
+                    f"path — a quarantined fault leaves the books "
+                    f"charged for work that never ran (the PR 6 "
+                    f"same-step-preemption class); mutate after "
+                    f"success, revert in the `if ... is None:` branch, "
+                    f"or annotate `# noqa: STATE-REVERT — <reason>`")))
+        hits.sort()
+        yield from self.findings(module, hits)

@@ -87,7 +87,7 @@ class SwallowedApiRule(Rule):
     def check(self, module: ParsedModule) -> Iterator[Finding]:
         hits: List[Tuple[int, str]] = []
         aliases = module.jax_aliases
-        for node in ast.walk(module.tree):
+        for node in module.nodes():
             if not isinstance(node, ast.Try):
                 continue
             body_calls = [n for n in walk_stmts(node.body)

@@ -76,7 +76,7 @@ class WallclockInReplayRule(Rule):
         if not module.path.replace("\\", "/").endswith(_SCOPE_FILES):
             return
         hits: List[Tuple[int, str]] = []
-        for node in ast.walk(module.tree):
+        for node in module.nodes():
             if isinstance(node, ast.Call):
                 chain = dotted_chain(node.func)
                 if chain is not None:

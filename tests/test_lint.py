@@ -891,7 +891,9 @@ class TestGate:
         assert rc == 0
         out = capsys.readouterr().out
         for name in ("SWALLOWED-API", "STALE-CAPTURE", "TRACED-BRANCH",
-                     "HOST-SYNC", "WALLCLOCK-IN-REPLAY", "JIT-CACHE-KEY"):
+                     "HOST-SYNC", "WALLCLOCK-IN-REPLAY", "JIT-CACHE-KEY",
+                     "DONATED-REUSE", "KEY-REUSE", "COLLECTIVE-MESH",
+                     "METRIC-CARDINALITY", "STATE-REVERT"):
             assert name in out
 
     def test_removing_a_live_noqa_fails_the_gate(self):

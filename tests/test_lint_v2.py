@@ -1,0 +1,900 @@
+"""graftlint v2 — the project-wide engine and the serving-contract rules.
+
+Covers the PR 14 surface on top of tests/test_lint.py's v1 suite:
+
+  * the five new rules, each with true-positive / suppressed / clean
+    fixtures reduced from the shipped bug class they encode;
+  * CallGraph unit behavior: import cycles, bounded re-export chase,
+    closure call edges (the v1 HOST-SYNC contract), module-alias
+    chains, constant resolution through from-imports;
+  * the dataflow driver: branch-union merge, bounded loop passes,
+    try/except joins, PerTarget unpacking, Summarizer depth/cycle
+    bounds;
+  * whole-tree properties: two sweeps are byte-identical, the sweep
+    fits the < 3 s CPU budget, SARIF output round-trips;
+  * baseline ergonomics: --prune-stale alone and with
+    --baseline-update.
+
+No jax import anywhere in this file — the analysis package loads
+standalone exactly as tools/graftlint.py loads it.
+"""
+import importlib.util
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CLI_PATH = os.path.join(REPO, "tools", "graftlint.py")
+
+
+def _load_cli():
+    mod = sys.modules.get("_graftlint_cli")
+    if mod is not None:
+        return mod
+    spec = importlib.util.spec_from_file_location("_graftlint_cli", _CLI_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_graftlint_cli"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+graftlint = _load_cli()
+analysis = graftlint.load_analysis()
+
+
+def run(source, path="fix.py", rule=None):
+    rules = [analysis.get_rule(rule)] if rule else None
+    return analysis.run_source(textwrap.dedent(source), path=path,
+                               rules=rules)
+
+
+def project_of(**files):
+    """Build a Project from {dotted_name: source} (dots become dirs)."""
+    modules = {}
+    for dotted, src in files.items():
+        path = dotted.replace(".", "/") + ".py"
+        modules[path] = analysis.ParsedModule(path, textwrap.dedent(src))
+    return analysis.Project(modules=modules)
+
+
+def write_pkg(root, files):
+    """Materialize {relpath: source} under root for run_paths tests."""
+    for rel, src in files.items():
+        full = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        with open(full, "w") as f:
+            f.write(textwrap.dedent(src))
+
+
+# ---------------------------------------------------------------------------
+# DONATED-REUSE
+# ---------------------------------------------------------------------------
+class TestDonatedReuse:
+    def test_read_after_donation_fires(self):
+        fs = run("""
+            import jax
+            def step(self, params, pools):
+                fn = jax.jit(self._impl, donate_argnums=(1,))
+                out = fn(params, pools)
+                x = pools.sum()
+                return out
+        """, rule="DONATED-REUSE")
+        assert [f.line for f in fs] == [6]
+        assert "donated" in fs[0].message
+
+    def test_rebind_from_output_is_clean(self):
+        fs = run("""
+            import jax
+            def step(self, params, pools):
+                fn = jax.jit(self._impl, donate_argnums=(1,))
+                out = fn(params, pools)
+                pools = out[1]
+                return pools.sum()
+        """, rule="DONATED-REUSE")
+        assert fs == []
+
+    def test_subscript_write_into_donated_fires(self):
+        fs = run("""
+            import jax
+            def step(self, params, pools):
+                fn = jax.jit(self._impl, donate_argnums=(1,))
+                out = fn(params, pools)
+                pools[0] = out[1]
+                return out
+        """, rule="DONATED-REUSE")
+        assert [f.line for f in fs] == [6]
+        assert "written into" in fs[0].message
+
+    def test_builder_call_counts_as_donating(self):
+        fs = run("""
+            import jax
+            def _build(fn):
+                return jax.jit(fn, donate_argnums=(0,))
+            def step(pools, fn):
+                f = _build(fn)
+                out = f(pools)
+                return pools.shape
+        """, rule="DONATED-REUSE")
+        assert [f.line for f in fs] == [8]
+
+    def test_branch_merge_is_union(self):
+        # donated on one branch only -> still donated after the If
+        fs = run("""
+            import jax
+            def step(self, params, pools, fast):
+                fn = jax.jit(self._impl, donate_argnums=(1,))
+                if fast:
+                    out = fn(params, pools)
+                else:
+                    out = None
+                return pools.sum()
+        """, rule="DONATED-REUSE")
+        assert [f.line for f in fs] == [9]
+
+    def test_noqa_suppresses(self):
+        fs = run("""
+            import jax
+            def step(self, params, pools):
+                fn = jax.jit(self._impl, donate_argnums=(1,))
+                out = fn(params, pools)
+                x = pools.sum()  # noqa: DONATED-REUSE — debug-only read before rebind
+                return out
+        """, rule="DONATED-REUSE")
+        assert fs == []
+
+    def test_cross_module_builder(self, tmp_path):
+        write_pkg(str(tmp_path), {
+            "pkg/__init__.py": "",
+            "pkg/builders.py": """
+                import jax
+                def make_step(fn):
+                    return jax.jit(fn, donate_argnums=(0,))
+            """,
+            "pkg/caller.py": """
+                from pkg.builders import make_step
+                def drive(pools, fn):
+                    f = make_step(fn)
+                    out = f(pools)
+                    return pools.shape
+            """,
+        })
+        fs = analysis.run_paths([str(tmp_path)], root=str(tmp_path),
+                                rules=[analysis.get_rule("DONATED-REUSE")])
+        assert [(f.path, f.line) for f in fs] == [("pkg/caller.py", 6)]
+
+
+# ---------------------------------------------------------------------------
+# KEY-REUSE
+# ---------------------------------------------------------------------------
+class TestKeyReuse:
+    def test_double_consumption_fires(self):
+        fs = run("""
+            import jax
+            def sample(key):
+                a = jax.random.normal(key)
+                b = jax.random.uniform(key)
+                return a + b
+        """, rule="KEY-REUSE")
+        assert [f.line for f in fs] == [5]
+        assert "second" in fs[0].message
+
+    def test_split_then_use_is_clean(self):
+        fs = run("""
+            import jax
+            def sample(key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.normal(k1)
+                b = jax.random.normal(k2)
+                return a + b
+        """, rule="KEY-REUSE")
+        assert fs == []
+
+    def test_split_targets_are_distinct(self):
+        # consuming BOTH halves of one split is the whole point; only a
+        # second consumption of the SAME half fires
+        fs = run("""
+            import jax
+            def sample(key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.normal(k1)
+                b = jax.random.normal(k1)
+                return a + b
+        """, rule="KEY-REUSE")
+        assert [f.line for f in fs] == [6]
+
+    def test_loop_reuse_fires(self):
+        fs = run("""
+            import jax
+            def gen(key, n):
+                outs = []
+                for i in range(n):
+                    outs.append(jax.random.normal(key))
+                return outs
+        """, rule="KEY-REUSE")
+        assert [f.line for f in fs] == [6]
+        assert "loop" in fs[0].message
+
+    def test_loop_split_rebind_is_clean(self):
+        fs = run("""
+            import jax
+            def gen(key, n):
+                outs = []
+                for i in range(n):
+                    key, sub = jax.random.split(key)
+                    outs.append(jax.random.normal(sub))
+                return outs
+        """, rule="KEY-REUSE")
+        assert fs == []
+
+    def test_fold_in_per_iteration_is_clean(self):
+        fs = run("""
+            import jax
+            def gen(key, n):
+                outs = []
+                for i in range(n):
+                    sub = jax.random.fold_in(key, i)
+                    outs.append(jax.random.normal(sub))
+                return outs
+        """, rule="KEY-REUSE")
+        assert fs == []
+
+    def test_interprocedural_consumer(self):
+        # helper consumes its parameter; calling it twice with the same
+        # key is the same bug as two direct consumptions
+        fs = run("""
+            import jax
+            def helper(k):
+                return jax.random.normal(k)
+            def outer(key):
+                a = helper(key)
+                b = helper(key)
+                return a + b
+        """, rule="KEY-REUSE")
+        assert [f.line for f in fs] == [7]
+        assert "helper" in fs[0].message
+
+    def test_escape_to_unknown_call_silences(self):
+        # a key passed to an unknown non-jax callable escapes: silent
+        fs = run("""
+            import jax
+            def sample(key, sink):
+                sink(key)
+                a = jax.random.normal(key)
+                return a
+        """, rule="KEY-REUSE")
+        assert fs == []
+
+    def test_noqa_suppresses(self):
+        fs = run("""
+            import jax
+            def sample(key):
+                a = jax.random.normal(key)
+                b = jax.random.uniform(key)  # noqa: KEY-REUSE — intentional correlated draw
+                return a + b
+        """, rule="KEY-REUSE")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# COLLECTIVE-MESH
+# ---------------------------------------------------------------------------
+class TestCollectiveMesh:
+    def test_undeclared_axis_fires(self):
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def build(devs, fn):
+                mesh = Mesh(devs, axis_names=("dp",))
+                return shard_map(lambda x: jax.lax.psum(x, "tp"), mesh=mesh,
+                                 in_specs=P(), out_specs=P())
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [7]
+        assert "'tp'" in fs[0].message and "['dp']" in fs[0].message
+
+    def test_declared_axis_is_clean(self):
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def build(devs, fn):
+                mesh = Mesh(devs, axis_names=("tp",))
+                return shard_map(lambda x: jax.lax.psum(x, "tp"), mesh=mesh,
+                                 in_specs=P(), out_specs=P())
+        """, rule="COLLECTIVE-MESH")
+        assert fs == []
+
+    def test_parameter_carried_axis_is_skipped(self):
+        # axis arrives as a function parameter: unresolvable, no guess
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def build(devs, fn, axis):
+                mesh = Mesh(devs, axis_names=("dp",))
+                return shard_map(lambda x: jax.lax.psum(x, axis), mesh=mesh,
+                                 in_specs=P(), out_specs=P())
+        """, rule="COLLECTIVE-MESH")
+        assert fs == []
+
+    def test_constant_chased_through_import(self, tmp_path):
+        write_pkg(str(tmp_path), {
+            "pkg/__init__.py": "",
+            "pkg/consts.py": 'TP_AXIS = "tp"\n',
+            "pkg/net.py": """
+                import jax
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import Mesh, PartitionSpec as P
+                from pkg.consts import TP_AXIS
+                def build(devs, fn):
+                    mesh = Mesh(devs, axis_names=("dp",))
+                    return shard_map(lambda x: jax.lax.psum(x, TP_AXIS),
+                                     mesh=mesh, in_specs=P(), out_specs=P())
+            """,
+        })
+        fs = analysis.run_paths([str(tmp_path)], root=str(tmp_path),
+                                rules=[analysis.get_rule("COLLECTIVE-MESH")])
+        assert [(f.path, f.line) for f in fs] == [("pkg/net.py", 8)]
+
+    def test_check_rep_false_without_noqa_fires(self):
+        fs = run("""
+            import jax
+            from jax import shard_map as _sm
+            def build(mesh, fn):
+                return _sm(fn, mesh=mesh, in_specs=None, out_specs=None,
+                           check_rep=False)
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [6]
+        assert "no `# noqa`" in fs[0].message
+
+    def test_reasonless_noqa_is_itself_the_finding(self):
+        fs = run("""
+            import jax
+            from jax import shard_map as _sm
+            def build(mesh, fn):
+                return _sm(fn, mesh=mesh, in_specs=None, out_specs=None,
+                           check_rep=False)  # noqa: COLLECTIVE-MESH
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [6]
+        assert "reasonless" in fs[0].message
+
+    def test_reasoned_noqa_is_clean(self):
+        fs = run("""
+            import jax
+            from jax import shard_map as _sm
+            def build(mesh, fn):
+                return _sm(fn, mesh=mesh, in_specs=None, out_specs=None,
+                           check_rep=False)  # noqa: COLLECTIVE-MESH — per-shard outputs by contract
+        """, rule="COLLECTIVE-MESH")
+        assert fs == []
+
+    def test_no_shard_map_no_findings(self):
+        # collectives outside shard_map modules are pmap-land: out of scope
+        fs = run("""
+            import jax
+            def allreduce(x):
+                return jax.lax.psum(x, "tp")
+        """, rule="COLLECTIVE-MESH")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# METRIC-CARDINALITY
+# ---------------------------------------------------------------------------
+class TestMetricCardinality:
+    def test_request_id_label_fires(self):
+        fs = run("""
+            def emit(reg, request_id):
+                reg.counter("reqs", labels={"rid": request_id})
+        """, rule="METRIC-CARDINALITY")
+        assert [f.line for f in fs] == [3]
+
+    def test_range_loop_label_fires(self):
+        fs = run("""
+            def emit(reg, n):
+                for i in range(n):
+                    reg.counter("x", labels={"shard": str(i)})
+        """, rule="METRIC-CARDINALITY")
+        assert [f.line for f in fs] == [4]
+
+    def test_fstring_label_fires(self):
+        fs = run("""
+            def emit(reg, host):
+                reg.counter("x", labels={"node": f"host-{host}"})
+        """, rule="METRIC-CARDINALITY")
+        assert [f.line for f in fs] == [3]
+
+    def test_dict_through_variable_fires(self):
+        fs = run("""
+            def emit(reg, n):
+                for i in range(n):
+                    d = {"shard": str(i)}
+                    reg.counter("x", labels=d)
+        """, rule="METRIC-CARDINALITY")
+        assert [f.line for f in fs] == [5]
+
+    def test_bounded_iteration_is_clean(self):
+        # iterating a finite collection (the slo.py classes idiom) is
+        # exactly the bounded-enum pattern the rule must not flag
+        fs = run("""
+            def emit(reg, classes):
+                for cls in classes:
+                    reg.counter("x", labels={"cls": cls})
+        """, rule="METRIC-CARDINALITY")
+        assert fs == []
+
+    def test_constant_labels_are_clean(self):
+        fs = run("""
+            def emit(reg):
+                reg.counter("x", labels={"phase": "prefill"})
+        """, rule="METRIC-CARDINALITY")
+        assert fs == []
+
+    def test_noqa_suppresses(self):
+        fs = run("""
+            def emit(reg, n):
+                for i in range(n):
+                    reg.counter("x", labels={"shard": str(i)})  # noqa: METRIC-CARDINALITY — n is tp_size, fixed at boot
+        """, rule="METRIC-CARDINALITY")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# STATE-REVERT
+# ---------------------------------------------------------------------------
+class TestStateRevert:
+    def test_charge_without_revert_fires(self):
+        fs = run("""
+            class Sched:
+                def step(self, req):
+                    req.num_computed_tokens += 16
+                    out = self.model._guarded_call(req)
+                    return out
+        """, rule="STATE-REVERT")
+        assert [f.line for f in fs] == [4]
+
+    def test_revert_on_none_is_clean(self):
+        fs = run("""
+            class Sched:
+                def step(self, req):
+                    req.num_computed_tokens += 16
+                    out = self.model._guarded_call(req)
+                    if out is None:
+                        req.num_computed_tokens -= 16
+                        return None
+                    return out
+        """, rule="STATE-REVERT")
+        assert fs == []
+
+    def test_revert_in_except_is_clean(self):
+        fs = run("""
+            class Sched:
+                def step(self, req):
+                    req.num_computed_tokens += 16
+                    try:
+                        out = self.model._guarded_call(req)
+                    except Exception:
+                        req.num_computed_tokens -= 16
+                        raise
+                    return out
+        """, rule="STATE-REVERT")
+        assert fs == []
+
+    def test_charge_after_guard_is_clean(self):
+        # charging only on success needs no revert
+        fs = run("""
+            class Sched:
+                def step(self, req):
+                    out = self.model._guarded_call(req)
+                    req.num_computed_tokens += 16
+                    return out
+        """, rule="STATE-REVERT")
+        assert fs == []
+
+    def test_non_accounting_attr_is_clean(self):
+        fs = run("""
+            class Sched:
+                def step(self, req):
+                    req.last_step = "decode"
+                    out = self.model._guarded_call(req)
+                    return out
+        """, rule="STATE-REVERT")
+        assert fs == []
+
+    def test_noqa_suppresses(self):
+        fs = run("""
+            class Sched:
+                def step(self, req):
+                    req.num_computed_tokens += 16  # noqa: STATE-REVERT — caller reverts via restore()
+                    out = self.model._guarded_call(req)
+                    return out
+        """, rule="STATE-REVERT")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# CallGraph
+# ---------------------------------------------------------------------------
+class TestCallGraph:
+    def test_import_cycle_terminates(self):
+        project = project_of(**{
+            "pkg.a": """
+                from pkg.b import g
+                def f():
+                    return g()
+            """,
+            "pkg.b": """
+                from pkg.a import f
+                def g():
+                    return f()
+            """,
+        })
+        graph = project.callgraph
+        fa = graph.resolve_symbol("pkg/a.py", "g")
+        fb = graph.resolve_symbol("pkg/b.py", "f")
+        assert [fn.name for fn in fa] == ["g"]
+        assert [fn.name for fn in fb] == ["f"]
+
+    def test_reexport_chase_is_bounded(self):
+        # a -> b -> c -> d -> e re-export chain exceeds _MAX_CHASE and
+        # resolves to nothing rather than recursing forever
+        files = {}
+        for i, (src, dst) in enumerate(
+                [("a", "b"), ("b", "c"), ("c", "d"), ("d", "e"),
+                 ("e", "f")]):
+            files[f"pkg.{src}"] = f"from pkg.{dst} import target\n"
+        files["pkg.f"] = "def target():\n    pass\n"
+        project = project_of(**files)
+        hit = project.callgraph.resolve_symbol("pkg/f.py", "target")
+        assert [fn.name for fn in hit] == ["target"]
+        assert project.callgraph.resolve_symbol("pkg/a.py", "target") == []
+
+    def test_closure_calls_belong_to_the_outer_function(self):
+        # the v1 HOST-SYNC contract: a closure's calls are reachable
+        # from the function that defines (and runs) it
+        project = project_of(**{
+            "pkg.m": """
+                class Engine:
+                    def outer(self):
+                        def inner():
+                            return self.helper()
+                        return inner()
+                    def helper(self):
+                        return 1
+                    def cold(self):
+                        return 2
+            """,
+        })
+        names = project.callgraph.reachable_names("pkg/m.py", {"outer"})
+        assert "helper" in names and "outer" in names
+        assert "cold" not in names
+
+    def test_lambda_bodies_contribute_call_edges(self):
+        project = project_of(**{
+            "pkg.m": """
+                def outer():
+                    thunk = lambda: helper()
+                    return thunk()
+                def helper():
+                    return 1
+            """,
+        })
+        names = project.callgraph.reachable_names("pkg/m.py", {"outer"})
+        assert "helper" in names
+
+    def test_module_alias_chain_resolution(self):
+        project = project_of(**{
+            "pkg.util": """
+                def helper():
+                    pass
+            """,
+            "pkg.m": """
+                import pkg.util as u
+                def f():
+                    return u.helper()
+            """,
+        })
+        hit = project.callgraph.resolve_chain("pkg/m.py", ["u", "helper"])
+        assert [fn.key.path for fn in hit] == ["pkg/util.py"]
+
+    def test_resolve_constant_through_from_import(self):
+        project = project_of(**{
+            "pkg.consts": 'AXIS = "tp"\n',
+            "pkg.m": "from pkg.consts import AXIS\n",
+        })
+        assert project.callgraph.resolve_constant("pkg/m.py", "AXIS") == "tp"
+
+    def test_callees_cross_module(self):
+        project = project_of(**{
+            "pkg.util": """
+                def helper():
+                    pass
+            """,
+            "pkg.m": """
+                from pkg.util import helper
+                def f():
+                    return helper()
+            """,
+        })
+        graph = project.callgraph
+        (f,) = graph.by_name("pkg/m.py")["f"]
+        callees = graph.callees(f.key)
+        assert {k.qualname for k in callees} == {"helper"}
+        assert graph.callees(f.key, same_module_only=True) == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# Dataflow driver
+# ---------------------------------------------------------------------------
+def _flow_env(source, flow_cls=None, **flow_kwargs):
+    import ast as _ast
+    module = analysis.ParsedModule("flow.py", textwrap.dedent(source))
+    cls = flow_cls or analysis.FunctionDataflow
+    flow = cls(module, analysis.Project.single(module), **flow_kwargs)
+    fns = [n for n in _ast.walk(module.tree)
+           if isinstance(n, (_ast.FunctionDef, _ast.AsyncFunctionDef))]
+    return flow, flow.run(fns[0])
+
+
+class _TokenFlow(analysis.FunctionDataflow):
+    """make() returns a fresh line-tagged token; everything else opaque."""
+
+    def call_result(self, call, chain, func_value, arg_values,
+                    kw_values, env):
+        if chain == ["make"]:
+            return frozenset({("t", call.lineno)})
+        if chain == ["split"]:
+            return analysis.PerTarget(
+                lambda i: frozenset({("s", call.lineno, i)}))
+        return None
+
+
+class TestDataflow:
+    def test_branch_merge_is_union(self):
+        _, env = _flow_env("""
+            def f(c):
+                if c:
+                    x = make()
+                else:
+                    x = make()
+                y = x
+        """, _TokenFlow)
+        assert env["y"] == frozenset({("t", 4), ("t", 6)})
+
+    def test_loop_carried_binding_is_seen(self):
+        # pass 1 binds x inside the loop; pass 2 must see it in `y = x`
+        _, env = _flow_env("""
+            def f(it):
+                y = None
+                for i in it:
+                    y = x if i else make()
+                    x = make()
+        """, _TokenFlow)
+        assert ("t", 6) in env["y"]
+
+    def test_try_handler_joins_pre_and_post_body(self):
+        _, env = _flow_env("""
+            def f():
+                x = make()
+                try:
+                    x = make()
+                except Exception:
+                    y = x
+                return y
+        """, _TokenFlow)
+        # the handler may run before OR after the body assignment
+        assert env["y"] == frozenset({("t", 3), ("t", 5)})
+
+    def test_per_target_unpack_is_distinct(self):
+        _, env = _flow_env("""
+            def f():
+                a, b = split()
+        """, _TokenFlow)
+        assert env["a"] == frozenset({("s", 3, 0)})
+        assert env["b"] == frozenset({("s", 3, 1)})
+        assert env["a"] != env["b"]
+
+    def test_rebinding_base_drops_extensions(self):
+        _, env = _flow_env("""
+            def f():
+                x = make()
+                x.sub = make()
+                x = make()
+        """, _TokenFlow)
+        assert "x.sub" not in env
+        assert env["x"] == frozenset({("t", 5)})
+
+    def test_summarizer_depth_bound(self):
+        calls = []
+
+        def compute(key, depth):
+            calls.append((key, depth))
+            return summ.get(key + 1, depth + 1)
+
+        summ = analysis.Summarizer(compute, default="BOUND", max_depth=3)
+        assert summ.get(0) == "BOUND"
+        assert max(d for _, d in calls) == 3
+
+    def test_summarizer_cycle_returns_default(self):
+        def compute(key, depth):
+            return summ.get(key, depth)  # re-enters itself
+
+        summ = analysis.Summarizer(compute, default="CYCLE")
+        assert summ.get("k") == "CYCLE"
+
+    def test_summarizer_memoizes(self):
+        count = [0]
+
+        def compute(key, depth):
+            count[0] += 1
+            return key * 2
+
+        summ = analysis.Summarizer(compute, default=None)
+        assert summ.get(21) == 42
+        assert summ.get(21) == 42
+        assert count[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# Whole-tree properties
+# ---------------------------------------------------------------------------
+class TestTreeProperties:
+    def _sweep(self):
+        return analysis.run_paths(
+            [os.path.join(REPO, "paddle_tpu")], root=REPO)
+
+    def test_sweep_is_deterministic(self):
+        a = [(f.rule, f.path, f.line, f.occurrence, f.fingerprint)
+             for f in self._sweep()]
+        b = [(f.rule, f.path, f.line, f.occurrence, f.fingerprint)
+             for f in self._sweep()]
+        assert a == b and a  # identical, and non-trivially so
+
+    def test_sweep_fits_cpu_budget(self):
+        # the budget bounds the analyzer's CPU work, not machine load or
+        # the GC debt of 1500 earlier tests: collect first, measure CPU
+        # seconds, take the best of two so one noisy sample can't flake
+        # the gate
+        import gc
+        gc.collect()
+        elapsed = []
+        for _ in range(2):
+            t0 = time.process_time()
+            self._sweep()
+            elapsed.append(time.process_time() - t0)
+        assert min(elapsed) < 3.0, (
+            f"full graftlint sweep took {min(elapsed):.2f}s CPU — the "
+            f"tier-1 gate budget is < 3s on CPU")
+
+    def test_sarif_round_trips(self):
+        findings = self._sweep()
+        rules = analysis.all_rules()
+        doc = json.loads(json.dumps(
+            analysis.report_sarif(findings, rules=rules)))
+        assert doc["version"] == "2.1.0"
+        rundoc = doc["runs"][0]
+        rule_ids = [r["id"] for r in rundoc["tool"]["driver"]["rules"]]
+        assert rule_ids == [r.name for r in rules]
+        assert len(rundoc["results"]) == len(findings)
+        for res, f in zip(rundoc["results"], findings):
+            assert res["ruleId"] == f.rule
+            assert rule_ids[res["ruleIndex"]] == f.rule
+            loc = res["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"] == f.path
+            assert loc["region"]["startLine"] == f.line
+            assert (res["partialFingerprints"]["graftlint/v1"]
+                    == f.fingerprint)
+
+
+# ---------------------------------------------------------------------------
+# Baseline pruning (CLI)
+# ---------------------------------------------------------------------------
+def _baseline_doc(entries):
+    return {"version": 1, "entries": entries}
+
+
+def _stale_entry():
+    return {
+        "rule": "SWALLOWED-API", "path": "gone.py", "line": 1,
+        "snippet": "pass", "fingerprint": "feedfacefeedface",
+        "reason": "code was deleted",
+    }
+
+
+class TestPruneStale:
+    def _target(self, tmp_path):
+        # a file with one real finding, so the baseline has a live entry
+        src = textwrap.dedent("""
+            import jax
+            def f(x):
+                try:
+                    return jax.jit(x)()
+                except Exception:
+                    return None
+        """)
+        # the CLI resolves every finding path against REPO_ROOT, so the
+        # fixture fingerprint must be computed against the same root
+        target = tmp_path / "mod.py"
+        target.write_text(src)
+        fs = analysis.run_paths([str(target)], root=REPO)
+        assert fs, "fixture must produce at least one finding"
+        live = {
+            "rule": fs[0].rule, "path": fs[0].path, "line": fs[0].line,
+            "snippet": fs[0].snippet, "fingerprint": fs[0].fingerprint,
+            "reason": "intentional fallback",
+        }
+        return target, live
+
+    def test_prune_stale_rewrites_in_place(self, tmp_path, capsys):
+        target, live = self._target(tmp_path)
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps(_baseline_doc([live, _stale_entry()])))
+        rc = graftlint.main([str(target), "--baseline", str(bl),
+                             "--prune-stale"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "pruned stale SWALLOWED-API gone.py:1" in out
+        doc = json.loads(bl.read_text())
+        assert [e["fingerprint"] for e in doc["entries"]] \
+            == [live["fingerprint"]]
+
+    def test_baseline_update_preserves_stale_by_default(self, tmp_path):
+        target, live = self._target(tmp_path)
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps(_baseline_doc([live, _stale_entry()])))
+        rc = graftlint.main([str(target), "--baseline", str(bl),
+                             "--baseline-update"])
+        assert rc == 0
+        fps = {e["fingerprint"]
+               for e in json.loads(bl.read_text())["entries"]}
+        assert fps == {live["fingerprint"], "feedfacefeedface"}
+
+    def test_baseline_update_with_prune_drops_stale(self, tmp_path,
+                                                    capsys):
+        target, live = self._target(tmp_path)
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps(_baseline_doc([live, _stale_entry()])))
+        rc = graftlint.main([str(target), "--baseline", str(bl),
+                             "--baseline-update", "--prune-stale"])
+        assert rc == 0
+        assert "pruned stale" in capsys.readouterr().out
+        entries = json.loads(bl.read_text())["entries"]
+        assert [e["fingerprint"] for e in entries] \
+            == [live["fingerprint"]]
+        # the surviving entry keeps its human reason
+        assert entries[0]["reason"] == "intentional fallback"
+
+    def test_prune_stale_without_baseline_is_usage_error(self, tmp_path):
+        target, _ = self._target(tmp_path)
+        rc = graftlint.main([str(target), "--no-baseline",
+                             "--prune-stale"])
+        assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# Loader contract
+# ---------------------------------------------------------------------------
+class TestLoader:
+    def test_no_jax_in_analysis_modules(self):
+        # the analysis package never imports jax. Standalone, the loader
+        # binds it as _graftlint_analysis; under the full pytest suite
+        # (conftest imports jax) load_analysis() legitimately reuses the
+        # real paddle_tpu.analysis — either way, no module of whichever
+        # package we got may have bound a `jax` name
+        pkg = analysis.__name__
+        for name, mod in list(sys.modules.items()):
+            if mod is None:
+                continue
+            if name == pkg or name.startswith(pkg + "."):
+                assert getattr(mod, "jax", None) is None, (
+                    f"{name} imported jax")
+
+    def test_v2_symbols_are_exported(self):
+        for sym in ("CallGraph", "FuncKey", "FuncNode", "Project",
+                    "FunctionDataflow", "PerTarget", "Summarizer",
+                    "report_sarif"):
+            assert hasattr(analysis, sym), sym

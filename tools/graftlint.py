@@ -19,6 +19,7 @@ import importlib.util
 import json
 import os
 import sys
+import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "graftlint_baseline.json")
@@ -67,8 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="report every finding, ignoring the baseline")
     p.add_argument("--baseline-update", action="store_true",
                    help="rewrite the baseline from current findings, "
-                        "keeping reasons for surviving fingerprints")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+                        "keeping reasons for surviving fingerprints and "
+                        "preserving stale entries (add --prune-stale to "
+                        "drop them)")
+    p.add_argument("--prune-stale", action="store_true",
+                   help="drop baseline entries whose fingerprint no "
+                        "longer matches any finding, printing each "
+                        "pruned entry; combines with --baseline-update "
+                        "or rewrites the baseline in place on its own")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule set and exit")
     return p
@@ -101,8 +110,10 @@ def main(argv=None) -> int:
         return 2
 
     cache = analysis.ModuleCache()
+    t0 = time.monotonic()
     findings = analysis.run_paths(paths, rules=rules, root=REPO_ROOT,
                                   cache=cache)
+    sweep_seconds = time.monotonic() - t0
 
     baseline_path = None if args.no_baseline else args.baseline
     baseline = analysis.load_baseline(baseline_path)
@@ -111,8 +122,31 @@ def main(argv=None) -> int:
         new = analysis.Baseline.from_findings(
             findings, default_reason="TODO: justify or fix")
         new.carry_reasons_from(baseline)
+        if args.prune_stale:
+            for e in baseline.stale_entries(findings):
+                print(f"graftlint: pruned stale {e['rule']} "
+                      f"{e['path']}:{e.get('line', '?')} "
+                      f"[{e['fingerprint']}]")
+        else:
+            new.adopt_missing_from(baseline)
         new.dump(args.baseline)
         print(f"graftlint: wrote {len(new)} entries to {args.baseline}")
+        return 0
+
+    if args.prune_stale:
+        if args.no_baseline:
+            print("graftlint: --prune-stale needs a baseline "
+                  "(--no-baseline given)", file=sys.stderr)
+            return 2
+        pruned = baseline.prune_stale(findings)
+        for e in pruned:
+            print(f"graftlint: pruned stale {e['rule']} "
+                  f"{e['path']}:{e.get('line', '?')} "
+                  f"[{e['fingerprint']}]")
+        baseline.dump(args.baseline)
+        print(f"graftlint: pruned {len(pruned)} entr"
+              f"{'y' if len(pruned) == 1 else 'ies'}, "
+              f"{len(baseline)} remain in {args.baseline}")
         return 0
 
     fresh, known = baseline.split(findings)
@@ -120,9 +154,16 @@ def main(argv=None) -> int:
 
     if args.format == "json":
         report = analysis.runner.report_json(
-            fresh, baselined=known, stale=stale, errors=cache.errors)
+            fresh, baselined=known, stale=stale, errors=cache.errors,
+            sweep_seconds=sweep_seconds)
         report["stale_baseline"] = stale
         json.dump(report, sys.stdout, indent=2)
+        print()
+    elif args.format == "sarif":
+        rules_for_table = rules if rules is not None \
+            else analysis.all_rules()
+        json.dump(analysis.report_sarif(fresh, rules=rules_for_table),
+                  sys.stdout, indent=2)
         print()
     else:
         for f in fresh:

@@ -249,6 +249,37 @@ def _run_gates(on_tpu: bool) -> dict:
                           jnp.int32)
         np.asarray(satt._ragged_paged_pallas(qq, kp, kp, pt, pos, rid))
 
+    def paged_decode_quant():
+        # dequantizing variant: int8 pools + fp32 scale slabs, page_size
+        # 32 (the int8 min-tile floor _quant_kernel_ok enforces)
+        from paddle_tpu.serving import attention as satt
+
+        kvh, hd, ps, pages, maxp, bb = 4, 128, 32, 16, 2, 4
+        kp = jnp.asarray(rng.randint(-127, 128, (kvh, pages, ps, hd)),
+                         jnp.int8)
+        ks = jnp.asarray(rng.rand(kvh, pages, ps, 1), jnp.float32)
+        qq = jnp.asarray(rng.randn(bb, 1, 8, hd), jnp.bfloat16)
+        pt = jnp.asarray(rng.randint(1, pages, (bb, maxp)), jnp.int32)
+        pos = jnp.asarray([3, 17, 33, 60], jnp.int32)
+        np.asarray(satt._paged_decode_pallas(qq, kp, kp, pt, pos,
+                                             k_scale=ks, v_scale=ks))
+
+    def ragged_paged_quant():
+        from paddle_tpu.serving import attention as satt
+
+        kvh, hd, ps, pages, maxp, rows, tt = 4, 128, 32, 16, 2, 4, 16
+        kp = jnp.asarray(rng.randint(-127, 128, (kvh, pages, ps, hd)),
+                         jnp.int8)
+        ks = jnp.asarray(rng.rand(kvh, pages, ps, 1), jnp.float32)
+        qq = jnp.asarray(rng.randn(1, tt, 8, hd), jnp.bfloat16)
+        pt = jnp.asarray(rng.randint(1, pages, (rows, maxp)), jnp.int32)
+        pos = jnp.asarray(np.r_[[5, 17], np.arange(8, 14),
+                                np.full(8, maxp * ps)], jnp.int32)
+        rid = jnp.asarray(np.r_[[0, 1], np.full(6, 2), np.zeros(8)],
+                          jnp.int32)
+        np.asarray(satt._ragged_paged_pallas(qq, kp, kp, pt, pos, rid,
+                                             k_scale=ks, v_scale=ks))
+
     gate("flash_fwd", flash_fwd)
     gate("flash_bwd", flash_bwd)
     gate("flash_dropout", flash_dropout)
@@ -256,6 +287,8 @@ def _run_gates(on_tpu: bool) -> dict:
     gate("ring_step", ring_step)
     gate("paged_decode", paged_decode)
     gate("ragged_paged", ragged_paged)
+    gate("paged_decode_quant", paged_decode_quant)
+    gate("ragged_paged_quant", ragged_paged_quant)
     return gates
 
 
@@ -556,6 +589,52 @@ def _run_serving_slo(on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
+def _run_serving_quant(on_tpu: bool) -> dict:
+    """Quantized-serving phase: pool capacity per byte and decode tok/s
+    at fp32/bf16/int8 KV (greedy parity deltas vs fp32), plus the TP
+    block-scaled int8 all-reduce probe with qar on/off. Non-fatal like
+    the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_quant_phase(model, cfg, on_tpu)
+        i8 = out["kv"]["int8"]
+        _log(f"phase=serving_quant: int8 pool {i8['pool_bytes']}B "
+             f"({i8['capacity_ratio']}x fp32 capacity), parity "
+             f"token_match={i8['token_match']} tok/s={i8['tok_s']}, "
+             f"qar probe {out['tp_psum_probe_us']}")
+        if not i8["token_match"]:
+            _log("phase=serving_quant: WARN int8 greedy stream diverged "
+                 "from fp32 on the tiny config")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_quant: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
+def _probe_backend_init(timeout_s: float) -> str | None:
+    """Backend-init watchdog: probe `jax.devices()` in a THROWAWAY
+    subprocess before the child commits its own (unkillable-from-inside)
+    backend init. A wedged TPU runtime — chip held by a dead process,
+    libtpu lockfile, metadata-server stall — hangs exactly here, so a
+    probe timeout means: force CPU now and record why, instead of eating
+    the whole watchdog budget. Returns None when healthy, else a short
+    reason string for the bench detail."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices()"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if proc.returncode != 0:
+            tail = (proc.stderr or proc.stdout or "").strip()[-300:]
+            return f"probe exit {proc.returncode}: {tail}"
+        return None
+    except subprocess.TimeoutExpired:
+        return f"probe timed out after {timeout_s:.0f}s"
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        return f"probe error {type(e).__name__}: {str(e)[:200]}"
+
+
 def make_train_step(model, opt):
     """The bench train step (fwd + MLM loss + grad + Adam, bf16 autocast).
 
@@ -707,6 +786,25 @@ def _run_aot_gates() -> dict:
          abs_((4, 4), jnp.int32), abs_((16,), jnp.int32),
          abs_((16,), jnp.int32))
 
+    # dequantizing twins: int8 pools + fp32 scale slabs at page_size 32
+    # (the int8 min-tile floor _quant_kernel_ok enforces on real Mosaic)
+    gate("paged_decode_quant",
+         lambda qq, kp, ks, pt, pos: satt._paged_decode_pallas(
+             qq, kp, kp, pt, pos, k_scale=ks, v_scale=ks),
+         abs_((4, 1, 8, 128), jnp.bfloat16),
+         abs_((4, 16, 32, 128), jnp.int8),
+         abs_((4, 16, 32, 1), jnp.float32),
+         abs_((4, 2), jnp.int32), abs_((4,), jnp.int32))
+
+    gate("ragged_paged_quant",
+         lambda qq, kp, ks, pt, pos, rid: satt._ragged_paged_pallas(
+             qq, kp, kp, pt, pos, rid, k_scale=ks, v_scale=ks),
+         abs_((1, 16, 8, 128), jnp.bfloat16),
+         abs_((4, 16, 32, 128), jnp.int8),
+         abs_((4, 16, 32, 1), jnp.float32),
+         abs_((4, 2), jnp.int32), abs_((16,), jnp.int32),
+         abs_((16,), jnp.int32))
+
     pk._on_tpu = orig
     return gates
 
@@ -724,10 +822,22 @@ def bench_child() -> None:
     _log("phase=init: importing jax")
     import jax
 
+    backend_init_timeout = None
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # the axon sitecustomize pins jax_platforms at interpreter start;
         # env vars alone cannot undo it — config.update before backend init
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # fail-fast probe: a wedged accelerator runtime hangs in
+        # jax.devices() with no exception to catch — detect it in a
+        # killable subprocess and fall back to CPU with the reason
+        # recorded, rather than burning the child's whole watchdog budget
+        backend_init_timeout = _probe_backend_init(
+            float(os.environ.get("BENCH_BACKEND_PROBE_SECS", "180")))
+        if backend_init_timeout is not None:
+            _log(f"phase=init: backend probe failed "
+                 f"({backend_init_timeout}) — forcing CPU")
+            jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
@@ -788,6 +898,10 @@ def bench_child() -> None:
     # observability v2 phase: SLO goodput, recorder cost, death bundle
     _enter_phase("serving_slo", 400.0)
     serving_slo = _run_serving_slo(on_tpu)
+
+    # quantized-serving phase: int8 capacity/parity + qar psum probe
+    _enter_phase("serving_quant", 400.0)
+    serving_quant = _run_serving_quant(on_tpu)
     _enter_phase("build")
 
     if on_tpu:
@@ -927,6 +1041,8 @@ def bench_child() -> None:
                 "serving_recovery": serving_recovery,
                 "serving_cluster": serving_cluster,
                 "serving_slo": serving_slo,
+                "serving_quant": serving_quant,
+                "backend_init_timeout": backend_init_timeout,
                 "lint": lint,
                 "observability": _obs_snapshot(),
             },

@@ -100,7 +100,8 @@ def main():
                    "serving_recovery": serving_recovery_phase(m, cfg,
                                                               on_tpu),
                    "serving_cluster": serving_cluster_phase(m, cfg,
-                                                            on_tpu)},
+                                                            on_tpu),
+                   "serving_quant": serving_quant_phase(m, cfg, on_tpu)},
     }))
 
 
@@ -320,6 +321,95 @@ def serving_tp_phase(model, cfg, on_tpu):
             results[f"tp{d}"]["decode_tokens_per_s"]
             / max(results["tp1"]["decode_tokens_per_s"], 1e-9), 2)
     return out
+
+
+def serving_quant_phase(model, cfg, on_tpu):
+    """Quantized-serving sweep (ISSUE 15): the same scheduled decode
+    workload with the KV pool at fp32 / bf16 / int8 (+ fp8 when the jax
+    build has float8_e4m3fn), reporting pool bytes, resident-capacity
+    ratio vs fp32 (same page count, fewer bytes — equivalently more
+    pages for the same HBM), decode tokens/s, and greedy-stream parity
+    vs the fp32 baseline (bf16 repro must be bit-exact by construction;
+    int8/fp8 carry the bounded-error contract, token_match reports
+    whether the tiny-config stream actually diverged). The tp=2 leg runs
+    int8 KV with the row-parallel all-reduce plain vs block-scaled int8
+    (`tp_quantized_allreduce`), surfacing both construction-time psum
+    probes — on the CPU fake-device mesh the probe time is the only
+    non-null signal, as in serving_tp_phase."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.RandomState(23)
+    n_req = 4
+    new_tokens = 48 if on_tpu else 24
+    prompts = [rng.randint(0, cfg.vocab_size, (12,)).tolist()
+               for _ in range(n_req)]
+    max_seq = min(cfg.max_position_embeddings, 128)
+    page_size = 32 if on_tpu else 8   # 32 = int8 Mosaic min-tile floor
+
+    def run(kv_dtype, tp=1, qar=False):
+        eng = ServingEngine(model, page_size=page_size,
+                            max_batch_size=n_req, max_seq_len=max_seq,
+                            decode_horizon=8, kv_dtype=kv_dtype,
+                            tp_size=tp, tp_quantized_allreduce=qar)
+        for p in prompts:            # warm wave: compiles
+            eng.add_request(p, max_new_tokens=new_tokens)
+        eng.run()
+        toks0 = eng.stats()["tokens_generated"]
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        out = eng.run()
+        wall = time.perf_counter() - t0
+        toks = eng.stats()["tokens_generated"] - toks0
+        entry = {"pool_bytes": eng.cache.pool_bytes,
+                 "page_bytes": eng.cache.page_bytes,
+                 "tok_s": round(toks / wall, 1),
+                 "wall_ms": round(wall * 1000, 2)}
+        if tp > 1 and eng.metrics is not None:
+            probe = eng.metrics.get("serving_tp_collective_seconds")
+            if probe is not None and probe.count:
+                entry["psum_probe_us"] = round(
+                    1e6 * probe.sum / probe.count, 1)
+        return entry, [out[r] for r in rids]
+
+    import jax.numpy as jnp
+    dtypes = ["fp32", "bf16", "int8"]
+    if hasattr(jnp, "float8_e4m3fn"):
+        dtypes.append("fp8")
+
+    kv, streams = {}, {}
+    for name in dtypes:
+        kv[name], streams[name] = run(name)
+    fp32 = kv["fp32"]
+    for name in dtypes:
+        kv[name]["capacity_ratio"] = round(
+            fp32["page_bytes"] / kv[name]["page_bytes"], 2)
+        kv[name]["token_match"] = streams[name] == streams["fp32"]
+
+    # tp leg: int8 KV, plain vs block-scaled int8 all-reduce
+    ndev = len(jax.devices())
+    n_kv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+    tp_probe, tp_parity = {}, None
+    if ndev >= 2 and n_kv % 2 == 0 and cfg.intermediate_size % 2 == 0:
+        plain, s_plain = run("int8", tp=2)
+        quant, s_quant = run("int8", tp=2, qar=True)
+        tp_probe = {"psum_us": plain.get("psum_probe_us"),
+                    "quantized_psum_us": quant.get("psum_probe_us")}
+        tp_parity = (s_plain == streams["int8"]
+                     and s_quant == streams["int8"])
+    return {
+        "requests": n_req, "new_tokens": new_tokens,
+        "page_size": page_size, "kv": kv,
+        "int8_speedup_vs_fp32": round(
+            kv["int8"]["tok_s"] / max(fp32["tok_s"], 1e-9), 2),
+        "tp_psum_probe_us": tp_probe,
+        "tp_int8_parity_ok": tp_parity,
+    }
 
 
 def serving_faults_phase(model, cfg, on_tpu):

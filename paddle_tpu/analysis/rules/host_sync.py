@@ -54,6 +54,15 @@ DEFAULT_HOT_MODULES: Dict[str, FrozenSet[str]] = {
     "observability/slo.py": frozenset(
         {"first_token", "decode_tokens", "step_tick"}),
     "observability/flight_recorder.py": frozenset({"record"}),
+    # ISSUE 15: quantize/dequantize run at TRACE time inside every jitted
+    # step of a quantized engine, and quantized_psum inside every TP
+    # block — a host sync slipped into any of them would stall each
+    # retrace and, worse, suggest scale math is happening on the host.
+    # Scales live on-device; the one intentional host read
+    # (measure_roundtrip_error's construction-time probe) is NOT
+    # reachable from these roots and carries its own noqa for the audit.
+    "serving/quant.py": frozenset(
+        {"quantize_tokens", "dequantize", "quantized_psum"}),
 }
 _SYNC_METHOD_TAILS = {"item", "tolist", "block_until_ready"}
 _SYNC_CHAINS = {

@@ -54,7 +54,16 @@ decoder models (LLaMA, GPT) with:
   tp_size=1. `ServingCluster(tp_size=N)` carves jax.devices() into
   `num_replicas x tp_size` disjoint sub-meshes. Page accounting,
   scheduling, recovery and migration are untouched (one logical page =
-  tp physical slabs; the journal is device-independent).
+  tp physical slabs; the journal is device-independent);
+- `quant`: quantized serving — `ServingEngine(kv_dtype="int8"|"fp8")`
+  stores K/V pages in 1-byte formats with per-(head, page, slot) fp32
+  scales in a parallel scale pool (one logical page = data slab + scale
+  slab; allocator/page-table/prefix-cache accounting unchanged), and
+  dequantizes inside every attention path — jnp reference and Pallas
+  kernels. `tp_quantized_allreduce=True` swaps the row-parallel psum for
+  an EQuARX-style block-scaled int8 all-reduce. fp32/bf16 stay bit-exact
+  and import zero quantization code; int8/fp8 carry a bounded-error
+  parity contract (tests/test_quant.py).
 
 See README.md "paddle_tpu.serving" for knobs and parity notes.
 """
@@ -89,12 +98,21 @@ from .scheduler import (  # noqa: F401
 # poisoned-module test
 _TP_EXPORTS = ("TPContext", "validate_tp_config", "tp_device_order")
 
+# quant exports are equally lazy: a kv_dtype="fp32"/"bf16" engine (the
+# default) must never import serving.quant — same raise-on-touch pin
+_QUANT_EXPORTS = ("KVQuantSpec", "resolve_kv_dtype", "quantize_tokens",
+                  "dequantize", "quantized_psum", "kv_pool_bytes")
+
 
 def __getattr__(name):
     if name in _TP_EXPORTS:
         from . import tp
 
         return getattr(tp, name)
+    if name in _QUANT_EXPORTS:
+        from . import quant
+
+        return getattr(quant, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -114,4 +132,6 @@ __all__ = [
     "paged_attend", "paged_decode_attention", "paged_decode_available",
     "advance_positions", "pages_for", "overflow_position",
     "NULL_PAGE", "PAD_TOKEN",
+    "KVQuantSpec", "resolve_kv_dtype", "quantize_tokens", "dequantize",
+    "quantized_psum", "kv_pool_bytes",
 ]

@@ -74,6 +74,14 @@ def paged_decode_available(page_size: int, head_dim: int) -> bool:
     return page_size % 8 == 0 and 8 <= head_dim <= 256
 
 
+def _quant_kernel_ok(page_size: int) -> bool:
+    """Extra shape gate for DEQUANTIZING kernels on real TPUs: int8/fp8
+    pool tiles need a 32-sublane page axis (Mosaic's narrow-dtype tile is
+    (32, 128); fp32/bf16 get away with 8/16). Interpret mode skips Mosaic
+    and accepts any page size."""
+    return page_size % 32 == 0
+
+
 def advance_positions(positions, live, max_pages: int,
                       page_size: int) -> jnp.ndarray:
     """Device-side position advance for the multi-step decode horizon:
@@ -127,8 +135,20 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
     b, s = q.shape[0], q.shape[1]
     max_pages = page_table.shape[1]
 
-    kd = (k._data if hasattr(k, "_data") else k).astype(kp.dtype)
-    vd = (v._data if hasattr(v, "_data") else v).astype(vp.dtype)
+    kd_raw = k._data if hasattr(k, "_data") else k
+    vd_raw = v._data if hasattr(v, "_data") else v
+    if cache.quantized:
+        # quantized pools: fresh K/V is quantized ONCE here, at page-write
+        # time, so every later read — decode, chunked prefill, ragged,
+        # prefix-cache reuse — sees the identical bytes (lazy import: an
+        # fp32/bf16 cache never reaches this branch)
+        from .quant import quantize_tokens
+        spec = _pool_quant_spec(kp.dtype)
+        kd, k_sc = quantize_tokens(kd_raw, spec)
+        vd, v_sc = quantize_tokens(vd_raw, spec)
+    else:
+        kd = kd_raw.astype(kp.dtype)
+        vd = vd_raw.astype(vp.dtype)
     pos = _positions(start_pos, b, s)                # (b, s)
     page_idx = pos // ps
     if cache.row_ids is not None:
@@ -151,7 +171,16 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
                       entries.reshape(-1), slots.reshape(-1))
     vp = _write_pages(vp, vd.reshape(b * s, *vd.shape[2:]),
                       entries.reshape(-1), slots.reshape(-1))
-    new_cache = PagedLayerCache(kp, vp, page_table, cache.row_ids)
+    ks_pool, vs_pool = cache.k_scale, cache.v_scale
+    if cache.quantized:
+        # the scale slab is scattered with the SAME entries/slots as the
+        # data slab — the null-page/overflow routing above covers both
+        ks_pool = _write_pages(ks_pool, k_sc.reshape(b * s, -1, 1),
+                               entries.reshape(-1), slots.reshape(-1))
+        vs_pool = _write_pages(vs_pool, v_sc.reshape(b * s, -1, 1),
+                               entries.reshape(-1), slots.reshape(-1))
+    new_cache = PagedLayerCache(kp, vp, page_table, cache.row_ids,
+                                k_scale=ks_pool, v_scale=vs_pool)
 
     raw_start = start_pos._data if hasattr(start_pos, "_data") else start_pos
     static_zero = isinstance(raw_start, int) and raw_start == 0
@@ -160,9 +189,17 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
     elif s == 1:
         ctx = paged_decode_attention(q, new_cache, pos[:, 0], rep,
                                      bias=bias)
-    elif static_zero:
+    elif static_zero and not cache.quantized:
         _count_dispatch("prefill")
         ctx = _prefill_attention(q, kd, vd, pos, rep, bias=bias)
+    elif static_zero:
+        # quantized pools route EVERY multi-token prefill through the
+        # paged gather: the exact path would read the un-quantized fresh
+        # K/V and diverge from what chunked/prefix/migration legs read
+        # back from the pool — within a quantized mode, all paths must
+        # see the same quantized bytes
+        _count_dispatch("prefill_paged_quant")
+        ctx = _prefill_attention_paged(q, new_cache, pos, rep, bias=bias)
     else:
         # prefill at a TRACED (or nonzero) offset: earlier K/V lives
         # only in the pool's pages, so attend through the page table.
@@ -170,9 +207,19 @@ def paged_attend(q, k, v, cache: PagedLayerCache, start_pos, rep,
         # AND every chunk of a chunked prefill (its offset is traced, so
         # even a first chunk at offset 0 takes this path; that is what
         # lets one chunked executable serve every chunk of every prompt)
-        _count_dispatch("prefill_paged")
+        _count_dispatch("prefill_paged_quant" if cache.quantized
+                        else "prefill_paged")
         ctx = _prefill_attention_paged(q, new_cache, pos, rep, bias=bias)
     return ctx, new_cache
+
+
+def _pool_quant_spec(storage_dtype):
+    """KVQuantSpec for a quantized pool's storage dtype (trace-time only,
+    reached exclusively from quantized branches)."""
+    from .quant import resolve_kv_dtype
+    name = ("int8" if jnp.dtype(storage_dtype) == jnp.dtype(jnp.int8)
+            else "fp8")
+    return resolve_kv_dtype(name)
 
 
 def _expand_kv(x, rep):
@@ -225,14 +272,19 @@ def _prefill_attention_paged(q, cache: PagedLayerCache, pos, rep,
     ps = cache.page_size
     length = page_table.shape[1] * ps
 
-    def gather(pool):
+    def gather(pool, scale=None):
         g = pool[:, page_table]                  # (kvh, b, maxP, ps, hd)
         kvh, _, mp, _, hd = g.shape
-        return jnp.transpose(g, (1, 2, 3, 0, 4)).reshape(
+        out = jnp.transpose(g, (1, 2, 3, 0, 4)).reshape(
             b, mp * ps, kvh, hd)
+        if scale is None:
+            return out
+        # quantized pool: dequantize against the gathered scale slab
+        # ((kvh, b, maxP, ps, 1) -> (b, L, kvh, 1) by the same permute)
+        return out.astype(jnp.float32) * gather(scale)
 
-    kf = _expand_kv(gather(kp), rep)
-    vf = _expand_kv(gather(vp), rep)
+    kf = _expand_kv(gather(kp, cache.k_scale), rep)
+    vf = _expand_kv(gather(vp, cache.v_scale), rep)
     # query at global pos[i, r] sees pool column j iff j <= pos[i, r];
     # pool padding (null page, beyond-length slots) masks to the same
     # -1e9 floor as the reference decode path
@@ -256,16 +308,23 @@ def paged_decode_attention(q, cache: PagedLayerCache, pos, rep,
     hd = q.shape[-1]
     use_kernel = (KERNEL_MODE != "off" and bias is None
                   and paged_decode_available(cache.page_size, hd)
+                  and (not cache.quantized
+                       or KERNEL_MODE == "interpret"
+                       or _quant_kernel_ok(cache.page_size))
                   and (KERNEL_MODE == "interpret" or _on_tpu()))
     if use_kernel:
-        _count_dispatch("decode_pallas_interpret"
-                        if KERNEL_MODE == "interpret" else "decode_pallas")
+        tag = ("decode_pallas_interpret"
+               if KERNEL_MODE == "interpret" else "decode_pallas")
+        _count_dispatch(tag + "_quant" if cache.quantized else tag)
         qd = q._data if hasattr(q, "_data") else q
         out = _paged_decode_pallas(qd, cache.k_pool, cache.v_pool,
                                    cache.page_table, pos,
+                                   k_scale=cache.k_scale,
+                                   v_scale=cache.v_scale,
                                    interpret=KERNEL_MODE == "interpret")
         return Tensor(out)
-    _count_dispatch("decode_reference")
+    _count_dispatch("decode_reference_quant" if cache.quantized
+                    else "decode_reference")
     return _paged_decode_reference(q, cache, pos, rep, bias)
 
 
@@ -281,14 +340,17 @@ def _paged_decode_reference(q, cache, pos, rep, bias=None):
     ps = cache.page_size
     length = page_table.shape[1] * ps
     # (kvh, b, maxP, ps, hd) -> (b, L, kvh, hd)
-    def gather(pool):
+    def gather(pool, scale=None):
         g = pool[:, page_table]
         kvh, _, mp, _, hd = g.shape
-        return jnp.transpose(g, (1, 2, 3, 0, 4)).reshape(
+        out = jnp.transpose(g, (1, 2, 3, 0, 4)).reshape(
             b, mp * ps, kvh, hd)
+        if scale is None:
+            return out
+        return out.astype(jnp.float32) * gather(scale)
 
-    kf = _expand_kv(gather(kp), rep)
-    vf = _expand_kv(gather(vp), rep)
+    kf = _expand_kv(gather(kp, cache.k_scale), rep)
+    vf = _expand_kv(gather(vp, cache.v_scale), rep)
     allowed = jnp.arange(length, dtype=jnp.int32)[None, :] <= pos[:, None]
     mask = jnp.where(allowed, 0.0, -1e9).astype(
         jnp.float32)[:, None, None, :]                    # (b, 1, 1, L)
@@ -320,17 +382,24 @@ def ragged_paged_attention(q, cache: PagedLayerCache, pos, rep, bias=None):
     hd = q.shape[-1]
     use_kernel = (KERNEL_MODE != "off" and bias is None
                   and ragged_attention_available(cache.page_size, hd)
+                  and (not cache.quantized
+                       or KERNEL_MODE == "interpret"
+                       or _quant_kernel_ok(cache.page_size))
                   and (KERNEL_MODE == "interpret" or _on_tpu()))
     if use_kernel:
-        _count_dispatch("ragged_pallas_interpret"
-                        if KERNEL_MODE == "interpret" else "ragged_pallas")
+        tag = ("ragged_pallas_interpret"
+               if KERNEL_MODE == "interpret" else "ragged_pallas")
+        _count_dispatch(tag + "_quant" if cache.quantized else tag)
         qd = q._data if hasattr(q, "_data") else q
         out = _ragged_paged_pallas(qd, cache.k_pool, cache.v_pool,
                                    cache.page_table, pos[0],
                                    cache.row_ids,
+                                   k_scale=cache.k_scale,
+                                   v_scale=cache.v_scale,
                                    interpret=KERNEL_MODE == "interpret")
         return Tensor(out)
-    _count_dispatch("ragged_reference")
+    _count_dispatch("ragged_reference_quant" if cache.quantized
+                    else "ragged_reference")
     return _ragged_attention_reference(q, cache, pos, rep, bias)
 
 
@@ -354,14 +423,17 @@ def _ragged_attention_reference(q, cache, pos, rep, bias=None):
     length = page_table.shape[1] * ps
     pt = page_table[rows]                             # (T, maxP)
 
-    def gather(pool):
+    def gather(pool, scale=None):
         g = pool[:, pt]                    # (kvh, T, maxP, pgsz, hd)
         kvh, _, mp, _, hd = g.shape
-        return jnp.transpose(g, (1, 2, 3, 0, 4)).reshape(
+        out = jnp.transpose(g, (1, 2, 3, 0, 4)).reshape(
             t, mp * ps, kvh, hd)
+        if scale is None:
+            return out
+        return out.astype(jnp.float32) * gather(scale)
 
-    kf = _expand_kv(gather(kp), rep)
-    vf = _expand_kv(gather(vp), rep)
+    kf = _expand_kv(gather(kp, cache.k_scale), rep)
+    vf = _expand_kv(gather(vp, cache.v_scale), rep)
     qd = q._data if hasattr(q, "_data") else q
     qt = Tensor(qd[0][:, None])                       # (T, 1, heads, hd)
     allowed = (jnp.arange(length, dtype=jnp.int32)[None, :]
@@ -381,13 +453,23 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                         acc_ref, m_ref, l_ref, *, ps, scale, n_pages):
+def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                         ps, scale, n_pages, quantized=False):
     """Grid (batch, kv_head, page): one (page_size, head_dim) K/V tile per
     step, gathered by the BlockSpec index map from the scalar-prefetched
     page table; online softmax in fp32 VMEM scratch (flash structure).
-    Pages wholly past the row's position are skipped splash-style."""
+    Pages wholly past the row's position are skipped splash-style.
+
+    Quantized pools add two (page_size, 1) fp32 scale tiles gathered by
+    the same index map; K/V tiles dequantize in-register (one cast + one
+    lane-broadcast multiply per tile) before the unchanged flash loop —
+    the unquantized trace is byte-identical to before."""
     from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
 
     b_ = pl.program_id(0)
     pi = pl.program_id(2)
@@ -400,9 +482,15 @@ def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     def _compute():
+        if quantized:
+            qblk = q_ref[0, 0].astype(jnp.float32)
+            kblk = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
+        else:
+            qblk = q_ref[0, 0]
+            kblk = k_ref[0, 0]
         # (G, ps) scores: the q group rides the MXU in the input dtype
         s = jax.lax.dot_general(
-            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.DEFAULT) * scale
         cols = pi * ps + jax.lax.broadcasted_iota(
@@ -420,6 +508,8 @@ def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_ref[...] = m_cur
         vblk = v_ref[0, 0]
+        if quantized:
+            vblk = vblk.astype(jnp.float32) * vs_ref[0, 0]
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -434,9 +524,10 @@ def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_decode_pallas(q, k_pool, v_pool, page_table, pos,
-                         interpret=False):
+                         k_scale=None, v_scale=None, interpret=False):
     """q: (b, 1, heads, hd); pools: (kvh, P, ps, hd); page_table: (b,
-    maxP) i32; pos: (b,) i32. Returns (b, 1, heads, hd)."""
+    maxP) i32; pos: (b,) i32; k_scale/v_scale: optional (kvh, P, ps, 1)
+    fp32 scale slabs (quantized pools). Returns (b, 1, heads, hd)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -445,6 +536,7 @@ def _paged_decode_pallas(q, k_pool, v_pool, page_table, pos,
     rep = heads // kvh
     max_pages = page_table.shape[1]
     scale = 1.0 / (hd ** 0.5)
+    quantized = k_scale is not None
 
     d_p = _round_up(hd, 128)
     g_p = _round_up(rep, 8)
@@ -460,10 +552,18 @@ def _paged_decode_pallas(q, k_pool, v_pool, page_table, pos,
     kv_spec = pl.BlockSpec((1, 1, ps, d_p),
                            lambda b_, h_, pi, pt, ps_: (h_, pt[b_, pi],
                                                         0, 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qg, kp, vp]
+    if quantized:
+        sc_spec = pl.BlockSpec((1, 1, ps, 1),
+                               lambda b_, h_, pi, pt, ps_: (h_, pt[b_, pi],
+                                                            0, 0))
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kvh, max_pages),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[
             pltpu.VMEM((g_p, d_p), jnp.float32),
@@ -473,26 +573,32 @@ def _paged_decode_pallas(q, k_pool, v_pool, page_table, pos,
     )
     out = pl.pallas_call(
         functools.partial(_paged_decode_kernel, ps=ps, scale=scale,
-                          n_pages=max_pages),
+                          n_pages=max_pages, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, g_p, d_p), q.dtype),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), pos.astype(jnp.int32), qg, kp, vp)
+    )(page_table.astype(jnp.int32), pos.astype(jnp.int32), *operands)
     return out[:, :, :rep, :hd].reshape(b, 1, heads, hd)
 
 
 # ------------------------------------------------------- Pallas ragged path
 
 def _ragged_attend_kernel(pt_ref, pos_ref, row_ref, q_ref, k_ref, v_ref,
-                          o_ref, acc_ref, m_ref, l_ref, *, ps, scale,
-                          n_pages):
+                          *rest, ps, scale, n_pages, quantized=False):
     """Grid (token, kv_head, page): the decode kernel's flash loop with the
     batch axis replaced by a flat TOKEN axis — the BlockSpec index map
     gathers page `pi` of token t's OWN page-table row (row_ref, scalar-
     prefetched alongside the table). Pages wholly past the token's
     position are skipped splash-style, and tokens parked at the table
-    capacity (flat-batch padding) skip every page and emit zeros."""
+    capacity (flat-batch padding) skip every page and emit zeros.
+    Quantized pools dequantize each K/V tile in-register against the
+    (page_size, 1) scale tiles, as in the decode kernel."""
     from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
 
     t_ = pl.program_id(0)
     pi = pl.program_id(2)
@@ -505,8 +611,14 @@ def _ragged_attend_kernel(pt_ref, pos_ref, row_ref, q_ref, k_ref, v_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     def _compute():
+        if quantized:
+            qblk = q_ref[0, 0].astype(jnp.float32)
+            kblk = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
+        else:
+            qblk = q_ref[0, 0]
+            kblk = k_ref[0, 0]
         s = jax.lax.dot_general(
-            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.DEFAULT) * scale
         cols = pi * ps + jax.lax.broadcasted_iota(
@@ -522,6 +634,8 @@ def _ragged_attend_kernel(pt_ref, pos_ref, row_ref, q_ref, k_ref, v_ref,
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_ref[...] = m_cur
         vblk = v_ref[0, 0]
+        if quantized:
+            vblk = vblk.astype(jnp.float32) * vs_ref[0, 0]
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -539,9 +653,10 @@ def _ragged_attend_kernel(pt_ref, pos_ref, row_ref, q_ref, k_ref, v_ref,
 
 
 def _ragged_paged_pallas(q, k_pool, v_pool, page_table, pos, row_ids,
-                         interpret=False):
+                         k_scale=None, v_scale=None, interpret=False):
     """q: (1, T, heads, hd); pools: (kvh, P, ps, hd); page_table:
-    (B, maxP) i32; pos/row_ids: (T,) i32. Returns (1, T, heads, hd)."""
+    (B, maxP) i32; pos/row_ids: (T,) i32; k_scale/v_scale: optional
+    (kvh, P, ps, 1) fp32 scale slabs. Returns (1, T, heads, hd)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -550,6 +665,7 @@ def _ragged_paged_pallas(q, k_pool, v_pool, page_table, pos, row_ids,
     rep = heads // kvh
     max_pages = page_table.shape[1]
     scale = 1.0 / (hd ** 0.5)
+    quantized = k_scale is not None
 
     d_p = _round_up(hd, 128)
     g_p = _round_up(rep, 8)
@@ -565,10 +681,18 @@ def _ragged_paged_pallas(q, k_pool, v_pool, page_table, pos, row_ids,
     kv_spec = pl.BlockSpec(
         (1, 1, ps, d_p),
         lambda t_, h_, pi, pt, ps_, rw: (h_, pt[rw[t_], pi], 0, 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qg, kp, vp]
+    if quantized:
+        sc_spec = pl.BlockSpec(
+            (1, 1, ps, 1),
+            lambda t_, h_, pi, pt, ps_, rw: (h_, pt[rw[t_], pi], 0, 0))
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(t, kvh, max_pages),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[
             pltpu.VMEM((g_p, d_p), jnp.float32),
@@ -578,10 +702,10 @@ def _ragged_paged_pallas(q, k_pool, v_pool, page_table, pos, row_ids,
     )
     out = pl.pallas_call(
         functools.partial(_ragged_attend_kernel, ps=ps, scale=scale,
-                          n_pages=max_pages),
+                          n_pages=max_pages, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, kvh, g_p, d_p), q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), pos.astype(jnp.int32),
-      row_ids.astype(jnp.int32), qg, kp, vp)
+      row_ids.astype(jnp.int32), *operands)
     return out[:, :, :rep, :hd].reshape(1, t, heads, hd)

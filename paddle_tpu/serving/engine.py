@@ -76,7 +76,7 @@ from ..observability.slo import SloTracker
 from ..profiler import RecordEvent
 from .attention import advance_positions
 from .kv_cache import (PagedKVCache, PagedLayerCache, overflow_position,
-                       pages_for)
+                       pages_for, pools_from_views, views_from_pools)
 from .prefix_cache import PrefixCache
 from .ragged import build_ragged_inputs
 from .ragged import token_buckets as ragged_token_buckets
@@ -267,6 +267,29 @@ class ServingObs:
             for i in range(tp_size)]
         self.lifecycle.tag = f"tp={tp_size}"
 
+    def bind_kv_pool(self, kv_dtype: str, pool_bytes: int,
+                     fp32_pool_bytes: int,
+                     rms_error: Optional[float] = None) -> None:
+        """KV-pool capacity observability (ISSUE 15): pool bytes (data +
+        scale slabs) labelled by storage format for every engine, plus —
+        quantized pools only — the capacity ratio against an equal-page
+        fp32 pool and the construction-time quantization-error probe
+        (the hot path keeps no fp32 originals, so error is characterized
+        once, offline)."""
+        r = self.registry
+        r.gauge("serving_kv_pool_bytes",
+                "bytes held by the paged KV pools (data + scale slabs)",
+                labels={"kv_dtype": kv_dtype}).set(pool_bytes)
+        if rms_error is not None:
+            r.gauge("serving_kv_capacity_ratio",
+                    "fp32 pool bytes / this pool's bytes at equal page "
+                    "count (resident-sequence capacity multiplier)"
+                    ).set(fp32_pool_bytes / pool_bytes)
+            r.gauge("serving_kv_quant_rms_error",
+                    "quantize->dequantize RMS relative error, one-shot "
+                    "construction-time probe on gaussian K/V"
+                    ).set(rms_error)
+
     # --------------------------------------------------- scheduler hooks
     def enqueued(self, req) -> None:
         self.lifecycle.point(req.request_id, "enqueued", req.arrival_t)
@@ -313,6 +336,7 @@ class ServingEngine:
                  max_seq_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  cache_dtype=jnp.float32,
+                 kv_dtype: str = "fp32",
                  enable_prefix_caching: bool = False,
                  decode_horizon: int = 8,
                  enable_chunked_prefill: bool = False,
@@ -329,6 +353,7 @@ class ServingEngine:
                  journal=None,
                  tp_size: int = 1,
                  devices: Optional[Sequence] = None,
+                 tp_quantized_allreduce: bool = False,
                  slo_classes: Optional[Sequence] = None,
                  slo_refresh_every: int = 64,
                  flight_recorder=None,
@@ -338,6 +363,36 @@ class ServingEngine:
         self.model = model
         model.eval()
         cfg = _config_of(model)
+        # quantized serving (ISSUE 15): `kv_dtype` names the KV pool
+        # storage format. "fp32"/"bf16" resolve HERE, without importing
+        # serving.quant (zero-touch guarantee, raise-on-touch pinned);
+        # "int8"/"fp8" are validated lazily by quant.resolve_kv_dtype
+        # inside PagedKVCache. `cache_dtype` stays as the legacy spelling
+        # of the unquantized formats; a conflict between the two knobs is
+        # an error, not a silent preference.
+        legacy = {"float32": "fp32", "bfloat16": "bf16"}.get(
+            jnp.dtype(cache_dtype).name)
+        if legacy is None:
+            raise ValueError(
+                f"unsupported cache_dtype {cache_dtype!r}: pools take "
+                "float32/bfloat16, or use kv_dtype='int8'/'fp8'")
+        kv_dtype = str(kv_dtype)
+        if kv_dtype == "fp32" and legacy != "fp32":
+            kv_dtype = legacy
+        elif legacy != "fp32" and kv_dtype != legacy:
+            raise ValueError(
+                f"conflicting cache_dtype={jnp.dtype(cache_dtype).name} "
+                f"and kv_dtype={kv_dtype!r}: pick one knob")
+        if kv_dtype not in ("fp32", "bf16", "int8", "fp8"):
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r}: expected one of "
+                "'fp32', 'bf16', 'int8', 'fp8'")
+        self.kv_dtype = kv_dtype
+        self.tp_quantized_allreduce = bool(tp_quantized_allreduce)
+        if self.tp_quantized_allreduce and int(tp_size) < 2:
+            raise ValueError(
+                "tp_quantized_allreduce replaces the row-parallel psum "
+                "and needs tp_size >= 2 (tp_size=1 has no collective)")
         # tensor parallelism (ISSUE 10): tp_size>1 shards the model
         # weights (Megatron column/row specs) and the KV pools' kv-head
         # axis over a sub-mesh of `devices` (sorted by id; default the
@@ -350,7 +405,9 @@ class ServingEngine:
         if self.tp_size > 1:
             from .tp import TPContext
 
-            self._tp = TPContext(model, self.tp_size, devices=devices)
+            self._tp = TPContext(
+                model, self.tp_size, devices=devices,
+                quantized_allreduce=self.tp_quantized_allreduce)
         else:
             self._tp = None
         self.page_size = page_size
@@ -406,7 +463,8 @@ class ServingEngine:
             # worst case every slot runs a full-length sequence, +1 null
             num_pages = max_batch_size * self.max_pages_per_seq + 1
         self.cache = PagedKVCache.for_model(model, num_pages, page_size,
-                                            cache_dtype)
+                                            cache_dtype,
+                                            kv_dtype=self.kv_dtype)
         if self._tp is not None:
             self.cache.shard_pools(self._tp.mesh, self._tp.pool_spec)
         # observability: ONE registry per engine is the single source of
@@ -422,6 +480,18 @@ class ServingEngine:
             self._obs.bind_tp(self.tp_size)
         if self.metrics is not None:
             self.cache.allocator.bind_metrics(self.metrics)
+        if self._obs is not None:
+            # equal-page fp32 baseline for the capacity gauge, computed
+            # WITHOUT touching serving.quant
+            c = self.cache
+            fp32_bytes = (c.num_layers * c.num_pages * c.page_size
+                          * 2 * c.num_kv_heads * c.head_dim * 4)
+            rms = None
+            if c.quantized:
+                from .quant import measure_roundtrip_error
+                rms = measure_roundtrip_error(c.quant_spec, c.head_dim)
+            self._obs.bind_kv_pool(c.kv_dtype, c.pool_bytes, fp32_bytes,
+                                   rms)
         # SLO accounting (ISSUE 13): per-request-class TTFT/TPOT targets
         # feeding windowed attainment gauges + a goodput counter. Rides
         # on the metrics registry, so it requires one; with no classes
@@ -948,8 +1018,7 @@ class ServingEngine:
 
             def prefill(params, buffers, ids, pools, page_table, last_idx,
                         key_data, temps, top_ks, top_ps):
-                views = [PagedLayerCache(kp, vp, page_table)
-                         for kp, vp in pools]
+                views = views_from_pools(pools, page_table)
                 (logits, new_views), _ = call_functional(
                     model, params, buffers, (Tensor(ids),),
                     kwargs={"caches": views, "start_pos": 0},
@@ -959,7 +1028,7 @@ class ServingEngine:
                 key_data, subs = _split_rows(key_data)
                 tok = _sample_batch(last, subs, temps, top_ks, top_ps)
                 return (tok.astype(jnp.int32), key_data,
-                        [(v.k_pool, v.v_pool) for v in new_views])
+                        pools_from_views(new_views))
 
             if tp is not None:
                 prefill = tp.wrap_prefill_exec(prefill)
@@ -980,8 +1049,7 @@ class ServingEngine:
 
             def prefill(params, buffers, ids, pools, page_table, last_idx,
                         offset, key_data, temps, top_ks, top_ps):
-                views = [PagedLayerCache(kp, vp, page_table)
-                         for kp, vp in pools]
+                views = views_from_pools(pools, page_table)
                 (logits, new_views), _ = call_functional(
                     model, params, buffers, (Tensor(ids),),
                     kwargs={"caches": views, "start_pos": offset},
@@ -991,7 +1059,7 @@ class ServingEngine:
                 key_data, subs = _split_rows(key_data)
                 tok = _sample_batch(last, subs, temps, top_ks, top_ps)
                 return (tok.astype(jnp.int32), key_data,
-                        [(v.k_pool, v.v_pool) for v in new_views])
+                        pools_from_views(new_views))
 
             if tp is not None:
                 prefill = tp.wrap_prefill_exec(prefill)
@@ -1115,8 +1183,7 @@ class ServingEngine:
 
             def prefill(params, buffers, ids, pools, page_table, last_idx,
                         offset, key_data, temps, top_ks, top_ps):
-                views = [PagedLayerCache(kp, vp, page_table)
-                         for kp, vp in pools]
+                views = views_from_pools(pools, page_table)
                 (logits, new_views), _ = call_functional(
                     model, params, buffers, (Tensor(ids),),
                     kwargs={"caches": views, "start_pos": offset},
@@ -1126,7 +1193,7 @@ class ServingEngine:
                 key_data, subs = _split_rows(key_data)
                 tok = _sample_batch(last, subs, temps, top_ks, top_ps)
                 return (tok.astype(jnp.int32), key_data,
-                        [(v.k_pool, v.v_pool) for v in new_views])
+                        pools_from_views(new_views))
 
             if tp is not None:
                 prefill = tp.wrap_prefill_exec(prefill)
@@ -1250,13 +1317,12 @@ class ServingEngine:
                              final_mask):
                 max_pages = page_tables.shape[1]
                 key_in = key_data
-                views = [PagedLayerCache(kp, vp, page_tables, row_ids)
-                         for kp, vp in pools]
+                views = views_from_pools(pools, page_tables, row_ids)
                 (logits, new_views), _ = call_functional(
                     model, params, buffers, (Tensor(flat_ids),),
                     kwargs={"caches": views, "start_pos": flat_pos},
                     training=False)
-                pools = [(v.k_pool, v.v_pool) for v in new_views]
+                pools = pools_from_views(new_views)
                 # iteration-0 postlude == the decode body's arithmetic,
                 # with each row's logits gathered from its last flat
                 # token
@@ -1275,13 +1341,12 @@ class ServingEngine:
 
                 def body(carry, _):
                     tokens, pools, positions, key_data, remaining = carry
-                    views = [PagedLayerCache(kp, vp, page_tables)
-                             for kp, vp in pools]
+                    views = views_from_pools(pools, page_tables)
                     (logits, new_views), _ = call_functional(
                         model, params, buffers, (Tensor(tokens[:, None]),),
                         kwargs={"caches": views, "start_pos": positions},
                         training=False)
-                    pools = [(v.k_pool, v.v_pool) for v in new_views]
+                    pools = pools_from_views(new_views)
                     key_data, subs = _split_rows(key_data)
                     nxt = _sample_batch(logits[:, 0], subs, temps,
                                         top_ks, top_ps).astype(jnp.int32)
@@ -1445,13 +1510,12 @@ class ServingEngine:
 
                 def body(carry, _):
                     tokens, pools, positions, key_data, remaining = carry
-                    views = [PagedLayerCache(kp, vp, page_tables)
-                             for kp, vp in pools]
+                    views = views_from_pools(pools, page_tables)
                     (logits, new_views), _ = call_functional(
                         model, params, buffers, (Tensor(tokens[:, None]),),
                         kwargs={"caches": views, "start_pos": positions},
                         training=False)
-                    pools = [(v.k_pool, v.v_pool) for v in new_views]
+                    pools = pools_from_views(new_views)
                     key_data, subs = _split_rows(key_data)
                     nxt = _sample_batch(logits[:, 0], subs, temps,
                                         top_ks, top_ps).astype(jnp.int32)
@@ -2045,6 +2109,18 @@ class ServingEngine:
         s["tp_size"] = self.tp_size
         if self._tp is not None:
             s["tp"] = self._tp.describe()
+        s["kv_dtype"] = self.kv_dtype
+        if self.cache.quantized:
+            c = self.cache
+            s["quant"] = {
+                "kv_dtype": c.kv_dtype,
+                "pool_bytes": c.pool_bytes,
+                "page_bytes": c.page_bytes,
+                "fp32_pool_bytes": (c.num_layers * c.num_pages
+                                    * c.page_size * 2 * c.num_kv_heads
+                                    * c.head_dim * 4),
+                "tp_quantized_allreduce": self.tp_quantized_allreduce,
+            }
         s["tokens_per_sync"] = (
             s["tokens_generated"] / s["host_syncs"]
             if s["host_syncs"] else 0.0)

@@ -28,9 +28,18 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PagedLayerCache",
-           "NULL_PAGE", "pages_for", "overflow_position"]
+           "NULL_PAGE", "pages_for", "overflow_position",
+           "views_from_pools", "pools_from_views"]
 
 NULL_PAGE = 0
+
+# unquantized pool dtypes resolvable WITHOUT importing serving.quant —
+# kv_dtype="fp32"/"bf16" must keep the quantization module entirely
+# un-imported (poisoned-module guarantee)
+_PLAIN_KV_DTYPES = {
+    "fp32": jnp.float32, "float32": jnp.float32,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+}
 
 
 def pages_for(num_tokens: int, page_size: int) -> int:
@@ -233,28 +242,69 @@ class PagedLayerCache:
                    row_ids[t] names the page-table row token t belongs to.
                    None (the default) keeps the classic one-row-per-batch-
                    entry layout.
+    k_scale/v_scale: optional (kv_heads, num_pages, page_size, 1) fp32 —
+                   quantized pools only (kv_dtype="int8"/"fp8"): one
+                   dequantization scale per (head, page, slot), scattered
+                   by the exact same page/slot arithmetic as the data, so
+                   a logical page is a data slab + a scale slab and the
+                   allocator/page-table accounting never changes.
     """
 
     k_pool: jnp.ndarray
     v_pool: jnp.ndarray
     page_table: jnp.ndarray
     row_ids: Optional[jnp.ndarray] = None
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
 
     @property
     def page_size(self) -> int:
         return self.k_pool.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
     def tree_flatten(self):
         # keep the 3-child structure (and treedef equality) of every
-        # existing executable when row_ids is absent
+        # existing executable when row_ids is absent; quantized views get
+        # their own aux tags so fp32/bf16 treedefs stay byte-identical
+        if self.k_scale is None:
+            if self.row_ids is None:
+                return (self.k_pool, self.v_pool, self.page_table), None
+            return (self.k_pool, self.v_pool, self.page_table,
+                    self.row_ids), True
         if self.row_ids is None:
-            return (self.k_pool, self.v_pool, self.page_table), None
+            return (self.k_pool, self.v_pool, self.page_table,
+                    self.k_scale, self.v_scale), "quant"
         return (self.k_pool, self.v_pool, self.page_table,
-                self.row_ids), True
+                self.k_scale, self.v_scale, self.row_ids), "quant+rows"
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        if aux in (None, True):
+            return cls(*children)
+        kp, vp, pt, ks, vs = children[:5]
+        rid = children[5] if aux == "quant+rows" else None
+        return cls(kp, vp, pt, rid, k_scale=ks, v_scale=vs)
+
+
+def views_from_pools(pools, page_table, row_ids=None):
+    """Per-layer PagedLayerCache list from engine pool tuples — 2-tuples
+    (k, v) for plain pools, 4-tuples (k, v, k_scale, v_scale) for
+    quantized ones. Runs at trace time inside every jitted step."""
+    return [PagedLayerCache(p[0], p[1], page_table, row_ids,
+                            k_scale=p[2] if len(p) == 4 else None,
+                            v_scale=p[3] if len(p) == 4 else None)
+            for p in pools]
+
+
+def pools_from_views(views):
+    """Inverse of `views_from_pools`: pool tuples from the new caches a
+    step returned, preserving 2- vs 4-tuple arity."""
+    return [(v.k_pool, v.v_pool) if v.k_scale is None
+            else (v.k_pool, v.v_pool, v.k_scale, v.v_scale)
+            for v in views]
 
 
 class PagedKVCache:
@@ -262,40 +312,115 @@ class PagedKVCache:
     so the engine can thread (and donate) them through jitted steps."""
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
-                 num_kv_heads: int, head_dim: int, dtype=jnp.float32):
+                 num_kv_heads: int, head_dim: int, dtype=jnp.float32,
+                 kv_dtype: Optional[str] = None):
         self.num_layers = num_layers
         self.num_pages = num_pages
         self.page_size = page_size
-        shape = (num_kv_heads, num_pages, page_size, head_dim)
-        self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                      for _ in range(num_layers)]
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        if kv_dtype is not None and kv_dtype in _PLAIN_KV_DTYPES:
+            dtype = _PLAIN_KV_DTYPES[kv_dtype]
+            kv_dtype = None
+        self.quant_spec = None
+        if kv_dtype is not None:
+            # quantized pools ONLY: the fp32/bf16 constructor path above
+            # must never import serving.quant
+            from .quant import SCALE_DTYPE, resolve_kv_dtype
+            self.quant_spec = resolve_kv_dtype(kv_dtype,
+                                               compute_dtype=dtype)
+            store = self.quant_spec.storage_dtype
+            shape = (num_kv_heads, num_pages, page_size, head_dim)
+            sshape = (num_kv_heads, num_pages, page_size, 1)
+            self.pools = [
+                (jnp.zeros(shape, store), jnp.zeros(shape, store),
+                 jnp.ones(sshape, SCALE_DTYPE),
+                 jnp.ones(sshape, SCALE_DTYPE))
+                for _ in range(num_layers)]
+        else:
+            shape = (num_kv_heads, num_pages, page_size, head_dim)
+            self.pools = [(jnp.zeros(shape, dtype),
+                           jnp.zeros(shape, dtype))
+                          for _ in range(num_layers)]
+        self.dtype = dtype
         self.allocator = BlockAllocator(num_pages)
+
+    @property
+    def kv_dtype(self) -> str:
+        """Canonical name of the pool storage format."""
+        if self.quant_spec is not None:
+            return self.quant_spec.name
+        return {"float32": "fp32",
+                "bfloat16": "bf16"}.get(jnp.dtype(self.dtype).name,
+                                        jnp.dtype(self.dtype).name)
+
+    @property
+    def quantized(self) -> bool:
+        return self.quant_spec is not None
+
+    @property
+    def page_bytes(self) -> int:
+        """Bytes one logical page occupies across all layers: K+V data
+        slabs plus (quantized pools) the parallel scale slabs. This is
+        the capacity unit — resident sequences per pool byte budget is
+        `budget // (pages_for(seq_len) * page_bytes)`."""
+        itemsize = (self.quant_spec.storage_itemsize
+                    if self.quant_spec is not None
+                    else jnp.dtype(self.dtype).itemsize)
+        per_slot = 2 * self.num_kv_heads * (
+            self.head_dim * itemsize + (4 if self.quantized else 0))
+        return self.num_layers * self.page_size * per_slot
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total bytes of all pool leaves (data + scale slabs)."""
+        return self.num_pages * self.page_bytes
 
     @classmethod
     def for_model(cls, model, num_pages: int, page_size: int,
-                  dtype=jnp.float32) -> "PagedKVCache":
+                  dtype=jnp.float32,
+                  kv_dtype: Optional[str] = None) -> "PagedKVCache":
         from ..models.generation import _config_of
 
         cfg = _config_of(model)
         kv_heads = getattr(cfg, "num_key_value_heads",
                            cfg.num_attention_heads)
         head_dim = cfg.hidden_size // cfg.num_attention_heads
+        # validate the model's compute dtype against the requested pool
+        # format up front — the old code silently assumed fp32 pools and
+        # a mismatch surfaced as a cryptic XLA dtype error mid-step
+        try:
+            compute = next(iter(model.parameters()))._data.dtype
+        except (StopIteration, AttributeError):
+            compute = jnp.float32
+        if jnp.dtype(compute) not in (jnp.dtype(jnp.float32),
+                                      jnp.dtype(jnp.bfloat16)):
+            raise ValueError(
+                f"paged serving needs a float32/bfloat16 model, got "
+                f"parameters of dtype {jnp.dtype(compute).name}")
+        if kv_dtype is not None and kv_dtype not in _PLAIN_KV_DTYPES \
+                and kv_dtype not in ("int8", "fp8"):
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r}: expected one of "
+                "'fp32', 'bf16', 'int8', 'fp8'")
         return cls(cfg.num_hidden_layers, num_pages, page_size, kv_heads,
-                   head_dim, dtype)
+                   head_dim, dtype, kv_dtype=kv_dtype)
 
     def shard_pools(self, mesh, spec) -> None:
-        """Place every layer's (k, v) pool onto `mesh` under `spec` —
+        """Place every layer's pool tuple onto `mesh` under `spec` —
         tensor-parallel serving shards the kv-head axis (`P("tp", ...)`)
         so each device owns a (kv_heads/tp, num_pages, page_size,
-        head_dim) slab. The pools' LOGICAL shape, the allocator, page
-        ids and the null page are untouched: one logical page is tp
-        physical slabs, so all host-side accounting stays byte-identical
-        to the single-device layout."""
+        head_dim) slab. Scale slabs are rank-4 with the same leading
+        kv-head axis, so the one spec covers every leaf. The pools'
+        LOGICAL shape, the allocator, page ids and the null page are
+        untouched: one logical page is tp physical slabs, so all
+        host-side accounting stays byte-identical to the single-device
+        layout."""
         from jax.sharding import NamedSharding
 
         sh = NamedSharding(mesh, spec)
-        self.pools = [(jax.device_put(kp, sh), jax.device_put(vp, sh))
-                      for kp, vp in self.pools]
+        self.pools = [tuple(jax.device_put(x, sh) for x in layer)
+                      for layer in self.pools]
 
     def page_table_array(self, page_lists: Sequence[Sequence[int]],
                          max_pages: int) -> jnp.ndarray:
@@ -314,9 +439,8 @@ class PagedKVCache:
     def layer_views(self, page_table: jnp.ndarray) -> List[PagedLayerCache]:
         """Per-layer PagedLayerCache list in the shape the models expect
         for their `caches` argument."""
-        return [PagedLayerCache(kp, vp, page_table)
-                for kp, vp in self.pools]
+        return views_from_pools(self.pools, page_table)
 
     def update(self, new_views: Sequence[PagedLayerCache]) -> None:
         """Adopt the pools a jitted step returned (the step's new_caches)."""
-        self.pools = [(v.k_pool, v.v_pool) for v in new_views]
+        self.pools = pools_from_views(new_views)

@@ -123,6 +123,26 @@ class _RowParallelPsumLinear(nn.Linear):
         return y
 
 
+class _RowParallelQuantPsumLinear(nn.Linear):
+    """`_RowParallelPsumLinear` with the psum swapped for the EQuARX-style
+    block-scaled int8 all-reduce (`quant.quantized_psum`): the partial sum
+    travels as int8 blocks + fp32 scales instead of fp32, and every shard
+    dequantizes/sums in fixed shard order — the result stays replicated,
+    so sampling and PRNG streams remain shard-identical (just not
+    bit-identical to the fp32 psum). Selected by
+    `TPContext(quantized_allreduce=True)`; the quant import is deferred
+    to trace time so an un-quantized TP engine never touches it."""
+
+    def forward(self, x):
+        from .quant import quantized_psum
+
+        y = x.matmul(self.weight)
+        y = Tensor(quantized_psum(y._data, TP_AXIS))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
 # suffix -> PartitionSpec tables (matched against named_parameters keys);
 # Linear weights are (in_features, out_features): column-parallel shards
 # axis 1, row-parallel shards axis 0
@@ -146,10 +166,12 @@ class TPContext:
     per (tp degree, device subset), so cluster replicas on different
     sub-meshes never share a compiled executable."""
 
-    def __init__(self, model, tp_size: int, devices=None):
+    def __init__(self, model, tp_size: int, devices=None,
+                 quantized_allreduce: bool = False):
         from ..models.generation import _config_of
 
         self.tp_size = int(tp_size)
+        self.quantized_allreduce = bool(quantized_allreduce)
         self.cfg = _config_of(model)
         validate_tp_config(self.cfg, self.tp_size)
         if hasattr(model, "llama"):
@@ -174,8 +196,11 @@ class TPContext:
         self.param_specs = self._build_param_specs(model)
         self.shard_model = self._build_shard_model(model)
         # model-level jit-cache key suffix: tp degree + device identity
+        # (+ a marker when the quantized all-reduce is traced in — the
+        # executables differ, so the cache must never mix the two)
         self.jit_key = ("tp", self.tp_size,
-                        tuple(d.id for d in self.devices))
+                        tuple(d.id for d in self.devices)) \
+            + (("qar",) if self.quantized_allreduce else ())
         self._probes: Dict[int, object] = {}
 
     # ------------------------------------------------------------ sharding
@@ -245,25 +270,34 @@ class TPContext:
         skel = type(model)(self.cfg)
         skel.eval()
         tp = self.tp_size
+        row_cls = (_RowParallelQuantPsumLinear if self.quantized_allreduce
+                   else _RowParallelPsumLinear)
         if self.family == "llama":
             for layer in skel.llama.layers:
                 att = layer.self_attn
                 att.num_heads //= tp
                 att.num_kv_heads //= tp
-                att.o_proj.__class__ = _RowParallelPsumLinear
-                layer.mlp.down_proj.__class__ = _RowParallelPsumLinear
+                att.o_proj.__class__ = row_cls
+                layer.mlp.down_proj.__class__ = row_cls
         else:
             for blk in skel.gpt.blocks:
                 blk.attn.num_heads //= tp
-                blk.attn.out.__class__ = _RowParallelPsumLinear
-                blk.ffn_out.__class__ = _RowParallelPsumLinear
+                blk.attn.out.__class__ = row_cls
+                blk.ffn_out.__class__ = row_cls
         for _, p in skel.named_parameters():
             p._data = jnp.zeros((), p._data.dtype)
         return skel
 
     # ----------------------------------------------------------- wrapping
-    def _pool_specs(self):
-        return [(self.pool_spec, self.pool_spec)] * self.num_layers
+    def _pool_specs(self, pools=None):
+        """Specs matching the engine's pool structure: 2-tuples (k, v)
+        for plain pools, 4-tuples (k, v, k_scale, v_scale) for quantized
+        ones. Every leaf — scale slabs included, they are rank-4 with
+        the same leading kv-head axis — shards under the one pool spec;
+        with no pools given (probe paths) assume the classic 2-tuples."""
+        if pools is None:
+            return [(self.pool_spec, self.pool_spec)] * self.num_layers
+        return jax.tree_util.tree_map(lambda _: self.pool_spec, pools)
 
     @staticmethod
     def _repl_like(tree):
@@ -278,10 +312,10 @@ class TPContext:
         `P()` outputs are genuinely identical across devices
         (check_rep=False: 0.4.x can't prove replication through the
         PRNG ops, but the final psum makes it so by construction)."""
-        pool_specs = self._pool_specs()
         param_specs, mesh = self.param_specs, self.mesh
 
         def wrapped(params, buffers, ids, pools, *rest):
+            pool_specs = self._pool_specs(pools)
             return _shard_map(
                 fn, mesh=mesh,
                 in_specs=(param_specs, self._repl_like(buffers), P(),
@@ -296,10 +330,10 @@ class TPContext:
         `(params, buffers, tokens, pools, *rest) ->
         (emitted, pools, tokens, positions, key_data, remaining)` —
         same placement contract as `wrap_prefill_exec`."""
-        pool_specs = self._pool_specs()
         param_specs, mesh = self.param_specs, self.mesh
 
         def wrapped(params, buffers, tokens, pools, *rest):
+            pool_specs = self._pool_specs(pools)
             return _shard_map(
                 fn, mesh=mesh,
                 in_specs=(param_specs, self._repl_like(buffers), P(),
@@ -317,10 +351,10 @@ class TPContext:
         every per-row array are replicated, the KV pools kv-head-
         sharded, and the emitted block + key state are computed from
         replicated logits on every shard."""
-        pool_specs = self._pool_specs()
         param_specs, mesh = self.param_specs, self.mesh
 
         def wrapped(params, buffers, flat_ids, pools, *rest):
+            pool_specs = self._pool_specs(pools)
             return _shard_map(
                 fn, mesh=mesh,
                 in_specs=(param_specs, self._repl_like(buffers), P(),
@@ -344,9 +378,17 @@ class TPContext:
         fn = self._probes.get(rows)
         if fn is None:
             mesh = self.mesh
+            if self.quantized_allreduce:
+                from .quant import quantized_psum
+
+                def reduce_one(y):
+                    return quantized_psum(y, TP_AXIS)
+            else:
+                def reduce_one(y):
+                    return jax.lax.psum(y, TP_AXIS)
 
             def allreduce(x):
-                return _shard_map(lambda y: jax.lax.psum(y, TP_AXIS),
+                return _shard_map(reduce_one,
                                   mesh=mesh, in_specs=P(), out_specs=P(),
                                   check_rep=False,  # noqa: COLLECTIVE-MESH — probe psum of a replicated buffer; rep tracking adds latency to the very overhead being measured
                                   )(x)
@@ -370,6 +412,7 @@ class TPContext:
         kv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
         return {
             "tp_size": self.tp_size,
+            "quantized_allreduce": self.quantized_allreduce,
             "devices": [d.id for d in self.devices],
             "kv_heads_per_shard": kv // self.tp_size,
             "heads_per_shard": cfg.num_attention_heads // self.tp_size,

@@ -496,6 +496,42 @@ class TestHostSync:
         assert len(findings) == 1
         assert "record" in findings[0].message
 
+    def test_quant_module_covered_by_default(self):
+        """ISSUE 15: quantize/dequantize trace inside every jitted step
+        of a quantized engine and quantized_psum inside every TP block —
+        all three are default hot roots. The device-only real shape is
+        clean; a smuggled host read fires; the construction-time
+        roundtrip probe (measure_roundtrip_error) is cold."""
+        findings = run("""
+            import jax.numpy as jnp
+
+            def quantize_tokens(x, spec):
+                amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+                scale = jnp.where(amax > 0, amax / spec.qmax, 1.0)
+                return jnp.round(x / scale).astype(spec.storage_dtype), scale
+
+            def dequantize(q, scale):
+                return q.astype(jnp.float32) * scale
+            """, path="paddle_tpu/serving/quant.py", rule="HOST-SYNC")
+        assert findings == []             # the real shape: device-only
+
+        findings = run("""
+            import numpy as np
+
+            def quantized_psum(x, axis_name, block=256):
+                return _pack(x, block)
+
+            def _pack(x, block):
+                return float(np.asarray(x).max())
+
+            def measure_roundtrip_error(spec, head_dim):
+                return float(np.asarray(spec.qmax))
+            """, path="paddle_tpu/serving/quant.py", rule="HOST-SYNC")
+        hit_fns = sorted(set(
+            f.message.split("hot-path function `")[1].split("`")[0]
+            for f in findings))
+        assert hit_fns == ["_pack"]       # probe is cold, helper is hot
+
     def test_hot_modules_mapping_is_configurable(self):
         """The traced-module list is constructor state, not a hardcoded
         constant: a custom mapping REPLACES the default roots."""

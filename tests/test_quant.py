@@ -1,0 +1,376 @@
+"""Quantized serving (ISSUE 15): int8/fp8 paged KV pool + block-scaled
+quantized all-reduce.
+
+Contract under test:
+
+- `kv_dtype="fp32"`/`"bf16"` are bit-exact aliases of the legacy
+  `cache_dtype` knob AND import zero quantization code (poisoned-module
+  pin, like the tp_size=1 zero-touch guarantee);
+- `kv_dtype="int8"` carries a bounded-error parity contract: on the
+  tiny greedy config the token stream matches fp32 exactly, and EVERY
+  quantized execution path — horizon 1/8, chunked prefill, prefix
+  cache, tp 1/2, plain vs quantized all-reduce, interpret-mode Pallas
+  kernels — produces the SAME stream bit-for-bit (they all read the
+  same quantized pool bytes);
+- the 1-byte pool holds >= 2x the resident sequences of fp32 for the
+  same byte budget (scale slabs included in the accounting);
+- page/scale recycling can never leak stale quantized state into a new
+  request, and prefix-cache page sharing works unchanged over
+  quantized pages (one logical page = data slab + scale slab);
+- a tp2-int8 request migrates onto a tp1-int8 survivor bit-identically
+  (`adopt_request` fold — the cluster's migration primitive).
+"""
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving import attention as satt
+from paddle_tpu.serving.kv_cache import PagedKVCache, PagedLayerCache
+from paddle_tpu.serving.quant import (
+    dequantize, kv_pool_bytes, quantize_tokens, quantized_psum,
+    resolve_kv_dtype,
+)
+
+_HAS_FP8 = hasattr(jnp, "float8_e4m3fn")
+PROMPT = [5, 6, 7, 8]
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(1234)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _run(model, prompts=(PROMPT,), new_tokens=10, **kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    eng = ServingEngine(model, **kw)
+    rids = [eng.add_request(list(p), max_new_tokens=new_tokens)
+            for p in prompts]
+    out = eng.run()
+    return [out[r] for r in rids], eng
+
+
+# ------------------------------------------------------------ primitives
+
+class TestQuantPrimitives:
+    def test_resolve_names(self):
+        i8 = resolve_kv_dtype("int8")
+        assert i8.storage_dtype == jnp.int8 and i8.qmax == 127.0
+        assert i8.storage_itemsize == 1
+        if _HAS_FP8:
+            f8 = resolve_kv_dtype("fp8")
+            assert f8.storage_dtype == jnp.float8_e4m3fn
+            assert f8.qmax == 448.0
+
+    def test_unknown_name_rejected(self):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            resolve_kv_dtype("int4")
+
+    def test_fp8_without_dtype_support_is_a_clear_error(self, monkeypatch):
+        """An old jax without float8_e4m3fn must fail at resolve time
+        with a message naming the missing dtype, not deep in tracing."""
+        monkeypatch.delattr(jnp, "float8_e4m3fn", raising=False)
+        with pytest.raises(ValueError, match="float8_e4m3fn"):
+            resolve_kv_dtype("fp8")
+
+    def test_compute_dtype_validated(self):
+        with pytest.raises(ValueError, match="compute"):
+            resolve_kv_dtype("int8", compute_dtype=jnp.float16)
+        resolve_kv_dtype("int8", compute_dtype=jnp.float32)
+        resolve_kv_dtype("int8", compute_dtype=jnp.bfloat16)
+
+    def test_int8_roundtrip_error_bound(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 7, 16)) * 3.0,
+                        jnp.float32)
+        q, scale = quantize_tokens(x, resolve_kv_dtype("int8"))
+        assert q.dtype == jnp.int8
+        assert scale.shape == x.shape[:-1] + (1,)
+        assert scale.dtype == jnp.float32
+        dq = np.asarray(dequantize(q, scale))
+        # per-slot bound: |err| <= scale/2 = amax/254 elementwise
+        amax = np.max(np.abs(np.asarray(x)), axis=-1, keepdims=True)
+        assert np.all(np.abs(dq - np.asarray(x)) <= amax / 253.0)
+
+    def test_zero_rows_stay_exactly_zero(self):
+        """All-zero slots take scale 1.0 (never 0/0) and round-trip to
+        exact zeros — unwritten pool slots must read as zeros too."""
+        x = jnp.zeros((2, 5, 8), jnp.float32)
+        q, scale = quantize_tokens(x, resolve_kv_dtype("int8"))
+        assert np.all(np.asarray(scale) == 1.0)
+        assert np.all(np.asarray(dequantize(q, scale)) == 0.0)
+
+    def test_pool_bytes_accounting(self):
+        c32 = PagedKVCache(2, 8, 8, 2, 16)
+        ci8 = PagedKVCache(2, 8, 8, 2, 16, kv_dtype="int8")
+        assert c32.pool_bytes == kv_pool_bytes(
+            2, 8, 8, 2, 16, itemsize=4, quantized=False)
+        assert ci8.pool_bytes == kv_pool_bytes(
+            2, 8, 8, 2, 16, itemsize=1, quantized=True)
+
+    @pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+    def test_quantized_psum_matches_psum(self):
+        from paddle_tpu.serving.tp import _shard_map
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("tp",))
+        P = jax.sharding.PartitionSpec
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((2, 4, 300)), jnp.float32)
+
+        def reduce_with(fn):
+            f = _shard_map(fn, mesh=mesh, in_specs=(P("tp"),),
+                           out_specs=P("tp"))
+            return np.asarray(jax.jit(f)(x))
+
+        exact = reduce_with(lambda s: jax.lax.psum(s, "tp"))
+        quant = reduce_with(lambda s: quantized_psum(s, "tp"))
+        # worst case per element: half an int8 step of the block amax
+        # per shard -> 2 * amax / 254; amax of N(0,1) over 256 is ~4
+        np.testing.assert_allclose(quant, exact, atol=4 * 2 / 254,
+                                   rtol=3e-2)
+
+
+# ------------------------------------------------- knob + validation
+
+class TestEngineKnob:
+    def test_fp32_knob_is_the_default_bit_exact(self, model):
+        base, _ = _run(model)
+        knob, eng = _run(model, kv_dtype="fp32")
+        assert knob == base
+        assert not eng.cache.quantized
+        assert eng.stats()["kv_dtype"] == "fp32"
+        assert "quant" not in eng.stats()
+
+    def test_bf16_knob_matches_legacy_cache_dtype(self, model):
+        legacy, _ = _run(model, cache_dtype="bfloat16")
+        knob, eng = _run(model, kv_dtype="bf16")
+        assert knob == legacy
+        assert eng.cache.dtype == jnp.bfloat16
+        assert not eng.cache.quantized
+
+    def test_conflicting_knobs_raise(self, model):
+        with pytest.raises(ValueError, match="pick one knob"):
+            ServingEngine(model, page_size=8, max_seq_len=64,
+                          cache_dtype="bfloat16", kv_dtype="int8")
+
+    def test_unknown_kv_dtype_raises(self, model):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            ServingEngine(model, page_size=8, max_seq_len=64,
+                          kv_dtype="int4")
+
+    def test_for_model_validates_name(self, model):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            PagedKVCache.for_model(model, 8, 8, kv_dtype="nope")
+
+    def test_quantized_pools_carry_scale_slabs(self):
+        c = PagedKVCache(2, 8, 8, 2, 16, kv_dtype="int8")
+        assert c.quantized and c.kv_dtype == "int8"
+        for layer in c.pools:
+            assert len(layer) == 4
+            kp, vp, ks, vs = layer
+            assert kp.dtype == jnp.int8 and vp.dtype == jnp.int8
+            assert ks.shape == (2, 8, 8, 1) and ks.dtype == jnp.float32
+            # unwritten slots: q=0 everywhere, scale=1 -> dequant 0
+            assert np.all(np.asarray(ks) == 1.0)
+            assert np.all(np.asarray(vs) == 1.0)
+
+    def test_tp_quantized_allreduce_needs_tp(self, model):
+        with pytest.raises(ValueError, match="tp"):
+            ServingEngine(model, page_size=8, max_seq_len=64,
+                          tp_quantized_allreduce=True)
+
+
+# ------------------------------------------------------ parity matrix
+
+class TestParityMatrix:
+    """One greedy request; every quantized execution path must emit the
+    SAME stream (shared quantized pool bytes), and on this config that
+    stream matches fp32 token-for-token."""
+
+    @pytest.fixture(scope="class")
+    def fp32_stream(self, model):
+        streams, _ = _run(model)
+        return streams[0]
+
+    @pytest.fixture(scope="class")
+    def int8_stream(self, model, fp32_stream):
+        streams, eng = _run(model, kv_dtype="int8")
+        assert eng.cache.quantized
+        assert streams[0] == fp32_stream        # the token-match pin
+        return streams[0]
+
+    def test_horizon_1(self, model, int8_stream):
+        streams, _ = _run(model, kv_dtype="int8", decode_horizon=1)
+        assert streams[0] == int8_stream
+
+    def test_chunked_prefill(self, model, int8_stream):
+        streams, _ = _run(model, kv_dtype="int8",
+                          enable_chunked_prefill=True)
+        assert streams[0] == int8_stream
+
+    def test_prefix_cache(self, model, int8_stream):
+        streams, _ = _run(model, kv_dtype="int8",
+                          enable_prefix_caching=True)
+        assert streams[0] == int8_stream
+
+    @pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+    def test_tp2(self, model, int8_stream):
+        streams, _ = _run(model, kv_dtype="int8", tp_size=2)
+        assert streams[0] == int8_stream
+
+    @pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+    def test_tp2_quantized_allreduce(self, model, int8_stream):
+        streams, eng = _run(model, kv_dtype="int8", tp_size=2,
+                            tp_quantized_allreduce=True)
+        assert streams[0] == int8_stream
+        probe = eng.metrics.get("serving_tp_collective_seconds")
+        assert probe is not None and probe.count > 0
+
+    def test_interpret_kernels(self, model, int8_stream, monkeypatch):
+        monkeypatch.setattr(satt, "KERNEL_MODE", "interpret")
+        streams, _ = _run(model, kv_dtype="int8")
+        assert streams[0] == int8_stream
+
+    @pytest.mark.skipif(not _HAS_FP8, reason="no float8_e4m3fn")
+    def test_fp8(self, model, fp32_stream):
+        """fp8 e4m3 (~2 significant digits) carries only the
+        bounded-error contract: the stream may legitimately diverge from
+        fp32 after a few tokens, but its first greedy token agrees and
+        every fp8 execution path is self-consistent bit-for-bit."""
+        streams, eng = _run(model, kv_dtype="fp8")
+        assert eng.cache.quantized and eng.cache.kv_dtype == "fp8"
+        n = len(PROMPT)
+        assert streams[0][:n + 1] == fp32_stream[:n + 1]
+        h1, _ = _run(model, kv_dtype="fp8", decode_horizon=1)
+        assert h1[0] == streams[0]              # self-consistency
+
+    def test_quant_stats_section(self, model):
+        _, eng = _run(model, kv_dtype="int8", new_tokens=2)
+        q = eng.stats()["quant"]
+        assert q["kv_dtype"] == "int8"
+        assert q["pool_bytes"] == eng.cache.pool_bytes
+        assert q["fp32_pool_bytes"] > 2 * q["pool_bytes"]
+
+
+# --------------------------------------------------------- capacity
+
+class TestCapacity:
+    def test_int8_holds_at_least_2x_fp32_residency(self):
+        """Same byte budget -> >= 2x the pages (hence >= 2x the resident
+        sequences the allocator can admit), scale slabs included."""
+        c32 = PagedKVCache(2, 8, 8, 2, 16)
+        ci8 = PagedKVCache(2, 8, 8, 2, 16, kv_dtype="int8")
+        assert c32.page_bytes >= 2 * ci8.page_bytes
+        budget = c32.pool_bytes
+        assert budget // ci8.page_bytes >= 2 * (budget // c32.page_bytes)
+
+    def test_engine_reports_capacity(self, model):
+        _, e32 = _run(model, new_tokens=1)
+        _, e8 = _run(model, new_tokens=1, kv_dtype="int8")
+        # identical logical geometry, >= 2x cheaper pages
+        assert e8.cache.num_pages == e32.cache.num_pages
+        assert e32.cache.page_bytes >= 2 * e8.cache.page_bytes
+
+
+# ---------------------------------------------- zero-import guarantee
+
+class TestZeroImport:
+    def _poison(self, monkeypatch):
+        def _boom(name):
+            raise AssertionError(f"serving.quant touched: {name}")
+
+        poison = types.ModuleType("paddle_tpu.serving.quant")
+        poison.__getattr__ = _boom
+        monkeypatch.setitem(sys.modules, "paddle_tpu.serving.quant",
+                            poison)
+
+    def test_fp32_engine_imports_zero_quant_code(self, model,
+                                                 monkeypatch):
+        """The default engine must run a FULL request lifecycle without
+        touching serving.quant — quantization support is free when
+        off."""
+        self._poison(monkeypatch)
+        streams, _ = _run(model, new_tokens=4)
+        assert len(streams[0]) == len(PROMPT) + 4
+
+    def test_int8_engine_does_touch_quant(self, model, monkeypatch):
+        self._poison(monkeypatch)
+        with pytest.raises(AssertionError, match="quant touched"):
+            ServingEngine(model, page_size=8, max_seq_len=64,
+                          kv_dtype="int8")
+
+
+# ----------------------------------- sharing, recycling, migration
+
+class TestQuantizedPages:
+    def test_prefix_sharing_over_quantized_pages(self, model):
+        """A shared quantized prefix page enters the follower's table at
+        refcount 2 (table + radix tree) and the follower's stream is
+        identical to a no-cache int8 run — scale slabs shared along with
+        the data slabs."""
+        shared = list(range(2, 18))             # two full 8-token pages
+        follower = shared + [1, 2, 3]
+        base, _ = _run(model, prompts=(follower,), new_tokens=6,
+                       kv_dtype="int8")
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=64, kv_dtype="int8",
+                            enable_prefix_caching=True)
+        eng.add_request(shared + [9], max_new_tokens=2)
+        eng.run()                               # cold fill of the tree
+        rid = eng.add_request(follower, max_new_tokens=6)
+        eng.step()                              # follower's prefill
+        assert any(v >= 2 for v in eng.cache.allocator._refs.values())
+        out = eng.run()
+        assert out[rid] == base[0]
+        pc = eng.stats()["prefix_cache"]
+        assert pc["hit_tokens"] > 0 and pc["hit_rate"] > 0
+
+    def test_recycled_pages_never_leak_stale_scales(self, model):
+        """Request A fills quantized pages with data+scales; after A
+        frees them, request B reuses the same physical pages. B's stream
+        must equal a fresh engine's — every slot B reads was rewritten
+        (data AND scale), never inherited."""
+        probe = [11, 12, 13, 14, 15]
+        fresh, _ = _run(model, prompts=(probe,), new_tokens=8,
+                        kv_dtype="int8")
+        eng = ServingEngine(model, page_size=8, max_batch_size=4,
+                            max_seq_len=64, kv_dtype="int8")
+        eng.add_request(list(range(20, 52)), max_new_tokens=8)
+        eng.run()                               # fills + frees pages
+        assert not eng.cache.allocator._refs    # everything recycled
+        rid = eng.add_request(probe, max_new_tokens=8)
+        out = eng.run()
+        assert out[rid] == fresh[0]
+
+    @pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+    def test_tp2_int8_migrates_onto_tp1_survivor(self, model):
+        """Cluster migration across tp degrees with a quantized pool:
+        fold prompt+delivered from a tp2-int8 engine into a tp1-int8
+        survivor via adopt_request; the continuation must complete the
+        exact stream the source would have produced (the journal and
+        fold are dtype- and topology-blind)."""
+        total = 10
+        streams, _ = _run(model, new_tokens=total, kv_dtype="int8",
+                          tp_size=2)
+        full = streams[0]
+        generated = full[len(PROMPT):]
+        delivered = generated[:3]
+        survivor = ServingEngine(model, page_size=8, max_batch_size=4,
+                                 max_seq_len=64, kv_dtype="int8")
+        rid = survivor.adopt_request(prompt=PROMPT, delivered=delivered,
+                                     max_new_tokens=total, seed=0)
+        out = survivor.run()
+        # run() echoes the FOLDED prompt (prompt + delivered), then the
+        # continuation — together the original stream, exactly once
+        assert out[rid] == full

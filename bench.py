@@ -612,6 +612,40 @@ def _run_serving_quant(on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
+def _run_pretrain_zero(on_tpu: bool) -> dict:
+    """ZeRO-sharded pretrain phase (ISSUE 16): replicated vs ZeRO-1/2
+    at dp 1/2/4 on the parallel substrate — tok/s, optimizer+param
+    bytes/chip (the 1/dp claim, asserted exactly), bit-parity vs the
+    replicated baseline, analytic max-batch headroom, and the dp
+    all-reduce probe. Throughput is an expected null on the CPU
+    fake-device mesh (see the phase docstring); non-fatal like the
+    phases around it."""
+    try:
+        mod = _gen_bench_module()
+        out = mod.pretrain_zero_phase(on_tpu)
+        if "skipped" in out:
+            _log(f"phase=pretrain_zero: skipped ({out['skipped']})")
+            return out
+        dp_max = out["degrees"][-1]
+        z1 = out.get(f"dp{dp_max}_stage1", {})
+        repl = out.get(f"dp{dp_max}_stage0", {})
+        _log(f"phase=pretrain_zero: dp{dp_max} ZeRO-1 "
+             f"{z1.get('tok_s')} tok/s vs replicated "
+             f"{repl.get('tok_s')}, opt bytes/chip "
+             f"{z1.get('opt_bytes_per_chip')} vs "
+             f"{repl.get('opt_bytes_per_chip')} "
+             f"(1/dp exact={out['opt_bytes_exactly_1_over_dp']}), "
+             f"parity_ok={out['parity_ok']}, probe "
+             f"{z1.get('dp_allreduce_probe_us')}us")
+        if not out["parity_ok"]:
+            _log("phase=pretrain_zero: WARN ZeRO params diverged from "
+                 "the replicated baseline — the bit-parity contract")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=pretrain_zero: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def _probe_backend_init(timeout_s: float) -> str | None:
     """Backend-init watchdog: probe `jax.devices()` in a THROWAWAY
     subprocess before the child commits its own (unkillable-from-inside)
@@ -902,6 +936,10 @@ def bench_child() -> None:
     # quantized-serving phase: int8 capacity/parity + qar psum probe
     _enter_phase("serving_quant", 400.0)
     serving_quant = _run_serving_quant(on_tpu)
+
+    # ZeRO pretrain phase: replicated vs sharded dp, 1/dp bytes + parity
+    _enter_phase("pretrain_zero", 400.0)
+    pretrain_zero = _run_pretrain_zero(on_tpu)
     _enter_phase("build")
 
     if on_tpu:
@@ -1042,6 +1080,7 @@ def bench_child() -> None:
                 "serving_cluster": serving_cluster,
                 "serving_slo": serving_slo,
                 "serving_quant": serving_quant,
+                "pretrain_zero": pretrain_zero,
                 "backend_init_timeout": backend_init_timeout,
                 "lint": lint,
                 "observability": _obs_snapshot(),

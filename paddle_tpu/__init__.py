@@ -51,7 +51,7 @@ _SUBPACKAGES = [
     "distributed", "device", "profiler", "incubate", "sparse", "framework",
     "hapi", "text", "audio", "distribution", "quantization", "utils",
     "inference", "linalg", "fft", "signal", "hub", "onnx", "serving",
-    "observability",
+    "observability", "parallel",
 ]
 import importlib as _importlib
 

@@ -63,6 +63,17 @@ DEFAULT_HOT_MODULES: Dict[str, FrozenSet[str]] = {
     # reachable from these roots and carries its own noqa for the audit.
     "serving/quant.py": frozenset(
         {"quantize_tokens", "dequantize", "quantized_psum"}),
+    # ISSUE 16: the ZeRO train-step bodies are the training hot path —
+    # one executable per training run, retraced per degree; the
+    # fixed-order collectives in parallel/mesh.py run at trace time
+    # inside every one of them AND inside the serving Megatron
+    # boundaries. A host read in any of these stalls every train step
+    # (and the degree-blind save/load helpers are deliberately host-side
+    # numpy — they are NOT reachable from these roots).
+    "parallel/mesh.py": frozenset(
+        {"ordered_psum", "ordered_psum_scatter"}),
+    "parallel/zero.py": frozenset(
+        {"_accumulated_grads", "_replicated_update", "_sharded_update"}),
 }
 _SYNC_METHOD_TAILS = {"item", "tolist", "block_until_ready"}
 _SYNC_CHAINS = {

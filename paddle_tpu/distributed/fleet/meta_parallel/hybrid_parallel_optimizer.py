@@ -131,3 +131,24 @@ class HybridParallelOptimizer:
 
     def functional_step(self, *a, **k):
         return self._inner_opt.functional_step(*a, **k)
+
+    # ------------------------------------------ sharded-dp (ZeRO) bridge
+    def zero_train_step(self, model, loss_fn=None, *, stage=None, **kwargs):
+        """fleet.distributed_optimizer's rebinding onto the
+        `paddle_tpu.parallel.zero` engine (ISSUE 16): build the explicit
+        shard_map ZeRO step at dp = the hcg's sharding (or data) parallel
+        degree. `stage` defaults to 1 (ZeRO-1) when the strategy enables
+        sharding, else 0 (plain replicated dp on the same substrate)."""
+        from ....parallel.zero import ZeroTrainStep
+
+        dp = 1
+        if self._hcg is not None:
+            sharding = self._hcg.get_sharding_parallel_world_size()
+            dp = sharding if sharding > 1 else \
+                self._hcg.get_data_parallel_world_size()
+            if stage is None:
+                stage = 1 if sharding > 1 else 0
+        return ZeroTrainStep(model, self._inner_opt, loss_fn,
+                             dp=max(int(dp), 1),
+                             stage=1 if stage is None else int(stage),
+                             **kwargs)

@@ -1,217 +1,20 @@
-"""GroupSharded (ZeRO stages 1-3) — fleet.meta_parallel.sharding.
+"""GroupSharded (ZeRO stages 1-3) — DEPRECATED re-export shim.
 
-Ref: fleet/meta_parallel/sharding/group_sharded_stage{2,3}.py,
-group_sharded_optimizer_stage2.py + python/paddle/distributed/sharding/
-group_sharded.py (upstream layout, unverified — mount empty).
+The implementation moved to `paddle_tpu.parallel.zero` (ISSUE 16): the
+GSPMD sharding-annotation surface (stages 1-3) and the explicit
+shard_map ZeRO-1/2 engine now live side by side on the one mesh
+substrate (`paddle_tpu.parallel.mesh`), sharing device ordering,
+sub-mesh carving and the degree-blind checkpoint layout with serving.
 
-Paddle implements ZeRO with explicit param slicing, pre-forward allgathers,
-grad reduce-scatter hooks and rank-local optimizer updates. The TPU-native
-equivalents are sharding ANNOTATIONS consumed by the jitted train step:
-
-* stage 1 ("os"): optimizer state arrays sharded dim-0 over the sharding axis
-  — rank-local moments, full grads (XLA reduce-scatters into the update and
-  all-gathers params only where needed).
-* stage 2 ("os_g"): same placement; gradients additionally constrained to the
-  sharded layout so XLA materializes reduce-scattered grads (never a full
-  grad buffer per device).
-* stage 3 ("p_g_os"): params themselves sharded dim-0 — XLA inserts the
-  per-layer all-gather before use and frees the gathered buffer after, which
-  is exactly GroupShardedStage3's gather-on-use/release-after discipline,
-  scheduled by the compiler with overlap.
-
-The wrappers expose data/param/opt-state sharding trees through the same
-interface DataParallel uses, so hapi Model and custom train steps consume
-them uniformly.
+Import from `paddle_tpu.parallel` (native) or keep using
+`paddle_tpu.distributed.sharding` (paddle-compat); this module only
+keeps legacy `fleet.meta_parallel.sharding` imports resolving.
 """
-from __future__ import annotations
-
-from typing import Optional
-
-import jax
-import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from ....nn import Layer
+from ....parallel.zero import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+    group_sharded_parallel, shard_leaf,
+)
 
 __all__ = ["GroupShardedStage2", "GroupShardedStage3",
            "GroupShardedOptimizerStage2", "group_sharded_parallel",
            "shard_leaf"]
-
-
-def _default_mesh(axis="sharding"):
-    devs = jax.devices()
-    return jax.sharding.Mesh(np.asarray(devs), (axis,))
-
-
-def shard_leaf(arr_or_shape, mesh, axis_name: str):
-    """Dim-0 sharding when divisible by the axis size, else replicated —
-    paddle pads slices; GSPMD shards evenly-divisible dims and we keep the
-    rest replicated (small params: biases, norms)."""
-    shape = getattr(arr_or_shape, "shape", arr_or_shape)
-    n = mesh.shape[axis_name]
-    if len(shape) > 0 and shape[0] % n == 0 and shape[0] >= n:
-        return NamedSharding(mesh, P(axis_name))
-    return NamedSharding(mesh, P())
-
-
-class _ShardedBase(Layer):
-    stage = None
-    _shard_params = False
-
-    def __init__(self, layer: Layer, optimizer=None, group=None,
-                 sync_buffers: bool = False, device: str = "tpu",
-                 segment_size: int = 2 ** 20, offload: bool = False,
-                 hcg=None, **kwargs):
-        super().__init__()
-        self._layers = layer
-        self._optimizer = optimizer
-        self.offload = offload
-        if offload:
-            try:  # fail LOUDLY at construction, not mid-training
-                jax.devices()[0].memory("pinned_host")
-            except Exception as e:
-                raise NotImplementedError(
-                    "offload=True needs a backend with pinned_host memory "
-                    f"support; {jax.devices()[0].platform} reports none"
-                ) from e
-        if hcg is not None and hcg.mesh is not None and \
-                hcg.get_sharding_parallel_world_size() > 1:
-            self.mesh = hcg.mesh
-            self.axis = "sharding"
-        elif group is not None and getattr(group, "mesh", None) is not None:
-            self.mesh = group.mesh
-            self.axis = group.axis_name
-        else:
-            self.mesh = _default_mesh()
-            self.axis = "sharding"
-        if self._shard_params:
-            self._place_params()
-
-    def forward(self, *inputs, **kwargs):
-        return self._layers(*inputs, **kwargs)
-
-    # ------------------------------------------------ sharding hint trees
-    def data_sharding(self):
-        axes = tuple(a for a in self.mesh.axis_names
-                     if a in ("dp", "sharding") and self.mesh.shape[a] > 1)
-        return NamedSharding(self.mesh, P(axes if axes else None))
-
-    def param_sharding(self):
-        """Prefix sharding for params: stage 1/2 replicate params."""
-        return NamedSharding(self.mesh, P())
-
-    def param_shardings(self, params: dict):
-        if not self._shard_params:
-            sh = self.param_sharding()
-            return {k: sh for k in params}
-        return {k: shard_leaf(v, self.mesh, self.axis)
-                for k, v in params.items()}
-
-    def opt_state_shardings(self, opt_state: dict):
-        """Moment slots shaped like the param shard dim-0; scalars repl.
-        With offload=True the slots additionally live in pinned host memory
-        (ZeRO-offload: HBM holds only params/grads/activations; XLA streams
-        the moments in for the update)."""
-        out = {}
-        for pname, acc in opt_state.items():
-            shardings = {}
-            for slot, v in acc.items():
-                sh = shard_leaf(v, self.mesh, self.axis)
-                if self.offload:
-                    sh = sh.with_memory_kind("pinned_host")
-                shardings[slot] = sh
-            out[pname] = shardings
-        return out
-
-    def grad_shardings(self, params: dict):
-        if self.stage >= 2:
-            return {k: shard_leaf(v, self.mesh, self.axis)
-                    for k, v in params.items()}
-        return {k: NamedSharding(self.mesh, P()) for k in params}
-
-    def _place_params(self):
-        for _, p in self._layers.named_parameters():
-            p._data = jax.device_put(
-                p._data, shard_leaf(p._data, self.mesh, self.axis))
-
-    # ------------------------------------------------------- delegation
-    def parameters(self, *a, **k):
-        return self._layers.parameters(*a, **k)
-
-    def named_parameters(self, *a, **k):
-        return self._layers.named_parameters(*a, **k)
-
-    def state_dict(self, *a, **k):
-        return self._layers.state_dict(*a, **k)
-
-    def set_state_dict(self, sd, *a, **k):
-        out = self._layers.set_state_dict(sd, *a, **k)
-        if self._shard_params:
-            self._place_params()
-        return out
-
-    def get_all_parameters(self, convert2cpu: bool = False):
-        """stage3 API: gather full params (device_put to replicated)."""
-        repl = NamedSharding(self.mesh, P())
-        for _, p in self._layers.named_parameters():
-            p._data = jax.device_put(p._data, repl)
-        return self._layers.parameters()
-
-
-class GroupShardedStage2(_ShardedBase):
-    stage = 2
-    _shard_params = False
-
-
-class GroupShardedStage3(_ShardedBase):
-    stage = 3
-    _shard_params = True
-
-
-class GroupShardedOptimizerStage2:
-    """Optimizer wrapper partitioning state over the sharding axis (ZeRO-1/2
-    optimizer side). Delegates the whole surface; the sharded placement is
-    applied by the jitted step through opt_state_shardings."""
-
-    def __init__(self, params, optim, group=None, offload: bool = False,
-                 device: str = "tpu", **kwargs):
-        self._optim = optim
-        self._params = params
-        self.offload = offload
-        self.group = group
-
-    def __getattr__(self, name):
-        return getattr(self._optim, name)
-
-    def step(self):
-        return self._optim.step()
-
-    def minimize(self, *a, **k):
-        return self._optim.minimize(*a, **k)
-
-
-def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
-                           scaler=None, group=None, offload: bool = False,
-                           sync_buffers: bool = False, buffer_max_size=2 ** 23,
-                           segment_size=2 ** 20, sync_comm: bool = False,
-                           dp_group=None, exclude_layer=None):
-    """paddle.distributed.sharding.group_sharded_parallel.
-
-    level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3).
-    """
-    if level not in ("os", "os_g", "p_g_os"):
-        raise ValueError(
-            f"group_sharded_parallel level must be 'os' (ZeRO-1), 'os_g' "
-            f"(ZeRO-2) or 'p_g_os' (ZeRO-3); got {level!r}")
-    if level == "p_g_os":
-        wrapped = GroupShardedStage3(model, optimizer=optimizer, group=group,
-                                     offload=offload)
-    else:
-        wrapped = GroupShardedStage2(model, optimizer=optimizer, group=group,
-                                     offload=offload)
-        wrapped.stage = 1 if level == "os" else 2
-    opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
-                                      group=group, offload=offload)
-    if scaler is not None:
-        return wrapped, opt, scaler
-    return wrapped, opt

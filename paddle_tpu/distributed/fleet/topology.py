@@ -95,9 +95,14 @@ class HybridCommunicateGroup:
 
         devices = jax.devices()
         if len(devices) >= self.nranks:
-            dev_grid = np.asarray(devices[: self.nranks]).reshape(
-                [topology.get_dim(n) for n in names])
-            self.mesh = jax.sharding.Mesh(dev_grid, tuple(names))
+            # the unified substrate (parallel.mesh): id-sorted device
+            # prefix reshaped onto the hybrid axes — identical grid to
+            # the old inline construction wherever jax.devices() was
+            # already id-ordered, permutation-proof where it wasn't
+            from ...parallel.mesh import build_mesh
+
+            self.mesh = build_mesh(
+                [(n, topology.get_dim(n)) for n in names], devices)
         else:
             # multi-host: each process owns a slice; mesh over global devices
             self.mesh = None

@@ -2,21 +2,15 @@
 
 Ref: python/paddle/distributed/sharding/group_sharded.py (upstream layout,
 unverified — mount empty).
+
+The implementation lives in `paddle_tpu.parallel.zero` (ISSUE 16): one
+engine behind both the paddle-compat surface here and the native
+`paddle_tpu.parallel.zero_train_step` builder, on the unified mesh
+substrate serving also uses.
 """
-from .fleet.meta_parallel.sharding import (  # noqa: F401
+from ..parallel.zero import (  # noqa: F401
     GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
-    group_sharded_parallel,
+    group_sharded_parallel, save_group_sharded_model,
 )
-from ..framework.io import save as _save
 
 __all__ = ["group_sharded_parallel", "save_group_sharded_model"]
-
-
-def save_group_sharded_model(model, output, optimizer=None):
-    """Gather-on-rank0 save (ref: group_sharded.py save util)."""
-    if hasattr(model, "get_all_parameters"):
-        model.get_all_parameters()
-    _save(model.state_dict(), str(output) + ".pdparams")
-    if optimizer is not None:
-        inner = getattr(optimizer, "_optim", optimizer)
-        _save(inner.state_dict(), str(output) + ".pdopt")

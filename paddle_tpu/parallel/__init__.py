@@ -1,0 +1,43 @@
+"""paddle_tpu.parallel — the unified mesh/sharding substrate and the
+training-parallelism engines built on it (ISSUE 16).
+
+Two layers:
+
+- ``parallel.mesh``: ONE device-id-sorted, permutation-independent
+  mesh/axis-carving module (dp x tp axes, disjoint sub-mesh carving,
+  PartitionSpec helpers, fixed-shard-order collectives). Both the
+  serving tensor-parallel context (``serving/tp.py``) and the training
+  layer below build their meshes here, so there is exactly one
+  sharding/resharding code path in the repo — the contract the future
+  autoscaler (ROADMAP item 2) reshards through.
+
+- ``parallel.zero``: ZeRO-1/2-shaped sharded data-parallel training
+  (arxiv 2004.13336): per-step reduce-scatter of gradients, shard-local
+  optimizer update on the 1/dp parameter slice, all-gather of updated
+  params — bit-identical (fp32) to the replicated dp update at every
+  degree, composed with tensor parallelism on one dp x tp mesh. The
+  paddle-compat ``group_sharded_parallel`` / ``GroupShardedStage2/3``
+  surface lives here too (the fleet.meta_parallel module is a
+  deprecated re-export shim).
+"""
+from . import mesh  # noqa: F401
+from .mesh import (  # noqa: F401
+    DP_AXIS, TP_AXIS, build_mesh, carve_submeshes, device_order,
+    copy_to_tp_region, ordered_psum, ordered_psum_scatter,
+    reduce_from_tp_region, shard_leaf, tp_dim_spec,
+)
+from .zero import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+    ZeroTrainStep, group_sharded_parallel, model_loss,
+    save_group_sharded_model, zero_train_step,
+)
+
+__all__ = [
+    "DP_AXIS", "TP_AXIS", "build_mesh", "carve_submeshes", "device_order",
+    "copy_to_tp_region", "ordered_psum", "ordered_psum_scatter",
+    "reduce_from_tp_region", "shard_leaf", "tp_dim_spec",
+    "ZeroTrainStep", "zero_train_step", "model_loss",
+    "GroupShardedOptimizerStage2", "GroupShardedStage2",
+    "GroupShardedStage3", "group_sharded_parallel",
+    "save_group_sharded_model", "mesh",
+]

@@ -1,0 +1,230 @@
+"""The unified mesh/sharding substrate (ISSUE 16 tentpole, layer 1).
+
+One device-id-sorted, permutation-independent mesh module shared by
+every parallel surface in the repo:
+
+- `serving/tp.py` `TPContext` builds its 1-axis tp mesh here
+  (`build_mesh`), and `serving/cluster.py` carves its disjoint replica
+  sub-meshes here (`carve_submeshes`);
+- `parallel/zero.py` builds its dp x tp training mesh here;
+- the fleet GroupSharded compat surface builds its "sharding"-axis mesh
+  here.
+
+Why one module: `jax.devices()` ordering is not guaranteed stable
+across processes, but device ids are. Sorting by id in exactly one
+place (`device_order`) makes every mesh — serving sub-mesh, cluster
+carving, training grid — a pure function of the device SET, so
+snapshot/restore, cluster replica carving and sharded-checkpoint
+resharding stay deterministic no matter how a caller's list was
+shuffled ("portable collective communication" needs a portable mesh:
+arxiv 2112.01075).
+
+The module also owns the FIXED-SHARD-ORDER collectives
+(`ordered_psum`, `ordered_psum_scatter`) and the Megatron
+tensor-parallel region boundaries (`copy_to_tp_region`,
+`reduce_from_tp_region`). Floating-point addition is not associative;
+`lax.psum`'s reduction order is an implementation detail, so a
+bit-determinism claim (ZeRO-vs-replicated parity, cross-process
+reproducibility) must spell the order out: all_gather, then a
+static-order shard sum. The same fixed-shard-order discipline the
+quantized all-reduce (`serving/quant.py`) already uses.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DP_AXIS", "TP_AXIS", "device_order", "build_mesh", "carve_submeshes",
+    "shard_leaf", "ordered_psum", "ordered_psum_scatter",
+    "copy_to_tp_region", "reduce_from_tp_region", "tp_dim_spec",
+    "local_shape",
+]
+
+# canonical axis names: every training mesh is (dp, tp); serving meshes
+# are 1-axis (tp,); the fleet compat surface uses its paddle name
+# ("sharding") over the same constructor
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+
+def device_order(devices=None):
+    """Sorted-by-id device list — THE canonical ordering for every mesh
+    in the repo (serving sub-mesh, cluster carving, training grid).
+    `jax.devices()` order is not guaranteed stable across processes;
+    device ids are, so pinning the sort here keeps snapshot/restore,
+    replica carving and sharded-checkpoint resharding deterministic no
+    matter how the caller's list was shuffled."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return sorted(devs, key=lambda d: d.id)
+
+
+def build_mesh(axes: Sequence[Tuple[str, int]], devices=None) -> Mesh:
+    """Build a Mesh from (axis_name, size) pairs over the id-sorted
+    device prefix. `build_mesh(((\"tp\", 2),))` on any permutation of the
+    same device list returns an identical mesh — permutation
+    independence is the whole contract."""
+    names = tuple(name for name, _ in axes)
+    sizes = tuple(int(size) for _, size in axes)
+    for name, size in zip(names, sizes):
+        if size < 1:
+            raise ValueError(
+                f"mesh axis {name!r} must have size >= 1, got {size}")
+    need = int(np.prod(sizes)) if sizes else 1
+    devs = device_order(devices)
+    if len(devs) < need:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {need} devices, got "
+            f"{len(devs)}")
+    grid = np.asarray(devs[:need]).reshape(sizes)
+    return Mesh(grid, names)
+
+
+def carve_submeshes(num_replicas: int, tp_size: int, devices=None
+                    ) -> List[tuple]:
+    """Carve the id-sorted device list into `num_replicas` disjoint
+    `tp_size`-wide groups; replica i gets devices [i*tp : (i+1)*tp].
+    Every process carves identically no matter how its `jax.devices()`
+    happens to be ordered (pinned by the cluster determinism tests)."""
+    devs = device_order(devices)
+    need = num_replicas * tp_size
+    if len(devs) < need:
+        raise ValueError(
+            f"{num_replicas} replicas x tp_size={tp_size} "
+            f"needs {need} devices, got {len(devs)}")
+    return [tuple(devs[i * tp_size:(i + 1) * tp_size])
+            for i in range(num_replicas)]
+
+
+def shard_leaf(arr_or_shape, mesh: Mesh, axis_name: str) -> NamedSharding:
+    """Dim-0 sharding when divisible by the axis size, else replicated —
+    paddle pads slices; GSPMD shards evenly-divisible dims and we keep
+    the rest replicated (small params: biases, norms)."""
+    shape = getattr(arr_or_shape, "shape", arr_or_shape)
+    n = mesh.shape[axis_name]
+    if len(shape) > 0 and shape[0] % n == 0 and shape[0] >= n:
+        return NamedSharding(mesh, P(axis_name))
+    return NamedSharding(mesh, P())
+
+
+def tp_dim_spec(spec: Optional[P], axis: str = TP_AXIS) -> Optional[int]:
+    """Index of the dimension `spec` shards over `axis`, or None when
+    the spec is replicated w.r.t. that axis. Specs sharding one dim over
+    multiple axes (e.g. P((\"dp\", \"tp\"))) are rejected — the training
+    engine only composes with single-axis Megatron specs."""
+    if spec is None:
+        return None
+    hit = None
+    for dim, entry in enumerate(tuple(spec)):
+        entries = entry if isinstance(entry, tuple) else (entry,)
+        if axis in entries:
+            if len(entries) > 1:
+                raise ValueError(
+                    f"spec {spec} shards one dim over multiple axes; "
+                    f"only single-axis {axis!r} sharding is supported")
+            if hit is not None:
+                raise ValueError(
+                    f"spec {spec} shards {axis!r} over two dims")
+            hit = dim
+    return hit
+
+
+def local_shape(shape: Sequence[int], spec: Optional[P], sizes: Dict[str, int]
+                ) -> Tuple[int, ...]:
+    """Per-shard shape of a global `shape` placed under `spec` on a mesh
+    with axis sizes `sizes` (e.g. {\"dp\": 2, \"tp\": 2})."""
+    out = list(int(d) for d in shape)
+    if spec is None:
+        return tuple(out)
+    for dim, entry in enumerate(tuple(spec)):
+        entries = entry if isinstance(entry, tuple) else (entry,)
+        for ax in entries:
+            if ax is None:
+                continue
+            n = sizes.get(ax, 1)
+            if out[dim] % n:
+                raise ValueError(
+                    f"dim {dim} of shape {tuple(shape)} not divisible by "
+                    f"axis {ax!r} size {n}")
+            out[dim] //= n
+    return tuple(out)
+
+
+# --------------------------------------------------------------- collectives
+def ordered_psum(x, axis_name: str):
+    """All-reduce with a SPELLED-OUT reduction order: all_gather, then a
+    static python-loop sum over shard index 0..n-1. Bit-identical on
+    every shard and across runs/processes (fp addition is not
+    associative; `lax.psum`'s order is unspecified). This is the
+    reduction every bit-parity claim in `parallel/zero.py` leans on."""
+    g = jax.lax.all_gather(x, axis_name)         # (n, ...)
+    out = g[0]
+    for i in range(1, g.shape[0]):
+        out = out + g[i]
+    return out
+
+
+def ordered_psum_scatter(x, axis_name: str):
+    """Reduce-scatter with the same fixed shard order as `ordered_psum`:
+    each shard keeps row i of the (n, n, chunk)-blocked ordered sum.
+    `x` must be a flat vector divisible by the axis size; bit-identical
+    to `ordered_psum(x)[i*chunk:(i+1)*chunk]` because the sum is
+    elementwise — ZeRO-2's grad shard without ever materializing the
+    full summed gradient in the update path."""
+    g = jax.lax.all_gather(x, axis_name)         # (n, flat)
+    n = g.shape[0]
+    blocked = g.reshape(n, n, -1)                # (src, dst, chunk)
+    i = jax.lax.axis_index(axis_name)
+    mine = jax.lax.dynamic_slice_in_dim(blocked, i, 1, axis=1)  # (src,1,chunk)
+    out = mine[0, 0]
+    for s in range(1, n):
+        out = out + mine[s, 0]
+    return out
+
+
+# --------------------------------------------- Megatron tp region boundaries
+# custom_vjp pairs instead of differentiating raw collectives: jax 0.4.x
+# shard_map(check_rep=False) has no transpose story for `psum` that
+# matches the replicated-input/partial-grad semantics Megatron needs, and
+# the custom rules keep the backward reduction on the SAME fixed shard
+# order as the forward.
+
+@jax.custom_vjp
+def copy_to_tp_region(x):
+    """Megatron's `f`: identity forward into a tensor-parallel region,
+    fixed-order tp all-reduce of the cotangent on the way back (each
+    shard's backward contributes a partial input-grad)."""
+    return x
+
+
+def _copy_fwd(x):
+    return x, None
+
+
+def _copy_bwd(_, g):
+    return (ordered_psum(g, TP_AXIS),)
+
+
+copy_to_tp_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@jax.custom_vjp
+def reduce_from_tp_region(y):
+    """Megatron's `g`: fixed-order tp all-reduce of the partial sums
+    leaving a tensor-parallel region, identity on the cotangent (the
+    incoming grad is already replicated across tp)."""
+    return ordered_psum(y, TP_AXIS)
+
+
+def _reduce_fwd(y):
+    return ordered_psum(y, TP_AXIS), None
+
+
+def _reduce_bwd(_, g):
+    return (g,)
+
+
+reduce_from_tp_region.defvjp(_reduce_fwd, _reduce_bwd)

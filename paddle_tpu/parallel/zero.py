@@ -1,0 +1,736 @@
+"""ZeRO-sharded data-parallel training (ISSUE 16 tentpole, layer 2).
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arxiv 2004.13336): instead of every dp replica holding the
+full optimizer state and redundantly applying the identical weight
+update, shard the update itself —
+
+    reduce-scatter grads -> shard-local optimizer update on the 1/dp
+    parameter slice -> all-gather updated params
+
+`ZeroTrainStep` / `zero_train_step` builds that step jit/shard_map-
+native on the unified (dp x tp) mesh from `parallel/mesh.py`:
+
+- **stage 0** (the baseline the parity claim is against): fixed-order
+  dp all-reduce of every grad, full replicated elementwise update.
+- **stage 1** (ZeRO-1, paddle level "os"): same all-reduced grad, but
+  the optimizer update runs on this shard's 1/dp flat slice only —
+  optimizer-state bytes/chip drop to 1/dp.
+- **stage 2** (ZeRO-2, "os_g"): the grad is reduce-SCATTERED (fixed
+  shard order), so the full summed gradient never materializes in the
+  update path.
+
+**Bit-parity (fp32), by construction**: all stages sum grads with the
+same fixed-shard-order `ordered_psum` (and `ordered_psum_scatter`,
+whose shard i output is bit-identical to slicing the ordered sum —
+the sum is elementwise); the optimizer update is the optimizer's OWN
+elementwise `functional_step`, so updating a slice and concatenating
+equals slicing the full update. Hence ZeRO-1/2 == replicated dp,
+bit-for-bit, at every dp degree (pinned by tests/test_zero.py).
+Cross-DEGREE bit-parity is NOT claimed: changing dp changes the batch
+summation order, which fp addition does not forgive.
+
+**Optimizer-state layout + degree-blind checkpoints**: each slot is
+stored as a (dp, tp, chunk) array placed P("dp", "tp"), where chunk =
+ceil(tp_local_flat_size / dp). `save_optimizer_state` reassembles full
+logical arrays (host-side, numpy), `load_optimizer_state` re-slices
+them for ANY (dp, tp) — save at dp=2, restore at dp=4, keep training:
+the same degree-blind contract the serving journal honors for tp.
+
+**tp composition**: params may carry Megatron PartitionSpecs over the
+tp axis; the dp machinery slices each shard's TP-LOCAL flat view, so
+dp x tp composes on one mesh with no special cases. Loss functions
+crossing tp regions must use `mesh.copy_to_tp_region` /
+`mesh.reduce_from_tp_region` (differentiating raw collectives under
+`shard_map(check_rep=False)` is undefined on jax 0.4.x).
+
+**Limits** (validated loudly at construction): elementwise optimizers
+only (Lamb's trust ratio and LBFGS's history are whole-tensor
+operations — a 1/dp slice changes them); `grad_clip` is rejected (the
+global-norm clip over a slice is wrong — use the GSPMD GroupSharded
+surface with `HybridParallelClipGrad` instead).
+
+The paddle-compat GroupSharded/`group_sharded_parallel` surface
+(GSPMD sharding-annotation flavor, stages 1-3) lives at the bottom of
+this module — `fleet.meta_parallel.sharding` and
+`distributed.sharding` are re-export shims onto it — and bridges to
+the explicit engine via `_ShardedBase.zero_train_step()`.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:                                   # newer jax exports it at top level
+    from jax import shard_map as _shard_map  # type: ignore
+except ImportError:                    # jax 0.4.x experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..nn import Layer
+from .mesh import (
+    DP_AXIS, TP_AXIS, build_mesh, device_order, local_shape, ordered_psum,
+    ordered_psum_scatter, shard_leaf, tp_dim_spec,
+)
+
+__all__ = [
+    "ZeroTrainStep", "zero_train_step", "model_loss",
+    "save_optimizer_state", "load_optimizer_state",
+    "GroupShardedStage2", "GroupShardedStage3",
+    "GroupShardedOptimizerStage2", "group_sharded_parallel",
+    "save_group_sharded_model", "shard_leaf",
+]
+
+# whole-tensor update rules: slicing changes the math, so the sharded
+# engine refuses them instead of silently diverging from the replica
+_NON_ELEMENTWISE = ("Lamb", "LBFGS")
+
+
+def model_loss(model, criterion=None):
+    """Build a `loss_fn(params, x, y) -> scalar` over a Layer via the
+    functional forward (`call_functional`), defaulting to mean squared
+    error. The mean must be over the LOCAL batch rows — the engine's
+    fixed-order dp reduction averages the shard losses."""
+    from ..core.tensor import Tensor
+    from ..jit.functional import call_functional
+
+    def loss_fn(params, x, y):
+        out, _ = call_functional(model, params, {}, (x,), training=True)
+        if criterion is None:
+            return jnp.mean((out - y) ** 2)
+        loss = criterion(Tensor(out), Tensor(y))
+        return getattr(loss, "_data", loss)
+
+    return loss_fn
+
+
+def _pad_flat(x, n: int):
+    """Flatten and zero-pad to length n (n >= x.size). Zero padding is
+    update-neutral for every elementwise rule: pad params and grads are
+    both 0, so the padded slots never feed back into real elements."""
+    flat = x.reshape(-1)
+    return jnp.pad(flat, (0, n - flat.shape[0]))
+
+
+# ------------------------------------------------------------- step bodies
+# module-level on purpose: these ARE the hot per-step path (traced into
+# the one train executable), and graftlint's HOST-SYNC rule audits them
+# by name — nested closures would dodge the audit.
+
+def _accumulated_grads(ctx, params, batch):
+    """Local (this dp shard's) loss and grads, averaged over
+    `ctx.grad_accum` micro-batches split from the local rows (static
+    unroll — one executable, no host loop)."""
+    vg = jax.value_and_grad(ctx.loss_fn)
+    k = ctx.grad_accum
+    if k == 1:
+        return vg(params, *batch)
+    per = batch[0].shape[0] // k
+    loss = None
+    gsum = None
+    for j in range(k):
+        micro = tuple(jax.lax.dynamic_slice_in_dim(b, j * per, per, axis=0)
+                      for b in batch)
+        step_loss, g = vg(params, *micro)
+        loss = step_loss if loss is None else loss + step_loss
+        gsum = g if gsum is None else jax.tree_util.tree_map(
+            lambda a, b: a + b, gsum, g)
+    inv = jnp.float32(1.0 / k)
+    return loss * inv, jax.tree_util.tree_map(lambda g: g * inv, gsum)
+
+
+def _replicated_update(ctx, params, grads, state, lr, t):
+    """Stage 0: fixed-order dp all-reduce of every grad, full
+    elementwise update everywhere — the reference the sharded stages
+    are bit-identical to."""
+    inv = jnp.float32(1.0 / ctx.dp)
+    g = {k: ordered_psum(grads[k], DP_AXIS) * inv for k in grads}
+    return ctx.optimizer.functional_step(params, g, state, lr, t)
+
+
+def _sharded_update(ctx, params, grads, state, lr, t):
+    """ZeRO-1/2: slice params + grads to this shard's 1/dp flat chunk,
+    run the optimizer's own elementwise update on the slice against the
+    (dp, tp, chunk)-laid-out state, then all-gather the updated slices
+    back into the tp-local param. Stage 1 all-reduces the full grad
+    first; stage 2 reduce-scatters so the full summed gradient never
+    materializes in the update path."""
+    inv = jnp.float32(1.0 / ctx.dp)
+    names = list(params)
+    i = jax.lax.axis_index(DP_AXIS)
+    sliced_p, sliced_g, local_state = {}, {}, {}
+    for k in names:
+        chunk = ctx._chunks[k]
+        padded = ctx.dp * chunk
+        if ctx.stage >= 2:
+            gs = ordered_psum_scatter(_pad_flat(grads[k], padded),
+                                      DP_AXIS) * inv
+        else:
+            gfull = ordered_psum(grads[k], DP_AXIS) * inv
+            gs = jax.lax.dynamic_slice(_pad_flat(gfull, padded),
+                                       (i * chunk,), (chunk,))
+        sliced_p[k] = jax.lax.dynamic_slice(_pad_flat(params[k], padded),
+                                            (i * chunk,), (chunk,))
+        sliced_g[k] = gs
+        # state leaves arrive as this shard's (1, 1, chunk) block
+        local_state[k] = {slot: v.reshape(-1)
+                          for slot, v in state[k].items()}
+    new_slices, new_state = ctx.optimizer.functional_step(
+        sliced_p, sliced_g, local_state, lr, t)
+    new_params = {}
+    for k in names:
+        full = jax.lax.all_gather(new_slices[k], DP_AXIS).reshape(-1)
+        new_params[k] = full[:ctx._loc_sizes[k]].reshape(ctx._loc_shapes[k])
+    return new_params, {k: {slot: v.reshape(1, 1, -1)
+                            for slot, v in new_state[k].items()}
+                        for k in names}
+
+
+# ------------------------------------------- degree-blind state layout
+def _to_zero_layout(full, spec_dim: Optional[int], dp: int, tp: int,
+                    chunk: int) -> np.ndarray:
+    """Full logical array -> (dp, tp, chunk) sharded layout (host-side
+    numpy; the inverse of `_from_zero_layout` at ANY dp)."""
+    full = np.asarray(full)
+    parts = (np.split(full, tp, axis=spec_dim) if spec_dim is not None
+             else [full] * tp)
+    blocks = []
+    for part in parts:
+        flat = np.ravel(part)
+        flat = np.pad(flat, (0, dp * chunk - flat.size))
+        blocks.append(flat.reshape(dp, chunk))
+    return np.stack(blocks, axis=1)
+
+
+def _from_zero_layout(arr, shape: Tuple[int, ...],
+                      spec_dim: Optional[int], tp: int) -> np.ndarray:
+    """(dp, tp, chunk) sharded layout -> full logical array. Degree
+    blind: only the layout's own leading dim says what dp it was saved
+    at; nothing else depends on it."""
+    arr = np.asarray(arr)
+    if spec_dim is None:
+        flat = np.ravel(arr[:, 0])
+        return flat[:int(np.prod(shape))].reshape(shape)
+    loc_shape = list(shape)
+    loc_shape[spec_dim] //= tp
+    loc = int(np.prod(loc_shape))
+    parts = [np.ravel(arr[:, j])[:loc].reshape(loc_shape)
+             for j in range(tp)]
+    return np.concatenate(parts, axis=spec_dim)
+
+
+class ZeroTrainStep:
+    """One jitted shard_map train step
+    `(params, opt_state, batch, lr, t) -> (loss, params, opt_state)`
+    over the unified (dp x tp) mesh, with the optimizer update sharded
+    across dp per `stage` (see module docstring). Build once per
+    (model, optimizer, degree); `init_state` places params/state, the
+    instance is the step callable."""
+
+    def __init__(self, model, optimizer, loss_fn=None, *, criterion=None,
+                 dp: Optional[int] = None, tp: int = 1, stage: int = 1,
+                 param_specs: Optional[Dict[str, P]] = None,
+                 batch_specs: Optional[Sequence[P]] = None,
+                 grad_accum: int = 1, devices=None):
+        if stage not in (0, 1, 2):
+            raise ValueError(
+                f"stage must be 0 (replicated baseline), 1 (ZeRO-1) or 2 "
+                f"(ZeRO-2); got {stage} — stage 3 (param sharding) is the "
+                "GSPMD GroupSharded surface (level='p_g_os')")
+        opt_name = type(optimizer).__name__
+        if opt_name in _NON_ELEMENTWISE:
+            raise NotImplementedError(
+                f"{opt_name} applies whole-tensor update rules; the "
+                "dp-sliced update would change its math. Use an "
+                "elementwise optimizer (SGD/Momentum/Adam/AdamW/...)")
+        if getattr(optimizer, "_grad_clip", None) is not None:
+            raise NotImplementedError(
+                "grad_clip inside the sharded update would clip by the "
+                "SLICE norm, not the global norm; clip before the step or "
+                "use the GSPMD GroupSharded surface with "
+                "HybridParallelClipGrad")
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = (loss_fn if loss_fn is not None
+                        else model_loss(model, criterion))
+        self.tp = int(tp)
+        devs = device_order(devices)
+        self.dp = int(dp) if dp is not None else max(
+            len(devs) // self.tp, 1)
+        if self.dp < 1:
+            raise ValueError(f"dp must be >= 1, got {self.dp}")
+        self.stage = int(stage)
+        self.grad_accum = int(grad_accum)
+        if self.grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.param_specs = dict(param_specs or {})
+        self.batch_specs = (tuple(batch_specs) if batch_specs is not None
+                            else None)
+        if self.grad_accum > 1 and self.batch_specs is not None and any(
+                tuple(s) != (DP_AXIS,) for s in self.batch_specs):
+            raise ValueError(
+                "grad_accum > 1 splits every batch leaf along its local "
+                "rows, so all batch_specs must be P('dp')")
+        self.mesh = build_mesh(((DP_AXIS, self.dp), (TP_AXIS, self.tp)),
+                               devices)
+        self.devices = tuple(self.mesh.devices.reshape(-1))
+        # per-param geometry, discovered at init_state/load time
+        # dp=1 "sharding" is an identity: the 1/dp slice IS the whole
+        # param, so the engine runs the stage-0 program outright — same
+        # math, and literally the same executable, so bit-parity with
+        # the replicated baseline is definitional rather than lucky
+        # (even boundary reshapes steer XLA's FMA selection enough to
+        # drift low bits otherwise)
+        self._sharded = self.stage >= 1 and self.dp > 1
+        self._shapes: Dict[str, Tuple[int, ...]] = {}
+        self._spec: Dict[str, P] = {}
+        self._spec_dim: Dict[str, Optional[int]] = {}
+        self._loc_shapes: Dict[str, Tuple[int, ...]] = {}
+        self._loc_sizes: Dict[str, int] = {}
+        self._chunks: Dict[str, int] = {}
+        self._state_spec: Dict[str, Dict[str, P]] = {}
+        self._step = None
+        self._probes: Dict[int, object] = {}
+
+    # ------------------------------------------------------------ geometry
+    def _record_geometry(self, params: Dict[str, jnp.ndarray]) -> None:
+        sizes = {DP_AXIS: self.dp, TP_AXIS: self.tp}
+        for name, arr in params.items():
+            shape = tuple(int(d) for d in arr.shape)
+            spec = self.param_specs.get(name, P())
+            self._shapes[name] = shape
+            self._spec[name] = spec
+            self._spec_dim[name] = tp_dim_spec(spec)
+            loc = local_shape(shape, spec, sizes)
+            self._loc_shapes[name] = loc
+            self._loc_sizes[name] = int(np.prod(loc)) if loc else 1
+            self._chunks[name] = max(
+                math.ceil(self._loc_sizes[name] / self.dp), 1)
+
+    def _slot_spec(self, name: str, slot_arr) -> P:
+        """Stage-0 placement of one state slot: follow the param's tp
+        spec when shaped like the param, else replicate (scalars)."""
+        if tuple(slot_arr.shape) == self._shapes[name]:
+            return self._spec[name]
+        return P()
+
+    # ------------------------------------------------------------ placement
+    def init_state(self, params: Optional[Dict[str, jnp.ndarray]] = None):
+        """Place full logical params on the mesh and build the sharded
+        optimizer state; returns `(params, opt_state)` ready for the
+        step callable."""
+        if params is None:
+            from ..jit.functional import extract_state
+
+            params, _ = extract_state(self.model)
+        params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._record_geometry(params)
+        placed = {k: jax.device_put(
+            v, NamedSharding(self.mesh, self._spec[k]))
+            for k, v in params.items()}
+        host_state = self.optimizer.functional_state(params)
+        return placed, self.load_optimizer_state(
+            {k: {s: np.asarray(v) for s, v in acc.items()}
+             for k, acc in host_state.items()})
+
+    def load_optimizer_state(self, host_state):
+        """Full-logical host state -> placed sharded state for THIS
+        (dp, tp, stage). Degree-blind restore: the host form carries no
+        dp imprint, so state saved at any degree loads at any other."""
+        if not self._shapes:
+            raise RuntimeError(
+                "call init_state() (or pass params to it) before "
+                "load_optimizer_state — the engine needs param geometry")
+        out = {}
+        for name, acc in host_state.items():
+            slots = {}
+            for slot, arr in acc.items():
+                arr = np.asarray(arr)
+                if not self._sharded:
+                    spec = self._slot_spec(name, arr)
+                    slots[slot] = jax.device_put(
+                        jnp.asarray(arr), NamedSharding(self.mesh, spec))
+                    self._state_spec.setdefault(name, {})[slot] = spec
+                else:
+                    laid = _to_zero_layout(arr, self._spec_dim[name],
+                                           self.dp, self.tp,
+                                           self._chunks[name])
+                    slots[slot] = jax.device_put(
+                        jnp.asarray(laid),
+                        NamedSharding(self.mesh, P(DP_AXIS, TP_AXIS)))
+                    self._state_spec.setdefault(name, {})[slot] = \
+                        P(DP_AXIS, TP_AXIS)
+            out[name] = slots
+        return out
+
+    def save_optimizer_state(self, opt_state):
+        """Placed sharded state -> full-logical host arrays (numpy),
+        restorable at ANY dp via `load_optimizer_state`."""
+        out = {}
+        for name, acc in opt_state.items():
+            slots = {}
+            for slot, arr in acc.items():
+                if not self._sharded:
+                    slots[slot] = np.asarray(arr)
+                else:
+                    slots[slot] = _from_zero_layout(
+                        arr, self._shapes[name], self._spec_dim[name],
+                        self.tp)
+            out[name] = slots
+        return out
+
+    # ----------------------------------------------------------- step build
+    def _build(self, batch_len: int):
+        pspec = {k: self._spec[k] for k in self._shapes}
+        sspec = {k: dict(v) for k, v in self._state_spec.items()}
+        bspec = (self.batch_specs if self.batch_specs is not None
+                 else tuple(P(DP_AXIS) for _ in range(batch_len)))
+        if len(bspec) != batch_len:
+            raise ValueError(
+                f"batch has {batch_len} leaves but batch_specs has "
+                f"{len(bspec)}")
+        ctx = self
+        inv_dp = jnp.float32(1.0 / self.dp)
+
+        def body(params, state, batch, lr, t):
+            loss, grads = _accumulated_grads(ctx, params, batch)
+            # pin the backward: without the barrier XLA fuses the grad
+            # computation with its CONSUMERS, and the stage-0 (full
+            # update) vs stage-1/2 (slice/gather) consumers steer it to
+            # differently-ordered reductions — observed bit drift at
+            # dp=1. The barrier makes the grads a sealed subprogram, so
+            # every stage compiles the identical backward.
+            loss, grads = jax.lax.optimization_barrier((loss, grads))
+            loss = ordered_psum(loss, DP_AXIS) * inv_dp
+            if not ctx._sharded:
+                new_p, new_s = _replicated_update(ctx, params, grads,
+                                                  state, lr, t)
+            else:
+                new_p, new_s = _sharded_update(ctx, params, grads,
+                                               state, lr, t)
+            return loss, new_p, new_s
+
+        self._step = jax.jit(_shard_map(
+            body, mesh=self.mesh,
+            in_specs=(pspec, sspec, bspec, P(), P()),
+            out_specs=(P(), pspec, sspec),
+            check_rep=False,  # noqa: COLLECTIVE-MESH — the ordered fixed-shard-order collectives and the (dp,tp,chunk) state outputs are per-shard by design; 0.4.x rep tracking can't see through custom_vjp boundaries
+            ))
+
+    def __call__(self, params, opt_state, batch, lr, t):
+        """One training step. `batch` is a tuple of GLOBAL arrays
+        (row-sharded over dp per batch_specs); `lr` scalar; `t` the
+        1-based step count (drives Adam bias correction)."""
+        batch = tuple(batch)
+        if self._step is None:
+            self._build(len(batch))
+        return self._step(params, opt_state, batch,
+                          jnp.asarray(lr, jnp.float32),
+                          jnp.asarray(t, jnp.int32))
+
+    # -------------------------------------------------------- observability
+    @staticmethod
+    def bytes_per_chip(tree) -> int:
+        """Max-over-devices resident bytes of a placed pytree — THE
+        1/dp measurement for the optimizer-state claim."""
+        total = 0
+        for arr in jax.tree_util.tree_leaves(tree):
+            total += max(s.data.size * s.data.dtype.itemsize
+                         for s in arr.addressable_shards)
+        return total
+
+    def optimizer_state_bytes_per_chip(self, opt_state) -> int:
+        return self.bytes_per_chip(opt_state)
+
+    def collective_seconds(self, samples: int = 3, rows: int = 1,
+                           width: int = 1024) -> List[float]:
+        """Measured wall seconds per fixed-order dp all-reduce of a
+        replicated (rows, width) f32 buffer — the training twin of
+        `TPContext.collective_seconds`. Feeds the
+        `parallel_dp_collective_seconds` bench probe. On CPU meshes one
+        dispatch's host overhead dominates — which is the honest
+        number."""
+        fn = self._probes.get((rows, width))
+        if fn is None:
+            mesh = self.mesh
+
+            def reduce_one(y):
+                return ordered_psum(y, DP_AXIS)
+
+            def allreduce(x):
+                return _shard_map(
+                    reduce_one, mesh=mesh, in_specs=P(), out_specs=P(),
+                    check_rep=False,  # noqa: COLLECTIVE-MESH — probe psum of a replicated buffer; rep tracking adds latency to the very overhead being measured
+                    )(x)
+            fn = jax.jit(allreduce)
+            self._probes[(rows, width)] = fn
+        x = jax.device_put(jnp.zeros((rows, width), jnp.float32),
+                           NamedSharding(self.mesh, P()))
+        fn(x).block_until_ready()              # compile + warm
+        out = []
+        for _ in range(max(int(samples), 1)):
+            t0 = time.perf_counter()
+            fn(x).block_until_ready()
+            out.append(time.perf_counter() - t0)
+        # the training twin of serving_tp_collective_seconds: same
+        # registry, same construction-time-probe discipline (per-step
+        # timing would measure dispatch queueing, not the collective)
+        from ..observability import global_registry
+
+        hist = global_registry().histogram(
+            "parallel_dp_collective_seconds",
+            "fixed-order dp all-reduce probe (ZeroTrainStep)")
+        for s in out:
+            hist.observe(s)
+        return out
+
+    def describe(self) -> Dict[str, object]:
+        return {
+            "dp": self.dp,
+            "tp": self.tp,
+            "stage": self.stage,
+            "grad_accum": self.grad_accum,
+            "devices": [d.id for d in self.devices],
+            "params": len(self._shapes),
+            "chunk_elems": sum(self._chunks.values()),
+        }
+
+
+def zero_train_step(model, optimizer, loss_fn=None, *, stage: int = 1,
+                    **kwargs) -> ZeroTrainStep:
+    """Builder form of `ZeroTrainStep` (the API named in ROADMAP item
+    4): `step = zero_train_step(model, opt, stage=1); params, st =
+    step.init_state(); loss, params, st = step(params, st, (x, y), lr,
+    t)`."""
+    return ZeroTrainStep(model, optimizer, loss_fn, stage=stage, **kwargs)
+
+
+def save_optimizer_state(step: ZeroTrainStep, opt_state):
+    """Module-level alias of the degree-blind save (mirrors the serving
+    journal's snapshot helpers)."""
+    return step.save_optimizer_state(opt_state)
+
+
+def load_optimizer_state(step: ZeroTrainStep, host_state):
+    return step.load_optimizer_state(host_state)
+
+
+# ===================================================================
+# paddle-compat GroupSharded surface (GSPMD sharding-annotation flavor)
+# -------------------------------------------------------------------
+# Ref: fleet/meta_parallel/sharding/group_sharded_stage{2,3}.py,
+# group_sharded_optimizer_stage2.py + python/paddle/distributed/
+# sharding/group_sharded.py (upstream layout, unverified — mount empty).
+#
+# Paddle implements ZeRO with explicit param slicing, pre-forward
+# allgathers, grad reduce-scatter hooks and rank-local optimizer
+# updates. This surface keeps the TPU-native GSPMD equivalent —
+# sharding ANNOTATIONS consumed by a jitted train step (stage 1:
+# opt-state dim-0 sharded; stage 2: + grads constrained to the
+# scattered layout; stage 3: + params sharded with gather-on-use
+# scheduled by XLA) — and now shares the repo's one mesh substrate and
+# bridges to the explicit shard_map engine above via
+# `zero_train_step()`.
+# ===================================================================
+
+def _default_mesh(axis: str = "sharding"):
+    devs = device_order()
+    return build_mesh(((axis, len(devs)),))
+
+
+class _ShardedBase(Layer):
+    stage = None
+    _shard_params = False
+
+    def __init__(self, layer: Layer, optimizer=None, group=None,
+                 sync_buffers: bool = False, device: str = "tpu",
+                 segment_size: int = 2 ** 20, offload: bool = False,
+                 hcg=None, **kwargs):
+        super().__init__()
+        self._layers = layer
+        self._optimizer = optimizer
+        self.offload = offload
+        if offload:
+            try:  # fail LOUDLY at construction, not mid-training
+                jax.devices()[0].memory("pinned_host")
+            except Exception as e:
+                raise NotImplementedError(
+                    "offload=True needs a backend with pinned_host memory "
+                    f"support; {jax.devices()[0].platform} reports none"
+                ) from e
+        if hcg is not None and hcg.mesh is not None and \
+                hcg.get_sharding_parallel_world_size() > 1:
+            self.mesh = hcg.mesh
+            self.axis = "sharding"
+        elif group is not None and getattr(group, "mesh", None) is not None:
+            self.mesh = group.mesh
+            self.axis = group.axis_name
+        else:
+            self.mesh = _default_mesh()
+            self.axis = "sharding"
+        if self._shard_params:
+            self._place_params()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # ------------------------------------------------ sharding hint trees
+    def data_sharding(self):
+        axes = tuple(a for a in self.mesh.axis_names
+                     if a in ("dp", "sharding") and self.mesh.shape[a] > 1)
+        return NamedSharding(self.mesh, P(axes if axes else None))
+
+    def param_sharding(self):
+        """Prefix sharding for params: stage 1/2 replicate params."""
+        return NamedSharding(self.mesh, P())
+
+    def param_shardings(self, params: dict):
+        if not self._shard_params:
+            sh = self.param_sharding()
+            return {k: sh for k in params}
+        return {k: shard_leaf(v, self.mesh, self.axis)
+                for k, v in params.items()}
+
+    def opt_state_shardings(self, opt_state: dict):
+        """Moment slots shaped like the param shard dim-0; scalars repl.
+        With offload=True the slots additionally live in pinned host memory
+        (ZeRO-offload: HBM holds only params/grads/activations; XLA streams
+        the moments in for the update)."""
+        out = {}
+        for pname, acc in opt_state.items():
+            shardings = {}
+            for slot, v in acc.items():
+                sh = shard_leaf(v, self.mesh, self.axis)
+                if self.offload:
+                    sh = sh.with_memory_kind("pinned_host")
+                shardings[slot] = sh
+            out[pname] = shardings
+        return out
+
+    def grad_shardings(self, params: dict):
+        if self.stage >= 2:
+            return {k: shard_leaf(v, self.mesh, self.axis)
+                    for k, v in params.items()}
+        return {k: NamedSharding(self.mesh, P()) for k in params}
+
+    def _place_params(self):
+        for _, p in self._layers.named_parameters():
+            p._data = jax.device_put(
+                p._data, shard_leaf(p._data, self.mesh, self.axis))
+
+    # ------------------------------------------ explicit-engine bridge
+    def zero_train_step(self, loss_fn=None, criterion=None,
+                        **kwargs) -> ZeroTrainStep:
+        """The one-implementation bridge (ISSUE 16 satellite): build
+        the explicit shard_map ZeRO step for THIS wrapper's model +
+        optimizer at dp = the sharding axis size. Stage 3 has no
+        shard_map twin — its gather-on-use param sharding is the GSPMD
+        placement-tree contract — so it refuses."""
+        if self.stage >= 3:
+            raise NotImplementedError(
+                "stage 3 (p_g_os) shards params via the GSPMD placement "
+                "trees (param_shardings); the explicit shard_map engine "
+                "covers stages 1/2")
+        return ZeroTrainStep(self._layers, self._optimizer,
+                             loss_fn, criterion=criterion,
+                             dp=int(self.mesh.shape[self.axis]),
+                             stage=self.stage, **kwargs)
+
+    # ------------------------------------------------------- delegation
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        out = self._layers.set_state_dict(sd, *a, **k)
+        if self._shard_params:
+            self._place_params()
+        return out
+
+    def get_all_parameters(self, convert2cpu: bool = False):
+        """stage3 API: gather full params (device_put to replicated)."""
+        repl = NamedSharding(self.mesh, P())
+        for _, p in self._layers.named_parameters():
+            p._data = jax.device_put(p._data, repl)
+        return self._layers.parameters()
+
+
+class GroupShardedStage2(_ShardedBase):
+    stage = 2
+    _shard_params = False
+
+
+class GroupShardedStage3(_ShardedBase):
+    stage = 3
+    _shard_params = True
+
+
+class GroupShardedOptimizerStage2:
+    """Optimizer wrapper partitioning state over the sharding axis (ZeRO-1/2
+    optimizer side). Delegates the whole surface; the sharded placement is
+    applied by the jitted step through opt_state_shardings."""
+
+    def __init__(self, params, optim, group=None, offload: bool = False,
+                 device: str = "tpu", **kwargs):
+        self._optim = optim
+        self._params = params
+        self.offload = offload
+        self.group = group
+
+    def __getattr__(self, name):
+        return getattr(self._optim, name)
+
+    def step(self):
+        return self._optim.step()
+
+    def minimize(self, *a, **k):
+        return self._optim.minimize(*a, **k)
+
+
+def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
+                           scaler=None, group=None, offload: bool = False,
+                           sync_buffers: bool = False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm: bool = False,
+                           dp_group=None, exclude_layer=None):
+    """paddle.distributed.sharding.group_sharded_parallel.
+
+    level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3).
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(
+            f"group_sharded_parallel level must be 'os' (ZeRO-1), 'os_g' "
+            f"(ZeRO-2) or 'p_g_os' (ZeRO-3); got {level!r}")
+    if level == "p_g_os":
+        wrapped = GroupShardedStage3(model, optimizer=optimizer, group=group,
+                                     offload=offload)
+    else:
+        wrapped = GroupShardedStage2(model, optimizer=optimizer, group=group,
+                                     offload=offload)
+        wrapped.stage = 1 if level == "os" else 2
+    opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
+                                      group=group, offload=offload)
+    if scaler is not None:
+        return wrapped, opt, scaler
+    return wrapped, opt
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Gather-on-rank0 save (ref: group_sharded.py save util)."""
+    from ..framework.io import save as _save
+
+    if hasattr(model, "get_all_parameters"):
+        model.get_all_parameters()
+    _save(model.state_dict(), str(output) + ".pdparams")
+    if optimizer is not None:
+        inner = getattr(optimizer, "_optim", optimizer)
+        _save(inner.state_dict(), str(output) + ".pdopt")

@@ -234,17 +234,10 @@ class ServingCluster:
         if self.tp_size < 1:
             raise ValueError(f"tp_size must be >= 1, got {tp_size}")
         if self.tp_size > 1:
-            from .tp import tp_device_order
+            from ..parallel.mesh import carve_submeshes
 
-            devs = tp_device_order(devices)
-            need = num_replicas * self.tp_size
-            if len(devs) < need:
-                raise ValueError(
-                    f"{num_replicas} replicas x tp_size={self.tp_size} "
-                    f"needs {need} devices, got {len(devs)}")
-            self._replica_devices: Optional[List[tuple]] = [
-                tuple(devs[i * self.tp_size:(i + 1) * self.tp_size])
-                for i in range(num_replicas)]
+            self._replica_devices: Optional[List[tuple]] = carve_submeshes(
+                num_replicas, self.tp_size, devices)
         else:
             self._replica_devices = None
         # factory protocol: pass only what the signature admits

@@ -38,9 +38,11 @@ families and a 1-axis `jax.sharding.Mesh` ("tp") of `tp_size` devices:
   params/pools sharded per the specs above, everything else (ids, page
   tables, positions, PRNG key data, sampling knobs) replicated.
 
-Mesh construction sorts devices by id, so any `jax.devices()` ordering
-produces the same mesh — snapshot/restore and cluster sub-mesh carving
-stay deterministic across processes. GQA validation requires
+Mesh construction lives on the unified substrate
+(`paddle_tpu.parallel.mesh`, shared with the ZeRO training engine):
+devices are sorted by id, so any `jax.devices()` ordering produces the
+same mesh — snapshot/restore and cluster sub-mesh carving stay
+deterministic across processes. GQA validation requires
 `kv_heads % tp == 0` (each shard owns whole KV-head groups).
 
 Nothing in this module is imported unless `ServingEngine(tp_size>1)` —
@@ -53,31 +55,30 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 try:                                   # newer jax exports it at top level
     from jax import shard_map as _shard_map  # type: ignore
 except ImportError:                    # jax 0.4.x experimental home
     from jax.experimental.shard_map import shard_map as _shard_map
 
+# the unified mesh substrate (ISSUE 16): device ordering and mesh
+# construction are shared with the training engines in
+# paddle_tpu.parallel — TP_AXIS here IS parallel.mesh.TP_AXIS
 from ..core.tensor import Tensor
+from ..parallel.mesh import TP_AXIS, build_mesh, device_order
 from .. import nn
 
 __all__ = ["TPContext", "validate_tp_config", "tp_device_order"]
 
-# the single mesh axis every serving executable is mapped over
-TP_AXIS = "tp"
-
 
 def tp_device_order(devices=None):
-    """Sorted-by-id device list — THE canonical ordering for every TP
-    mesh (engine sub-mesh, cluster carving). `jax.devices()` order is
-    not guaranteed stable across processes; device ids are, so pinning
-    the sort here keeps snapshot/restore and cluster replica carving
-    deterministic no matter how the caller's list was shuffled."""
-    devs = list(devices) if devices is not None else list(jax.devices())
-    return sorted(devs, key=lambda d: d.id)
+    """Sorted-by-id device list — delegates to the substrate's
+    `parallel.mesh.device_order`, THE canonical ordering for every mesh
+    in the repo (engine sub-mesh, cluster carving, training grid), so
+    snapshot/restore and cluster replica carving stay deterministic no
+    matter how the caller's list was shuffled."""
+    return device_order(devices)
 
 
 def validate_tp_config(cfg, tp_size: int) -> None:
@@ -189,7 +190,9 @@ class TPContext:
                 f"tp_size={self.tp_size} needs that many devices, got "
                 f"{len(devs)}")
         self.devices: Tuple = tuple(devs[:self.tp_size])
-        self.mesh = Mesh(np.asarray(self.devices), (TP_AXIS,))
+        # byte-identical to the pre-substrate construction: the sorted
+        # device prefix reshaped onto the one (tp,) axis
+        self.mesh = build_mesh(((TP_AXIS, self.tp_size),), self.devices)
         self.num_layers = self.cfg.num_hidden_layers
         self.pool_spec = P(TP_AXIS, None, None, None)
         self.model = model

@@ -381,6 +381,96 @@ class TestCollectiveMesh:
         """, rule="COLLECTIVE-MESH")
         assert fs == []
 
+    # ---- the ZeRO reduce-scatter / all-gather idiom (ISSUE 16) -------
+    # parallel/mesh.py builds its ordered collectives out of
+    # jax.lax.all_gather + fixed-order sums; the sharded update in
+    # parallel/zero.py gathers updated param slices back with the same
+    # primitive. These fixtures pin that the rule sees through the
+    # idiom: gathers/scatters on a declared dp axis are clean, a stale
+    # axis in either half of the exchange fires.
+
+    def test_allgather_on_declared_dp_axis_is_clean(self):
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            DP_AXIS = "dp"
+            def ordered_psum(x):
+                # all-gather then fixed-shard-order sum: the ordered
+                # (bit-deterministic) allreduce idiom
+                chunks = jax.lax.all_gather(x, DP_AXIS)
+                total = chunks[0]
+                for i in range(1, 4):
+                    total = total + chunks[i]
+                return total
+            def build(devs):
+                mesh = Mesh(devs, axis_names=("dp", "tp"))
+                return shard_map(ordered_psum, mesh=mesh,
+                                 in_specs=P("dp"), out_specs=P("dp"))
+        """, rule="COLLECTIVE-MESH")
+        assert fs == []
+
+    def test_allgather_stale_axis_fires(self):
+        # the all-gather half of the exchange against an axis the mesh
+        # never declared: wrong values, no error, once check_rep is off
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def gather_params(x):
+                return jax.lax.all_gather(x, "sharding")
+            def build(devs):
+                mesh = Mesh(devs, axis_names=("dp", "tp"))
+                return shard_map(gather_params, mesh=mesh,
+                                 in_specs=P("dp"), out_specs=P("dp"))
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [6]
+        assert "'sharding'" in fs[0].message
+        assert "all_gather" in fs[0].message
+
+    def test_psum_scatter_stale_axis_fires(self):
+        # the reduce-scatter half: a typo'd module constant resolves and
+        # is checked against the declared axes
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            GRAD_AXIS = "data"
+            def shard_grads(g):
+                return jax.lax.psum_scatter(g, GRAD_AXIS)
+            def build(devs):
+                mesh = Mesh(devs, axis_names=("dp", "tp"))
+                return shard_map(shard_grads, mesh=mesh,
+                                 in_specs=P("dp"), out_specs=P("dp"))
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [7]
+        assert "'data'" in fs[0].message
+
+    def test_parallel_mesh_axis_constants_chase(self, tmp_path):
+        # the substrate layout itself: DP_AXIS/TP_AXIS live in one
+        # module, the ZeRO step imports them — constants chase through
+        # the from-import and both halves of the exchange stay clean
+        write_pkg(str(tmp_path), {
+            "pkg/__init__.py": "",
+            "pkg/mesh.py": 'DP_AXIS = "dp"\nTP_AXIS = "tp"\n',
+            "pkg/zero.py": """
+                import jax
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import Mesh, PartitionSpec as P
+                from pkg.mesh import DP_AXIS, TP_AXIS
+                def step(g):
+                    mine = jax.lax.psum_scatter(g, DP_AXIS)
+                    return jax.lax.all_gather(mine, DP_AXIS)
+                def build(devs):
+                    mesh = Mesh(devs, axis_names=("dp", "tp"))
+                    return shard_map(step, mesh=mesh, in_specs=P("dp"),
+                                     out_specs=P("dp"))
+            """,
+        })
+        fs = analysis.run_paths([str(tmp_path)], root=str(tmp_path),
+                                rules=[analysis.get_rule("COLLECTIVE-MESH")])
+        assert fs == []
+
 
 # ---------------------------------------------------------------------------
 # METRIC-CARDINALITY

@@ -427,6 +427,34 @@ def _run_serving_tp(on_tpu: bool) -> dict:
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
+def _run_serving_spec(on_tpu: bool) -> dict:
+    """Speculative-decoding phase: model-free n-gram drafts on vs off
+    at horizon 1/8 over repetitive and random prompts — accept rate,
+    emitted tokens per target step, greedy-stream parity. tok/s is an
+    expected null on CPU (verify flops run serially); the CPU-true
+    signal is tokens_per_target_step > 1 on repetitive traffic.
+    Non-fatal like the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_spec_phase(model, cfg, on_tpu)
+        rep, rnd = out["repetitive"]["h8"], out["random"]["h8"]
+        _log(f"phase=serving_spec: repetitive h8 "
+             f"a={rep['on'].get('accept_rate')} "
+             f"t/s={rep['on'].get('tokens_per_target_step')} "
+             f"({rep['off']['tok_s']} -> {rep['on']['tok_s']} tok/s), "
+             f"random h8 a={rnd['on'].get('accept_rate')} "
+             f"t/s={rnd['on'].get('tokens_per_target_step')}, "
+             f"parity_ok={rep['parity_ok'] and rnd['parity_ok']}")
+        if not (rep["parity_ok"] and rnd["parity_ok"]):
+            _log("phase=serving_spec: WARN greedy spec stream diverged "
+                 "from non-speculative decoding")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_spec: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
 def _run_serving_faults(on_tpu: bool) -> dict:
     """Seeded chaos serving phase: the workload re-runs under a
     FaultInjector schedule (transient dispatch faults, periodic alloc
@@ -909,6 +937,11 @@ def bench_child() -> None:
     _enter_phase("serving_tp", 400.0)
     serving_tp = _run_serving_tp(on_tpu)
 
+    # speculative-decoding phase: accept rate + tokens/target-step,
+    # greedy parity; tok/s null on CPU by design
+    _enter_phase("serving_spec", 400.0)
+    serving_spec = _run_serving_spec(on_tpu)
+
     # seeded chaos phase: fault-injected run vs fault-free parity
     _enter_phase("serving_faults", 400.0)
     serving_faults = _run_serving_faults(on_tpu)
@@ -1073,6 +1106,7 @@ def bench_child() -> None:
                 "serving_prefix": serving_prefix,
                 "serving_decode": serving_decode,
                 "serving_tp": serving_tp,
+                "serving_spec": serving_spec,
                 "serving_faults": serving_faults,
                 "serving_chunked": serving_chunked,
                 "serving_ragged": serving_ragged,

@@ -74,6 +74,15 @@ DEFAULT_HOT_MODULES: Dict[str, FrozenSet[str]] = {
         {"ordered_psum", "ordered_psum_scatter"}),
     "parallel/zero.py": frozenset(
         {"_accumulated_grads", "_replicated_update", "_sharded_update"}),
+    # ISSUE 17: the speculative decoder's host-side paths — draft
+    # proposal + buffer packing run BETWEEN two dispatches of every
+    # spec block (drafts come from host request state), and the drain's
+    # emit parsing runs inside THE one sync per block. A device read in
+    # any of them would serialize the async decode pipeline exactly
+    # like one in the scheduler. Construction-time probes (SpecConfig
+    # validation) are cold and deliberately out of scope.
+    "serving/spec.py": frozenset(
+        {"propose_drafts", "build_draft_buffer", "parse_emitted_row"}),
 }
 _SYNC_METHOD_TAILS = {"item", "tolist", "block_until_ready"}
 _SYNC_CHAINS = {

@@ -65,11 +65,11 @@ class StaleCaptureRule(Rule):
 
         for info in traced_functions(module):
             fn = info.node
-            body = getattr(fn, "body", None)
-            if body is None:  # Lambda
-                body_nodes = list(ast.walk(fn.body))
-            else:
+            body = fn.body
+            if isinstance(body, list):
                 body_nodes = [n for stmt in body for n in ast.walk(stmt)]
+            else:  # Lambda: .body is a single expression, not a list
+                body_nodes = list(ast.walk(body))
             for n in body_nodes:
                 if (isinstance(n, ast.Attribute)
                         and isinstance(n.value, ast.Name)

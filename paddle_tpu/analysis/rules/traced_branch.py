@@ -70,9 +70,9 @@ class TracedBranchRule(Rule):
         hits: List[Tuple[int, str]] = []
         aliases = module.jax_aliases
         for info in traced_functions(module):
-            body = getattr(info.node, "body", None)
-            if body is None:
-                continue  # a Lambda cannot contain statements
+            body = info.node.body
+            if not isinstance(body, list):
+                continue  # a Lambda body is one expression, no statements
             tainted: Set[str] = set()
             for stmt in body:
                 for node in ast.walk(stmt):

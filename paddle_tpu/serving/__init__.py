@@ -63,7 +63,17 @@ decoder models (LLaMA, GPT) with:
   kernels. `tp_quantized_allreduce=True` swaps the row-parallel psum for
   an EQuARX-style block-scaled int8 all-reduce. fp32/bf16 stay bit-exact
   and import zero quantization code; int8/fp8 carry a bounded-error
-  parity contract (tests/test_quant.py).
+  parity contract (tests/test_quant.py);
+- `spec`: speculative decoding — `ServingEngine(spec_config=
+  SpecConfig(...))` proposes model-free drafts (n-gram prompt-lookup
+  over the request's own stream, or a read-only prefix-cache radix
+  probe) and verifies up to `lookahead` of them per target pass INSIDE
+  the fused decode/ragged executables, with on-device rejection
+  sampling that exactly preserves the target distribution: greedy
+  streams are bit-identical to non-speculative decoding, stochastic
+  streams distribution-correct. Pages charge the worst case
+  (horizon × (1+lookahead)) and revert after each drain; spec-off
+  engines import zero spec code (raise-on-touch pin).
 
 See README.md "paddle_tpu.serving" for knobs and parity notes.
 """
@@ -103,6 +113,11 @@ _TP_EXPORTS = ("TPContext", "validate_tp_config", "tp_device_order")
 _QUANT_EXPORTS = ("KVQuantSpec", "resolve_kv_dtype", "quantize_tokens",
                   "dequantize", "quantized_psum", "kv_pool_bytes")
 
+# spec exports are equally lazy: a spec-off engine (the default) must
+# never import serving.spec — same raise-on-touch pin
+_SPEC_EXPORTS = ("SpecConfig", "propose_drafts", "build_draft_buffer",
+                 "parse_emitted_row")
+
 
 def __getattr__(name):
     if name in _TP_EXPORTS:
@@ -113,6 +128,10 @@ def __getattr__(name):
         from . import quant
 
         return getattr(quant, name)
+    if name in _SPEC_EXPORTS:
+        from . import spec
+
+        return getattr(spec, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -134,4 +153,6 @@ __all__ = [
     "NULL_PAGE", "PAD_TOKEN",
     "KVQuantSpec", "resolve_kv_dtype", "quantize_tokens", "dequantize",
     "quantized_psum", "kv_pool_bytes",
+    "SpecConfig", "propose_drafts", "build_draft_buffer",
+    "parse_emitted_row",
 ]

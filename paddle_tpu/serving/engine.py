@@ -151,7 +151,7 @@ class ServingObs:
     (tests/test_serving.py pins that with a raise-on-touch guard)."""
 
     FAMILIES = ("prefill", "prefill_offset", "prefill_chunked", "decode",
-                "ragged", "sample")
+                "ragged", "spec", "sample")
 
     def __init__(self, registry: MetricsRegistry):
         self.registry = registry
@@ -248,6 +248,14 @@ class ServingObs:
         # engine runs with tp_size>1 — None means zero TP metrics work
         self.tp_collective = None
         self.tp_free_pages = None
+        # speculative-decoding handles, bound by bind_spec() only when
+        # the engine runs with spec_config — None means zero spec
+        # metrics work (the enable_metrics=False discipline)
+        self.spec_drafted = None
+        self.spec_accepted = None
+        self.spec_wasted = None
+        self.spec_target_steps = None
+        self.spec_tokens_per_step = None
 
     def bind_tp(self, tp_size: int) -> None:
         """TP observability (ISSUE 10): the measured all-reduce latency
@@ -289,6 +297,32 @@ class ServingObs:
                     "quantize->dequantize RMS relative error, one-shot "
                     "construction-time probe on gaussian K/V"
                     ).set(rms_error)
+
+    def bind_spec(self) -> None:
+        """Speculative-decoding observability (ISSUE 17): drafted /
+        accepted / wasted draft-token counters, the target-model pass
+        counter their accept-rate divides into, and the per-request
+        tokens-per-target-step histogram — the multiplier speculation
+        exists to raise (1.0 = non-speculative; the goodput interplay
+        shows up through the existing SLO plane, whose TPOT samples
+        simply arrive in bigger per-block bursts)."""
+        c = self.registry.counter
+        self.spec_drafted = c(
+            "serving_spec_drafted_tokens_total",
+            "draft tokens submitted to fused verification")
+        self.spec_accepted = c(
+            "serving_spec_accepted_tokens_total",
+            "draft tokens accepted by rejection sampling")
+        self.spec_wasted = c(
+            "serving_spec_wasted_tokens_total",
+            "draft tokens rejected (verified but not emitted)")
+        self.spec_target_steps = c(
+            "serving_spec_target_steps_total",
+            "target-model verify passes over speculative rows")
+        self.spec_tokens_per_step = self.registry.histogram(
+            "serving_spec_tokens_per_target_step",
+            "tokens emitted per target-model pass, one sample per "
+            "request per drained speculative block")
 
     # --------------------------------------------------- scheduler hooks
     def enqueued(self, req) -> None:
@@ -339,6 +373,7 @@ class ServingEngine:
                  kv_dtype: str = "fp32",
                  enable_prefix_caching: bool = False,
                  decode_horizon: int = 8,
+                 spec_config=None,
                  enable_chunked_prefill: bool = False,
                  prefill_chunk_tokens: int = 256,
                  max_num_batched_tokens: Optional[int] = None,
@@ -417,6 +452,23 @@ class ServingEngine:
         self.decode_horizon = int(decode_horizon)
         if self.decode_horizon < 1:
             raise ValueError("decode_horizon must be >= 1")
+        # speculative decoding (ISSUE 17): model-free drafts (n-gram
+        # prompt-lookup / prefix-cache continuation) verified inside the
+        # fused decode/ragged executables with on-device rejection
+        # sampling. The import stays inside the branch: a spec-off
+        # engine runs ZERO spec code (raise-on-touch pinned in
+        # tests/test_spec.py), and its non-spec streams are byte
+        # identical to pre-spec engines
+        if spec_config is not None:
+            from . import spec as _spec_module
+
+            self._spec_mod = _spec_module
+            self.spec_config = spec_config.validate()
+        else:
+            self._spec_mod = None
+            self.spec_config = None
+        self._spec_lookahead = (self.spec_config.lookahead
+                                if self.spec_config is not None else 0)
         # chunked prefill (Sarathi-Serve): prompts run in page-aligned
         # chunks co-scheduled with decode under a per-step token budget.
         # Off by default; when on, the chunk width must be a positive
@@ -433,10 +485,12 @@ class ServingEngine:
                     f"be a positive multiple of page_size ({page_size})")
             if max_num_batched_tokens is None:
                 # default: one full chunk always fits alongside a full
-                # decode batch (decoders charge a block's worst case)
+                # decode batch (decoders charge a block's worst case —
+                # under speculation that is horizon × (1+lookahead))
                 max_num_batched_tokens = (
                     self.prefill_chunk_tokens
-                    + max_batch_size * self.decode_horizon)
+                    + max_batch_size * self.decode_horizon
+                    * (1 + self._spec_lookahead))
             self.max_num_batched_tokens = int(max_num_batched_tokens)
             if self.max_num_batched_tokens < self.prefill_chunk_tokens:
                 raise ValueError(
@@ -492,6 +546,8 @@ class ServingEngine:
                 rms = measure_roundtrip_error(c.quant_spec, c.head_dim)
             self._obs.bind_kv_pool(c.kv_dtype, c.pool_bytes, fp32_bytes,
                                    rms)
+        if self._obs is not None and self.spec_config is not None:
+            self._obs.bind_spec()
         # SLO accounting (ISSUE 13): per-request-class TTFT/TPOT targets
         # feeding windowed attainment gauges + a goodput counter. Rides
         # on the metrics registry, so it requires one; with no classes
@@ -573,7 +629,8 @@ class ServingEngine:
                                    self.prefill_chunk_tokens,
                                    max_num_batched_tokens=
                                    self.max_num_batched_tokens,
-                                   ragged_steps=self.enable_ragged_step)
+                                   ragged_steps=self.enable_ragged_step,
+                                   spec_lookahead=self._spec_lookahead)
         self.params, self.buffers = extract_state(model)
         if self._tp is not None:
             self.params = self._tp.shard_params(self.params)
@@ -609,7 +666,7 @@ class ServingEngine:
         self._exec_shapes: Dict[str, set] = {
             "prefill": set(), "prefill_offset": set(),
             "prefill_chunked": set(), "decode": set(), "ragged": set(),
-            "sample": set()}
+            "spec": set(), "sample": set()}
         # measure this sub-mesh's all-reduce latency ONCE at construction
         # (a few samples of the decode-step payload shape) — blocking on
         # a probe per step would measure device-queue time, not the
@@ -887,6 +944,15 @@ class ServingEngine:
             # stretch where every running request is still mid-prefill
             # with nobody decode-ready — resets the gap clock
             self._last_decode_dispatch_t = None
+        if self._pending is not None and self._pending.get("kind") == "spec":
+            # A spec block's drain reverts its worst-case page charge
+            # (`revert_spec_pages`), so it must run BEFORE schedule()
+            # charges the NEXT block's worst case — draining after would
+            # free pages the new block's table already needs covered,
+            # silently sinking its KV writes into the null page. The
+            # early drain costs nothing: spec blocks never chain on
+            # device carries, so _spec_decode would sync here anyway.
+            self._spill.extend(self._drain_pending())
         t_sched = time.perf_counter()
         decision = self.scheduler.schedule()   # drain_hook may spill here
         if self._obs is not None:
@@ -902,7 +968,7 @@ class ServingEngine:
         if decision.kind == "prefill":
             return spilled + self._prefill(decision.prefill)
         if decision.kind == "decode":
-            return spilled + self._decode(decision.decode)
+            return spilled + self._decode_path(decision.decode)
         if decision.kind == "ragged":
             return spilled + self._ragged_step(decision)
         if decision.kind == "mixed":
@@ -918,7 +984,7 @@ class ServingEngine:
         ordinary pending-drain path; intermediate chunks sync nothing."""
         events: List[Tuple[int, int]] = []
         if decision.decode:
-            events.extend(self._decode(decision.decode))
+            events.extend(self._decode_path(decision.decode))
         elif self._pending is not None:
             # belt: every pending block's requests are running decoders,
             # so an empty decode batch should imply no pending block
@@ -1397,16 +1463,23 @@ class ServingEngine:
         if not chunks:
             # every chunk went stale (finalized/preempted during the
             # drain): fall through to the plain decode pipeline
-            return events + (self._decode(decode) if decode else [])
+            return events + (self._decode_path(decode) if decode else [])
+        spec_on = self.spec_config is not None
+        L = self._spec_lookahead
+        # a spec ragged step's decode rows can emit 1 (iteration 0) +
+        # (horizon-1) × (1+lookahead) tokens; the in-flight bound (the
+        # only thing build_ragged_inputs' horizon feeds) scales with it
+        cap_horizon = (1 + (self.decode_horizon - 1) * (1 + L)
+                       if spec_on else self.decode_horizon)
         batch = build_ragged_inputs(
             decode, chunks, buckets=self.token_buckets,
-            max_batch=self.max_batch_size, horizon=self.decode_horizon,
+            max_batch=self.max_batch_size, horizon=cap_horizon,
             page_size=self.page_size, max_pages=self.max_pages_per_seq)
         if batch is None:
             return events
-        self._note_exec("ragged",
+        self._note_exec("spec" if spec_on else "ragged",
                         (batch.t_bucket, self.max_batch_size,
-                         self.decode_horizon, self.cache.num_pages,
+                         self.decode_horizon, L, self.cache.num_pages,
                          self.max_pages_per_seq))
         page_tables = self.cache.page_table_array(
             batch.page_lists, self.max_pages_per_seq)
@@ -1415,19 +1488,47 @@ class ServingEngine:
                    * (self.max_batch_size - len(batch.reqs)))
         key_data = jnp.stack(kds)
         rids = tuple(r.request_id for r in batch.reqs)
+        if spec_on:
+            # drafts for the decode rows only (rows 0..d-1 of the flat
+            # batch); chunk rows stay PAD — a final chunk emits its one
+            # iteration-0 token and parks, so drafts could never land
+            dbuf = self._spec_mod.build_draft_buffer(
+                decode, self.max_batch_size,
+                self.decode_horizon * (1 + L), self.spec_config,
+                self.prefix_cache)
 
         def dispatch():
-            out = self._ragged_jit(batch.t_bucket)(
-                self.params, self.buffers, jnp.asarray(batch.flat_ids),
-                self.cache.pools, page_tables,
-                jnp.asarray(batch.flat_pos), jnp.asarray(batch.row_ids),
-                jnp.asarray(batch.last_idx), jnp.asarray(batch.tokens),
-                jnp.asarray(batch.positions), key_data,
-                jnp.asarray(batch.temps), jnp.asarray(batch.top_ks),
-                jnp.asarray(batch.top_ps), jnp.asarray(batch.eos_ids),
-                jnp.asarray(batch.remaining),
-                jnp.asarray(batch.decode_mask),
-                jnp.asarray(batch.final_mask))
+            if spec_on:
+                out = self._spec_ragged_jit(batch.t_bucket)(
+                    self.params, self.buffers,
+                    jnp.asarray(batch.flat_ids), self.cache.pools,
+                    page_tables, jnp.asarray(dbuf),
+                    jnp.asarray(batch.flat_pos),
+                    jnp.asarray(batch.row_ids),
+                    jnp.asarray(batch.last_idx),
+                    jnp.asarray(batch.tokens),
+                    jnp.asarray(batch.positions), key_data,
+                    jnp.asarray(batch.temps), jnp.asarray(batch.top_ks),
+                    jnp.asarray(batch.top_ps),
+                    jnp.asarray(batch.eos_ids),
+                    jnp.asarray(batch.remaining),
+                    jnp.asarray(batch.decode_mask),
+                    jnp.asarray(batch.final_mask))
+            else:
+                out = self._ragged_jit(batch.t_bucket)(
+                    self.params, self.buffers,
+                    jnp.asarray(batch.flat_ids), self.cache.pools,
+                    page_tables, jnp.asarray(batch.flat_pos),
+                    jnp.asarray(batch.row_ids),
+                    jnp.asarray(batch.last_idx),
+                    jnp.asarray(batch.tokens),
+                    jnp.asarray(batch.positions), key_data,
+                    jnp.asarray(batch.temps), jnp.asarray(batch.top_ks),
+                    jnp.asarray(batch.top_ps),
+                    jnp.asarray(batch.eos_ids),
+                    jnp.asarray(batch.remaining),
+                    jnp.asarray(batch.decode_mask),
+                    jnp.asarray(batch.final_mask))
             self.cache.pools = out[1]
             return out
 
@@ -1447,7 +1548,7 @@ class ServingEngine:
                 [r for r in batch.reqs if r.status == "running"], err,
                 "ragged")
             return events
-        emitted, pools, key_out = out
+        emitted, pools, key_out = out[0], out[1], out[2]
         for req, n in zip(batch.reqs, batch.incr):
             req.inflight += n
         now = time.perf_counter()
@@ -1485,6 +1586,10 @@ class ServingEngine:
                 "incr": list(batch.incr), "emitted": emitted,
                 "key_data": key_out, "t0": t0,
             }
+            if spec_on:
+                self._pending["spec_stats"] = out[3]
+                self._pending["windows"] = (
+                    (1,) + (L + 1,) * (self.decode_horizon - 1))
         # else: intermediate chunks only — nothing can emit and no key
         # state moved, so dropping the record outright saves a drain
         # (and its host sync) that would deliver zero tokens
@@ -1681,6 +1786,151 @@ class ServingEngine:
             return events_prev + self._drain_record(prev)
         return events_prev
 
+    # --------------------------------------------------------- speculative
+    def _decode_path(self, reqs: Sequence[Request]) -> List[Tuple[int, int]]:
+        """Route a decode batch to the speculative block when spec is
+        on; the spec-off path is the unchanged `_decode` (byte-identical
+        streams, zero spec code executed)."""
+        if self.spec_config is not None:
+            return self._spec_decode(reqs)
+        return self._decode(reqs)
+
+    def _spec_block_jit(self, horizon: int):
+        """ONE fused speculative decode-block executable per (horizon,
+        lookahead): `horizon` verify windows, each a (b, 1+lookahead)
+        target pass + on-device rejection sampling + the decode body's
+        EOS/budget masking (spec.make_spec_decode_fn)."""
+        tp = self._tp
+        L = self.spec_config.lookahead
+        key = (("spec", horizon, L, self.page_size)
+               + (tp.jit_key if tp is not None else ()))
+        if key not in self._jit_cache:
+            model = self.model if tp is None else tp.shard_model
+            fn = self._spec_mod.make_spec_decode_fn(
+                model, horizon=horizon, lookahead=L,
+                page_size=self.page_size)
+            if tp is not None:
+                fn = tp.wrap_spec_exec(fn)
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(3,))
+        return self._jit_cache[key]
+
+    def _spec_ragged_jit(self, t_bucket: int):
+        """The ragged mixed-step executable with speculation fused in:
+        iteration 0 is the plain flat forward (chunk rows need it),
+        the remaining horizon-1 iterations are verify windows over the
+        decode rows (spec.make_spec_ragged_fn)."""
+        tp = self._tp
+        L = self.spec_config.lookahead
+        key = (("spec_ragged", t_bucket, self.decode_horizon, L,
+                self.max_batch_size, self.page_size)
+               + (tp.jit_key if tp is not None else ()))
+        if key not in self._jit_cache:
+            model = self.model if tp is None else tp.shard_model
+            fn = self._spec_mod.make_spec_ragged_fn(
+                model, horizon=self.decode_horizon, lookahead=L,
+                page_size=self.page_size)
+            if tp is not None:
+                fn = tp.wrap_spec_ragged_exec(fn)
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(3,))
+        return self._jit_cache[key]
+
+    def _spec_decode(self, reqs: Sequence[Request]) -> List[Tuple[int, int]]:
+        """Speculative decode block (ISSUE 17). Structurally `_decode`
+        with two differences: drafts are proposed from HOST request
+        state, so the pending block always drains FIRST — spec blocks
+        never chain on device carries (async overlap is preserved in
+        the other direction: this block's record drains under the NEXT
+        dispatch) — and the block can emit up to horizon×(1+lookahead)
+        tokens per row, whose worst-case page charge the drain reverts
+        down to actual acceptance via `revert_spec_pages`."""
+        events = self._drain_pending()
+        t_in = time.perf_counter()
+        reqs = [r for r in reqs if r.status == "running"]
+        if not reqs:
+            return events
+        h = self.decode_horizon
+        L = self.spec_config.lookahead
+        cap_tokens = h * (1 + L)
+        rids = tuple(r.request_id for r in reqs)
+        b = self._decode_rows(len(reqs))
+        self._note_exec("spec", (b, h, L, self.cache.num_pages,
+                                 self.max_pages_per_seq))
+        page_lists: List[Sequence[int]] = [()] * b
+        for i, req in enumerate(reqs):
+            page_lists[i] = req.pages
+        page_tables = self.cache.page_table_array(page_lists,
+                                                  self.max_pages_per_seq)
+        park = overflow_position(self.max_pages_per_seq, self.page_size)
+        tokens = np.zeros((b,), np.int32)
+        positions = np.full((b,), park, np.int32)
+        remaining = np.zeros((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
+        top_ks = np.zeros((b,), np.int32)
+        top_ps = np.ones((b,), np.float32)
+        eos_ids = np.full((b,), PAD_TOKEN, np.int32)
+        kds = []
+        for i, req in enumerate(reqs):
+            tokens[i] = (req.generated[-1] if req.generated
+                         else req.prompt[-1])
+            positions[i] = req.num_tokens - 1
+            remaining[i] = req.max_new_tokens - len(req.generated)
+            sp = req.sampling
+            temps[i], top_ks[i], top_ps[i] = (sp.temperature,
+                                              sp.top_k, sp.top_p)
+            if req.eos_token_id is not None:
+                eos_ids[i] = req.eos_token_id
+            kds.append(self._key_state[req.request_id])
+        kds.extend([jnp.zeros((2,), jnp.uint32)] * (b - len(reqs)))
+        # drafts ride in as one (b, cap) PAD-padded buffer; each verify
+        # window slides its per-row cursor by the emitted count
+        dbuf = self._spec_mod.build_draft_buffer(
+            reqs, b, cap_tokens, self.spec_config, self.prefix_cache)
+        incr = []
+        for req in reqs:
+            cap = req.max_new_tokens - len(req.generated) - req.inflight
+            incr.append(max(min(cap_tokens, cap), 0))
+
+        def dispatch():
+            out = self._spec_block_jit(h)(
+                self.params, self.buffers, jnp.asarray(tokens),
+                self.cache.pools, page_tables, jnp.asarray(dbuf),
+                jnp.asarray(positions), jnp.stack(kds),
+                jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), jnp.asarray(eos_ids),
+                jnp.asarray(remaining))
+            self.cache.pools = out[1]
+            return out
+
+        t0 = time.perf_counter()
+        if self._recorder is not None:
+            self._recorder.record("dispatch", family="spec",
+                                  rows=len(reqs), horizon=h, lookahead=L)
+        with RecordEvent("serving.spec_block"):
+            out, err = self._guarded_call("dispatch", dispatch)
+        if out is None:
+            self._quarantine(
+                [r for r in reqs if r.status == "running"], err, "spec")
+            return events
+        emitted, _pools, _tok, _pos, key_data, _rem, sstats = out
+        for req, n in zip(reqs, incr):
+            req.inflight += n
+        if self._obs is not None:
+            t1 = time.perf_counter()
+            self._obs.step_phase["assemble"].observe(t0 - t_in)
+            self._obs.step_phase["dispatch"].observe(t1 - t0)
+            self._obs.decode_steps.inc()
+            self._obs.dispatches.inc()
+            if self._last_decode_dispatch_t is not None:
+                self._obs.decode_stall.observe(
+                    max(t0 - self._last_decode_dispatch_t, 0.0))
+        self._last_decode_dispatch_t = t0
+        self._pending = {
+            "kind": "spec", "rids": rids, "reqs": list(reqs),
+            "incr": incr, "emitted": emitted, "key_data": key_data,
+            "spec_stats": sstats, "windows": (L + 1,) * h, "t0": t0,
+        }
+        return events
+
     # ---------------------------------------------------------------- drain
     def _drain_for_scheduler(self) -> None:
         """Scheduler drain_hook: the emitted events surface through
@@ -1700,9 +1950,17 @@ class ServingEngine:
         key state from the block's device carries."""
         o = self._obs
         t_in = time.perf_counter()
+        sstats = rec.get("spec_stats")
+        windows = rec.get("windows")
         with RecordEvent("serving.host_drain"):
-            toks, err = self._guarded_call(
-                "drain", lambda: np.asarray(jax.device_get(rec["emitted"])))  # noqa: HOST-SYNC — THE one sync per decode block (PR 3 contract)
+            if sstats is None:
+                toks, err = self._guarded_call(
+                    "drain", lambda: np.asarray(jax.device_get(rec["emitted"])))  # noqa: HOST-SYNC — THE one sync per decode block (PR 3 contract)
+            else:
+                pulled, err = self._guarded_call(
+                    "drain", lambda: jax.device_get((rec["emitted"], rec["spec_stats"])))  # noqa: HOST-SYNC — still THE one sync per block: a spec block's tokens and accept counters come back in a single transfer (PR 3 contract)
+                toks, sstats = (pulled if pulled is not None
+                                else (None, None))
         if toks is None:
             # the block's tokens are unrecoverable: give back the
             # in-flight reservation and isolate exactly the block's
@@ -1726,7 +1984,13 @@ class ServingEngine:
                 continue
             prev_t = req.last_token_t
             k0 = len(events)
-            for t in toks[i]:
+            row = toks[i]
+            if windows is not None:
+                # speculative emit layout: PAD-terminated windows, a
+                # row's later windows restarting after each one — the
+                # parse flattens them back to one PAD-free stream
+                row = self._spec_mod.parse_emitted_row(row, windows)
+            for t in row:
                 t = int(t)
                 if t == PAD_TOKEN:
                     break
@@ -1734,6 +1998,27 @@ class ServingEngine:
                 if req.status != "running":
                     break
             k = len(events) - k0
+            if sstats is not None:
+                d_cnt, a_cnt, s_cnt = (int(v) for v in sstats[i])
+                req.spec_drafted += d_cnt
+                req.spec_accepted += a_cnt
+                req.spec_target_steps += s_cnt
+                req.spec_emitted += k
+                if o is not None and o.spec_drafted is not None:
+                    o.spec_drafted.inc(d_cnt)
+                    o.spec_accepted.inc(a_cnt)
+                    o.spec_wasted.inc(d_cnt - a_cnt)
+                    o.spec_target_steps.inc(s_cnt)
+                    if s_cnt:
+                        o.spec_tokens_per_step.observe(k / s_cnt)
+                    if req.status != "running" and req.spec_target_steps:
+                        acc = (req.spec_accepted
+                               / max(req.spec_drafted, 1))
+                        tps = (req.spec_emitted
+                               / req.spec_target_steps)
+                        o.lifecycle.point(
+                            req.request_id,
+                            f"spec[a={acc:.2f},t/s={tps:.1f}]", now)
             if o is not None and k:
                 # one lifecycle span per request per drained block
                 # (profiler-only: per-token volume must not grow the
@@ -1748,6 +2033,13 @@ class ServingEngine:
                         o.inter_token.observe(per_tok)
                     if self._slo is not None:
                         self._slo.decode_tokens(req.slo_class, per_tok, k)
+        if windows is not None:
+            # roll the speculative worst-case page charge back to what
+            # was actually accepted; the next block's reservation
+            # re-tops through the ordinary _ensure_decode_pages path
+            for req in rec["reqs"]:
+                if req.status == "running":
+                    self.scheduler.revert_spec_pages(req)
         # decode wall time without double-counting overlapped block spans
         start = max(rec["t0"], self._last_drain_t)
         if o is not None:
@@ -1802,11 +2094,16 @@ class ServingEngine:
             if toks is not None:
                 now = time.perf_counter()
                 kd = rec["key_data"]
+                windows = rec.get("windows")
                 for i, req in enumerate(rec["reqs"]):
                     self._key_state[req.request_id] = kd[i]
                     if req.status != "running":
                         continue
-                    for t in toks[i]:
+                    row = toks[i]
+                    if windows is not None:
+                        row = self._spec_mod.parse_emitted_row(
+                            row, windows)
+                    for t in row:
                         t = int(t)
                         if t == PAD_TOKEN:
                             break
@@ -2181,6 +2478,30 @@ class ServingEngine:
         s["max_num_batched_tokens"] = self.max_num_batched_tokens
         if self.prefix_cache is not None:
             s["prefix_cache"] = self.prefix_cache.stats()
+        # speculative decoding (ISSUE 17): derived from request state so
+        # the shape is identical with metrics off (the registry keeps
+        # the same counts under serving_spec_*_total)
+        if self.spec_config is not None:
+            drafted = sum(r.spec_drafted for r in self.requests.values())
+            accepted = sum(r.spec_accepted
+                           for r in self.requests.values())
+            steps = sum(r.spec_target_steps
+                        for r in self.requests.values())
+            emitted = sum(r.spec_emitted for r in self.requests.values())
+            s["spec"] = {
+                "lookahead": self.spec_config.lookahead,
+                "method": self.spec_config.method,
+                "drafted_tokens": drafted,
+                "accepted_tokens": accepted,
+                "wasted_tokens": drafted - accepted,
+                "accept_rate": accepted / drafted if drafted else 0.0,
+                "target_steps": steps,
+                "tokens_per_target_step": (emitted / steps
+                                           if steps else 0.0),
+                "tokens_per_step": (
+                    o.spec_tokens_per_step.summary()
+                    if o is not None else Histogram.empty_summary()),
+            }
         per_req = {}
         for rid, req in self.requests.items():
             per_req[rid] = {

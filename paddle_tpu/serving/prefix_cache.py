@@ -145,6 +145,49 @@ class PrefixCache:
             node = child
         return n
 
+    def continuation(self, tokens: Sequence[int],
+                     max_tokens: int) -> List[int]:
+        """Predict up to `max_tokens` tokens CONTINUING `tokens`, from
+        cached streams that share its prefix — the speculative decoder's
+        radix draft probe (ISSUE 17). Read-only with `peek` discipline:
+        no references, no LRU ticks, no lookup counts, no fault sites —
+        drafting must never perturb cache state or eviction order.
+
+        Walk the full-page chunks of `tokens` down the tree; at the
+        deepest match, the remainder r (the partial last page, possibly
+        empty) selects a child whose chunk starts with r, and that
+        child's chunk past r — then min-key descendants while more
+        tokens are wanted — is the draft. Ambiguity (several matching
+        children) resolves to the smallest chunk key, so drafts are
+        deterministic for a given tree state."""
+        if max_tokens <= 0:
+            return []
+        ps = self.page_size
+        node = self._root
+        k = len(tokens) // ps
+        for i in range(k):
+            child = node.children.get(
+                tuple(tokens[i * ps:(i + 1) * ps]))
+            if child is None:
+                return []
+            node = child
+        r = tuple(tokens[k * ps:])
+        out: List[int] = []
+        if r:
+            child = min(
+                (c for c in node.children
+                 if len(c) > len(r) and c[:len(r)] == r),
+                default=None)
+            if child is None:
+                return []
+            out.extend(child[len(r):])
+            node = node.children[child]
+        while len(out) < max_tokens and node.children:
+            chunk = min(node.children)
+            out.extend(chunk)
+            node = node.children[chunk]
+        return out[:max_tokens]
+
     def record(self, total_tokens: int, hit_tokens: int) -> None:
         """Count one committed lookup (called on successful admission, so
         a deferred-and-retried request isn't double counted)."""

@@ -140,6 +140,15 @@ class Request:
     # registered classes at add_request time; the scheduler never reads
     # it — it rides along for the engine's latency observation sites
     slo_class: Optional[str] = None
+    # speculative decoding accounting (ISSUE 17), filled by the engine's
+    # drain: draft tokens verified / accepted, target-model passes that
+    # scored this row, and tokens emitted by speculative blocks — the
+    # per-request accept-rate and tokens-per-target-step the lifecycle
+    # lanes and stats()["spec"] report. Zero when spec is off.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_target_steps: int = 0
+    spec_emitted: int = 0
 
     # metrics (perf_counter timestamps, filled by the engine)
     arrival_t: float = dataclasses.field(default_factory=time.perf_counter)
@@ -220,13 +229,21 @@ class Scheduler:
                  max_prefill_tokens: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
                  max_num_batched_tokens: Optional[int] = None,
-                 ragged_steps: bool = False):
+                 ragged_steps: bool = False,
+                 spec_lookahead: int = 0):
         self.allocator = allocator
         self.page_size = page_size
         self.max_batch_size = max_batch_size
         self.max_pages_per_seq = max_pages_per_seq
         self.prefix_cache = prefix_cache
         self.decode_horizon = max(int(decode_horizon), 1)
+        # speculative decoding (ISSUE 17): a decode block can emit up to
+        # horizon × (1 + lookahead) tokens, so every page-accounting
+        # site that used to charge decode_horizon charges block_tokens —
+        # the WORST case, reverted down to actual acceptance by
+        # revert_spec_pages after each drain. Identity when spec is off.
+        self.spec_lookahead = max(int(spec_lookahead), 0)
+        self.block_tokens = self.decode_horizon * (1 + self.spec_lookahead)
         # bounded waiting queue: add() past this raises EngineOverloaded
         # (backpressure to the caller); None = unbounded, as before
         self.max_waiting = max_waiting
@@ -356,7 +373,10 @@ class Scheduler:
         # into a fresh page; page 0 (null) is outside the allocator, so
         # no off-by-one hides there either).
         # tests/test_serving.py::TestAdmissionPageAccounting pins this.
-        first_block = max(1, min(self.decode_horizon,
+        # Under speculation a block emits up to block_tokens tokens, so
+        # the first-block charge scales accordingly (worst case; the
+        # unaccepted remainder is reverted after the drain).
+        first_block = max(1, min(self.block_tokens,
                                  req.max_new_tokens - 1))
         return pages_for(len(req.prompt) + first_block, self.page_size)
 
@@ -370,9 +390,30 @@ class Scheduler:
         assumed = req.num_tokens + req.inflight
         rem = max(req.max_new_tokens - len(req.generated) - req.inflight,
                   0)
-        want = max(assumed - 1 + min(self.decode_horizon, rem),
+        want = max(assumed - 1 + min(self.block_tokens, rem),
                    req.num_tokens)
         return pages_for(want, self.page_size)
+
+    def revert_spec_pages(self, req: Request) -> int:
+        """Roll back the speculative block's WORST-CASE page charge to
+        what the drain actually accepted (ISSUE 17). The block was
+        admitted holding pages for `block_tokens` emits per row; after
+        the drain, host state (`num_tokens`) plus any still-undrained
+        in-flight bound is the truth — tail pages past it go back to
+        the pool. The popped tail can never be shared prefix-cache
+        pages: those cover at most `cached_tokens <= len(prompt) <=
+        num_tokens` tokens, and the kept count never drops below
+        pages_for(num_tokens) (nor below the chunked-prefill cursor's
+        charge, which `check_consistency` audits). Returns the number
+        of pages released."""
+        keep = max(
+            pages_for(req.num_tokens + req.inflight, self.page_size),
+            pages_for(req.num_computed_tokens, self.page_size))
+        freed = 0
+        while len(req.pages) > keep:
+            self.allocator.free(req.pages.pop())
+            freed += 1
+        return freed
 
     def _alloc_n(self, n: int) -> Optional[List[int]]:
         """All-or-nothing alloc that reclaims unreferenced prefix-cache
@@ -593,7 +634,7 @@ class Scheduler:
             self._ensure_decode_pages()      # may drain and/or preempt
             decode = [r for r in self.running
                       if r.prefill_done][:self.max_batch_size]
-            budget -= self.decode_horizon * len(decode)
+            budget -= self.block_tokens * len(decode)
         chunks: List[ChunkTask] = []
         for req in list(self.running):
             if budget < chunk:

@@ -1,0 +1,469 @@
+"""Speculative decoding: model-free drafts + fused batched verification.
+
+Every target-model step of the plain decode block emits exactly one
+token per row. This module multiplies tokens-per-target-step without a
+draft MODEL (arxiv 2211.17192's separate drafter): drafts come from the
+request's OWN token stream — n-gram prompt-lookup (arxiv 2304.04487:
+the continuation of the most recent earlier occurrence of the trailing
+n-gram in prompt+generated) — and, optionally, from a read-only radix
+probe of the engine's prefix cache (a previously served request that
+shares the current stream's tail predicts its continuation).
+
+Verification is fused INTO the decode/ragged executables: one batched
+target pass over `(b, 1+L)` verify windows — the row's last token plus
+L draft tokens at per-row positions — scores every draft position in a
+single dispatch (the same `_prefill_attention_paged` path chunked
+prefill uses; K/V writes ride the existing page tables). Acceptance is
+the standard rejection-sampling rule, entirely on device:
+
+- greedy rows (temperature 0): accept draft d_i iff it equals the
+  target argmax — the accepted stream is BIT-IDENTICAL to
+  non-speculative decoding;
+- stochastic rows: accept d_i with probability p(d_i) under the
+  target's sampling-adjusted distribution (the draft proposer is a
+  point mass, so min(1, p/q) = p(d_i)); on rejection, resample from p
+  with the refused token removed and renormalized. This provably
+  preserves the target distribution: P(emit t) = p(t)·[t = d] +
+  (1 - p(d)) · p(t)·[t ≠ d]/(1 - p(d)) = p(t).
+
+PRNG discipline: the per-row key chain advances by EXACTLY one split
+per emitted token (the window splits L+1 times and each row adopts the
+chain entry indexed by its emitted count), so greedy streams are
+bit-identical to the non-speculative chain and recovery's
+replay-by-delivered-count stays sound. Rows with no drafts degenerate
+to the plain decode step — same logits slot, same subkey, same sampler.
+
+Rejected-suffix K/V never survives into an attend: a window writes all
+its lanes BEFORE attending, and the next window's lanes re-write every
+position past the accepted frontier before any later query reads them.
+The page-level charge (`horizon × (1+lookahead)` worst case) is
+reverted by the scheduler after each drain (`revert_spec_pages`).
+
+Everything host-side here (draft proposal, draft-buffer packing, the
+drain's emit parsing) is plain python/numpy over host request state —
+it runs between two dispatches, so graftlint's HOST-SYNC rule covers
+this module: no device value may be read in these paths.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.functional import call_functional
+from .attention import advance_positions
+from .kv_cache import pools_from_views, views_from_pools
+
+# engine constants/helpers: safe at module level — the engine imports
+# this module only lazily, inside its spec_config ctor branch
+from .engine import PAD_TOKEN, _sample_batch, _split_rows
+
+__all__ = ["SpecConfig", "propose_drafts", "build_draft_buffer",
+           "parse_emitted_row", "make_spec_decode_fn",
+           "make_spec_ragged_fn"]
+
+_METHODS = ("ngram", "prefix_cache", "combined")
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decoding knobs (`ServingEngine(spec_config=...)`).
+
+    `lookahead` is L: draft tokens verified per target pass (per window;
+    a decode block runs `decode_horizon` windows). The scheduler charges
+    pages for the worst case — `decode_horizon × (1 + lookahead)`
+    tokens per block — and reverts the unaccepted remainder after each
+    drain. `method` picks the proposer: "ngram" (prompt-lookup over the
+    request's own prompt+generated), "prefix_cache" (read-only radix
+    continuation probe), or "combined" (ngram first, radix fallback)."""
+
+    lookahead: int = 4
+    method: str = "ngram"
+    # n-gram match lengths tried longest-first: the trailing k-gram for
+    # k in [ngram_min, ngram_max] is searched in the earlier stream
+    ngram_max: int = 3
+    ngram_min: int = 1
+
+    def validate(self) -> "SpecConfig":
+        if self.lookahead < 1:
+            raise ValueError(
+                f"spec lookahead must be >= 1, got {self.lookahead}")
+        if self.method not in _METHODS:
+            raise ValueError(
+                f"unknown spec method {self.method!r}: expected one of "
+                f"{_METHODS}")
+        if not (1 <= self.ngram_min <= self.ngram_max):
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max, got "
+                f"ngram_min={self.ngram_min} ngram_max={self.ngram_max}")
+        return self
+
+
+# ------------------------------------------------------- draft proposers
+def _ngram_continuation(ctx: List[int], max_tokens: int,
+                        ngram_max: int, ngram_min: int) -> List[int]:
+    """Prompt-lookup drafts: find the most recent EARLIER occurrence of
+    the stream's trailing k-gram (longest k first) and propose the
+    tokens that followed it. Pure python over host ints."""
+    n = len(ctx)
+    for k in range(min(ngram_max, n - 1), ngram_min - 1, -1):
+        tail = ctx[n - k:]
+        for j in range(n - k - 1, -1, -1):
+            if ctx[j:j + k] == tail:
+                cont = ctx[j + k:j + k + max_tokens]
+                if cont:
+                    return cont
+                break   # the only match ends the stream: shorter k
+                        # would match the same spot's suffix
+    return []
+
+
+def propose_drafts(req, cfg: SpecConfig, prefix_cache=None,
+                   max_tokens: Optional[int] = None) -> List[int]:
+    """Up to `max_tokens` (default `cfg.lookahead`) draft tokens
+    continuing `req`'s prompt+generated stream. Host-side and
+    side-effect free: the prefix-cache probe is the read-only
+    `continuation` walk (no refs, no LRU ticks, no fault sites)."""
+    limit = cfg.lookahead if max_tokens is None else max_tokens
+    ctx = list(req.prompt) + list(req.generated)
+    drafts: List[int] = []
+    if cfg.method in ("ngram", "combined"):
+        drafts = _ngram_continuation(ctx, limit, cfg.ngram_max,
+                                     cfg.ngram_min)
+    if not drafts and cfg.method in ("prefix_cache", "combined") \
+            and prefix_cache is not None:
+        drafts = prefix_cache.continuation(ctx, limit)
+    return drafts[:limit]
+
+
+def build_draft_buffer(reqs: Sequence, rows: int, width: int,
+                       cfg: SpecConfig, prefix_cache=None) -> np.ndarray:
+    """The block's (rows, width) draft buffer: row i carries request
+    i's proposed continuation, PAD-padded (PAD lanes verify as invalid
+    and degenerate to plain decode steps). `width` is the block's emit
+    capacity — each verify window slides its cursor forward by the
+    row's emitted count, consuming drafts only while the emitted stream
+    still matches the proposal."""
+    buf = np.full((rows, width), PAD_TOKEN, np.int32)
+    for i, req in enumerate(reqs):
+        d = propose_drafts(req, cfg, prefix_cache, max_tokens=width)
+        if d:
+            buf[i, :len(d)] = d
+    return buf
+
+
+# ---------------------------------------------------------- drain parse
+def parse_emitted_row(row, windows: Tuple[int, ...]) -> List[int]:
+    """One row of a speculative block's emitted buffer -> its token
+    list. The buffer is a sequence of windows of the given widths; each
+    window's emits form a PAD-terminated prefix, and a row that starts
+    a window with PAD was dead for the rest of the block (budgets only
+    run down). Host-side list building — no device reads."""
+    out: List[int] = []
+    i = 0
+    for w in windows:
+        seg = row[i:i + w]
+        i += w
+        if len(seg) == 0 or seg[0] == PAD_TOKEN:
+            break
+        for t in seg:
+            t = int(t)
+            if t == PAD_TOKEN:
+                break
+            out.append(t)
+    return out
+
+
+# ----------------------------------------------------- device-side verify
+def _target_logits(logits, temps, top_ks, top_ps):
+    """The decode sampler's masked, temperature-scaled logits — the
+    EXACT arithmetic of engine._sample_batch up to (but excluding) the
+    categorical draw. softmax of these IS the per-row distribution the
+    sampler draws from, i.e. the distribution the accept/resample rule
+    must preserve."""
+    vocab = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    t_safe = jnp.where(temps > 0.0, temps, 1.0)
+    scaled = logits / t_safe[:, None]
+    k_eff = jnp.where(top_ks > 0, jnp.minimum(top_ks, vocab), vocab)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
+    sorted_m = jnp.sort(masked, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_m, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.minimum(
+        jnp.sum(cum < top_ps[:, None], axis=-1, keepdims=True), vocab - 1)
+    cutoff = jnp.take_along_axis(sorted_m, cutoff_idx, axis=-1)
+    return jnp.where(masked < cutoff, -jnp.inf, masked)
+
+
+def _verify_window(model, params, buffers, pools, page_tables, dbuf,
+                   tokens, positions, remaining, key_data, cursor,
+                   matched, stats, temps, top_ks, top_ps, eos_ids, *,
+                   lookahead: int, page_size: int):
+    """One speculative verify window: a (b, 1+L) target forward at
+    per-row positions, on-device rejection sampling over the L draft
+    lanes, then the decode body's EOS/budget masking unrolled over the
+    up-to-(L+1) emit slots. Returns the advanced carries plus the
+    window's (b, L+1) PAD-terminated emit block.
+
+    Carry semantics: `cursor` indexes the row's progress through the
+    block's draft buffer; `matched` is whether the emitted stream still
+    equals the proposal (a rejection breaks it; later windows then run
+    as draft-free plain steps). The key chain splits L+1 times and each
+    row adopts the entry indexed by its emitted count, so splits ==
+    emitted tokens — the invariant greedy bit-identity and recovery's
+    replay-by-delivered-count both rest on."""
+    L = lookahead
+    b = tokens.shape[0]
+    max_pages = page_tables.shape[1]
+    alive0 = remaining > 0
+
+    # the row's next L drafts plus one peek lane (bonus-slot matching)
+    take = jax.vmap(
+        lambda row, c: jax.lax.dynamic_slice(row, (c,), (L + 1,)))(
+            dbuf, cursor)
+    drafts = take[:, :L]
+    have = matched & alive0
+    valid = have[:, None] & (jnp.cumprod(
+        (drafts != PAD_TOKEN).astype(jnp.int32), axis=1) > 0)
+    v_cnt = jnp.sum(valid.astype(jnp.int32), axis=1)
+
+    # invalid lanes carry token 0: their K/V lands past the accepted
+    # frontier and is re-written by the next window before any query
+    # attends it, and their logits slots are never consumed
+    ids = jnp.concatenate(
+        [tokens[:, None], jnp.where(valid, drafts, 0)], axis=1)
+    views = views_from_pools(pools, page_tables)
+    (logits, new_views), _ = call_functional(
+        model, params, buffers, (Tensor(ids),),
+        kwargs={"caches": views, "start_pos": positions},
+        training=False)
+    pools = pools_from_views(new_views)
+
+    # key chain: L+1 splits up front; per-row adoption at the end keeps
+    # splits == emitted
+    chain = [key_data]
+    subs = []
+    for _ in range(L + 1):
+        nxt_key, sub = _split_rows(chain[-1])
+        chain.append(nxt_key)
+        subs.append(sub)
+
+    # target samples per slot — the plain decode sampler on the slot's
+    # logits with the slot's subkey (slot i of a draft-free row IS the
+    # non-speculative decode step, bit for bit)
+    tgt = [
+        _sample_batch(logits[:, i], subs[i], temps, top_ks,
+                      top_ps).astype(jnp.int32)
+        for i in range(L + 1)
+    ]
+
+    # acceptance per draft lane: greedy = exact argmax match; stochastic
+    # = u < p(d) under the target's sampling-adjusted distribution (the
+    # point-mass draft makes min(1, p/q) = p(d))
+    accepts = []
+    for i in range(L):
+        d_i = jnp.where(valid[:, i], drafts[:, i], 0)
+        p_full = jax.nn.softmax(
+            _target_logits(logits[:, i], temps, top_ks, top_ps), axis=-1)
+        p_d = jnp.take_along_axis(p_full, d_i[:, None], axis=1)[:, 0]
+        u = jax.vmap(jax.random.uniform)(subs[i])
+        ok = jnp.where(temps == 0.0, drafts[:, i] == tgt[i], u < p_d)
+        accepts.append(valid[:, i] & ok)
+    if L:
+        acc = jnp.stack(accepts, axis=1)
+        k_cnt = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1),
+                        axis=1)
+    else:
+        k_cnt = jnp.zeros((b,), jnp.int32)
+
+    # stop slot k: the first rejected lane (resample from p minus the
+    # refused draft) or, with every valid draft accepted, the bonus
+    # sample from the last lane's logits — which for k = v = 0 is the
+    # plain decode step
+    k_idx = k_cnt[:, None]
+    logits_k = jnp.take_along_axis(
+        logits.astype(jnp.float32), k_idx[:, :, None], axis=1)[:, 0]
+    tgt_k = jnp.take_along_axis(jnp.stack(tgt, axis=1), k_idx,
+                                axis=1)[:, 0]
+    sub_data = jnp.stack([jax.random.key_data(s) for s in subs], axis=1)
+    sub_k = jnp.take_along_axis(
+        sub_data, k_idx[:, :, None], axis=1)[:, 0]
+    d_k = jnp.take_along_axis(take, k_idx, axis=1)[:, 0]
+    masked_k = _target_logits(logits_k, temps, top_ks, top_ps)
+    vocab = masked_k.shape[-1]
+    refuse = jnp.clip(d_k, 0, vocab - 1)
+    res_logits = jnp.where(
+        jnp.arange(vocab)[None, :] == refuse[:, None], -jnp.inf,
+        masked_k)
+    # the resample key is fold_in(subkey_k, 1): decoupled from the
+    # accept coin u_k = uniform(subkey_k) that conditioned this branch
+    res_keys = jax.vmap(
+        lambda kd: jax.random.fold_in(jax.random.wrap_key_data(kd), 1))(
+            sub_k)
+    resample = jax.vmap(jax.random.categorical)(
+        res_keys, res_logits).astype(jnp.int32)
+    rejected = k_cnt < v_cnt
+    stop_tok = jnp.where((temps == 0.0) | ~rejected, tgt_k, resample)
+
+    # emit slots 0..L with the decode body's masking arithmetic, one
+    # emitted token at a time (EOS inside an accepted run must cut the
+    # run exactly where non-speculative decoding would)
+    rem = remaining
+    last_tok = tokens
+    m_cnt = jnp.zeros((b,), jnp.int32)
+    emits = []
+    for i in range(L + 1):
+        cand = (jnp.where(i < k_cnt, drafts[:, i], stop_tok)
+                if i < L else stop_tok)
+        can = (rem > 0) & (i <= k_cnt)
+        hit_eos = can & (eos_ids >= 0) & (cand == eos_ids)
+        emits.append(jnp.where(can, cand, jnp.int32(PAD_TOKEN)))
+        rem = jnp.where(can, rem - 1, rem)
+        rem = jnp.where(hit_eos, jnp.int32(0), rem)
+        last_tok = jnp.where(can, cand, last_tok)
+        m_cnt = m_cnt + can.astype(jnp.int32)
+    emit = jnp.stack(emits, axis=1)
+
+    # the stream matches the proposal iff every emitted token did; the
+    # emitted prefix below the stop slot is drafts by construction, so
+    # only an emitted stop token can break the match (against the peek
+    # lane — PAD there compares unequal to any real token)
+    stop_emitted = m_cnt > k_cnt
+    peek = jnp.take_along_axis(take, k_idx, axis=1)[:, 0]
+    matched = matched & (~stop_emitted | (stop_tok == peek))
+    cursor = cursor + m_cnt
+    tokens = last_tok
+    live = rem > 0
+    positions = jnp.where(live, positions + m_cnt,
+                          jnp.int32(max_pages * page_size))
+
+    chain_stack = jnp.stack(chain, axis=1)          # (b, L+2, 2)
+    key_data = jnp.take_along_axis(
+        chain_stack, m_cnt[:, None, None], axis=1)[:, 0]
+
+    stats = stats + jnp.stack(
+        [v_cnt, jnp.minimum(k_cnt, m_cnt), alive0.astype(jnp.int32)],
+        axis=1)
+    return (pools, emit, tokens, positions, rem, key_data, cursor,
+            matched, stats)
+
+
+def make_spec_decode_fn(model, *, horizon: int, lookahead: int,
+                        page_size: int):
+    """The speculative decode-block executable body: `horizon` verify
+    windows inside one lax.scan — the spec analogue of the engine's
+    fused decode block, with the draft buffer riding in and per-row
+    (drafted, accepted, target_steps) counters riding out. Emit layout
+    is `horizon` PAD-terminated windows of width lookahead+1."""
+    L = lookahead
+
+    def spec_block(params, buffers, tokens, pools, page_tables, dbuf,
+                   positions, key_data, temps, top_ks, top_ps, eos_ids,
+                   remaining):
+        b = tokens.shape[0]
+        cursor = jnp.zeros((b,), jnp.int32)
+        matched = jnp.ones((b,), bool)
+        stats = jnp.zeros((b, 3), jnp.int32)
+
+        def body(carry, _):
+            (tokens, pools, positions, key_data, remaining, cursor,
+             matched, stats) = carry
+            (pools, emit, tokens, positions, remaining, key_data,
+             cursor, matched, stats) = _verify_window(
+                model, params, buffers, pools, page_tables, dbuf,
+                tokens, positions, remaining, key_data, cursor, matched,
+                stats, temps, top_ks, top_ps, eos_ids,
+                lookahead=L, page_size=page_size)
+            return (tokens, pools, positions, key_data, remaining,
+                    cursor, matched, stats), emit
+
+        carry = (tokens, pools, positions, key_data, remaining, cursor,
+                 matched, stats)
+        (tokens, pools, positions, key_data, remaining, cursor, matched,
+         stats), emits = jax.lax.scan(body, carry, None, length=horizon)
+        emitted = jnp.transpose(emits, (1, 0, 2)).reshape(
+            b, horizon * (L + 1))
+        return (emitted, pools, tokens, positions, key_data, remaining,
+                stats)
+
+    return spec_block
+
+
+def make_spec_ragged_fn(model, *, horizon: int, lookahead: int,
+                        page_size: int):
+    """The speculative ragged mixed-step body: iteration 0 is the flat
+    forward + one-token postlude of the plain ragged executable,
+    UNCHANGED (chunk rows need the flat path; its sample consumes the
+    draft buffer's first guess as a degenerate zero-draft window), then
+    `horizon-1` verify windows run over the decode rows. Emit layout is
+    one width-1 window followed by horizon-1 windows of width
+    lookahead+1; per-row key selection keeps the plain executable's
+    row-class contract (scan-carried for decode rows, the iteration-0
+    split for final chunks, untouched otherwise)."""
+    L = lookahead
+
+    def spec_ragged(params, buffers, flat_ids, pools, page_tables, dbuf,
+                    flat_pos, row_ids, last_idx, tokens, positions,
+                    key_data, temps, top_ks, top_ps, eos_ids, remaining,
+                    decode_mask, final_mask):
+        max_pages = page_tables.shape[1]
+        key_in = key_data
+        views = views_from_pools(pools, page_tables, row_ids)
+        (logits, new_views), _ = call_functional(
+            model, params, buffers, (Tensor(flat_ids),),
+            kwargs={"caches": views, "start_pos": flat_pos},
+            training=False)
+        pools = pools_from_views(new_views)
+        key_data, subs = _split_rows(key_data)
+        key_split1 = key_data
+        nxt = _sample_batch(logits[0, last_idx], subs, temps,
+                            top_ks, top_ps).astype(jnp.int32)
+        alive = remaining > 0
+        hit_eos = alive & (eos_ids >= 0) & (nxt == eos_ids)
+        emit0 = jnp.where(alive, nxt, jnp.int32(PAD_TOKEN))
+        remaining = jnp.where(alive, remaining - 1, remaining)
+        remaining = jnp.where(hit_eos, jnp.int32(0), remaining)
+        tokens = jnp.where(alive, nxt, tokens)
+        positions = advance_positions(
+            positions, remaining > 0, max_pages, page_size)
+        b = tokens.shape[0]
+        # iteration 0 as a degenerate window: its one sample consumed
+        # the proposer's first guess, so the match state starts there
+        cursor = alive.astype(jnp.int32)
+        matched = jnp.where(alive, nxt == dbuf[:, 0], True)
+        stats = jnp.zeros((b, 3), jnp.int32)
+        stats = stats.at[:, 2].add(alive.astype(jnp.int32))
+
+        def body(carry, _):
+            (tokens, pools, positions, key_data, remaining, cursor,
+             matched, stats) = carry
+            (pools, emit, tokens, positions, remaining, key_data,
+             cursor, matched, stats) = _verify_window(
+                model, params, buffers, pools, page_tables, dbuf,
+                tokens, positions, remaining, key_data, cursor, matched,
+                stats, temps, top_ks, top_ps, eos_ids,
+                lookahead=L, page_size=page_size)
+            return (tokens, pools, positions, key_data, remaining,
+                    cursor, matched, stats), emit
+
+        carry = (tokens, pools, positions, key_data, remaining, cursor,
+                 matched, stats)
+        (tokens, pools, positions, key_data, remaining, cursor, matched,
+         stats), emits = jax.lax.scan(body, carry, None,
+                                      length=horizon - 1)
+        rest = jnp.transpose(emits, (1, 0, 2)).reshape(
+            b, (horizon - 1) * (L + 1))
+        emitted = jnp.concatenate([emit0[:, None], rest], axis=1)
+        key_out = jnp.where(
+            decode_mask[:, None], key_data,
+            jnp.where(final_mask[:, None], key_split1, key_in))
+        return emitted, pools, key_out, stats
+
+    return spec_ragged

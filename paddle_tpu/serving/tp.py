@@ -367,6 +367,44 @@ class TPContext:
                 )(params, buffers, flat_ids, pools, *rest)
         return wrapped
 
+    def wrap_spec_exec(self, fn):
+        """shard_map the speculative decode block
+        `(params, buffers, tokens, pools, *rest) ->
+        (emitted, pools, tokens, positions, key_data, remaining,
+        spec_stats)` — the decode contract plus the per-row accept
+        counters, which like the emitted block are computed from
+        replicated logits on every shard."""
+        param_specs, mesh = self.param_specs, self.mesh
+
+        def wrapped(params, buffers, tokens, pools, *rest):
+            pool_specs = self._pool_specs(pools)
+            return _shard_map(
+                fn, mesh=mesh,
+                in_specs=(param_specs, self._repl_like(buffers), P(),
+                          pool_specs) + tuple(P() for _ in rest),
+                out_specs=(P(), pool_specs, P(), P(), P(), P(), P()),
+                check_rep=False,  # noqa: COLLECTIVE-MESH — pool outputs are per-shard by design (kv-head-sharded pages); rep checking would reject the contract
+                )(params, buffers, tokens, pools, *rest)
+        return wrapped
+
+    def wrap_spec_ragged_exec(self, fn):
+        """shard_map the speculative ragged mixed step
+        `(params, buffers, flat_ids, pools, *rest) ->
+        (emitted, pools, key_out, spec_stats)` — the ragged contract
+        plus the per-row accept counters."""
+        param_specs, mesh = self.param_specs, self.mesh
+
+        def wrapped(params, buffers, flat_ids, pools, *rest):
+            pool_specs = self._pool_specs(pools)
+            return _shard_map(
+                fn, mesh=mesh,
+                in_specs=(param_specs, self._repl_like(buffers), P(),
+                          pool_specs) + tuple(P() for _ in rest),
+                out_specs=(P(), pool_specs, P(), P()),
+                check_rep=False,  # noqa: COLLECTIVE-MESH — pool outputs are per-shard by design (kv-head-sharded pages); rep checking would reject the contract
+                )(params, buffers, flat_ids, pools, *rest)
+        return wrapped
+
     # -------------------------------------------------------- observability
     def collective_seconds(self, samples: int = 3, rows: int = 1
                            ) -> List[float]:

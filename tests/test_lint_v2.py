@@ -605,6 +605,46 @@ class TestStateRevert:
         """, rule="STATE-REVERT")
         assert fs == []
 
+    def test_spec_charge_revert_idiom_is_clean(self):
+        # ISSUE 17: the speculative block's idiom — the worst-case
+        # in-flight charge lands only AFTER the guarded dispatch
+        # succeeds, and the drain's failure branch reverts it — the
+        # exact shape engine._spec_decode/_drain_record ship
+        fs = run("""
+            class Engine:
+                def spec_block(self, reqs, incr):
+                    out = self._guarded_call(self.dispatch)
+                    if out is None:
+                        return []
+                    for req, n in zip(reqs, incr):
+                        req.inflight += n
+                    return out
+
+                def drain(self, rec):
+                    toks = self._guarded_call(self.pull)
+                    if toks is None:
+                        for i, req in enumerate(rec["reqs"]):
+                            req.inflight = max(
+                                req.inflight - rec["incr"][i], 0)
+                        return []
+                    return toks
+        """, rule="STATE-REVERT")
+        assert fs == []
+
+    def test_spec_charge_before_dispatch_fires(self):
+        # the dirty variant: charging the speculative worst case BEFORE
+        # the dispatch with no revert — a quarantined fault would leave
+        # pages reserved for horizon*(1+lookahead) tokens that never ran
+        fs = run("""
+            class Engine:
+                def spec_block(self, reqs, cap_tokens):
+                    for req in reqs:
+                        req.inflight += cap_tokens
+                    out = self._guarded_call(self.dispatch)
+                    return out
+        """, rule="STATE-REVERT")
+        assert [f.line for f in fs] == [5]
+
 
 # ---------------------------------------------------------------------------
 # CallGraph

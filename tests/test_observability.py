@@ -372,6 +372,22 @@ class TestTraceSummary:
         assert ts.span_stats(list(map(dict, _SYNTH_EVENTS)))[
             "step"]["gap"] == 0.0
 
+    def test_spec_point_folds_into_request_header(self):
+        # the engine's drain drops one spec[a=...,t/s=...] point per
+        # finished speculative request; the summary folds it into the
+        # request header line instead of rendering it as a stage
+        ts = _trace_summary_mod()
+        events = list(map(dict, _SYNTH_EVENTS)) + [
+            {"name": "serving.request[3].spec[a=0.71,t/s=2.9]", "ph": "X",
+             "ts": 26, "dur": 0, "pid": 1, "tid": 2},
+        ]
+        out = ts.format_requests(ts.request_timelines(events))
+        assert "request 3 spec a=0.71 t/s=2.9:" in out
+        # folded, not a timeline row
+        assert "spec[a=0.71,t/s=2.9]" not in out
+        # requests without the point are unannotated
+        assert "request 4:" in out and "request 4 spec" not in out
+
     def test_cli_end_to_end(self, tmp_path, capsys):
         ts = _trace_summary_mod()
         path = tmp_path / "trace.json"

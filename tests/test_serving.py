@@ -974,13 +974,14 @@ class TestServingObservability:
             fam: eng.metrics.get("serving_jit_compile_misses_total",
                                  {"family": fam}).value
             for fam in ("prefill", "prefill_offset", "prefill_chunked",
-                        "decode", "ragged", "sample")}
+                        "decode", "ragged", "spec", "sample")}
         assert counts["prefill"] == reg_counts["prefill"] == 1
         assert counts["decode"] == reg_counts["decode"] == 1
         assert counts["sample"] == reg_counts["sample"] == 0
         assert counts["prefill_chunked"] == \
             reg_counts["prefill_chunked"] == 0     # chunking off
         assert counts["ragged"] == reg_counts["ragged"] == 0
+        assert counts["spec"] == reg_counts["spec"] == 0  # spec off
         # dedup sets and registry counters stay in lockstep
         assert {f: len(s) for f, s in eng._exec_shapes.items()} == \
             reg_counts

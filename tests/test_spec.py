@@ -1,0 +1,452 @@
+"""Speculative decoding (ISSUE 17): model-free drafts + batched
+verification inside the fused decode/ragged block.
+
+The contract under test, in order of importance:
+
+* greedy spec streams are BIT-IDENTICAL to non-speculative decoding
+  across lookahead {2,4,8} x horizon {1,8} and each serving variant
+  (chunked prefill, prefix cache, preemption pressure, tp=2) — the
+  matrix's heavy cells are `slow`, a fast core pins one cell per
+  variant plus the multi-block charge/revert regression (h=4);
+* seeded stochastic runs are deterministic (per-row PRNG chain), and
+  the accepted marginal matches the target distribution (slow, TV
+  distance over a tiny vocab);
+* `stats()["spec"]` reports accept_rate and tokens_per_target_step
+  > 1.0 on a repetitive prompt;
+* spec-off engines import ZERO spec code (poisoned-module proof);
+* the worst-case page charge is reverted after each drain: pools
+  drain to empty, and `check_consistency()` holds mid-stream under
+  preemption pressure.
+"""
+import functools
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ServingEngine, SpecConfig
+from paddle_tpu.serving.engine import PAD_TOKEN
+from paddle_tpu.serving.spec import (
+    _ngram_continuation, build_draft_buffer, parse_emitted_row,
+    propose_drafts,
+)
+
+VOCAB = LlamaConfig.tiny().vocab_size
+
+
+@functools.lru_cache(maxsize=None)
+def _llama():
+    paddle.seed(1234)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _sequential_reference(model, prompts, max_new_tokens):
+    return [list(model.generate(paddle.to_tensor(np.asarray(p)[None]),
+                                max_new_tokens=max_new_tokens,
+                                temperature=0.0).numpy()[0])
+            for p in prompts]
+
+
+def _prompts(n=3, repetitive=True, seed=53):
+    """Repetitive prompts draft well (prompt-lookup hits); random ones
+    exercise the all-PAD degenerate path."""
+    rng = np.random.RandomState(seed)
+    if repetitive:
+        pat = rng.randint(0, VOCAB, (8,)).tolist()
+        return [pat * 3 + pat[:1 + i] for i in range(n)]
+    return [rng.randint(0, VOCAB, (10 + 3 * i,)).tolist()
+            for i in range(n)]
+
+
+def _run(model, prompts, nt=16, spec=None, **kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_batch_size", max(len(prompts), 1))
+    kw.setdefault("max_seq_len", 160)
+    eng = ServingEngine(model, spec_config=spec, **kw)
+    rids = [eng.add_request(p, max_new_tokens=nt) for p in prompts]
+    outs = eng.run()
+    assert eng.cache.allocator.num_used == 0
+    return [outs[r] for r in rids], eng
+
+
+# ------------------------------------------------------------ host units
+
+class TestSpecConfig:
+    def test_defaults_validate(self):
+        cfg = SpecConfig().validate()
+        assert cfg.lookahead == 4 and cfg.method == "ngram"
+
+    def test_rejects_bad_lookahead(self):
+        with pytest.raises(ValueError, match="lookahead must be >= 1"):
+            SpecConfig(lookahead=0).validate()
+
+    def test_rejects_unknown_method(self):
+        with pytest.raises(ValueError, match="unknown spec method"):
+            SpecConfig(method="medusa").validate()
+
+    def test_rejects_bad_ngram_bounds(self):
+        with pytest.raises(ValueError, match="ngram_min <= ngram_max"):
+            SpecConfig(ngram_min=3, ngram_max=2).validate()
+        with pytest.raises(ValueError, match="ngram_min <= ngram_max"):
+            SpecConfig(ngram_min=0).validate()
+
+
+class TestNgramContinuation:
+    def test_prefers_longest_match(self):
+        # trailing [1,2] occurs earlier followed by 9; trailing [2]
+        # occurs even earlier followed by 7 — the 2-gram must win
+        ctx = [5, 2, 7, 1, 2, 9, 4, 1, 2]
+        assert _ngram_continuation(ctx, 3, 3, 1) == [9, 4, 1]
+
+    def test_most_recent_occurrence_wins(self):
+        ctx = [1, 2, 3, 1, 2, 4, 1, 2]
+        assert _ngram_continuation(ctx, 2, 2, 1)[0] == 4
+
+    def test_no_match_returns_empty(self):
+        assert _ngram_continuation([1, 2, 3, 4], 4, 3, 1) == []
+        assert _ngram_continuation([], 4, 3, 1) == []
+        assert _ngram_continuation([7], 4, 3, 1) == []
+
+    def test_periodic_stream_drafts_the_period(self):
+        pat = [3, 1, 4, 1, 5]
+        ctx = pat * 3
+        got = _ngram_continuation(ctx, 5, 3, 1)
+        assert got == pat
+
+    def test_match_ending_stream_falls_to_shorter_k(self):
+        # the only [8,9] match is the tail itself (j pointing at the
+        # final occurrence yields an empty continuation) -> k=1 path
+        ctx = [9, 6, 8, 9]
+        assert _ngram_continuation(ctx, 2, 2, 1) == [6, 8]
+
+
+class TestDraftBuffer:
+    def test_padded_rows_and_width_clamp(self):
+        class R:
+            prompt = [1, 2, 3, 1, 2, 3, 1, 2]
+            generated = []
+        buf = build_draft_buffer([R()], rows=3, width=4,
+                                 cfg=SpecConfig(lookahead=4))
+        assert buf.shape == (3, 4) and buf.dtype == np.int32
+        assert (buf[1:] == PAD_TOKEN).all()   # ghost rows stay PAD
+        assert buf[0, 0] != PAD_TOKEN         # periodic prompt drafts
+
+    def test_no_draft_row_is_all_pad(self):
+        class R:
+            prompt = [1, 2, 3, 4]
+            generated = []
+        buf = build_draft_buffer([R()], rows=1, width=4,
+                                 cfg=SpecConfig(lookahead=4))
+        assert (buf == PAD_TOKEN).all()
+
+    def test_propose_drafts_caps_at_limit(self):
+        class R:
+            prompt = [7, 8] * 10
+            generated = []
+        d = propose_drafts(R(), SpecConfig(lookahead=3))
+        assert len(d) <= 3
+
+
+class TestParseEmittedRow:
+    def test_full_acceptance(self):
+        row = [1, 2, 3, 4, 5, 6]
+        assert parse_emitted_row(row, (3, 3)) == [1, 2, 3, 4, 5, 6]
+
+    def test_pad_terminates_window_not_block(self):
+        P = PAD_TOKEN
+        row = [1, P, P, 2, 3, P]
+        assert parse_emitted_row(row, (3, 3)) == [1, 2, 3]
+
+    def test_window_leading_pad_kills_the_rest(self):
+        P = PAD_TOKEN
+        row = [1, 2, P, P, 9, 9]    # window 2 starts PAD: 9s are stale
+        assert parse_emitted_row(row, (3, 3)) == [1, 2]
+
+    def test_empty_block(self):
+        P = PAD_TOKEN
+        assert parse_emitted_row([P, P, P, P], (2, 2)) == []
+
+
+# -------------------------------------------------------- greedy parity
+
+class TestGreedyParity:
+    """Spec-on greedy streams must be bit-identical to the engine's
+    non-speculative output (itself pinned to sequential generate by
+    test_serving). Multi-block runs (nt > h*(1+L)) are the load-bearing
+    cells: they cross the charge -> drain -> revert boundary where a
+    shrunken page table silently sinks KV writes into the null page."""
+
+    def _parity(self, h, L, nt=24, n=4, repetitive=True, **kw):
+        model = _llama()
+        prompts = _prompts(n, repetitive=repetitive)
+        off, _ = _run(model, prompts, nt=nt, spec=None,
+                      decode_horizon=h, **kw)
+        on, eng = _run(model, prompts, nt=nt,
+                       spec=SpecConfig(lookahead=L),
+                       decode_horizon=h, **kw)
+        assert on == off
+        return eng
+
+    def test_multiblock_charge_revert_regression(self):
+        """h=4, L=4, nt=24: three spec blocks back-to-back. Pre-fix,
+        block N+1's leading drain reverted the pages schedule() had
+        just charged, and the block's KV writes past pages_for(
+        num_tokens) vanished into the null page — streams diverged at
+        the next block boundary."""
+        eng = self._parity(4, 4, nt=16, n=2)
+        st = eng.stats()["spec"]
+        assert st["drafted_tokens"] > 0
+
+    def test_h1_lookahead4(self):
+        self._parity(1, 4)
+
+    def test_h8_lookahead4(self):
+        self._parity(8, 4)
+
+    def test_h8_lookahead2_random_prompts(self):
+        # random prompts rarely draft: the all-PAD degenerate path
+        # must still match plain decode exactly
+        self._parity(8, 2, repetitive=False)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("h", [1, 8])
+    @pytest.mark.parametrize("L", [2, 4, 8])
+    def test_matrix_plain(self, h, L):
+        self._parity(h, L)
+
+    def test_chunked_prefill_ragged_path(self):
+        # mid-prefill rows ride the same ragged block as spec decode
+        # rows: iteration 0 is the plain forward, drafts start at w2
+        self._parity(8, 4, prefill_chunk_tokens=8)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("L", [2, 8])
+    def test_chunked_prefill_matrix(self, L):
+        self._parity(8, L, **{"prefill_chunk_tokens": 8})
+
+    def test_prefix_cache_and_radix_drafts(self):
+        """Two waves sharing a prefix: wave 2 prefills from cached
+        pages AND the combined proposer probes the radix tree for
+        continuation drafts — both must leave the stream untouched."""
+        model = _llama()
+        prompts = _prompts(3)
+        spec = SpecConfig(lookahead=4, method="combined")
+
+        def run(cfg):
+            eng = ServingEngine(model, page_size=8, max_batch_size=3,
+                                max_seq_len=160, decode_horizon=8,
+                                enable_prefix_caching=True,
+                                spec_config=cfg)
+            first = [eng.add_request(p, max_new_tokens=16)
+                     for p in prompts]
+            eng.run()
+            second = [eng.add_request(p, max_new_tokens=16)
+                      for p in prompts]
+            outs = eng.run()
+            assert eng.scheduler.check_consistency()
+            return [outs[r] for r in first + second]
+
+        assert run(spec) == run(None)
+
+    def test_preemption_pressure(self):
+        """Pool too small for every request's worst-case charge: the
+        spec path preempts/requeues through the same drain_hook and
+        stays token-identical, with the audit passing at the end."""
+        model = _llama()
+        prompts = _prompts(3)
+        refs = _sequential_reference(model, prompts, 12)
+        eng = ServingEngine(model, page_size=8, max_batch_size=3,
+                            max_seq_len=64, num_pages=14,
+                            decode_horizon=4,
+                            spec_config=SpecConfig(lookahead=4))
+        rids = [eng.add_request(p, max_new_tokens=12) for p in prompts]
+        outs = eng.run()
+        for rid, ref in zip(rids, refs):
+            assert outs[rid] == ref
+        assert eng.stats()["preemptions"] >= 1
+        assert eng.cache.allocator.num_used == 0
+        assert eng.scheduler.check_consistency()
+
+    def test_tp2(self):
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        self._parity(8, 4, n=2, **{"tp_size": 2})
+
+
+# ---------------------------------------------------------- stochastic
+
+class TestStochastic:
+    def test_seeded_run_is_deterministic(self):
+        """Per-row PRNG chain: the same seeds through the same spec
+        engine config reproduce the streams bit-for-bit."""
+        model = _llama()
+        prompts = _prompts(3)
+
+        def run():
+            eng = ServingEngine(model, page_size=8, max_batch_size=3,
+                                max_seq_len=160, decode_horizon=4,
+                                spec_config=SpecConfig(lookahead=4))
+            rids = [eng.add_request(p, max_new_tokens=12,
+                                    temperature=0.8, top_k=40, seed=7 + i)
+                    for i, p in enumerate(prompts)]
+            outs = eng.run()
+            return [outs[r] for r in rids]
+
+        a, b = run(), run()
+        assert a == b
+        # and the chains are genuinely stochastic, not greedy in disguise
+        off = _sequential_reference(model, prompts, 12)
+        assert a != off
+
+    def test_stochastic_horizon_invariance(self):
+        """The key chain is per-row and per-window, independent of the
+        blocking: the same seeds emit the same stream at h=1 and h=4."""
+        model = _llama()
+        prompts = _prompts(2)
+
+        def run(h):
+            eng = ServingEngine(model, page_size=8, max_batch_size=2,
+                                max_seq_len=160, decode_horizon=h,
+                                spec_config=SpecConfig(lookahead=4))
+            rids = [eng.add_request(p, max_new_tokens=10,
+                                    temperature=1.0, seed=11 + i)
+                    for i, p in enumerate(prompts)]
+            outs = eng.run()
+            return [outs[r] for r in rids]
+
+        assert run(1) == run(4)
+
+    @pytest.mark.slow
+    def test_accepted_marginal_matches_target_distribution(self):
+        """The rejection-sampling rule preserves the target
+        distribution: over many seeds, the marginal of the first
+        generated token with spec ON matches spec OFF (same prompt,
+        temperature 1). TV distance over the observed support — loose
+        bound, but far above what a biased accept rule produces (e.g.
+        always-accept-draft collapses the marginal onto one token)."""
+        model = _llama()
+        pat = _prompts(1)[0]
+        n_seeds = 96
+
+        def marginal(spec):
+            counts = {}
+            # batch all seeds as parallel requests: one engine, one
+            # compile, n_seeds independent PRNG chains
+            eng = ServingEngine(model, page_size=8,
+                                max_batch_size=n_seeds,
+                                max_seq_len=48, num_pages=256,
+                                decode_horizon=1, spec_config=spec)
+            rids = [eng.add_request(pat, max_new_tokens=2,
+                                    temperature=1.0, seed=s)
+                    for s in range(n_seeds)]
+            outs = eng.run()
+            for r in rids:
+                t = outs[r][len(pat) + 1]   # second generated token:
+                counts[t] = counts.get(t, 0) + 1   # drafts verified here
+            return counts
+
+        on, off = marginal(SpecConfig(lookahead=4)), marginal(None)
+        support = set(on) | set(off)
+        tv = 0.5 * sum(abs(on.get(t, 0) - off.get(t, 0))
+                       for t in support) / n_seeds
+        assert tv < 0.35, f"TV distance {tv:.3f}: accept rule is biased"
+
+
+# ------------------------------------------------------------- metrics
+
+class TestSpecStats:
+    def test_repetitive_prompt_beats_one_token_per_step(self):
+        model = _llama()
+        _, eng = _run(model, _prompts(4), nt=24,
+                      spec=SpecConfig(lookahead=4), decode_horizon=1)
+        st = eng.stats()["spec"]
+        assert st["lookahead"] == 4 and st["method"] == "ngram"
+        assert st["drafted_tokens"] > 0
+        assert 0.0 < st["accept_rate"] <= 1.0
+        assert st["accepted_tokens"] + st["wasted_tokens"] \
+            == st["drafted_tokens"]
+        assert st["tokens_per_target_step"] > 1.0
+
+    def test_spec_off_stats_has_no_spec_key(self):
+        model = _llama()
+        _, eng = _run(model, _prompts(1), nt=4, spec=None)
+        assert "spec" not in eng.stats()
+
+
+# ----------------------------------------------------------- zero touch
+
+class TestZeroTouchSpecOff:
+    def test_spec_off_never_imports_spec_module(self, monkeypatch):
+        """Poison paddle_tpu.serving.spec in sys.modules: a spec-off
+        engine must run a full request without touching it, and a
+        spec-on engine must trip the poison — the constructor knob is
+        the ONLY gate."""
+        poison = types.ModuleType("paddle_tpu.serving.spec")
+
+        def _boom(name):
+            raise AssertionError(f"spec module touched spec-off: {name}")
+
+        poison.__getattr__ = _boom
+        # both lookup paths: `import paddle_tpu.serving.spec` consults
+        # sys.modules, the engine's `from . import spec` reads the
+        # attribute the real import already bound on the package
+        monkeypatch.setitem(sys.modules, "paddle_tpu.serving.spec",
+                            poison)
+        import paddle_tpu.serving as serving_pkg
+        monkeypatch.setattr(serving_pkg, "spec", poison)
+        model = _llama()
+        outs, _ = _run(model, _prompts(1), nt=6, spec=None)
+        assert len(outs[0]) > len(_prompts(1)[0])
+        eng = ServingEngine(model, page_size=8, max_batch_size=1,
+                            max_seq_len=160,
+                            spec_config=SpecConfig(lookahead=4))
+        eng.add_request(_prompts(1)[0], max_new_tokens=4)
+        with pytest.raises(AssertionError, match="spec module touched"):
+            eng.run()
+
+
+# ------------------------------------------------------ page accounting
+
+class TestPageAccounting:
+    def test_charge_revert_audited_every_step(self):
+        """Walk the engine step by step under a pool that forces
+        preemption: after EVERY host-visible step the scheduler/
+        allocator audit must hold — the worst-case charge and its
+        post-drain revert never double-free, leak, or strand a page."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=3,
+                            max_seq_len=64, num_pages=14,
+                            decode_horizon=4,
+                            spec_config=SpecConfig(lookahead=4))
+        for p in _prompts(3):
+            eng.add_request(p, max_new_tokens=12)
+        steps = 0
+        while any(r.status in ("waiting", "running")
+                  for r in eng.requests.values()):
+            eng.step()
+            assert eng.scheduler.check_consistency()
+            steps += 1
+            assert steps < 400, "engine stopped making progress"
+        eng.drain_all()
+        assert eng.cache.allocator.num_used == 0
+        assert eng.scheduler.check_consistency()
+
+    def test_mid_block_rejection_reverts_tail_pages(self):
+        """A request whose drafts go stale mid-stream (repetitive
+        prompt, budget ends mid-block) must end with every page back:
+        the revert trims the worst-case charge down to acceptance."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=8, max_batch_size=1,
+                            max_seq_len=160, decode_horizon=8,
+                            spec_config=SpecConfig(lookahead=8))
+        rid = eng.add_request(_prompts(1)[0], max_new_tokens=13)
+        outs = eng.run()
+        assert len(outs[rid]) == len(_prompts(1)[0]) + 13
+        assert eng.cache.allocator.num_used == 0
+        assert eng.scheduler.check_consistency()

@@ -147,6 +147,20 @@ def test_stage_memory_footprints_differ():
     assert per_device_bytes(sharded) < per_device_bytes(repl)
 
 
+def _has_pinned_host() -> bool:
+    """Whether this backend exposes a `pinned_host` memory space —
+    CPU-only jax builds (this container) don't, and device_put to it
+    fails; the offload CONTRACT is still exercised on TPU/GPU CI."""
+    try:
+        return any(m.kind == "pinned_host"
+                   for m in jax.devices()[0].addressable_memories())
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(
+    not _has_pinned_host(),
+    reason="backend has no pinned_host memory space (CPU-only jax)")
 def test_offload_places_opt_state_on_host():
     net = _build()
     opt = paddle.optimizer.Adam(learning_rate=0.01,

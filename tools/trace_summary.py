@@ -29,7 +29,10 @@ Two views over a `*.pt.trace.json` (or any chrome://tracing JSON):
   (serving/tp.py) suffix every lifecycle span with `@tp=N`; the suffix
   is stripped from the timeline stages, each request header shows its
   `@tp=N`, and the TP degree(s) present print in the report's header
-  line.
+  line. Speculative-decoding engines (serving/spec.py) drop one
+  `spec[a=<rate>,t/s=<tokens>]` point per finished request; it folds
+  into the request header as `spec a=0.71 t/s=2.9` (accept rate,
+  emitted tokens per target step) instead of rendering as a stage.
 
 Usage:
     python tools/trace_summary.py TRACE.json [--top N] [--requests]
@@ -62,6 +65,11 @@ CLUSTER_MOVE_RE = re.compile(
     r"^serving\.cluster\.(migrate|hedge)\[(\d+)\]\.(r\d+)->(r\d+)$")
 # the replica tag inside a request's own lifecycle lane
 REPLICA_STAGE_RE = re.compile(r"^replica\[(r\d+)\]$")
+# speculative-decoding summary point the engine drops on a finished
+# request (serving/engine.py drain): accept rate over drafted tokens +
+# emitted tokens per target step — folded into the request header as
+# `spec a=0.71 t/s=2.9` instead of rendering as a timeline stage
+SPEC_STAGE_RE = re.compile(r"^spec\[a=([\d.]+),t/s=([\d.]+)\]$")
 
 
 def load_trace(path: str) -> List[dict]:
@@ -229,15 +237,20 @@ def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]],
         # order with consecutive duplicates collapsed: [r1] for a
         # request that never moved, [r1->r2] across a migration/hedge
         journey: List[str] = []
+        spec_note = ""
         for stage, _, _ in evs:
             rm = REPLICA_STAGE_RE.match(stage)
             if rm and (not journey or journey[-1] != rm.group(1)):
                 journey.append(rm.group(1))
+            sm = SPEC_STAGE_RE.match(stage)
+            if sm:
+                spec_note = f" spec a={sm.group(1)} t/s={sm.group(2)}"
         for tag in journey:
             lanes.setdefault(tag, []).append(rid)
         lane = f" [{'->'.join(journey)}]" if journey else ""
         if rid in tags:
             lane += f" @{tags[rid]}"
+        lane += spec_note
         if bad is not None:
             bad_counts[bad] = bad_counts.get(bad, 0) + 1
             lines.append(f"request {rid}{lane}:  !! {bad}")
@@ -262,8 +275,8 @@ def format_requests(timelines: Dict[int, List[Tuple[str, float, float]]],
                 kind, src, dst, _, mdur = jumps.pop(0)
                 lines.append(f"  >> {kind}d {src}->{dst}"
                              f" ({mdur / 1e3:.3f} ms)")
-            if REPLICA_STAGE_RE.match(stage):
-                continue                # folded into the header journey
+            if REPLICA_STAGE_RE.match(stage) or SPEC_STAGE_RE.match(stage):
+                continue                # folded into the header line
             tail = f"  ({dur / 1e3:.3f} ms)" if dur > 0 else ""
             mark = " !!" if stage in BAD_TERMINALS else (
                 " ~" if stage == "recovered" else "")

@@ -280,6 +280,22 @@ def _run_gates(on_tpu: bool) -> dict:
         np.asarray(satt._ragged_paged_pallas(qq, kp, kp, pt, pos, rid,
                                              k_scale=ks, v_scale=ks))
 
+    def paged_decode_overlap():
+        # the overlap engine's split-collective ring (ISSUE 18): K
+        # micro-row ppermute transports interleaved with the consumer
+        # matmul, compiled over a real tp mesh — Mosaic must lower the
+        # ring schedule itself, not just the serial psum it replaces
+        import jax
+        from paddle_tpu.parallel.mesh import build_mesh
+        from paddle_tpu.serving.overlap import overlap_probe_fn
+
+        ndev = len(jax.devices())
+        if ndev < 2:
+            raise RuntimeError("split-collective ring needs >= 2 devices")
+        mesh = build_mesh((("tp", 4 if ndev >= 4 else 2),))
+        x = jnp.asarray(rng.randn(8, 256), jnp.float32)
+        np.asarray(jax.jit(overlap_probe_fn(mesh, 256, 2))(x))
+
     gate("flash_fwd", flash_fwd)
     gate("flash_bwd", flash_bwd)
     gate("flash_dropout", flash_dropout)
@@ -289,6 +305,7 @@ def _run_gates(on_tpu: bool) -> dict:
     gate("ragged_paged", ragged_paged)
     gate("paged_decode_quant", paged_decode_quant)
     gate("ragged_paged_quant", ragged_paged_quant)
+    gate("paged_decode_overlap", paged_decode_overlap)
     return gates
 
 
@@ -424,6 +441,36 @@ def _run_serving_tp(on_tpu: bool) -> dict:
         return out
     except Exception as e:  # noqa: BLE001 — bench must degrade, not die
         _log(f"phase=serving_tp: FAIL {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
+def _run_serving_tp_overlap(on_tpu: bool) -> dict:
+    """Collective/compute overlap phase: the tp decode workload serial
+    vs split-psum ring at chunks 2/4, with the bit-identical-token
+    assertion and the measured overlap fraction. overlap_fraction ~0 on
+    CPU is the honest null (ring hops are host memcpys with no
+    independent interconnect to hide under); parity is the CPU-true
+    signal. Non-fatal like the phases around it."""
+    try:
+        mod = _gen_bench_module()
+        model, cfg = _tiny_serving_model()
+        out = mod.serving_tp_overlap_phase(model, cfg, on_tpu)
+        if "skipped" in out:
+            _log(f"phase=serving_tp_overlap: skipped ({out['skipped']})")
+            return out
+        cells = ", ".join(
+            f"tp{d} serial={out[f'tp{d}']['serial']['decode_tokens_per_s']}"
+            f" c2={out[f'tp{d}']['chunks2']['decode_tokens_per_s']}"
+            f" (ovl {out[f'tp{d}']['chunks2']['overlap_fraction']:.3f})"
+            for d in out["degrees"][1:])
+        _log(f"phase=serving_tp_overlap: {cells} tok/s, "
+             f"parity_ok={out['parity_ok']}")
+        if not out["parity_ok"]:
+            _log("phase=serving_tp_overlap: WARN overlapped tokens "
+                 "diverged from serial engine")
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must degrade, not die
+        _log(f"phase=serving_tp_overlap: FAIL {type(e).__name__}: {e}")
         return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
 
@@ -867,6 +914,29 @@ def _run_aot_gates() -> dict:
          abs_((4, 2), jnp.int32), abs_((16,), jnp.int32),
          abs_((16,), jnp.int32))
 
+    # the overlap engine's split-collective ring (ISSUE 18) over the
+    # full 2x2 topology mesh: the probe body IS the ring schedule the
+    # overlapped decode executables trace, so a compile here pins
+    # Mosaic lowering of interleaved ppermute transports + matmuls
+    t0 = time.perf_counter()
+    try:
+        from paddle_tpu.parallel.mesh import build_mesh
+        from paddle_tpu.serving.overlap import overlap_probe_fn
+
+        mesh = build_mesh((("tp", 4),), devices=devs)
+        rep = jax.sharding.NamedSharding(mesh,
+                                         jax.sharding.PartitionSpec())
+        jax.jit(overlap_probe_fn(mesh, 256, 2)).lower(
+            jax.ShapeDtypeStruct((8, 256), jnp.float32,
+                                 sharding=rep)).compile()
+        gates["paged_decode_overlap"] = (
+            f"aot-ok ({time.perf_counter() - t0:.1f}s)")
+    except Exception as e:  # noqa: BLE001 — gate must record, not die
+        gates["paged_decode_overlap"] = (
+            f"FAIL {type(e).__name__}: {str(e)[:300]}")
+    _log(f"phase=gates(aot): paged_decode_overlap: "
+         f"{gates['paged_decode_overlap'][:80]}")
+
     pk._on_tpu = orig
     return gates
 
@@ -936,6 +1006,11 @@ def bench_child() -> None:
     # tensor-parallel sweep: parity bit + psum probe, null tok/s on CPU
     _enter_phase("serving_tp", 400.0)
     serving_tp = _run_serving_tp(on_tpu)
+
+    # collective/compute overlap: serial vs ring-chunked psum, parity
+    # bit + overlap fraction (~0 on CPU is the expected null)
+    _enter_phase("serving_tp_overlap", 400.0)
+    serving_tp_overlap = _run_serving_tp_overlap(on_tpu)
 
     # speculative-decoding phase: accept rate + tokens/target-step,
     # greedy parity; tok/s null on CPU by design
@@ -1106,6 +1181,7 @@ def bench_child() -> None:
                 "serving_prefix": serving_prefix,
                 "serving_decode": serving_decode,
                 "serving_tp": serving_tp,
+                "serving_tp_overlap": serving_tp_overlap,
                 "serving_spec": serving_spec,
                 "serving_faults": serving_faults,
                 "serving_chunked": serving_chunked,

@@ -10,7 +10,10 @@ and host-sync counts at decode_horizon 1 vs 8 (the fused multi-token
 decode block + async host/device overlap); a serving_tp phase sweeps
 tensor parallelism tp 1/2/4, asserting bit-identical tokens and
 reporting decode tokens/s + the psum-probe collective time (a deliberate
-null result on the CPU fake-device mesh); a serving_spec phase sweeps
+null result on the CPU fake-device mesh); a serving_tp_overlap phase
+repeats that sweep with the split-psum micro-row ring overlap on vs off
+(chunks 2/4), asserting serial-engine parity and reporting the measured
+overlap_fraction (also a CPU null); a serving_spec phase sweeps
 speculative decoding on/off at horizon 1 vs 8 over repetitive and
 random prompts (accept rate, tokens per target step, greedy parity —
 tok/s is an expected null on CPU); last, a serving_faults phase
@@ -95,6 +98,8 @@ def main():
                    "serving_prefix": serving_prefix_phase(m, cfg, on_tpu),
                    "serving_decode": serving_decode_phase(m, cfg, on_tpu),
                    "serving_tp": serving_tp_phase(m, cfg, on_tpu),
+                   "serving_tp_overlap": serving_tp_overlap_phase(
+                       m, cfg, on_tpu),
                    "serving_spec": serving_spec_phase(m, cfg, on_tpu),
                    "serving_faults": serving_faults_phase(m, cfg, on_tpu),
                    "serving_chunked": serving_chunked_phase(m, cfg,
@@ -308,7 +313,8 @@ def serving_tp_phase(model, cfg, on_tpu):
         entry = {"decode_tokens_per_s": round(toks / wall, 1),
                  "wall_ms": round(wall * 1000, 2), "tokens": toks}
         if tp > 1 and eng.metrics is not None:
-            probe = eng.metrics.get("serving_tp_collective_seconds")
+            probe = eng.metrics.get("serving_tp_collective_seconds",
+                                    labels={"overlap": "off"})
             if probe is not None and probe.count:
                 entry["psum_probe_us"] = round(
                     1e6 * probe.sum / probe.count, 1)
@@ -326,6 +332,106 @@ def serving_tp_phase(model, cfg, on_tpu):
             results[f"tp{d}"]["decode_tokens_per_s"]
             / max(results["tp1"]["decode_tokens_per_s"], 1e-9), 2)
     return out
+
+
+def serving_tp_overlap_phase(model, cfg, on_tpu):
+    """Collective/compute overlap sweep (ISSUE 18): the serving_tp
+    workload at tp 1/2/4 with the split-psum micro-row ring overlap on
+    vs off, chunks in {2, 4}, asserting per-request token parity vs the
+    serial engine at every cell (the ordered-ring bit-identity
+    contract) and reporting decode tokens/s, the warmed best-of psum
+    probe, and the construction-time `overlap_fraction` (share of the
+    serial collective wall the ring hides behind consumer matmuls). On
+    the CPU fake-device mesh BOTH the throughput delta and the overlap
+    fraction are EXPECTED nulls — shards are threads on one chip, so
+    there is no independent interconnect for the ring transport to
+    occupy while compute proceeds; the phase carries the harness and
+    the parity assertion to multi-chip hardware, where overlap_fraction
+    becomes the measured answer to "how much of the collective did we
+    hide"."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+
+    ndev = len(jax.devices())
+    if on_tpu:
+        ov_model, ov_cfg = model, cfg
+    else:
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        ov_cfg = LlamaConfig(vocab_size=512, hidden_size=64,
+                             num_hidden_layers=2, num_attention_heads=4,
+                             num_key_value_heads=4, intermediate_size=128,
+                             max_position_embeddings=128)
+        ov_model = LlamaForCausalLM(ov_cfg)
+        ov_model.eval()
+
+    kv = getattr(ov_cfg, "num_key_value_heads",
+                 ov_cfg.num_attention_heads)
+    degrees = [d for d in (1, 2, 4)
+               if d <= ndev and kv % d == 0
+               and ov_cfg.num_attention_heads % d == 0
+               and ov_cfg.intermediate_size % d == 0]
+    if degrees == [1]:
+        return {"skipped": f"no tp degree fits (devices={ndev}, "
+                           f"kv_heads={kv})"}
+
+    rng = np.random.RandomState(17)
+    n_req = 4
+    new_tokens = 96 if on_tpu else 48
+    prompts = [rng.randint(0, ov_cfg.vocab_size, (12,)).tolist()
+               for _ in range(n_req)]
+    max_seq = min(ov_cfg.max_position_embeddings, 128)
+
+    def run(tp, overlap=False, chunks=2):
+        eng = ServingEngine(ov_model, page_size=8, max_batch_size=n_req,
+                            max_seq_len=max_seq, decode_horizon=8,
+                            tp_size=tp, tp_overlap=overlap,
+                            tp_overlap_chunks=chunks)
+        for p in prompts:            # warm wave: compiles
+            eng.add_request(p, max_new_tokens=new_tokens)
+        eng.run()
+        toks0 = eng.stats()["tokens_generated"]
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        out = eng.run()
+        wall = time.perf_counter() - t0
+        toks = eng.stats()["tokens_generated"] - toks0
+        entry = {"decode_tokens_per_s": round(toks / wall, 1),
+                 "wall_ms": round(wall * 1000, 2)}
+        if tp > 1:
+            st = eng.stats()["tp"]
+            entry["overlap_fraction"] = st["overlap_fraction"]
+            probe = eng.metrics.get(
+                "serving_tp_collective_seconds",
+                labels={"overlap": "on" if st["overlap"] else "off"})
+            if probe is not None and probe.count:
+                entry["psum_probe_us"] = round(
+                    1e6 * probe.sum / probe.count, 1)
+        return entry, [out[r] for r in rids]
+
+    results = {}
+    _, base = run(1)
+    for d in degrees[1:]:
+        serial, s_serial = run(d)
+        serial.pop("overlap_fraction", None)   # None by construction
+        cell = {"serial": serial,
+                "parity_ok": s_serial == base}
+        for chunks in (2, 4):
+            ovl, s_ovl = run(d, overlap=True, chunks=chunks)
+            cell[f"chunks{chunks}"] = ovl
+            cell["parity_ok"] = cell["parity_ok"] and s_ovl == base
+        results[f"tp{d}"] = cell
+    return {"devices": ndev, "degrees": degrees, "requests": n_req,
+            "new_tokens": new_tokens,
+            "parity_ok": all(c["parity_ok"] for c in results.values()),
+            **results}
 
 
 def serving_quant_phase(model, cfg, on_tpu):
@@ -376,7 +482,8 @@ def serving_quant_phase(model, cfg, on_tpu):
                  "tok_s": round(toks / wall, 1),
                  "wall_ms": round(wall * 1000, 2)}
         if tp > 1 and eng.metrics is not None:
-            probe = eng.metrics.get("serving_tp_collective_seconds")
+            probe = eng.metrics.get("serving_tp_collective_seconds",
+                                    labels={"overlap": "off"})
             if probe is not None and probe.count:
                 entry["psum_probe_us"] = round(
                     1e6 * probe.sum / probe.count, 1)

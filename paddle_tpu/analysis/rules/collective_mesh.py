@@ -1,8 +1,9 @@
-"""COLLECTIVE-MESH — collectives must name a real mesh axis, and every
-``check_rep=False`` must say why.
+"""COLLECTIVE-MESH — collectives must name a real mesh axis, every
+``check_rep=False`` must say why, and ``ppermute`` rings must be sized
+from the mesh.
 
-Two contracts from the tensor-parallel work (PR 9), both about
-``shard_map``:
+Three contracts from the tensor-parallel work (PR 9 + ISSUE 18), all
+about ``shard_map``:
 
   1. **Axis names.** ``jax.lax.psum(y, TP_AXIS)`` inside a
      shard_map-wrapped function runs on the axis the *wrap site's* mesh
@@ -25,9 +26,20 @@ Two contracts from the tensor-parallel work (PR 9), both about
      the noqa's reason tail directly and bypasses the normal
      suppression path for this sub-check, so you cannot silence the
      demand for a reason with the bare marker it is demanding.
+  3. **Split-collective rings (ISSUE 18).** The overlap work moves
+     psum payloads over fixed-order ``lax.ppermute`` rings. A
+     permutation table written as a *literal* — ``[(0, 1), (1, 0)]``,
+     or a comprehension over ``range(2)`` — encodes ONE tp degree: at
+     any other degree it silently drops shards (values wrong, no
+     error, same class as a stale axis name). Tables must be built
+     from the declared mesh axis size (``parallel.mesh.ring_perm``);
+     a table that arrives as a variable or helper call resolves to
+     nothing and is trusted — same conservative silence as the axis
+     check.
 
 Scoped to modules that call shard_map at all; modules with no
-resolvable mesh axes get only the check_rep audit.
+resolvable mesh axes get only the check_rep audit and the ppermute
+ring check (the literal-table hazard needs no mesh resolution).
 """
 import ast
 from typing import Iterator, List, Optional, Set, Tuple
@@ -45,6 +57,42 @@ def _axis_operands(call: ast.Call) -> List[ast.expr]:
     if not out and len(call.args) >= 2:
         out = [call.args[1]]
     return out
+
+
+def _perm_operand(call: ast.Call) -> Optional[ast.expr]:
+    """The expression carrying ppermute's permutation table, if present."""
+    for kw in call.keywords:
+        if kw.arg == "perm":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def _is_literal_perm(node: ast.expr) -> bool:
+    """True when a perm table is hard-coded for one ring size.
+
+    Fires on literal lists/tuples of pairs (``[(0, 1), (1, 0)]``) and on
+    comprehensions whose only iterable is ``range(<constant>)`` — both
+    pin the shard count at write time. Names and helper calls
+    (``ring_perm(axis_size)``) are trusted: conservative silence.
+    """
+    if isinstance(node, (ast.List, ast.Tuple)):
+        try:
+            ast.literal_eval(node)
+        except (ValueError, TypeError, SyntaxError, MemoryError):
+            return False
+        return True
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        if len(node.generators) != 1:
+            return False
+        it = node.generators[0].iter
+        return (isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range"
+                and bool(it.args)
+                and all(isinstance(a, ast.Constant) for a in it.args))
+    return False
 
 
 class CollectiveMeshRule(Rule):
@@ -137,16 +185,16 @@ class CollectiveMeshRule(Rule):
 
         hits: List[Tuple[int, str]] = []
         mesh_axes = self._mesh_axes(module, project)
-        if mesh_axes is not None:
-            for node in module.nodes():
-                if not isinstance(node, ast.Call):
-                    continue
-                chain = dotted_chain(node.func)
-                if chain is None or chain[-1] not in _COLLECTIVES:
-                    continue
-                if chain[0] not in module.jax_aliases \
-                        and chain[0] != "lax":
-                    continue
+        for node in module.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_chain(node.func)
+            if chain is None or chain[-1] not in _COLLECTIVES:
+                continue
+            if chain[0] not in module.jax_aliases \
+                    and chain[0] != "lax":
+                continue
+            if mesh_axes is not None:
                 for operand in _axis_operands(node):
                     axes, ok = self._resolve_axes(operand, module,
                                                   project)
@@ -160,6 +208,16 @@ class CollectiveMeshRule(Rule):
                             f"{sorted(mesh_axes)} — a stale axis name "
                             f"is the PR 5 swallowed-axis class: wrong "
                             f"values, no error, once check_rep is off")))
+            if chain[-1] == "ppermute":
+                perm = _perm_operand(node)
+                if perm is not None and _is_literal_perm(perm):
+                    hits.append((node.lineno, (
+                        f"`{'.'.join(chain)}` builds its permutation "
+                        f"table from a literal — a ring written for one "
+                        f"tp degree silently drops shards at any other "
+                        f"(wrong values, no error, the stale-axis class "
+                        f"again); build it from the declared mesh axis "
+                        f"size: `parallel.mesh.ring_perm(axis_size)`")))
         yield from self.findings(module, hits)
 
         # check_rep=False audit: bypasses inline suppression — a

@@ -68,19 +68,40 @@ class GPTAttention(nn.Layer):
         qkv = qkv.transpose([2, 0, 1, 3, 4])  # 3,b,s,nh,hd
         q, k, v = qkv[0], qkv[1], qkv[2]
         if cache is not None:  # KV-cache decode (inference only)
-            from .generation import attend_with_cache
-            ctx, new_cache = attend_with_cache(q, k, v, cache, start_pos, 1)
-            # num_heads*head_dim, not cfg.hidden_size: under tensor
-            # parallelism this module runs with num_heads/tp local heads,
-            # so ctx is narrower than the input (and b may be a symbolic
-            # -1 under to_static, ruling out a -1 here)
-            return self.out(
-                ctx.reshape([b, s, self.num_heads * self.head_dim])), new_cache
+            return self.attend(q, k, v, b, s, cache, start_pos)
         ctx = F.scaled_dot_product_attention(
             q, k, v, is_causal=True,
             dropout_p=self.dropout_p if self.training else 0.0)
         ctx = ctx.reshape([b, s, self.num_heads * self.head_dim])
         return self.out(ctx)
+
+    def attend(self, q, k, v, b, s, cache, start_pos):
+        """Cache-path tail of the block, factored so the TP ring-overlap
+        driver (serving/overlap.py) can feed q/k/v assembled from
+        micro-row chunk matmuls: cache/paged attention, then the output
+        projection — which under TP retyping returns either the reduced
+        tensor (serial psum) or an un-reduced ring partial. The serial
+        forward calls it with identical inputs (pure code motion)."""
+        from .generation import attend_with_cache
+        ctx, new_cache = attend_with_cache(q, k, v, cache, start_pos, 1)
+        # num_heads*head_dim, not cfg.hidden_size: under tensor
+        # parallelism this module runs with num_heads/tp local heads,
+        # so ctx is narrower than the input (and b may be a symbolic
+        # -1 under to_static, ruling out a -1 here)
+        return self.out(
+            ctx.reshape([b, s, self.num_heads * self.head_dim])), new_cache
+
+
+def _resolve_tp_overlap(x):
+    """Finish a pending tensor-parallel ring reduction: the serving
+    overlap driver (serving/overlap.py) threads an un-reduced handle
+    through the decoder loop so block i's output all-reduce can overlap
+    block i+1's QKV matmuls, and the handle past the LAST block is
+    closed here, before the final norm. Plain tensors pass through
+    untouched — the overlap-off path stays zero-cost (duck-typed: no
+    serving import)."""
+    fin = getattr(x, "_tp_overlap_finish", None)
+    return x if fin is None else fin()
 
 
 class GPTBlock(nn.Layer):
@@ -178,7 +199,7 @@ class GPTModel(nn.Layer):
         for blk, cache in zip(self.blocks, caches):
             x, nc = blk(x, cache, start_pos)
             new_caches.append(nc)
-        return self.ln_f(x), new_caches
+        return self.ln_f(_resolve_tp_overlap(x)), new_caches
 
 
 class GPTEmbeddingPipe(nn.Layer):

@@ -76,10 +76,10 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
-        q, k = rotary_position_embedding(q, k, theta=self.rope_theta,
-                                         position_offset=start_pos)
-        rep = self.num_heads // self.num_kv_heads
         if cache is None:
+            q, k = rotary_position_embedding(q, k, theta=self.rope_theta,
+                                             position_offset=start_pos)
+            rep = self.num_heads // self.num_kv_heads
             if rep > 1:  # GQA: expand KV to full heads for the flash kernel
                 k = k.repeat_interleave(rep, axis=2)
                 v = v.repeat_interleave(rep, axis=2)
@@ -90,10 +90,37 @@ class LlamaAttention(nn.Layer):
             # -1 under to_static, ruling out a -1 here)
             return self.o_proj(
                 ctx.reshape([b, s, self.num_heads * self.head_dim]))
+        return self.attend(q, k, v, b, s, cache, start_pos)
+
+    def attend(self, q, k, v, b, s, cache, start_pos):
+        """Cache-path tail of the block, factored so the TP ring-overlap
+        driver (serving/overlap.py) can feed q/k/v assembled from
+        micro-row chunk matmuls: RoPE, cache/paged attention, then the
+        output projection — which under TP retyping returns either the
+        reduced tensor (serial psum) or an un-reduced ring partial. The
+        serial forward calls it with identical inputs (pure code
+        motion)."""
+        from ..tensor import rotary_position_embedding
         from .generation import attend_with_cache
+
+        q, k = rotary_position_embedding(q, k, theta=self.rope_theta,
+                                         position_offset=start_pos)
+        rep = self.num_heads // self.num_kv_heads
         ctx, new_cache = attend_with_cache(q, k, v, cache, start_pos, rep)
         return self.o_proj(
             ctx.reshape([b, s, self.num_heads * self.head_dim])), new_cache
+
+
+def _resolve_tp_overlap(x):
+    """Finish a pending tensor-parallel ring reduction: the serving
+    overlap driver (serving/overlap.py) threads an un-reduced handle
+    through the decoder loop so layer i's output all-reduce can overlap
+    layer i+1's QKV matmuls, and the handle past the LAST layer is
+    closed here, before the final norm. Plain tensors pass through
+    untouched — the overlap-off path stays zero-cost (duck-typed: no
+    serving import)."""
+    fin = getattr(x, "_tp_overlap_finish", None)
+    return x if fin is None else fin()
 
 
 class LlamaMLP(nn.Layer):
@@ -158,7 +185,7 @@ class LlamaModel(nn.Layer):
         for layer, cache in zip(self.layers, caches):
             x, nc = layer(x, cache, start_pos)
             new_caches.append(nc)
-        return self.norm(x), new_caches
+        return self.norm(_resolve_tp_overlap(x)), new_caches
 
 
 class LlamaForCausalLM(nn.Layer):

@@ -34,12 +34,14 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "DP_AXIS", "TP_AXIS", "device_order", "build_mesh", "carve_submeshes",
     "shard_leaf", "ordered_psum", "ordered_psum_scatter",
+    "ring_perm", "ring_collect", "ring_ordered_psum",
     "copy_to_tp_region", "reduce_from_tp_region", "tp_dim_spec",
     "local_shape",
 ]
@@ -163,6 +165,56 @@ def ordered_psum(x, axis_name: str):
     g = jax.lax.all_gather(x, axis_name)         # (n, ...)
     out = g[0]
     for i in range(1, g.shape[0]):
+        out = out + g[i]
+    return out
+
+
+def ring_perm(axis_size: int):
+    """Fixed-order ring permutation table for `lax.ppermute`: shard s
+    forwards to shard (s+1) % axis_size. ALWAYS built from the declared
+    mesh axis size, never a hard-coded table — a literal written for one
+    tp degree silently drops shards at another (the COLLECTIVE-MESH
+    split-collective rule rejects literal perm tables for this reason)."""
+    n = int(axis_size)
+    if n < 1:
+        raise ValueError(f"ring_perm needs axis_size >= 1, got {axis_size}")
+    return [(s, (s + 1) % n) for s in range(n)]
+
+
+def ring_collect(x, axis_name: str, axis_size: int):
+    """Collect every shard's `x` into a SOURCE-INDEXED (axis_size, ...)
+    buffer using axis_size-1 fixed-order `lax.ppermute` ring hops instead
+    of one `all_gather`. After hop t, shard i holds the value that
+    originated on shard (i - t) % n, so scattering each arrival into its
+    source slot rebuilds exactly the all_gather layout — a static-order
+    sum over the leading axis is then bit-identical to `ordered_psum`.
+    The value of the ring form: each hop moves a micro-chunk and has no
+    data dependency on the consumer of the previous chunk, so XLA's
+    latency-hiding scheduler can overlap transport with compute
+    (serving/overlap.py's split-psum pipeline; T3, arxiv 2401.16677)."""
+    n = int(axis_size)
+    perm = ring_perm(n)
+    i = jax.lax.axis_index(axis_name)
+    buf = jnp.zeros((n,) + x.shape, x.dtype)
+    zeros = (0,) * x.ndim
+    buf = jax.lax.dynamic_update_slice(buf, x[None], (i,) + zeros)
+    val = x
+    for t in range(1, n):
+        val = jax.lax.ppermute(val, axis_name, perm)
+        src = (i - t) % n
+        buf = jax.lax.dynamic_update_slice(buf, val[None], (src,) + zeros)
+    return buf
+
+
+def ring_ordered_psum(x, axis_name: str, axis_size: int):
+    """`ordered_psum` with the all_gather swapped for the fixed-order
+    ppermute ring: identical static shard-order sum over the collected
+    buffer, so the result is bit-identical to `ordered_psum` (and, pinned
+    empirically by the serving overlap tests, to `lax.psum`) on every
+    shard — the transport changes, the arithmetic does not."""
+    g = ring_collect(x, axis_name, axis_size)    # (n, ...)
+    out = g[0]
+    for i in range(1, int(axis_size)):
         out = out + g[i]
     return out
 

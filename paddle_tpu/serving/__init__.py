@@ -55,6 +55,16 @@ decoder models (LLaMA, GPT) with:
   `num_replicas x tp_size` disjoint sub-meshes. Page accounting,
   scheduling, recovery and migration are untouched (one logical page =
   tp physical slabs; the journal is device-independent);
+- `overlap`: collective/compute overlap — `ServingEngine(tp_size=N,
+  tp_overlap=True, tp_overlap_chunks=K)` splits each decode-step
+  row-parallel psum into K micro-row chunks moved by a fixed-order
+  ppermute ring, double-buffered so ring transport runs under the
+  consumer matmuls (attention-half reduction under the MLP columns,
+  layer i's final reduction under layer i+1's QKV). Static shard-order
+  accumulation keeps tokens bit-identical to the serial engine, fp32
+  and quantized; a construction probe publishes
+  `stats()["tp"]["overlap_fraction"]` (~0 on CPU is honest — no
+  independent interconnect to hide);
 - `quant`: quantized serving — `ServingEngine(kv_dtype="int8"|"fp8")`
   stores K/V pages in 1-byte formats with per-(head, page, slot) fp32
   scales in a parallel scale pool (one logical page = data slab + scale

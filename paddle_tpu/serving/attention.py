@@ -24,7 +24,11 @@ kv pool slabs of num_kv_heads/tp heads, queries of num_heads/tp heads —
 while page tables, positions and lengths arrive replicated. Attention is
 embarrassingly parallel over heads, so the shard-local result is exact;
 the block's single psum lives downstream in the row-parallel O
-projection, never in the attention op itself.
+projection, never in the attention op itself. That stays true under
+collective/compute overlap (serving.overlap): the ring-split reduction
+replaces only the downstream psum — this op's output just becomes the
+partial the ring chunks, transports and reduces while the next matmuls
+run.
 """
 from __future__ import annotations
 

@@ -257,17 +257,21 @@ class ServingObs:
         self.spec_target_steps = None
         self.spec_tokens_per_step = None
 
-    def bind_tp(self, tp_size: int) -> None:
+    def bind_tp(self, tp_size: int, overlap: bool = False) -> None:
         """TP observability (ISSUE 10): the measured all-reduce latency
-        histogram, one free-page gauge per shard (page accounting is
-        shard-replicated, so every shard reports the same number — the
-        label keeps per-shard dashboards well-formed), and a `tp=N` tag
-        appended to every lifecycle span name."""
+        histogram — labelled `overlap="on"/"off"` since ISSUE 18, so
+        dashboards can compare the serial wall against the
+        ring-overlapped one without mixing samples — one free-page gauge
+        per shard (page accounting is shard-replicated, so every shard
+        reports the same number; the label keeps per-shard dashboards
+        well-formed), and a `tp=N` tag appended to every lifecycle span
+        name."""
         r = self.registry
         self.tp_collective = r.histogram(
             "serving_tp_collective_seconds",
             "measured all-reduce wall seconds on the engine's tp "
-            "sub-mesh (decode-step payload shape)")
+            "sub-mesh (decode-step payload shape)",
+            labels={"overlap": "on" if overlap else "off"})
         self.tp_free_pages = [
             r.gauge("serving_kv_pages_free",
                     "free KV pages per tensor-parallel shard",
@@ -389,6 +393,8 @@ class ServingEngine:
                  tp_size: int = 1,
                  devices: Optional[Sequence] = None,
                  tp_quantized_allreduce: bool = False,
+                 tp_overlap: bool = False,
+                 tp_overlap_chunks: int = 2,
                  slo_classes: Optional[Sequence] = None,
                  slo_refresh_every: int = 64,
                  flight_recorder=None,
@@ -428,6 +434,24 @@ class ServingEngine:
             raise ValueError(
                 "tp_quantized_allreduce replaces the row-parallel psum "
                 "and needs tp_size >= 2 (tp_size=1 has no collective)")
+        # collective/compute overlap (ISSUE 18): split each row-parallel
+        # all-reduce into `tp_overlap_chunks` micro-row ring chunks that
+        # interleave with the consumer matmuls, tokens bit-identical to
+        # the serial psum. chunks=1 degenerates to the serial schedule
+        # (TPContext normalizes it off and reuses the serial
+        # executables); tp_size=1 has no collective to hide
+        self.tp_overlap = bool(tp_overlap)
+        self.tp_overlap_chunks = int(tp_overlap_chunks)
+        if self.tp_overlap:
+            if int(tp_size) < 2:
+                raise ValueError(
+                    "tp_overlap pipelines the row-parallel all-reduce "
+                    "and needs tp_size >= 2 (tp_size=1 has no "
+                    "collective to hide)")
+            if self.tp_overlap_chunks < 1:
+                raise ValueError(
+                    f"tp_overlap_chunks must be >= 1, got "
+                    f"{tp_overlap_chunks}")
         # tensor parallelism (ISSUE 10): tp_size>1 shards the model
         # weights (Megatron column/row specs) and the KV pools' kv-head
         # axis over a sub-mesh of `devices` (sorted by id; default the
@@ -442,7 +466,9 @@ class ServingEngine:
 
             self._tp = TPContext(
                 model, self.tp_size, devices=devices,
-                quantized_allreduce=self.tp_quantized_allreduce)
+                quantized_allreduce=self.tp_quantized_allreduce,
+                overlap=self.tp_overlap,
+                overlap_chunks=self.tp_overlap_chunks)
         else:
             self._tp = None
         self.page_size = page_size
@@ -531,7 +557,7 @@ class ServingEngine:
         self._obs = (ServingObs(self.metrics)
                      if self.metrics is not None else None)
         if self._obs is not None and self._tp is not None:
-            self._obs.bind_tp(self.tp_size)
+            self._obs.bind_tp(self.tp_size, overlap=self._tp.overlap)
         if self.metrics is not None:
             self.cache.allocator.bind_metrics(self.metrics)
         if self._obs is not None:

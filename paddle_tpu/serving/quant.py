@@ -111,6 +111,47 @@ def dequantize(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     return q.astype(SCALE_DTYPE) * scale
 
 
+def block_quantize(x: jnp.ndarray,
+                   block: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The shard-local half of :func:`quantized_psum`: ``x`` (..., hidden)
+    padded to a multiple of ``block``, split into block-wide chunks along
+    the hidden axis, each quantized against its own abs-max.  Returns
+    ``(q, scale)`` with ``q`` int8 of shape (..., nblocks, block) and
+    ``scale`` fp32 of shape (..., nblocks, 1).  Factored out so the
+    ring-overlapped all-reduce (serving/overlap.py) moves byte-identical
+    payloads to the all_gather form — rows are quantized independently,
+    so quantizing a micro-row chunk equals slicing the full quantization.
+    """
+    h = x.shape[-1]
+    nblocks = -(-h // block)
+    pad = nblocks * block - h
+    xp = x.astype(jnp.float32)
+    if pad:
+        xp = jnp.pad(xp, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    xb = xp.reshape(x.shape[:-1] + (nblocks, block))
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.round(jnp.clip(xb / scale, -127.0, 127.0)).astype(jnp.int8)
+    return q, scale
+
+
+def block_dequant_sum(qg: jnp.ndarray, sg: jnp.ndarray, h: int,
+                      out_dtype) -> jnp.ndarray:
+    """The replicated half of :func:`quantized_psum`: gathered int8
+    payloads ``qg`` (tp, ..., nblocks, block) and scales ``sg``
+    (tp, ..., nblocks, 1) dequantized and summed in fixed shard order
+    (one ``jnp.sum`` over the leading shard axis), unpadded back to
+    hidden size ``h``.  The ring-overlapped reduction feeds this the
+    SAME expression on ring-collected buffers, so both transports
+    produce bit-identical results."""
+    full = jnp.sum(qg.astype(jnp.float32) * sg, axis=0)
+    lead = full.shape[:-2]
+    out = full.reshape(lead + (full.shape[-2] * full.shape[-1],))
+    if out.shape[-1] != h:
+        out = out[..., :h]
+    return out.astype(out_dtype)
+
+
 def quantized_psum(x: jnp.ndarray, axis_name: str,
                    block: int = 256) -> jnp.ndarray:
     """EQuARX-style block-scaled int8 all-reduce over a mesh axis.
@@ -123,23 +164,10 @@ def quantized_psum(x: jnp.ndarray, axis_name: str,
     invariant the TP engine's sampling path relies on.  Wire cost per
     element drops from 4 bytes to ~1 byte (+ scales, amortized 1/block).
     """
-    h = x.shape[-1]
-    nblocks = -(-h // block)
-    pad = nblocks * block - h
-    xp = x.astype(jnp.float32)
-    if pad:
-        xp = jnp.pad(xp, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
-    xb = xp.reshape(x.shape[:-1] + (nblocks, block))
-    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.round(jnp.clip(xb / scale, -127.0, 127.0)).astype(jnp.int8)
+    q, scale = block_quantize(x, block)
     qg = jax.lax.all_gather(q, axis_name)          # (tp, ..., nb, block)
     sg = jax.lax.all_gather(scale, axis_name)      # (tp, ..., nb, 1)
-    full = jnp.sum(qg.astype(jnp.float32) * sg, axis=0)
-    out = full.reshape(x.shape[:-1] + (nblocks * block,))
-    if pad:
-        out = out[..., :h]
-    return out.astype(x.dtype)
+    return block_dequant_sum(qg, sg, x.shape[-1], x.dtype)
 
 
 def kv_pool_bytes(num_layers: int, num_pages: int, page_size: int,

@@ -471,6 +471,105 @@ class TestCollectiveMesh:
                                 rules=[analysis.get_rule("COLLECTIVE-MESH")])
         assert fs == []
 
+    # ---- the split-collective ppermute ring idiom (ISSUE 18) ---------
+    # serving/overlap.py moves psum payloads over a fixed-order
+    # ppermute ring so the reduction can interleave with consumer
+    # matmuls. The ring's permutation table must be built from the
+    # declared mesh axis size: a table literal-coded for one tp degree
+    # silently drops shards at any other.
+
+    def test_ppermute_literal_table_fires(self):
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def rotate(x):
+                return jax.lax.ppermute(x, "tp", perm=[(0, 1), (1, 0)])
+            def build(devs):
+                mesh = Mesh(devs, axis_names=("tp",))
+                return shard_map(rotate, mesh=mesh, in_specs=P("tp"),
+                                 out_specs=P("tp"))
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [6]
+        assert "literal" in fs[0].message
+        assert "ring_perm" in fs[0].message
+
+    def test_ppermute_range_literal_comprehension_fires(self):
+        # a comprehension over range(2) pins the shard count at write
+        # time just as hard as the expanded table does
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def rotate(x):
+                return jax.lax.ppermute(
+                    x, "tp", perm=[(s, (s + 1) % 2) for s in range(2)])
+            def build(devs):
+                mesh = Mesh(devs, axis_names=("tp",))
+                return shard_map(rotate, mesh=mesh, in_specs=P("tp"),
+                                 out_specs=P("tp"))
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [6]
+        assert "literal" in fs[0].message
+
+    def test_ppermute_mesh_sized_table_is_clean(self):
+        # the blessed idiom: the table comes from a helper fed the
+        # declared axis size — nothing literal, nothing to pin
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def ring_perm(n):
+                return [(s, (s + 1) % n) for s in range(n)]
+            def make_rotate(axis_size):
+                perm = ring_perm(axis_size)
+                def rotate(x):
+                    return jax.lax.ppermute(x, "tp", perm=perm)
+                return rotate
+            def build(devs, axis_size):
+                mesh = Mesh(devs, axis_names=("tp",))
+                return shard_map(make_rotate(axis_size), mesh=mesh,
+                                 in_specs=P("tp"), out_specs=P("tp"))
+        """, rule="COLLECTIVE-MESH")
+        assert fs == []
+
+    def test_ppermute_stale_axis_still_fires(self):
+        # the ring check composes with the axis check: a mesh-sized
+        # table does not excuse naming an axis the mesh never declared
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def make_rotate(perm):
+                def rotate(x):
+                    return jax.lax.ppermute(x, "ring", perm=perm)
+                return rotate
+            def build(devs, perm):
+                mesh = Mesh(devs, axis_names=("tp",))
+                return shard_map(make_rotate(perm), mesh=mesh,
+                                 in_specs=P("tp"), out_specs=P("tp"))
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [7]
+        assert "'ring'" in fs[0].message
+        assert "ppermute" in fs[0].message
+
+    def test_ppermute_literal_fires_without_mesh_resolution(self):
+        # the literal-table hazard needs no mesh: even when no Mesh
+        # constructor resolves (mesh arrives as a parameter), the ring
+        # check still runs
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            def rotate(x):
+                return jax.lax.ppermute(x, "tp", perm=[(0, 1), (1, 0)])
+            def build(mesh):
+                return shard_map(rotate, mesh=mesh, in_specs=P("tp"),
+                                 out_specs=P("tp"))
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [6]
+        assert "ring_perm" in fs[0].message
+
 
 # ---------------------------------------------------------------------------
 # METRIC-CARDINALITY

@@ -234,7 +234,8 @@ class TestParityMatrix:
         streams, eng = _run(model, kv_dtype="int8", tp_size=2,
                             tp_quantized_allreduce=True)
         assert streams[0] == int8_stream
-        probe = eng.metrics.get("serving_tp_collective_seconds")
+        probe = eng.metrics.get("serving_tp_collective_seconds",
+                                labels={"overlap": "off"})
         assert probe is not None and probe.count > 0
 
     def test_interpret_kernels(self, model, int8_stream, monkeypatch):

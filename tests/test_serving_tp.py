@@ -299,7 +299,8 @@ class TestObservability:
     def test_collective_histogram_and_per_shard_gauges(self):
         eng, _ = _staggered(_llama(), tp_size=2)
         reg = eng.metrics
-        h = reg.get("serving_tp_collective_seconds")
+        h = reg.get("serving_tp_collective_seconds",
+                    labels={"overlap": "off"})
         assert h is not None and h.count >= 3
         assert h.sum > 0.0
         g0 = reg.get("serving_kv_pages_free", labels={"shard": "0"})

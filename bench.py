@@ -694,7 +694,11 @@ def _run_pretrain_zero(on_tpu: bool) -> dict:
     replicated baseline, analytic max-batch headroom, and the dp
     all-reduce probe. Throughput is an expected null on the CPU
     fake-device mesh (see the phase docstring); non-fatal like the
-    phases around it."""
+    phases around it. Since ISSUE 19 the phase also carries a training
+    observability leg: telemetry snapshot + sentinel summary, measured
+    per-step telemetry overhead (<2% target on real hardware), and a
+    deliberate-NaN divergence drill that must dump exactly one
+    parseable postmortem bundle."""
     try:
         mod = _gen_bench_module()
         out = mod.pretrain_zero_phase(on_tpu)
@@ -715,6 +719,26 @@ def _run_pretrain_zero(on_tpu: bool) -> dict:
         if not out["parity_ok"]:
             _log("phase=pretrain_zero: WARN ZeRO params diverged from "
                  "the replicated baseline — the bit-parity contract")
+        try:  # ISSUE 19 telemetry leg — log-only, never fails the phase
+            t = out.get("telemetry") or {}
+            drill = t.get("divergence_drill") or {}
+            _log(f"phase=pretrain_zero: telemetry dp{t.get('dp')} "
+                 f"stage{t.get('stage')} overhead "
+                 f"{t.get('overhead_pct')}% "
+                 f"(on {t.get('step_ms_on')}ms / off "
+                 f"{t.get('step_ms_off')}ms, <2%="
+                 f"{t.get('overhead_under_2pct')}), "
+                 f"one_sync_per_step={t.get('one_sync_per_step')}, "
+                 f"tok/s/chip {t.get('tokens_per_sec_per_chip')}, "
+                 f"drill tripped={drill.get('tripped')} "
+                 f"cond={drill.get('condition')} "
+                 f"bundles={drill.get('bundle_files')}")
+            if not drill.get("tripped"):
+                _log("phase=pretrain_zero: WARN divergence drill did "
+                     "not trip — sentinel contract")
+        except Exception as e:  # noqa: BLE001 — log-only decoration
+            _log(f"phase=pretrain_zero: telemetry log skipped "
+                 f"({type(e).__name__}: {e})")
         return out
     except Exception as e:  # noqa: BLE001 — bench must degrade, not die
         _log(f"phase=pretrain_zero: FAIL {type(e).__name__}: {e}")

@@ -1317,11 +1317,122 @@ def pretrain_zero_phase(on_tpu):
             headroom[f"stage{stage}_extra_rows"] = saved // row_bytes
         headroom["row_bytes_model"] = row_bytes
 
+    # ---- training observability leg (ISSUE 19): run the dp_max ZeRO-1
+    # combo once more with TrainingTelemetry enabled — snapshot + sentinel
+    # summary ride in the bench JSON, per-step overhead is measured
+    # against a matched telemetry-off loop (target <2% on real hardware;
+    # on the CPU fake-device mesh the number is noisy but recorded), and
+    # a deliberate-NaN divergence drill asserts the sentinel trips and
+    # dumps exactly one parseable postmortem bundle.
+    telemetry_out = _pretrain_telemetry_leg(
+        build, zero_train_step, x, y, batch=batch,
+        dp=dp_max, stage=(1 if dp_max > 1 else 0), on_tpu=on_tpu)
+
     return {"devices": ndev, "degrees": degrees, "batch": batch,
             "steps": steps, "hidden": hid, **results,
             "parity_ok": bool(parity),
             "opt_bytes_exactly_1_over_dp": bool(bytes_exact),
-            "max_batch_headroom": headroom}
+            "max_batch_headroom": headroom,
+            "telemetry": telemetry_out}
+
+
+def _pretrain_telemetry_leg(build, zero_train_step, x, y, *, batch,
+                            dp, stage, on_tpu):
+    """ISSUE 19 bench leg: telemetry-on training snapshot + measured
+    per-step overhead + divergence drill. Returns a JSON-able dict;
+    any assertion failure propagates so bench.py logs it as a FAIL."""
+    import json
+    import os
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    from paddle_tpu.observability.training import (
+        SentinelConfig, TrainingDiverged, TrainingTelemetry)
+
+    obs_steps = 16 if on_tpu else 8
+
+    def timed_loop(telemetry):
+        model, optim = build()
+        step = zero_train_step(model, optim, stage=stage, dp=dp,
+                               telemetry=telemetry)
+        params, opt_state = step.init_state()
+        loss, params, opt_state = step(params, opt_state, (x, y), 1e-3, 1)
+        jax.block_until_ready(params)          # compile + warm
+        t0 = time.perf_counter()
+        for t in range(2, obs_steps + 2):
+            loss, params, opt_state = step(
+                params, opt_state, (x, y), 1e-3, t)
+        jax.block_until_ready(params)
+        if telemetry is None:
+            float(np.asarray(loss))   # match the host read telemetry does
+        return time.perf_counter() - t0, step
+
+    reg = MetricsRegistry()
+    tele = TrainingTelemetry(reg, tokens_per_step=batch)
+    wall_on, step_on = timed_loop(tele)
+    wall_off, _ = timed_loop(None)
+    overhead_pct = (wall_on - wall_off) / wall_off * 100.0
+
+    snap = tele.snapshot()
+    json.dumps(snap)                  # must be wire-able as-is
+    summary = step_on.describe()["telemetry"]
+    shard_probe = step_on.shard_step_seconds(samples=2, best_of=2)
+
+    # divergence drill: poison the batch at one step, expect the sentinel
+    # to trip with exactly one parseable paddle_tpu.postmortem/v1 bundle
+    drill = {"tripped": False}
+    with tempfile.TemporaryDirectory(prefix="paddle-tpu-bench-pm-") as d:
+        dtele = TrainingTelemetry(
+            MetricsRegistry(),
+            sentinel=SentinelConfig(window=4, warmup_steps=2),
+            postmortem_dir=d, tokens_per_step=batch)
+        model, optim = build()
+        step = zero_train_step(model, optim, stage=stage, dp=dp,
+                               telemetry=dtele)
+        params, opt_state = step.init_state()
+        x_bad = jnp.asarray(x).at[0, 0].set(jnp.nan)
+        try:
+            for t in range(1, 8):
+                bx = x_bad if t == 4 else x
+                loss, params, opt_state = step(
+                    params, opt_state, (bx, y), 1e-3, t)
+        except TrainingDiverged as e:
+            bundles = sorted(os.listdir(d))
+            assert len(bundles) == 1, bundles
+            with open(os.path.join(d, bundles[0])) as f:
+                doc = json.load(f)
+            assert doc["schema"] == "paddle_tpu.postmortem/v1"
+            assert doc["training"]["verdict"]["condition"] == "nan"
+            drill = {"tripped": True, "step": e.verdict["step"],
+                     "condition": e.verdict["condition"],
+                     "bundle_files": len(bundles)}
+        assert drill["tripped"], \
+            "NaN injection did not trip the divergence sentinel"
+
+    return {
+        "dp": dp, "stage": stage, "steps": obs_steps,
+        "step_ms_on": round(wall_on / obs_steps * 1000, 3),
+        "step_ms_off": round(wall_off / obs_steps * 1000, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_under_2pct": bool(overhead_pct < 2.0),
+        "tokens_per_sec": summary["tokens_per_sec"],
+        "tokens_per_sec_per_chip": summary["tokens_per_sec_per_chip"],
+        "host_syncs": summary["host_syncs"],
+        "one_sync_per_step": bool(summary["host_syncs"]
+                                  == summary["steps"]),
+        "phases_ms": {k: round(v["mean"] * 1000, 3)
+                      for k, v in summary["phases"].items()},
+        "shard_probe_us": {k: round(v * 1e6, 1)
+                           for k, v in shard_probe.items()},
+        "sentinel": summary["sentinel"],
+        "divergence_drill": drill,
+        "snapshot": snap,
+    }
 
 
 if __name__ == "__main__":

@@ -16,7 +16,12 @@ Three pieces:
   existing log-bucket histograms (windowed bucket deltas);
 - `flight_recorder`: `FlightRecorder` — bounded ring of control-plane
   events plus JSON post-mortem bundles dumped on engine death /
-  quarantine (`tools/postmortem.py` renders them).
+  quarantine (`tools/postmortem.py` renders them);
+- `training`: `TrainingTelemetry`/`DivergenceSentinel` — the ZeRO
+  trainer's telemetry plane (ISSUE 19): in-executable health scalars,
+  step-phase histograms, divergence sentinel + training postmortems.
+  Exported LAZILY (PEP 562) so a telemetry-off process never imports
+  it (`ZeroTrainStep` zero-cost-when-off pin).
 
 `global_registry()` is the process-wide registry for library-level
 signals (e.g. trace-time paged-attention dispatch counts); each
@@ -41,7 +46,27 @@ __all__ = [
     "global_registry",
     "SloClass", "SloTracker", "HistogramWindow",
     "FlightRecorder", "build_postmortem", "dump_postmortem",
+    "TrainingTelemetry", "TrainingDiverged", "DivergenceSentinel",
+    "SentinelConfig",
 ]
+
+# training-plane symbols resolved lazily (PEP 562): importing the
+# package must NOT import observability/training.py — a telemetry-off
+# trainer imports zero training-observability code, and the pin in
+# tests/test_training_obs.py poisons the submodule to prove it
+_LAZY_TRAINING = {
+    "TrainingTelemetry", "TrainingDiverged", "DivergenceSentinel",
+    "SentinelConfig",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY_TRAINING:
+        from . import training
+
+        return getattr(training, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 _GLOBAL: Optional[MetricsRegistry] = None
 _GLOBAL_LOCK = threading.Lock()

@@ -30,8 +30,21 @@ def _fmt_value(v) -> str:
 
 
 def _escape(s: str) -> str:
+    """Label-VALUE escaping per the Prometheus text format: backslash
+    first (so later substitutions don't double-escape), then newline,
+    then double-quote — exactly these three, in exactly this order
+    (ISSUE 19 audit; round-tripped in tests/test_observability.py)."""
     return (s.replace("\\", r"\\").replace("\n", r"\n")
             .replace('"', r'\"'))
+
+
+def _escape_help(s: str) -> str:
+    """HELP-line escaping: the text format escapes ONLY backslash and
+    newline there — HELP text is not quoted, so a literal `"` must
+    pass through unescaped (the ISSUE 19 audit's one real gap: HELP
+    previously went through the label-value escaper and emitted `\\"`,
+    which scrapers render verbatim)."""
+    return s.replace("\\", r"\\").replace("\n", r"\n")
 
 
 def _fmt_labels(labels: Dict[str, str]) -> str:
@@ -51,7 +64,7 @@ def to_prometheus(registry: MetricsRegistry) -> str:
             seen.add(m.name)
             if m.help:
                 lines.append(f"# HELP {m.name} "
-                             f"{_escape(m.help)}")
+                             f"{_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
         if m.kind == "histogram":
             cum = 0

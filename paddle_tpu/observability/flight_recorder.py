@@ -57,6 +57,9 @@ EVENT_KINDS = (
     "dead",         # supervisor declared the engine dead
     "migrate",      # cluster moved a request off a dead replica
     "adopt",        # a surviving replica adopted a migrated request
+    # training plane (ISSUE 19, observability/training.py)
+    "train_step",   # one ZeRO train step completed (scalars only)
+    "diverged",     # the divergence sentinel flagged a condition
 )
 
 POSTMORTEM_SCHEMA = "paddle_tpu.postmortem/v1"

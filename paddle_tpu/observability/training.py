@@ -1,0 +1,608 @@
+"""Training observability plane (ISSUE 19).
+
+The serving stack got its telemetry in ISSUEs 4/13 — a metrics
+registry, SLO windows, a flight recorder and postmortem bundles. The
+ZeRO trainer (ISSUE 16) was flying blind: one construction-time
+collective probe, no per-step signal at all. This module brings the
+same discipline to training, under the same two hard rules:
+
+- **zero cost when off**: `ZeroTrainStep` imports this module lazily
+  and only when a telemetry knob is set, so a telemetry-off trainer
+  executes (and imports) zero training-observability code
+  (poisoned-module pinned in tests/test_training_obs.py);
+- **one host sync per step when on**: every health scalar — loss,
+  global grad norm, param norm, update norm, NaN/Inf counts — is
+  computed INSIDE the existing jitted step body and returned as one
+  packed f32 vector alongside the loss, so the whole set rides a
+  single device->host drain (`TrainingTelemetry._host_read`, the one
+  noqa'd sync below). No extra executable is built (compile-count
+  pinned) and the telemetry-on step is bit-identical in
+  params/opt-state to telemetry-off: the health computation only
+  *consumes* values the update already produced, behind the step's
+  optimization barriers.
+
+Pieces:
+
+- traced helpers (`sumsq` / `nonfinite_count` / `combine_leaf_stats` /
+  `pack_health`) — called from the step body at trace time; the
+  cross-shard combines use the same fixed-shard-order `ordered_psum`
+  as the update itself, so the packed vector is replicated and
+  deterministic;
+- `TrainingTelemetry` — resolve-once handles for the
+  `training_step_phase_seconds{phase=}` histograms (batch_build /
+  dispatch / host_drain), tokens/sec and tokens/sec/chip gauges,
+  health gauges and step/token/host-sync counters, all labelled with
+  the bounded {dp, tp, stage} geometry; a host-side ring of recent
+  step scalars; flight-recorder events per step;
+- `DivergenceSentinel` — sliding-window monitor (reusing
+  `HistogramWindow` bucket-delta means as the reference) over
+  loss/grad-norm flagging nan / loss_spike / grad_spike / plateau;
+  a tripped condition dumps a `paddle_tpu.postmortem/v1` *training*
+  bundle through the existing `build_postmortem` machinery and raises
+  the typed `TrainingDiverged`;
+- `probe_best_of` — the straggler probe's min-estimator, shared with
+  `ZeroTrainStep.shard_step_seconds` (same warmed best-of-N
+  discipline as `TPContext.collective_seconds`).
+
+What a training bundle deliberately does NOT capture: parameter,
+gradient or optimizer-state VALUES, and batch contents. It carries
+scalars only — the recent step ring, the metrics snapshot, the
+sentinel verdict and the mesh/stage geometry — so a bundle is always
+small and never leaks weights.
+"""
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .flight_recorder import FlightRecorder, build_postmortem, \
+    dump_postmortem
+from .metrics import MetricsRegistry
+from .slo import HistogramWindow
+
+__all__ = [
+    "HEALTH_FIELDS", "TRAINING_SNAPSHOT_SCHEMA",
+    "SentinelConfig", "DivergenceSentinel", "TrainingDiverged",
+    "TrainingTelemetry", "probe_best_of",
+    "sumsq", "nonfinite_count", "combine_leaf_stats", "pack_health",
+]
+
+# the packed in-executable health vector, index-aligned with
+# `pack_health` below (tests and the report CLI index by this tuple)
+HEALTH_FIELDS = ("loss", "grad_norm", "param_norm", "update_norm",
+                 "nonfinite_grads", "nonfinite_params")
+
+TRAINING_SNAPSHOT_SCHEMA = "paddle_tpu.training_telemetry/v1"
+
+# host wall split of one __call__: build the batch tuple + lazy build,
+# dispatch the one executable, drain the packed health vector
+PHASES = ("batch_build", "dispatch", "host_drain")
+
+
+def probe_best_of(trials):
+    """Best-of-N estimator for the straggler probe: the MINIMUM of the
+    timed trials. min is monotone non-increasing as trials are added —
+    more trials can only tighten the estimate toward the true cost
+    (pinned by tests) — which is what makes per-shard numbers
+    comparable: every shard reports its best case, so a consistently
+    slower best IS a straggler, not scheduler noise."""
+    return min(trials)
+
+
+# --------------------------------------------------------------- traced
+# in-executable health scalars. These run INSIDE the jitted step body
+# (zero.py calls them at trace time); they must never touch the host.
+# graftlint's HOST-SYNC rule audits `pack_health` by name via
+# DEFAULT_HOT_MODULES.
+
+def sumsq(x):
+    """f32 sum of squares of one leaf (flattened)."""
+    import jax.numpy as jnp
+
+    return jnp.sum(jnp.square(x.astype(jnp.float32).reshape(-1)))
+
+
+def nonfinite_count(x):
+    """f32 count of NaN/Inf elements in one leaf."""
+    import jax.numpy as jnp
+
+    return jnp.sum((~jnp.isfinite(x)).astype(jnp.float32)).astype(
+        jnp.float32)
+
+
+def combine_leaf_stats(vec, tp_mask, dp_reduce: bool):
+    """Cross-shard combine of per-leaf stat rows (nleaves, k).
+
+    `dp_reduce=True` sums rows over the dp axis first (stage-2 slices
+    partition each leaf across dp shards; replicated rows must NOT be
+    dp-reduced or they multiply by dp). tp-sharded leaves additionally
+    need their tp parts summed: `tp_mask` is a (nleaves, 1) 0/1 trace
+    constant — masked rows go through a tp psum (replicated rows
+    contribute exact zeros there), unmasked rows pass through. Both
+    combines are the same fixed-shard-order `ordered_psum` the update
+    uses, so the result is deterministic and replicated."""
+    from ..parallel.mesh import DP_AXIS, TP_AXIS, ordered_psum
+
+    if dp_reduce:
+        vec = ordered_psum(vec, DP_AXIS)
+    if tp_mask is not None:
+        vec = vec * (1.0 - tp_mask) + ordered_psum(vec * tp_mask, TP_AXIS)
+    return vec
+
+
+def tp_leaf_mask(ctx, names):
+    """(nleaves, 1) 0/1 mask of tp-sharded leaves for `ctx` (a
+    ZeroTrainStep), or None when no leaf is tp-sharded (skips the tp
+    combine entirely — the common tp=1 case adds no collective)."""
+    import jax.numpy as jnp
+
+    flags = [1.0 if ctx._spec_dim.get(k) is not None else 0.0
+             for k in names]
+    if not any(flags):
+        return None
+    return jnp.asarray(flags, jnp.float32)[:, None]
+
+
+def grad_leaf_stats(ctx, per_leaf, dp_reduce: bool):
+    """Reduce per-leaf local (sumsq, nonfinite) gradient pairs to the
+    global `(grad_sumsq, nonfinite_grads)` aux scalars the step body
+    threads to `pack_health`. `per_leaf` is an ordered {name: leaf}
+    dict of the leaves the stats were taken over (full mean grads in
+    the replicated path, this shard's scatter slices in the sharded
+    path — the slices partition the padded flat grad, and the zero
+    padding contributes exactly 0 to both stats)."""
+    import jax.numpy as jnp
+
+    names = list(per_leaf)
+    rows = jnp.stack([jnp.stack([sumsq(per_leaf[k]),
+                                 nonfinite_count(per_leaf[k])])
+                      for k in names])
+    vec = combine_leaf_stats(rows, tp_leaf_mask(ctx, names), dp_reduce)
+    return jnp.sum(vec[:, 0]), jnp.sum(vec[:, 1])
+
+
+def pack_health(ctx, loss, old_params, new_params, grad_aux):
+    """Pack the six HEALTH_FIELDS scalars into ONE replicated f32
+    vector — the single extra output of the telemetry-on step body,
+    drained by `TrainingTelemetry._host_read` in one transfer.
+    Param/update stats are computed from the (replicated-across-dp,
+    tp-local) old/new params, with tp-sharded leaves combined over the
+    tp axis; `grad_aux` arrives pre-reduced from `grad_leaf_stats`."""
+    import jax.numpy as jnp
+
+    names = list(new_params)
+    rows = jnp.stack([jnp.stack([
+        sumsq(new_params[k]),
+        sumsq(new_params[k] - old_params[k]),
+        nonfinite_count(new_params[k]),
+    ]) for k in names])
+    vec = combine_leaf_stats(rows, tp_leaf_mask(ctx, names),
+                             dp_reduce=False)
+    gsq, nfg = grad_aux
+    return jnp.stack([
+        loss.astype(jnp.float32),
+        jnp.sqrt(gsq),
+        jnp.sqrt(jnp.sum(vec[:, 0])),
+        jnp.sqrt(jnp.sum(vec[:, 1])),
+        nfg,
+        jnp.sum(vec[:, 2]),
+    ])
+
+
+# ------------------------------------------------------------- sentinel
+@dataclass(frozen=True)
+class SentinelConfig:
+    """Divergence sentinel thresholds. The window references are
+    HistogramWindow bucket-delta means re-anchored every `window`
+    steps; spike verdicts compare the current value against the LAST
+    COMPLETED window's mean, so a single noisy step inside a window
+    never moves its own reference."""
+
+    window: int = 32            # steps per reference window
+    warmup_steps: int = 8       # no spike/plateau verdicts before this
+    loss_spike_factor: float = 3.0
+    grad_spike_factor: float = 10.0
+    plateau_steps: int = 200    # steps without best-loss improvement
+    plateau_rtol: float = 1e-3  # relative improvement that resets it
+    # conditions that RAISE TrainingDiverged (others only flag + count;
+    # plateau defaults to flag-only — a stalled run is a tuning
+    # problem, not a crash)
+    trip_on: Tuple[str, ...] = ("nan", "loss_spike", "grad_spike")
+    max_bundles: int = 1        # postmortem bundles per sentinel life
+
+    def __post_init__(self):
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1 (got {self.window})")
+        if self.loss_spike_factor <= 1.0 or self.grad_spike_factor <= 1.0:
+            raise ValueError("spike factors must be > 1")
+        unknown = set(self.trip_on) - {"nan", "loss_spike",
+                                       "grad_spike", "plateau"}
+        if unknown:
+            raise ValueError(f"unknown trip conditions: {sorted(unknown)}")
+
+
+class TrainingDiverged(RuntimeError):
+    """Typed divergence signal: the sentinel tripped. Carries the
+    verdict dict, the dumped bundle path (None if no postmortem_dir)
+    and the bundle itself for in-process handling."""
+
+    def __init__(self, message: str, *, verdict: Dict[str, Any],
+                 bundle_path: Optional[str] = None,
+                 bundle: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.verdict = verdict
+        self.bundle_path = bundle_path
+        self.bundle = bundle
+
+
+class DivergenceSentinel:
+    """Sliding-window loss/grad-norm monitor.
+
+    Observations land in two wide log-bucket histograms
+    (`training_loss_observations` / `training_grad_norm_observations`);
+    `HistogramWindow`s over them supply the per-window mean that
+    becomes the spike reference — no second accumulator, the windows
+    are pure bucket-delta views (slo.py discipline). `check` is the
+    hot path: a handful of float compares per step.
+    """
+
+    CONDITIONS = ("nan", "loss_spike", "grad_spike", "plateau")
+
+    def __init__(self, registry: MetricsRegistry,
+                 config: Optional[SentinelConfig] = None,
+                 labels: Optional[Dict[str, str]] = None):
+        self.config = config or SentinelConfig()
+        lab = dict(labels or {})
+        # wide range: losses/grad norms are not latency-shaped
+        self._loss_hist = registry.histogram(
+            "training_loss_observations",
+            "per-step training loss (sentinel window source)",
+            labels=lab or None, lo=1e-9, hi=1e9, growth=2.0 ** 0.5)
+        self._grad_hist = registry.histogram(
+            "training_grad_norm_observations",
+            "per-step global grad norm (sentinel window source)",
+            labels=lab or None, lo=1e-9, hi=1e9, growth=2.0 ** 0.5)
+        self._loss_win = HistogramWindow(self._loss_hist)
+        self._grad_win = HistogramWindow(self._grad_hist)
+        self._flag_counters = {
+            c: registry.counter(
+                "training_sentinel_flags_total",
+                "sentinel conditions flagged (tripped or not)",
+                labels={**lab, "condition": c})
+            for c in self.CONDITIONS
+        }
+        self._loss_ref: Optional[float] = None
+        self._grad_ref: Optional[float] = None
+        self._in_window = 0
+        self._seen = 0
+        self._best_loss = math.inf
+        self._best_step = 0
+        self._last_verdict: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------ hot path
+    def check(self, *, step: int, loss: float, grad_norm: float,
+              nonfinite: float) -> Optional[Dict[str, Any]]:
+        """Feed one step's scalars; returns a verdict dict when a
+        condition fires (caller decides whether `tripped` escalates),
+        else None."""
+        self._seen += 1
+        cfg = self.config
+        if nonfinite > 0 or loss != loss or grad_norm != grad_norm \
+                or math.isinf(loss) or math.isinf(grad_norm):
+            return self._verdict(
+                "nan", step, loss, grad_norm,
+                detail=f"nonfinite={nonfinite:g}")
+        self._loss_hist.observe(loss)
+        self._grad_hist.observe(grad_norm)
+        warm = self._seen > cfg.warmup_steps
+        if warm and self._loss_ref is not None and \
+                loss > cfg.loss_spike_factor * max(self._loss_ref, 1e-12):
+            return self._verdict(
+                "loss_spike", step, loss, grad_norm,
+                detail=f"ref={self._loss_ref:g} "
+                       f"factor={cfg.loss_spike_factor:g}")
+        if warm and self._grad_ref is not None and \
+                grad_norm > cfg.grad_spike_factor * max(self._grad_ref,
+                                                        1e-12):
+            return self._verdict(
+                "grad_spike", step, loss, grad_norm,
+                detail=f"ref={self._grad_ref:g} "
+                       f"factor={cfg.grad_spike_factor:g}")
+        if loss < self._best_loss * (1.0 - cfg.plateau_rtol):
+            self._best_loss = loss
+            self._best_step = step
+        elif warm and step - self._best_step >= cfg.plateau_steps:
+            self._best_step = step  # re-arm: one flag per plateau span
+            return self._verdict(
+                "plateau", step, loss, grad_norm,
+                detail=f"best={self._best_loss:g} over last "
+                       f"{cfg.plateau_steps} steps")
+        self._in_window += 1
+        if self._in_window >= cfg.window:
+            self._roll_window()
+        return None
+
+    def _roll_window(self) -> None:
+        """Close the current window: its mean becomes the next spike
+        reference, and both windows re-anchor."""
+        if self._loss_win.count:
+            self._loss_ref = self._loss_win.sum / self._loss_win.count
+        if self._grad_win.count:
+            self._grad_ref = self._grad_win.sum / self._grad_win.count
+        self._loss_win.anchor()
+        self._grad_win.anchor()
+        self._in_window = 0
+
+    def _verdict(self, condition: str, step: int, loss: float,
+                 grad_norm: float, detail: str) -> Dict[str, Any]:
+        self._flag_counters[condition].inc()
+        v = {
+            "condition": condition,
+            "step": step,
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "detail": detail,
+            "tripped": condition in self.config.trip_on,
+            "message": f"sentinel: {condition} at step {step} "
+                       f"(loss={loss:g} grad_norm={grad_norm:g}; "
+                       f"{detail})",
+        }
+        self._last_verdict = v
+        return v
+
+    # ----------------------------------------------------------- cold path
+    def state(self) -> Dict[str, Any]:
+        """JSON-able sentinel state for snapshots and bundles."""
+        return {
+            "seen": self._seen,
+            "loss_ref": self._loss_ref,
+            "grad_ref": self._grad_ref,
+            "best_loss": (None if math.isinf(self._best_loss)
+                          else self._best_loss),
+            "best_step": self._best_step,
+            "flags": {c: self._flag_counters[c].value
+                      for c in self.CONDITIONS},
+            "last_verdict": self._last_verdict,
+            "config": asdict(self.config),
+        }
+
+
+# ------------------------------------------------------------ telemetry
+class TrainingTelemetry:
+    """The per-trainer telemetry plane. Construct (optionally with your
+    own registry/recorder/sentinel config), pass as
+    `ZeroTrainStep(..., telemetry=...)` — or let
+    `enable_telemetry=True` build this default. The trainer calls
+    `bind()` once with its geometry (resolve-handles-once, metrics.py
+    discipline) and `record_step()` once per step."""
+
+    PHASES = PHASES
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None, *,
+                 sentinel: Optional[SentinelConfig] = None,
+                 enable_sentinel: bool = True,
+                 recorder: Optional[FlightRecorder] = None,
+                 enable_recorder: bool = True,
+                 postmortem_dir: Optional[str] = None,
+                 tokens_per_step: Optional[int] = None,
+                 history: int = 128,
+                 clock=time.perf_counter):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.recorder = recorder if recorder is not None else (
+            FlightRecorder(256, clock=clock) if enable_recorder else None)
+        self.postmortem_dir = postmortem_dir
+        self.tokens_per_step = tokens_per_step
+        self.clock = clock
+        self._sentinel_cfg = sentinel if enable_sentinel else None
+        if enable_sentinel and sentinel is None:
+            self._sentinel_cfg = SentinelConfig()
+        self.sentinel: Optional[DivergenceSentinel] = None
+        self._ring: deque = deque(maxlen=max(int(history), 1))
+        self._bundles_dumped = 0
+        self.geometry: Dict[str, Any] = {}
+        self._bound = False
+
+    # ---------------------------------------------------------------- bind
+    def bind(self, *, dp: int, tp: int, stage: int,
+             device_ids: List[int]) -> None:
+        """Resolve every metric handle once for this trainer's bounded
+        {dp, tp, stage} label set. Idempotent for identical geometry;
+        a second bind with different geometry is a bug (one telemetry
+        plane per trainer)."""
+        geometry = {"dp": int(dp), "tp": int(tp), "stage": int(stage),
+                    "devices": [int(d) for d in device_ids]}
+        if self._bound:
+            if geometry != self.geometry:
+                raise ValueError(
+                    f"telemetry already bound to {self.geometry}; "
+                    f"rebinding to {geometry} would mix series — build "
+                    "one TrainingTelemetry per trainer")
+            return
+        self.geometry = geometry
+        lab = {"dp": str(geometry["dp"]), "tp": str(geometry["tp"]),
+               "stage": str(geometry["stage"])}
+        self._labels = lab
+        self._n_chips = max(len(geometry["devices"]), 1)
+        reg = self.registry
+        self._phase = {
+            ph: reg.histogram(
+                "training_step_phase_seconds",
+                "host wall split of one train step by phase",
+                labels={**lab, "phase": ph})
+            for ph in PHASES
+        }
+        self._step_wall = reg.histogram(
+            "training_step_seconds",
+            "end-to-end host wall of one train step", labels=lab)
+        self._steps = reg.counter(
+            "training_steps_total", "train steps completed", labels=lab)
+        self._tokens = reg.counter(
+            "training_tokens_total", "tokens consumed", labels=lab)
+        self._host_syncs = reg.counter(
+            "training_host_syncs_total",
+            "device->host drains (exactly one per step)", labels=lab)
+        self._nonfinite_total = reg.counter(
+            "training_nonfinite_total",
+            "nonfinite grad/param elements seen", labels=lab)
+        self._tps = reg.gauge(
+            "training_tokens_per_sec",
+            "tokens/sec over the last step's wall", labels=lab)
+        self._tps_chip = reg.gauge(
+            "training_tokens_per_sec_per_chip",
+            "tokens/sec/chip over the last step's wall", labels=lab)
+        self._health_gauges = {
+            name: reg.gauge(f"training_{name}",
+                            f"last step's {name}", labels=lab)
+            for name in ("loss", "grad_norm", "param_norm", "update_norm")
+        }
+        if self._sentinel_cfg is not None:
+            self.sentinel = DivergenceSentinel(
+                reg, self._sentinel_cfg, labels=lab)
+        self._bound = True
+
+    # ------------------------------------------------------------ hot path
+    def _host_read(self, health) -> List[float]:
+        """THE one device->host sync of a telemetry-on step: drain the
+        packed health vector. Everything record_step consumes is a
+        plain host float after this."""
+        host = np.asarray(health)  # noqa: HOST-SYNC — the ONE intentional per-step drain: all six health scalars ride this single transfer (zero-extra-sync pin in tests/test_training_obs.py)
+        return [float(v) for v in host]  # noqa: HOST-SYNC — host-side unpack of the already-drained numpy vector, not a second device sync
+
+    def record_step(self, health, *, step: int, tokens: int,
+                    batch_build_s: float, dispatch_s: float) -> float:
+        """Record one completed step: drains `health` (the step body's
+        packed vector) in the one host sync, observes the three phase
+        histograms, refreshes throughput + health gauges, appends to
+        the step ring, records a flight-recorder event and runs the
+        sentinel. Returns the host loss (the trainer hands it back to
+        the caller so the caller's own loss read is NOT a second
+        sync). Raises TrainingDiverged when the sentinel trips."""
+        t0 = self.clock()
+        vals = self._host_read(health)
+        drain_s = self.clock() - t0
+        loss, grad_norm, param_norm, update_norm, nfg, nfp = vals
+        self._host_syncs.inc()
+        self._steps.inc()
+        self._tokens.inc(int(tokens))
+        self._phase["batch_build"].observe(batch_build_s)
+        self._phase["dispatch"].observe(dispatch_s)
+        self._phase["host_drain"].observe(drain_s)
+        wall = batch_build_s + dispatch_s + drain_s
+        self._step_wall.observe(wall)
+        tps = tokens / wall if wall > 0 else 0.0
+        self._tps.set(tps)
+        self._tps_chip.set(tps / self._n_chips)
+        self._health_gauges["loss"].set(loss)
+        self._health_gauges["grad_norm"].set(grad_norm)
+        self._health_gauges["param_norm"].set(param_norm)
+        self._health_gauges["update_norm"].set(update_norm)
+        nonfinite = nfg + nfp
+        if nonfinite > 0:
+            self._nonfinite_total.inc(int(nonfinite))
+        self._ring.append({
+            "step": int(step), "loss": loss, "grad_norm": grad_norm,
+            "param_norm": param_norm, "update_norm": update_norm,
+            "nonfinite": nonfinite, "tokens": int(tokens),
+            "wall_s": wall,
+        })
+        if self.recorder is not None:
+            self.recorder.record(
+                "train_step", step=int(step), loss=loss,
+                grad_norm=grad_norm, tokens=int(tokens), wall_s=wall)
+        if self.sentinel is not None:
+            verdict = self.sentinel.check(
+                step=int(step), loss=loss, grad_norm=grad_norm,
+                nonfinite=nonfinite)
+            if verdict is not None:
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "diverged", step=int(step),
+                        condition=verdict["condition"],
+                        tripped=verdict["tripped"])
+                if verdict["tripped"]:
+                    self._trip(verdict)
+        return loss
+
+    # ----------------------------------------------------------- cold path
+    def _trip(self, verdict: Dict[str, Any]) -> None:
+        """Tripped-sentinel path: build + (maybe) dump the training
+        postmortem bundle, then raise. Deliberately NOT on the happy
+        path — only a tripped verdict reaches here."""
+        bundle = self.build_bundle(
+            reason=f"diverged-{verdict['condition']}", verdict=verdict)
+        path = None
+        cfg = self.sentinel.config if self.sentinel is not None else None
+        max_bundles = cfg.max_bundles if cfg is not None else 1
+        if self.postmortem_dir and self._bundles_dumped < max_bundles:
+            path = dump_postmortem(bundle, self.postmortem_dir,
+                                   prefix="training-postmortem")
+            self._bundles_dumped += 1
+        raise TrainingDiverged(verdict["message"], verdict=verdict,
+                               bundle_path=path, bundle=bundle)
+
+    def build_bundle(self, reason: str,
+                     verdict: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+        """A `paddle_tpu.postmortem/v1` bundle with the training
+        section (`bundle["training"]`): recent step ring, sentinel
+        state + verdict, geometry. Scalars only — never parameter,
+        gradient or optimizer-state values (module docstring
+        contract)."""
+        bundle = build_postmortem(
+            reason, recorder=self.recorder, registry=self.registry,
+            info={"variant": "training", **self.geometry})
+        bundle["training"] = {
+            "schema": TRAINING_SNAPSHOT_SCHEMA,
+            "geometry": dict(self.geometry),
+            "steps": list(self._ring),
+            "sentinel": (self.sentinel.state()
+                         if self.sentinel is not None else None),
+            "verdict": verdict,
+        }
+        return bundle
+
+    def observe_shard_step(self, shard: str, seconds: float) -> None:
+        """Publish one straggler-probe measurement for a dp shard
+        (bounded label: one series per dp row)."""
+        self.registry.histogram(
+            "training_shard_step_seconds",
+            "warmed best-of-N per-dp-shard step-time probe",
+            labels={**self._labels, "shard": str(shard)}).observe(seconds)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able telemetry snapshot (`tools/training_report.py`
+        renders it): geometry, full metrics snapshot, step ring,
+        sentinel state and the compact summary."""
+        return {
+            "schema": TRAINING_SNAPSHOT_SCHEMA,
+            "geometry": dict(self.geometry),
+            "metrics": self.registry.snapshot(),
+            "steps": list(self._ring),
+            "sentinel": (self.sentinel.state()
+                         if self.sentinel is not None else None),
+            "summary": self.summary(),
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact `describe()["telemetry"]` view."""
+        if not self._bound:
+            return {"bound": False}
+        return {
+            "bound": True,
+            "geometry": dict(self.geometry),
+            "steps": self._steps.value,
+            "tokens": self._tokens.value,
+            "host_syncs": self._host_syncs.value,
+            "tokens_per_sec": self._tps.value,
+            "tokens_per_sec_per_chip": self._tps_chip.value,
+            "last": (dict(self._ring[-1]) if self._ring else None),
+            "phases": {ph: h.summary() for ph, h in self._phase.items()},
+            "sentinel": (self.sentinel.state()
+                         if self.sentinel is not None else None),
+        }

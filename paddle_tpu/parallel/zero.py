@@ -147,10 +147,19 @@ def _accumulated_grads(ctx, params, batch):
 def _replicated_update(ctx, params, grads, state, lr, t):
     """Stage 0: fixed-order dp all-reduce of every grad, full
     elementwise update everywhere — the reference the sharded stages
-    are bit-identical to."""
+    are bit-identical to. Returns `(new_params, new_state, grad_aux)`
+    where grad_aux is the telemetry (grad_sumsq, nonfinite) pair over
+    the MEAN grad (None when telemetry is off — the telemetry-off
+    trace is unchanged)."""
     inv = jnp.float32(1.0 / ctx.dp)
     g = {k: ordered_psum(grads[k], DP_AXIS) * inv for k in grads}
-    return ctx.optimizer.functional_step(params, g, state, lr, t)
+    new_p, new_s = ctx.optimizer.functional_step(params, g, state, lr, t)
+    aux = None
+    if ctx._telemetry is not None:
+        # g is replicated across dp (already all-reduced): no dp
+        # combine, tp-sharded leaves combined inside grad_leaf_stats
+        aux = ctx._trmod.grad_leaf_stats(ctx, g, dp_reduce=False)
+    return new_p, new_s, aux
 
 
 def _sharded_update(ctx, params, grads, state, lr, t):
@@ -159,7 +168,15 @@ def _sharded_update(ctx, params, grads, state, lr, t):
     (dp, tp, chunk)-laid-out state, then all-gather the updated slices
     back into the tp-local param. Stage 1 all-reduces the full grad
     first; stage 2 reduce-scatters so the full summed gradient never
-    materializes in the update path."""
+    materializes in the update path.
+
+    Telemetry keeps that property: the grad health stats are taken
+    over each shard's SLICE of the mean grad (the slices partition the
+    padded flat grad; zero padding contributes 0 to both sumsq and the
+    nonfinite count), then dp-combined as per-leaf scalars inside
+    `grad_leaf_stats` — the full summed gradient still never
+    materializes. Returns `(new_params, new_state, grad_aux)`;
+    grad_aux is None when telemetry is off."""
     inv = jnp.float32(1.0 / ctx.dp)
     names = list(params)
     i = jax.lax.axis_index(DP_AXIS)
@@ -186,9 +203,12 @@ def _sharded_update(ctx, params, grads, state, lr, t):
     for k in names:
         full = jax.lax.all_gather(new_slices[k], DP_AXIS).reshape(-1)
         new_params[k] = full[:ctx._loc_sizes[k]].reshape(ctx._loc_shapes[k])
+    aux = None
+    if ctx._telemetry is not None:
+        aux = ctx._trmod.grad_leaf_stats(ctx, sliced_g, dp_reduce=True)
     return new_params, {k: {slot: v.reshape(1, 1, -1)
                             for slot, v in new_state[k].items()}
-                        for k in names}
+                        for k in names}, aux
 
 
 # ------------------------------------------- degree-blind state layout
@@ -236,7 +256,8 @@ class ZeroTrainStep:
                  dp: Optional[int] = None, tp: int = 1, stage: int = 1,
                  param_specs: Optional[Dict[str, P]] = None,
                  batch_specs: Optional[Sequence[P]] = None,
-                 grad_accum: int = 1, devices=None):
+                 grad_accum: int = 1, devices=None,
+                 telemetry=None, enable_telemetry: bool = False):
         if stage not in (0, 1, 2):
             raise ValueError(
                 f"stage must be 0 (replicated baseline), 1 (ZeRO-1) or 2 "
@@ -296,6 +317,22 @@ class ZeroTrainStep:
         self._state_spec: Dict[str, Dict[str, P]] = {}
         self._step = None
         self._probes: Dict[int, object] = {}
+        # ---- training observability (ISSUE 19), opt-in. The import is
+        # lazy AND conditional: a telemetry-off trainer never imports
+        # observability/training.py at all (poisoned-module pinned in
+        # tests/test_training_obs.py — zero cost when off means zero
+        # code, not just zero work).
+        self._telemetry = None
+        self._trmod = None
+        if telemetry is not None or enable_telemetry:
+            from ..observability import training as _trmod
+
+            self._trmod = _trmod
+            self._telemetry = (telemetry if telemetry is not None
+                               else _trmod.TrainingTelemetry())
+            self._telemetry.bind(
+                dp=self.dp, tp=self.tp, stage=self.stage,
+                device_ids=[d.id for d in self.devices])
 
     # ------------------------------------------------------------ geometry
     def _record_geometry(self, params: Dict[str, jnp.ndarray]) -> None:
@@ -408,30 +445,68 @@ class ZeroTrainStep:
             loss, grads = jax.lax.optimization_barrier((loss, grads))
             loss = ordered_psum(loss, DP_AXIS) * inv_dp
             if not ctx._sharded:
-                new_p, new_s = _replicated_update(ctx, params, grads,
-                                                  state, lr, t)
+                new_p, new_s, aux = _replicated_update(ctx, params, grads,
+                                                       state, lr, t)
             else:
-                new_p, new_s = _sharded_update(ctx, params, grads,
-                                               state, lr, t)
-            return loss, new_p, new_s
+                new_p, new_s, aux = _sharded_update(ctx, params, grads,
+                                                    state, lr, t)
+            if ctx._telemetry is None:
+                return loss, new_p, new_s
+            # seal the update the same way the backward is sealed: the
+            # health packing only CONSUMES barriered copies, so it
+            # cannot steer how XLA compiles the update itself — the
+            # telemetry-on step stays bit-identical to telemetry-off
+            # (pinned across the whole (dp, stage) matrix in
+            # tests/test_training_obs.py)
+            loss, new_p, new_s, params, aux = jax.lax.optimization_barrier(
+                (loss, new_p, new_s, params, aux))
+            health = ctx._trmod.pack_health(ctx, loss, params, new_p, aux)
+            return loss, new_p, new_s, health
 
+        out_specs = ((P(), pspec, sspec) if self._telemetry is None
+                     else (P(), pspec, sspec, P()))
         self._step = jax.jit(_shard_map(
             body, mesh=self.mesh,
             in_specs=(pspec, sspec, bspec, P(), P()),
-            out_specs=(P(), pspec, sspec),
+            out_specs=out_specs,
             check_rep=False,  # noqa: COLLECTIVE-MESH — the ordered fixed-shard-order collectives and the (dp,tp,chunk) state outputs are per-shard by design; 0.4.x rep tracking can't see through custom_vjp boundaries
             ))
 
     def __call__(self, params, opt_state, batch, lr, t):
         """One training step. `batch` is a tuple of GLOBAL arrays
         (row-sharded over dp per batch_specs); `lr` scalar; `t` the
-        1-based step count (drives Adam bias correction)."""
+        1-based step count (drives Adam bias correction).
+
+        With telemetry enabled the returned loss is the HOST float the
+        telemetry plane drained (same value, already synced) — the one
+        per-step host sync covers the caller's loss read too — and the
+        call may raise `TrainingDiverged` when the sentinel trips."""
+        tele = self._telemetry
+        if tele is None:
+            batch = tuple(batch)
+            if self._step is None:
+                self._build(len(batch))
+            return self._step(params, opt_state, batch,
+                              jnp.asarray(lr, jnp.float32),
+                              jnp.asarray(t, jnp.int32))
+        t_in = tele.clock()
         batch = tuple(batch)
         if self._step is None:
             self._build(len(batch))
-        return self._step(params, opt_state, batch,
-                          jnp.asarray(lr, jnp.float32),
-                          jnp.asarray(t, jnp.int32))
+        lr_ = jnp.asarray(lr, jnp.float32)
+        t_ = jnp.asarray(t, jnp.int32)
+        # tokens from batch SHAPE metadata — never a device read
+        rows = batch[0].shape[0]
+        tokens = (tele.tokens_per_step if tele.tokens_per_step is not None
+                  else int(rows))
+        t0 = tele.clock()
+        loss, new_p, new_s, health = self._step(params, opt_state, batch,
+                                                lr_, t_)
+        t1 = tele.clock()
+        host_loss = tele.record_step(
+            health, step=int(t), tokens=tokens,
+            batch_build_s=t0 - t_in, dispatch_s=t1 - t0)
+        return host_loss, new_p, new_s
 
     # -------------------------------------------------------- observability
     @staticmethod
@@ -489,6 +564,55 @@ class ZeroTrainStep:
             hist.observe(s)
         return out
 
+    def shard_step_seconds(self, samples: int = 3, rows: int = 128,
+                           width: int = 128,
+                           best_of: int = 3) -> Dict[str, float]:
+        """Per-dp-shard straggler probe: a warmed best-of-N single-
+        device micro-step (matmul-shaped) timed on EACH dp row's lead
+        device, published as `training_shard_step_seconds{shard=}`.
+        Same discipline as `collective_seconds`/`TPContext.
+        collective_seconds`: two warm-up dispatches, then best-of-N per
+        sample (`observability.training.probe_best_of` = min, monotone
+        as trials are added) — so a shard whose BEST case is slow is a
+        real straggler, not scheduler noise, and it shows up before it
+        stalls the whole mesh at the next collective."""
+        from ..observability import training as trmod
+
+        fn = self._probes.get(("shard", rows, width))
+        if fn is None:
+            fn = jax.jit(lambda a: (a @ a.T).sum())
+            self._probes[("shard", rows, width)] = fn
+        out: Dict[str, float] = {}
+        # enumerate over the mesh's (dp, tp) device grid rows — the
+        # shard label cardinality is the dp degree, bounded by the mesh
+        for shard, dev_row in enumerate(self.mesh.devices):
+            dev = dev_row.reshape(-1)[0]
+            x = jax.device_put(jnp.ones((rows, width), jnp.float32), dev)
+            fn(x).block_until_ready()          # compile + warm
+            fn(x).block_until_ready()
+            best = []
+            for _ in range(max(int(samples), 1)):
+                trials = []
+                for _ in range(max(int(best_of), 1)):
+                    t0 = time.perf_counter()
+                    fn(x).block_until_ready()
+                    trials.append(time.perf_counter() - t0)
+                best.append(trmod.probe_best_of(trials))
+            if self._telemetry is not None:
+                for s in best:
+                    self._telemetry.observe_shard_step(str(shard), s)
+            else:
+                from ..observability import global_registry
+
+                hist = global_registry().histogram(
+                    "training_shard_step_seconds",
+                    "warmed best-of-N per-dp-shard step-time probe",
+                    labels={"shard": str(shard)})
+                for s in best:
+                    hist.observe(s)
+            out[str(shard)] = trmod.probe_best_of(best)
+        return out
+
     def describe(self) -> Dict[str, object]:
         return {
             "dp": self.dp,
@@ -498,6 +622,8 @@ class ZeroTrainStep:
             "devices": [d.id for d in self.devices],
             "params": len(self._shapes),
             "chunk_elems": sum(self._chunks.values()),
+            "telemetry": (self._telemetry.summary()
+                          if self._telemetry is not None else None),
         }
 
 

@@ -186,10 +186,16 @@ def _sample_registry():
     return r
 
 
-# one sample line: name{labels}? value  (value may be +Inf/-Inf/float/int)
+# one sample line: name{labels}? value  (value may be +Inf/-Inf/float/int).
+# Label values follow the text-format escaping rules — `\\`, `\n`, `\"`
+# — so the value pattern is "any run of non-quote-non-backslash chars or
+# backslash escapes" (ISSUE 19 audit: the old `[^"]*` silently accepted
+# a BROKEN exposition where a raw `"` inside a value ended it early)
+_PROM_VALUE = r'(?:[^"\\\n]|\\.)*'
 _PROM_LINE = re.compile(
     r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
-    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="' + _PROM_VALUE +
+    r'"(,[a-zA-Z_][a-zA-Z0-9_]*="' + _PROM_VALUE + r'")*\})?'
     r' (\+Inf|-Inf|-?[0-9.]+(e[+-]?[0-9]+)?)$')
 
 
@@ -235,6 +241,85 @@ class TestPrometheusExport:
         r.counter("c_total", labels={"path": 'a"b\\c'}).inc()
         text = to_prometheus(r)
         assert r'path="a\"b\\c"' in text
+
+    def test_label_escaping_order_and_newline(self):
+        """Backslash is escaped FIRST, then newline, then quote — so a
+        value containing all three round-trips without double-escaping
+        (ISSUE 19 audit of export._escape)."""
+        r = MetricsRegistry()
+        r.counter("c_total", labels={"path": 'a\\n"b\nc'}).inc()
+        text = to_prometheus(r)
+        # literal backslash+n -> \\n, the quote -> \", real newline -> \n
+        assert 'path="a\\\\n\\"b\\nc"' in text
+        # no raw newline may survive inside the exposition line
+        for line in text.strip().split("\n"):
+            if not line.startswith("#"):
+                assert _PROM_LINE.match(line), f"unparseable: {line!r}"
+
+    def test_help_escaping_is_backslash_and_newline_only(self):
+        """HELP text is unquoted in the exposition format: only backslash
+        and newline are escaped there; a literal double-quote must pass
+        through untouched (the gap the ISSUE 19 audit fixed — HELP used
+        to go through the label-value escaper and emit \\")."""
+        r = MetricsRegistry()
+        r.counter("c_total", 'tokens "in flight" per\nshard \\ chip').inc()
+        text = to_prometheus(r)
+        assert ('# HELP c_total tokens "in flight" per\\nshard \\\\ chip'
+                in text)
+        assert r'\"' not in text.split("\n")[0]
+
+    def test_training_series_round_trip_line_by_line(self):
+        """The ISSUE 19 training plane's dp/tp/stage-labeled series —
+        phase seconds, shard step seconds, sentinel flag counters,
+        throughput gauges — must all survive the line-by-line parser,
+        including a hostile label value with quote/backslash/newline."""
+        r = MetricsRegistry()
+        lab = {"dp": "2", "tp": "2", "stage": "1"}
+        for phase in ("batch_build", "dispatch", "host_drain"):
+            h = r.histogram("training_step_phase_seconds",
+                            "per-phase wall seconds",
+                            labels={**lab, "phase": phase})
+            h.observe(0.001 * (1 + len(phase)))
+        for shard in range(4):
+            r.histogram("training_shard_step_seconds",
+                        "per-shard probe",
+                        labels={**lab, "shard": str(shard)}).observe(2e-4)
+        for cond in ("nan", "loss_spike", "grad_spike", "plateau"):
+            r.counter("training_sentinel_flags_total",
+                      "sentinel flags",
+                      labels={**lab, "condition": cond}).inc()
+        r.gauge("training_tokens_per_sec", "throughput", labels=lab) \
+            .set(123456.789)
+        r.gauge("training_tokens_per_sec_per_chip", "per chip",
+                labels=lab).set(30864.2)
+        r.counter("training_steps_total", "steps", labels=lab).inc(7)
+        # hostile value: the escape-aware parser must still take the line
+        r.counter("c_total", labels={"note": 'sp"ike\\at\nstep 4'}).inc()
+        text = to_prometheus(r)
+        names = set()
+        for line in text.strip().split("\n"):
+            if line.startswith("#"):
+                continue
+            m = _PROM_LINE.match(line)
+            assert m, f"unparseable: {line!r}"
+            names.add(line.split("{", 1)[0].split(" ", 1)[0])
+        assert "training_step_phase_seconds_bucket" in names
+        assert "training_shard_step_seconds_count" in names
+        assert "training_sentinel_flags_total" in names
+        assert "training_tokens_per_sec_per_chip" in names
+        # label sets render sorted and fully escaped
+        assert 'phase="dispatch"' in text
+        assert 'condition="loss_spike"' in text
+        assert 'dp="2",phase="batch_build",stage="1",tp="2"' in text
+        assert r'note="sp\"ike\\at\nstep 4"' in text
+
+    def test_parser_rejects_unescaped_quote_in_value(self):
+        """The escape-aware pattern is strict, not just permissive: a raw
+        `"` inside a label value (what a broken escaper would emit) must
+        NOT parse."""
+        assert _PROM_LINE.match('m{a="x\\"y"} 1')
+        assert not _PROM_LINE.match('m{a="x"y"} 1')
+        assert not _PROM_LINE.match('m{a="x\\"} 1')
 
 
 class TestJsonSnapshot:

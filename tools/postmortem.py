@@ -33,7 +33,7 @@ from typing import Dict, List, Optional
 BAD_TERMINALS = ("failed", "expired", "shed")
 
 # event kinds worth shouting about in the timeline
-_ALARM_KINDS = {"fault", "quarantine", "dead"}
+_ALARM_KINDS = {"fault", "quarantine", "dead", "diverged"}
 _MOVE_KINDS = {"migrate"}
 _RECOVER_KINDS = {"restart", "adopt"}
 
@@ -100,6 +100,15 @@ def _event_detail(ev: dict) -> str:
         return (f"request {ev.get('rid', '?')} "
                 f"delivered={ev.get('delivered', '?')} "
                 f"remaining={ev.get('remaining', '?')}")
+    if kind == "train_step":
+        return (f"step {ev.get('step', '?')} "
+                f"loss={ev.get('loss', '?')} "
+                f"grad_norm={ev.get('grad_norm', '?')} "
+                f"tokens={ev.get('tokens', '?')}")
+    if kind == "diverged":
+        trip = "TRIPPED" if ev.get("tripped") else "flagged"
+        return (f"{trip} {ev.get('condition', '?')} at step "
+                f"{ev.get('step', '?')}")
     skip = {"seq", "t", "kind"}
     return " ".join(f"{k}={v}" for k, v in ev.items() if k not in skip)
 
@@ -183,6 +192,13 @@ def format_key_metrics(snapshot: Optional[dict]) -> str:
         "serving_transient_retries_total",
         "serving_cluster_replica_deaths_total",
         "serving_cluster_migrations_total",
+        "training_steps_total",
+        "training_tokens_total",
+        "training_host_syncs_total",
+        "training_nonfinite_total",
+        "training_tokens_per_sec_per_chip",
+        "training_loss",
+        "training_grad_norm",
     )
     for d in rows:
         if d.get("name") in wanted_values and "value" in d:
@@ -193,7 +209,8 @@ def format_key_metrics(snapshot: Optional[dict]) -> str:
     # step-phase p95s from the raw histogram rows, if present
     for d in rows:
         if d.get("name") in ("serving_step_phase_seconds",
-                             "serving_device_residency_seconds") \
+                             "serving_device_residency_seconds",
+                             "training_step_phase_seconds") \
                 and d.get("count"):
             mean = d["sum"] / d["count"] if d["count"] else 0.0
             lines.append(
@@ -214,6 +231,43 @@ def format_journal_tail(tail: List[dict]) -> str:
                      f"{status:<11}"
                      f"{r.get('delivered_tokens') or 0:>5} delivered"
                      f"{err}{mark}")
+    return "\n".join(lines)
+
+
+def format_training(training: dict) -> str:
+    """Compact digest of a training bundle's section: verdict, recent
+    step tail, sentinel flags. tools/training_report.py renders the
+    full report (sparklines, phase breakdown, straggler table)."""
+    lines = []
+    geo = training.get("geometry") or {}
+    lines.append(
+        f"training run: dp={geo.get('dp', '?')} tp={geo.get('tp', '?')} "
+        f"stage={geo.get('stage', '?')} "
+        f"devices={len(geo.get('devices') or [])}")
+    verdict = training.get("verdict")
+    if verdict:
+        mark = "!!" if verdict.get("tripped") else " ~"
+        lines.append(f"  {mark} {verdict.get('message', verdict)}")
+    sentinel = training.get("sentinel") or {}
+    flags = {c: n for c, n in (sentinel.get("flags") or {}).items() if n}
+    if flags:
+        lines.append("  sentinel flags: " + ", ".join(
+            f"{c}={n}" for c, n in sorted(flags.items())))
+    steps = training.get("steps") or []
+    lines.append(f"  step ring ({len(steps)} retained), last 8:")
+    for s in steps[-8:]:
+        nf = s.get("nonfinite", 0)
+        mark = " !!" if (nf and nf > 0) else ""
+        loss = s.get("loss")
+        gnorm = s.get("grad_norm")
+        lines.append(
+            f"    step {s.get('step', '?'):<6}"
+            f"loss={(f'{loss:g}' if isinstance(loss, float) else loss):<14}"
+            f"grad_norm="
+            f"{f'{gnorm:g}' if isinstance(gnorm, float) else gnorm}"
+            f"{mark}")
+    lines.append("  (full report: python tools/training_report.py "
+                 "BUNDLE.json)")
     return "\n".join(lines)
 
 
@@ -238,13 +292,21 @@ def render(bundle: dict, last_events: Optional[int] = None,
                              bundle.get("ring_capacity", 0),
                              last=last_events))
     out.append("")
-    out.append("requests:")
-    out.append(format_requests(bundle.get("requests") or []))
-    out.append("")
-    out.append("journal tail (token COUNTS only — the journal owns "
-               "token state):")
-    out.append(format_journal_tail(bundle.get("journal_tail") or []))
-    out.append("")
+    if bundle.get("training"):
+        # training bundle variant (ISSUE 19): dumped by the ZeRO
+        # trainer's divergence sentinel — there are no requests and no
+        # journal, so render the training digest instead of an empty
+        # serving casualty table
+        out.append(format_training(bundle["training"]))
+        out.append("")
+    else:
+        out.append("requests:")
+        out.append(format_requests(bundle.get("requests") or []))
+        out.append("")
+        out.append("journal tail (token COUNTS only — the journal owns "
+                   "token state):")
+        out.append(format_journal_tail(bundle.get("journal_tail") or []))
+        out.append("")
     if full_metrics:
         out.append("metrics snapshot:")
         out.append(json.dumps(bundle.get("metrics"), indent=1,

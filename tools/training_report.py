@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Render a training telemetry snapshot or training post-mortem bundle
+(ISSUE 19).
+
+Input is either a `paddle_tpu.training_telemetry/v1` snapshot
+(`TrainingTelemetry.snapshot()`, as embedded in bench detail) or a
+`paddle_tpu.postmortem/v1` bundle whose `training` section the ZeRO
+trainer's divergence sentinel dumped. Output is the story a human
+reads first:
+
+- geometry + throughput header (dp/tp/stage, tokens/sec/chip,
+  host-sync count vs step count — they must match);
+- the recent step ring as a loss + grad-norm sparkline table
+  (nonfinite steps marked `!`);
+- the host wall split by phase (batch_build / dispatch / host_drain)
+  from the `training_step_phase_seconds{phase=}` histograms;
+- the per-shard straggler table from
+  `training_shard_step_seconds{shard=}` (best-of probes; a shard whose
+  BEST case is slow is flagged);
+- the sentinel verdict and flag counts.
+
+Usage:
+    python tools/training_report.py SNAPSHOT_OR_BUNDLE.json
+        [--steps N] [--metrics]
+
+Standalone on purpose (json/argparse only, same contract as
+tools/postmortem.py): point it at a file from any machine without
+installing the framework.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+TRAINING_SCHEMA_PREFIX = "paddle_tpu.training_telemetry/"
+POSTMORTEM_SCHEMA_PREFIX = "paddle_tpu.postmortem/"
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def load_report(path: str) -> Tuple[dict, Optional[dict], dict]:
+    """-> (training section, metrics snapshot or None, outer doc).
+    Accepts both input shapes; anything else is a loud exit."""
+    with open(path) as f:
+        doc = json.load(f)
+    schema = doc.get("schema", "")
+    if schema.startswith(TRAINING_SCHEMA_PREFIX):
+        return doc, doc.get("metrics"), doc
+    if schema.startswith(POSTMORTEM_SCHEMA_PREFIX):
+        training = doc.get("training")
+        if not training:
+            raise SystemExit(
+                f"{path}: a serving post-mortem (no 'training' section) "
+                "— render it with tools/postmortem.py")
+        return training, doc.get("metrics"), doc
+    raise SystemExit(
+        f"{path}: neither a training telemetry snapshot nor a "
+        f"post-mortem bundle (schema={schema!r})")
+
+
+def sparkline(values: List[float], lo: Optional[float] = None,
+              hi: Optional[float] = None) -> str:
+    """One block character per value, min-max scaled over the finite
+    values; NaN/Inf render as `!` (that's the interesting step)."""
+    finite = [v for v in values if v == v and not math.isinf(v)]
+    if not finite:
+        return "!" * len(values)
+    lo = min(finite) if lo is None else lo
+    hi = max(finite) if hi is None else hi
+    span = hi - lo
+    out = []
+    for v in values:
+        if v != v or math.isinf(v):
+            out.append("!")
+        elif span <= 0:
+            out.append(_BLOCKS[0])
+        else:
+            i = int((v - lo) / span * (len(_BLOCKS) - 1))
+            out.append(_BLOCKS[max(0, min(i, len(_BLOCKS) - 1))])
+    return "".join(out)
+
+
+def _fmt(v, nd: int = 5) -> str:
+    if v is None:
+        return "?"
+    if isinstance(v, float):
+        if v != v:
+            return "nan"
+        if math.isinf(v):
+            return "inf" if v > 0 else "-inf"
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def format_steps(steps: List[dict], last: Optional[int] = None) -> str:
+    if not steps:
+        return "  (empty step ring)"
+    shown = steps[-last:] if last else steps
+    lines = []
+    losses = [s.get("loss", float("nan")) for s in shown]
+    grads = [s.get("grad_norm", float("nan")) for s in shown]
+    lines.append(f"  loss      {sparkline(losses)}")
+    lines.append(f"  grad_norm {sparkline(grads)}")
+    lines.append("")
+    lines.append(f"  {'step':>6}  {'loss':>12}  {'grad_norm':>12}  "
+                 f"{'update_norm':>12}  {'wall ms':>9}")
+    for s in shown:
+        nf = s.get("nonfinite", 0)
+        mark = " !!" if (nf and nf > 0) else ""
+        wall = s.get("wall_s")
+        lines.append(
+            f"  {s.get('step', '?'):>6}  {_fmt(s.get('loss')):>12}  "
+            f"{_fmt(s.get('grad_norm')):>12}  "
+            f"{_fmt(s.get('update_norm')):>12}  "
+            f"{(wall * 1e3 if wall is not None else 0):>9.3f}{mark}")
+    if last and len(steps) > len(shown):
+        lines.append(f"  ... {len(steps) - len(shown)} earlier ring "
+                     "step(s) elided (--steps)")
+    return "\n".join(lines)
+
+
+def _metric_rows(snapshot: Optional[dict]) -> List[dict]:
+    if not snapshot:
+        return []
+    return list(snapshot.get("metrics", ()))
+
+
+def format_phases(snapshot: Optional[dict]) -> str:
+    rows = [d for d in _metric_rows(snapshot)
+            if d.get("name") == "training_step_phase_seconds"
+            and d.get("count")]
+    if not rows:
+        return "  (no phase histograms in the snapshot)"
+    total = sum(d["sum"] for d in rows) or 1.0
+    lines = []
+    for d in sorted(rows, key=lambda d: -d["sum"]):
+        phase = (d.get("labels") or {}).get("phase", "?")
+        mean = d["sum"] / d["count"]
+        share = d["sum"] / total
+        lines.append(f"  {phase:<12}{d['count']:>6} obs  "
+                     f"mean {mean * 1e3:9.3f} ms  "
+                     f"{share * 100:5.1f}% of host wall")
+    return "\n".join(lines)
+
+
+def format_stragglers(snapshot: Optional[dict]) -> str:
+    rows = [d for d in _metric_rows(snapshot)
+            if d.get("name") == "training_shard_step_seconds"
+            and d.get("count")]
+    if not rows:
+        return "  (no straggler probe data — run shard_step_seconds())"
+    bests = {}
+    for d in rows:
+        shard = (d.get("labels") or {}).get("shard", "?")
+        bests[shard] = d
+    mins = sorted(d.get("min") for d in bests.values()
+                  if d.get("min") is not None)
+    median_best = mins[len(mins) // 2] if mins else 0.0
+    lines = []
+    for shard in sorted(bests, key=lambda s: (len(s), s)):
+        d = bests[shard]
+        best = d.get("min")
+        mean = d["sum"] / d["count"]
+        slow = (best is not None and median_best > 0
+                and best > 1.5 * median_best)
+        mark = "  << straggler (best-case >1.5x median)" if slow else ""
+        lines.append(f"  shard {shard:<4}{d['count']:>4} probes  "
+                     f"best {(best or 0) * 1e6:9.1f} us  "
+                     f"mean {mean * 1e6:9.1f} us{mark}")
+    return "\n".join(lines)
+
+
+def format_sentinel(sentinel: Optional[dict],
+                    verdict: Optional[dict]) -> str:
+    if not sentinel:
+        return "  (sentinel disabled)"
+    lines = []
+    if verdict:
+        mark = "!!" if verdict.get("tripped") else " ~"
+        lines.append(f"  {mark} {verdict.get('message', verdict)}")
+    flags = sentinel.get("flags") or {}
+    flagged = {c: n for c, n in flags.items() if n}
+    lines.append(f"  seen {sentinel.get('seen', 0)} step(s); flags: "
+                 + (", ".join(f"{c}={n}"
+                              for c, n in sorted(flagged.items()))
+                    if flagged else "none"))
+    if sentinel.get("loss_ref") is not None:
+        lines.append(f"  window refs: loss {_fmt(sentinel['loss_ref'])}"
+                     f"  grad {_fmt(sentinel.get('grad_ref'))}")
+    if sentinel.get("best_loss") is not None:
+        lines.append(f"  best loss {_fmt(sentinel['best_loss'])} at "
+                     f"step {sentinel.get('best_step', '?')}")
+    return "\n".join(lines)
+
+
+def _counter_value(snapshot: Optional[dict], name: str):
+    for d in _metric_rows(snapshot):
+        if d.get("name") == name and "value" in d:
+            return d["value"]
+    return None
+
+
+def render(training: dict, snapshot: Optional[dict], doc: dict,
+           last_steps: Optional[int] = None,
+           full_metrics: bool = False) -> str:
+    out = []
+    geo = training.get("geometry") or {}
+    verdict = training.get("verdict")
+    if doc.get("schema", "").startswith(POSTMORTEM_SCHEMA_PREFIX):
+        when = doc.get("unix_time")
+        stamp = (time.strftime("%Y-%m-%d %H:%M:%S",
+                               time.localtime(when)) if when else "?")
+        out.append(f"training post-mortem: {doc.get('reason', '?')}   "
+                   f"dumped {stamp}")
+    else:
+        out.append("training telemetry snapshot")
+    out.append(
+        f"geometry: dp={geo.get('dp', '?')} tp={geo.get('tp', '?')} "
+        f"stage={geo.get('stage', '?')} "
+        f"devices={len(geo.get('devices') or [])}")
+    steps_total = _counter_value(snapshot, "training_steps_total")
+    syncs = _counter_value(snapshot, "training_host_syncs_total")
+    tokens = _counter_value(snapshot, "training_tokens_total")
+    tps_chip = _counter_value(snapshot, "training_tokens_per_sec_per_chip")
+    line = (f"steps {steps_total if steps_total is not None else '?'}   "
+            f"tokens {tokens if tokens is not None else '?'}   "
+            f"host syncs {syncs if syncs is not None else '?'}")
+    if tps_chip is not None:
+        line += f"   tokens/sec/chip {_fmt(float(tps_chip))}"
+    out.append(line)
+    if steps_total is not None and syncs is not None \
+            and syncs != steps_total:
+        out.append(f"!! host syncs ({syncs}) != steps ({steps_total}) — "
+                   "the one-sync-per-step contract is broken")
+    out.append("")
+    out.append("sentinel:")
+    out.append(format_sentinel(training.get("sentinel"), verdict))
+    out.append("")
+    ring = training.get("steps") or []
+    out.append(f"recent steps ({len(ring)} in ring):")
+    out.append(format_steps(ring, last=last_steps))
+    out.append("")
+    out.append("host wall by phase:")
+    out.append(format_phases(snapshot))
+    out.append("")
+    out.append("per-shard straggler probe (best-of-N):")
+    out.append(format_stragglers(snapshot))
+    if full_metrics:
+        out.append("")
+        out.append("metrics snapshot:")
+        out.append(json.dumps(snapshot, indent=1, sort_keys=True))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render a paddle_tpu training telemetry snapshot "
+                    "or training post-mortem bundle (sparkline step "
+                    "table, phase breakdown, straggler table, sentinel "
+                    "verdict)")
+    ap.add_argument("report",
+                    help="snapshot .json or training-postmortem-*.json")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="show only the last N ring steps")
+    ap.add_argument("--metrics", action="store_true",
+                    help="append the full metrics snapshot")
+    args = ap.parse_args(argv)
+    training, snapshot, doc = load_report(args.report)
+    print(render(training, snapshot, doc, last_steps=args.steps,
+                 full_metrics=args.metrics))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

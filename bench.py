@@ -38,6 +38,12 @@ UNIT = "tokens/s/chip"
 TRACE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench_trace")
 PARTIAL_PATH = os.path.join(TRACE_DIR, "bench_partial.json")
+# sticky backend-init probe verdict (BENCH_r05): written by a child whose
+# probe found the accelerator runtime wedged, read by the supervisor AND
+# later children so attempt 2 starts pinned to CPU instead of re-burning
+# its budget on the same dead backend; cleared at the start of each
+# supervisor run
+VERDICT_PATH = os.path.join(TRACE_DIR, "backend_probe_verdict.json")
 
 PEAK_BF16_FLOPS = {
     # device_kind substring -> peak bf16 FLOP/s per chip
@@ -698,7 +704,11 @@ def _run_pretrain_zero(on_tpu: bool) -> dict:
     observability leg: telemetry snapshot + sentinel summary, measured
     per-step telemetry overhead (<2% target on real hardware), and a
     deliberate-NaN divergence drill that must dump exactly one
-    parseable postmortem bundle."""
+    parseable postmortem bundle. Since ISSUE 20 it also carries the
+    bucketed/overlapped schedule sweep: {serial, overlap} x
+    bucket_bytes x {fp32, bf16} cells with per-cell tok/s, the
+    comm-probe wall times, the measured overlap fraction, and the
+    fp32 bit-parity / bf16 bounded-error flags."""
     try:
         mod = _gen_bench_module()
         out = mod.pretrain_zero_phase(on_tpu)
@@ -739,6 +749,25 @@ def _run_pretrain_zero(on_tpu: bool) -> dict:
         except Exception as e:  # noqa: BLE001 — log-only decoration
             _log(f"phase=pretrain_zero: telemetry log skipped "
                  f"({type(e).__name__}: {e})")
+        try:  # ISSUE 20 bucket/overlap leg — log-only, never fails it
+            b = out.get("bucketed") or {}
+            cells = b.get("cells") or {}
+            probes = b.get("probes") or {}
+            dpk = f"dp{dp_max}"
+            serial = cells.get(f"{dpk}_serial_bucket_off_fp32", {})
+            overlap = cells.get(f"{dpk}_overlap_bucket_1MiB_fp32", {})
+            bf16 = cells.get(f"{dpk}_overlap_bucket_1MiB_bf16", {})
+            probe = probes.get(dpk, {})
+            _log(f"phase=pretrain_zero: bucketed {dpk} serial "
+                 f"{serial.get('tok_s')} tok/s vs overlap(1MiB) "
+                 f"{overlap.get('tok_s')} (bf16 {bf16.get('tok_s')}), "
+                 f"overlap_fraction={probe.get('overlap_fraction')}, "
+                 f"comm_us={probe.get('comm_us')}, "
+                 f"fp32_parity={b.get('parity_ok_fp32')}, "
+                 f"bf16_bounded={b.get('bf16_bounded_ok')}")
+        except Exception as e:  # noqa: BLE001 — log-only decoration
+            _log(f"phase=pretrain_zero: bucket log skipped "
+                 f"({type(e).__name__}: {e})")
         return out
     except Exception as e:  # noqa: BLE001 — bench must degrade, not die
         _log(f"phase=pretrain_zero: FAIL {type(e).__name__}: {e}")
@@ -752,11 +781,16 @@ def _probe_backend_init(timeout_s: float) -> str | None:
     libtpu lockfile, metadata-server stall — hangs exactly here, so a
     probe timeout means: force CPU now and record why, instead of eating
     the whole watchdog budget. Returns None when healthy, else a short
-    reason string for the bench detail."""
+    reason string for the bench detail.
+
+    BENCH_BACKEND_PROBE_CMD overrides the probed `-c` code — the test
+    seam tests/test_bench_supervisor.py uses to fake a wedging backend
+    without owning one."""
+    code = os.environ.get("BENCH_BACKEND_PROBE_CMD",
+                          "import jax; jax.devices()")
     try:
         proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices()"],
+            [sys.executable, "-c", code],
             capture_output=True, text=True, timeout=timeout_s)
         if proc.returncode != 0:
             tail = (proc.stderr or proc.stdout or "").strip()[-300:]
@@ -766,6 +800,31 @@ def _probe_backend_init(timeout_s: float) -> str | None:
         return f"probe timed out after {timeout_s:.0f}s"
     except Exception as e:  # noqa: BLE001 — bench must degrade, not die
         return f"probe error {type(e).__name__}: {str(e)[:200]}"
+
+
+def _read_probe_verdict() -> str | None:
+    """The sticky verdict a prior attempt left (reason string), else
+    None. Unreadable/garbled files read as no-verdict — the probe will
+    simply run again."""
+    try:
+        with open(VERDICT_PATH) as f:
+            v = json.load(f)
+        return str(v.get("reason", "backend probe failed"))
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def _write_probe_verdict(reason: str) -> None:
+    """Persist a failed backend-init probe so every later attempt of
+    THIS run starts pinned to CPU (best-effort — bench must degrade,
+    not die)."""
+    try:
+        os.makedirs(TRACE_DIR, exist_ok=True)
+        with open(VERDICT_PATH, "w") as f:
+            json.dump({"reason": reason, "schema": "bench.probe_verdict/v1"},
+                      f)
+    except OSError:
+        pass
 
 
 def make_train_step(model, opt):
@@ -983,6 +1042,14 @@ def bench_child() -> None:
         # the axon sitecustomize pins jax_platforms at interpreter start;
         # env vars alone cannot undo it — config.update before backend init
         jax.config.update("jax_platforms", "cpu")
+    elif (sticky := _read_probe_verdict()) is not None:
+        # a prior attempt this run already found the backend wedged —
+        # the verdict is sticky, so don't re-probe (let alone re-init)
+        # the same dead runtime: start pinned to CPU immediately
+        backend_init_timeout = f"sticky: {sticky}"
+        _log(f"phase=init: sticky backend verdict from a prior attempt "
+             f"({sticky}) — forcing CPU without re-probing")
+        jax.config.update("jax_platforms", "cpu")
     else:
         # fail-fast probe: a wedged accelerator runtime hangs in
         # jax.devices() with no exception to catch — detect it in a
@@ -993,6 +1060,7 @@ def bench_child() -> None:
         if backend_init_timeout is not None:
             _log(f"phase=init: backend probe failed "
                  f"({backend_init_timeout}) — forcing CPU")
+            _write_probe_verdict(backend_init_timeout)
             jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
@@ -1328,6 +1396,27 @@ def _run_child(extra_env: dict, timeout: float) -> str | None:
     return None
 
 
+def _backend_wedged_verdict() -> str | None:
+    """Did the previous attempt die inside backend init? Either the
+    child's probe caught it (sticky verdict file) or the child hard-
+    wedged before/inside init and the per-phase watchdog recorded
+    wedged_phase=init|smoke in the partial. Returns the reason string,
+    else None (attempt died later — the backend itself came up, retry
+    it)."""
+    reason = _read_probe_verdict()
+    if reason is not None:
+        return reason
+    try:
+        with open(PARTIAL_PATH) as f:
+            detail = json.load(f).get("detail", {})
+    except (OSError, json.JSONDecodeError):
+        return None
+    wedged = detail.get("wedged_phase")
+    if wedged in ("init", "smoke"):
+        return f"prior attempt wedged in phase={wedged}"
+    return None
+
+
 def _read_partial() -> dict | None:
     """A TPU partial result left by a wedged child beats a CPU fallback."""
     try:
@@ -1352,18 +1441,44 @@ def main() -> None:
             sys.exit(3)
         return
 
-    # stale partials from a previous run must not masquerade as this run's
-    try:
-        os.remove(PARTIAL_PATH)
-    except OSError:
-        pass
+    # stale partials/verdicts from a previous run must not masquerade as
+    # this run's
+    for stale in (PARTIAL_PATH, VERDICT_PATH):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
 
-    # supervisor: retry the default (TPU) backend twice, then CPU fallback
+    # supervisor: retry the default (TPU) backend twice, then CPU fallback.
+    # The backend-init verdict is STICKY across attempts (BENCH_r05): once
+    # attempt 1 dies inside init — probe-detected (verdict file) or hard-
+    # wedged (partial's wedged_phase) — every later attempt starts pinned
+    # to CPU instead of re-importing jax on the same dead runtime and
+    # burning its whole budget with no parsed metric.
     timeouts = [1350.0, 700.0]
+    cpu_reason = None
     for i, timeout in enumerate(timeouts):
+        if cpu_reason is None and i > 0:
+            cpu_reason = _backend_wedged_verdict()
+        extra_env = {}
+        if cpu_reason is not None:
+            extra_env["BENCH_FORCE_CPU"] = "1"
+            _log(f"supervisor: attempt {i + 1} pinned to CPU "
+                 f"(sticky backend verdict: {cpu_reason})")
         _log(f"supervisor: attempt {i + 1}/{len(timeouts)} (timeout {timeout}s)")
-        line = _run_child({}, timeout)
+        line = _run_child(extra_env, timeout)
         if line is not None:
+            if cpu_reason is not None:
+                # a pinned-CPU attempt can never be a TPU number: mark it
+                # exactly like the terminal CPU fallback would
+                parsed = json.loads(line)
+                parsed["error"] = \
+                    "tpu backend unavailable; CPU fallback number"
+                parsed["vs_baseline"] = 0.0
+                parsed.setdefault("detail", {})["backend_verdict"] = \
+                    cpu_reason
+                _emit(parsed)
+                return
             print(line, flush=True)
             return
         if i + 1 < len(timeouts):

@@ -70,10 +70,21 @@ DEFAULT_HOT_MODULES: Dict[str, FrozenSet[str]] = {
     # boundaries. A host read in any of these stalls every train step
     # (and the degree-blind save/load helpers are deliberately host-side
     # numpy — they are NOT reachable from these roots).
+    # ISSUE 20 widens both entries to the bucketing/ring-pipeline
+    # paths: the ring transport (`ring_collect` + the shared
+    # `ring_pipeline` scheduler, also serving's), the blocked fixed-
+    # order reduce (`collected_shard_sum` and its ring composition),
+    # and the bucketed/overlapped step bodies — all trace into the one
+    # train (or decode) executable. `build_bucket_layout`/`chunk_bounds`
+    # are build-time host planning, deliberately NOT hot roots.
     "parallel/mesh.py": frozenset(
-        {"ordered_psum", "ordered_psum_scatter"}),
+        {"ordered_psum", "ordered_psum_scatter", "collected_shard_sum",
+         "ring_collect", "ring_ordered_psum",
+         "ring_ordered_psum_scatter", "ring_pipeline"}),
     "parallel/zero.py": frozenset(
-        {"_accumulated_grads", "_replicated_update", "_sharded_update"}),
+        {"_accumulated_grads", "_replicated_update", "_sharded_update",
+         "_bucketed_update", "_overlapped_update", "_pack_bucket",
+         "_unscale_shard", "_grad_nonfinite", "_scaler_next"}),
     # ISSUE 17: the speculative decoder's host-side paths — draft
     # proposal + buffer packing run BETWEEN two dispatches of every
     # spec block (drafts come from host request state), and the drain's

@@ -66,7 +66,7 @@ from .metrics import MetricsRegistry
 from .slo import HistogramWindow
 
 __all__ = [
-    "HEALTH_FIELDS", "TRAINING_SNAPSHOT_SCHEMA",
+    "HEALTH_FIELDS", "SCALER_FIELDS", "TRAINING_SNAPSHOT_SCHEMA",
     "SentinelConfig", "DivergenceSentinel", "TrainingDiverged",
     "TrainingTelemetry", "probe_best_of",
     "sumsq", "nonfinite_count", "combine_leaf_stats", "pack_health",
@@ -76,6 +76,13 @@ __all__ = [
 # `pack_health` below (tests and the report CLI index by this tuple)
 HEALTH_FIELDS = ("loss", "grad_norm", "param_norm", "update_norm",
                  "nonfinite_grads", "nonfinite_params")
+
+# bf16 mixed-precision extras appended AFTER the six health scalars
+# when the trainer runs with dynamic loss scaling (param_dtype="bf16"):
+# the post-transition scale and a 0/1 skipped-step flag. HEALTH_FIELDS
+# stays a 6-tuple — existing indexers are untouched; `record_step`
+# keys off the drained vector's length.
+SCALER_FIELDS = ("loss_scale", "skipped_step")
 
 TRAINING_SNAPSHOT_SCHEMA = "paddle_tpu.training_telemetry/v1"
 
@@ -166,13 +173,17 @@ def grad_leaf_stats(ctx, per_leaf, dp_reduce: bool):
     return jnp.sum(vec[:, 0]), jnp.sum(vec[:, 1])
 
 
-def pack_health(ctx, loss, old_params, new_params, grad_aux):
+def pack_health(ctx, loss, old_params, new_params, grad_aux,
+                extras=None):
     """Pack the six HEALTH_FIELDS scalars into ONE replicated f32
     vector — the single extra output of the telemetry-on step body,
     drained by `TrainingTelemetry._host_read` in one transfer.
     Param/update stats are computed from the (replicated-across-dp,
     tp-local) old/new params, with tp-sharded leaves combined over the
-    tp axis; `grad_aux` arrives pre-reduced from `grad_leaf_stats`."""
+    tp axis; `grad_aux` arrives pre-reduced from `grad_leaf_stats`.
+    `extras` (bf16 mode) appends the SCALER_FIELDS scalars — same
+    vector, same single drain: mixed precision adds zero host
+    syncs."""
     import jax.numpy as jnp
 
     names = list(new_params)
@@ -184,14 +195,17 @@ def pack_health(ctx, loss, old_params, new_params, grad_aux):
     vec = combine_leaf_stats(rows, tp_leaf_mask(ctx, names),
                              dp_reduce=False)
     gsq, nfg = grad_aux
-    return jnp.stack([
+    fields = [
         loss.astype(jnp.float32),
         jnp.sqrt(gsq),
         jnp.sqrt(jnp.sum(vec[:, 0])),
         jnp.sqrt(jnp.sum(vec[:, 1])),
         nfg,
         jnp.sum(vec[:, 2]),
-    ])
+    ]
+    if extras is not None:
+        fields.extend(e.astype(jnp.float32) for e in extras)
+    return jnp.stack(fields)
 
 
 # ------------------------------------------------------------- sentinel
@@ -461,6 +475,38 @@ class TrainingTelemetry:
                             f"last step's {name}", labels=lab)
             for name in ("loss", "grad_norm", "param_norm", "update_norm")
         }
+        # ---- ISSUE 20: comms visibility + mixed-precision scaler.
+        # All label sets bounded (2 collectives, 2 scale events), so
+        # resolve-once at bind keeps the hot path allocation-free.
+        self._comm = {
+            c: reg.histogram(
+                "training_comm_seconds",
+                "warmed best-of-N ZeRO collective probe "
+                "(reduce-scatter / all-gather wall seconds)",
+                labels={**lab, "collective": c})
+            for c in ("reduce_scatter", "all_gather")
+        }
+        self._overlap_gauge = reg.gauge(
+            "training_overlap_fraction",
+            "measured fraction of bucket-collective wall hidden by the "
+            "ring pipeline", labels=lab)
+        self._loss_scale_gauge = reg.gauge(
+            "training_loss_scale",
+            "current dynamic loss scale (bf16 mixed precision)",
+            labels=lab)
+        self._scale_events = {
+            ev: reg.counter(
+                "training_loss_scale_events_total",
+                "dynamic loss-scale transitions",
+                labels={**lab, "event": ev})
+            for ev in ("backoff", "growth")
+        }
+        self._skipped_steps = reg.counter(
+            "training_skipped_steps_total",
+            "optimizer steps skipped on nonfinite grads "
+            "(dynamic loss scaling)", labels=lab)
+        self._last_scale: Optional[float] = None
+        self._overlap_fraction: Optional[float] = None
         if self._sentinel_cfg is not None:
             self.sentinel = DivergenceSentinel(
                 reg, self._sentinel_cfg, labels=lab)
@@ -486,7 +532,25 @@ class TrainingTelemetry:
         t0 = self.clock()
         vals = self._host_read(health)
         drain_s = self.clock() - t0
-        loss, grad_norm, param_norm, update_norm, nfg, nfp = vals
+        loss, grad_norm, param_norm, update_norm, nfg, nfp = vals[:6]
+        # bf16 mode appends the SCALER_FIELDS pair (same drain)
+        loss_scale: Optional[float] = None
+        skipped = False
+        if len(vals) > 6:
+            loss_scale = vals[6]
+            skipped = vals[7] > 0.0
+            prev = self._last_scale
+            self._loss_scale_gauge.set(loss_scale)
+            if prev is not None and loss_scale != prev:
+                ev = "backoff" if loss_scale < prev else "growth"
+                self._scale_events[ev].inc()
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "loss_scale", step=int(step), event=ev,
+                        scale=loss_scale)
+            self._last_scale = loss_scale
+            if skipped:
+                self._skipped_steps.inc()
         self._host_syncs.inc()
         self._steps.inc()
         self._tokens.inc(int(tokens))
@@ -505,17 +569,25 @@ class TrainingTelemetry:
         nonfinite = nfg + nfp
         if nonfinite > 0:
             self._nonfinite_total.inc(int(nonfinite))
-        self._ring.append({
+        entry = {
             "step": int(step), "loss": loss, "grad_norm": grad_norm,
             "param_norm": param_norm, "update_norm": update_norm,
             "nonfinite": nonfinite, "tokens": int(tokens),
             "wall_s": wall,
-        })
+        }
+        if loss_scale is not None:
+            entry["loss_scale"] = loss_scale
+            entry["skipped"] = bool(skipped)
+        self._ring.append(entry)
         if self.recorder is not None:
             self.recorder.record(
                 "train_step", step=int(step), loss=loss,
                 grad_norm=grad_norm, tokens=int(tokens), wall_s=wall)
-        if self.sentinel is not None:
+        # a skipped step bypasses the sentinel entirely: its loss/grads
+        # MAY be nonfinite, but the scaler already handled it (params
+        # reverted, scale backed off) — a divergence trip would turn
+        # the designed recovery path into a crash
+        if self.sentinel is not None and not skipped:
             verdict = self.sentinel.check(
                 step=int(step), loss=loss, grad_norm=grad_norm,
                 nonfinite=nonfinite)
@@ -567,6 +639,18 @@ class TrainingTelemetry:
         }
         return bundle
 
+    def observe_comm(self, collective: str, seconds: float) -> None:
+        """Publish one collective-probe measurement
+        (`training_comm_seconds{collective=reduce_scatter|all_gather}`
+        — resolve-once handles from bind)."""
+        self._comm[collective].observe(seconds)
+
+    def set_overlap_fraction(self, fraction: float) -> None:
+        """Record the measured overlap fraction (see
+        `ZeroTrainStep.measure_overlap_fraction`) — gauge + summary."""
+        self._overlap_fraction = float(fraction)
+        self._overlap_gauge.set(float(fraction))
+
     def observe_shard_step(self, shard: str, seconds: float) -> None:
         """Publish one straggler-probe measurement for a dp shard
         (bounded label: one series per dp row)."""
@@ -603,6 +687,12 @@ class TrainingTelemetry:
             "tokens_per_sec_per_chip": self._tps_chip.value,
             "last": (dict(self._ring[-1]) if self._ring else None),
             "phases": {ph: h.summary() for ph, h in self._phase.items()},
+            "comm": {c: h.summary() for c, h in self._comm.items()},
+            "overlap_fraction": self._overlap_fraction,
+            "loss_scale": self._last_scale,
+            "skipped_steps": self._skipped_steps.value,
+            "loss_scale_events": {
+                ev: c.value for ev, c in self._scale_events.items()},
             "sentinel": (self.sentinel.state()
                          if self.sentinel is not None else None),
         }

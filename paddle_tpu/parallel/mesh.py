@@ -42,6 +42,8 @@ __all__ = [
     "DP_AXIS", "TP_AXIS", "device_order", "build_mesh", "carve_submeshes",
     "shard_leaf", "ordered_psum", "ordered_psum_scatter",
     "ring_perm", "ring_collect", "ring_ordered_psum",
+    "collected_shard_sum", "ring_ordered_psum_scatter",
+    "chunk_bounds", "ring_pipeline",
     "copy_to_tp_region", "reduce_from_tp_region", "tp_dim_spec",
     "local_shape",
 ]
@@ -219,14 +221,14 @@ def ring_ordered_psum(x, axis_name: str, axis_size: int):
     return out
 
 
-def ordered_psum_scatter(x, axis_name: str):
-    """Reduce-scatter with the same fixed shard order as `ordered_psum`:
-    each shard keeps row i of the (n, n, chunk)-blocked ordered sum.
-    `x` must be a flat vector divisible by the axis size; bit-identical
-    to `ordered_psum(x)[i*chunk:(i+1)*chunk]` because the sum is
-    elementwise — ZeRO-2's grad shard without ever materializing the
-    full summed gradient in the update path."""
-    g = jax.lax.all_gather(x, axis_name)         # (n, flat)
+def collected_shard_sum(g, axis_name: str):
+    """The reduce half of a fixed-order reduce-scatter: `g` is the
+    (n, flat) source-indexed buffer an `all_gather` or `ring_collect`
+    produced; each shard keeps column-block i of the (src, dst, chunk)
+    blocked view and sums it in static shard order 0..n-1. Split out so
+    the overlapped training pipeline can emit the TRANSPORT of bucket
+    j+1 before running this reduce for bucket j — the arithmetic is the
+    one piece both the serial and the pipelined scatter share."""
     n = g.shape[0]
     blocked = g.reshape(n, n, -1)                # (src, dst, chunk)
     i = jax.lax.axis_index(axis_name)
@@ -235,6 +237,75 @@ def ordered_psum_scatter(x, axis_name: str):
     for s in range(1, n):
         out = out + mine[s, 0]
     return out
+
+
+def ordered_psum_scatter(x, axis_name: str):
+    """Reduce-scatter with the same fixed shard order as `ordered_psum`:
+    each shard keeps row i of the (n, n, chunk)-blocked ordered sum.
+    `x` must be a flat vector divisible by the axis size; bit-identical
+    to `ordered_psum(x)[i*chunk:(i+1)*chunk]` because the sum is
+    elementwise — ZeRO-2's grad shard without ever materializing the
+    full summed gradient in the update path."""
+    g = jax.lax.all_gather(x, axis_name)         # (n, flat)
+    return collected_shard_sum(g, axis_name)
+
+
+def ring_ordered_psum_scatter(x, axis_name: str, axis_size: int):
+    """`ordered_psum_scatter` with the all_gather swapped for the
+    fixed-order ppermute ring: `ring_collect` rebuilds the identical
+    source-indexed (n, flat) buffer, and `collected_shard_sum` runs the
+    identical static shard-order arithmetic — so each shard's slice is
+    bit-identical to the all_gather form (pinned in tests/test_zero_
+    bucket.py), while the hop-by-hop transport is overlappable."""
+    g = ring_collect(x, axis_name, axis_size)    # (n, flat)
+    return collected_shard_sum(g, axis_name)
+
+
+# ------------------------------------------------- ring-pipeline scheduler
+def chunk_bounds(chunks: int, rows: int) -> List[Tuple[int, int]]:
+    """Static micro-chunk bounds: up to `chunks` non-empty [lo, hi)
+    ranges covering [0, rows). Degenerates gracefully — a 1-row payload
+    yields one chunk (nothing to pipeline, but the ring transport is
+    still bit-identical). Shared by the serving decode overlap
+    (micro-row chunks of one activation) and any caller splitting a
+    payload for `ring_pipeline`."""
+    k = max(1, min(int(chunks), int(rows)))
+    bounds = []
+    for j in range(k):
+        lo, hi = (j * rows) // k, ((j + 1) * rows) // k
+        if hi > lo:
+            bounds.append((lo, hi))
+    return bounds
+
+
+def ring_pipeline(items: Sequence, transport, reduce, consume) -> None:
+    """THE double-buffered overlap schedule (T3, arxiv 2401.16677),
+    shared by serving TP decode (serving/overlap.py, items = micro-row
+    chunk bounds) and the ZeRO trainer (parallel/zero.py, items = grad
+    buckets): for each item emit the NEXT item's ring transport before
+    reducing and consuming the current one —
+
+        moved = transport(items[0])
+        for j: transport(items[j+1]); consume(j, reduce(moved))
+
+    `transport(item)` issues the fixed-order ppermute hops and returns
+    an opaque in-flight handle; `reduce(handle)` finishes the
+    fixed-shard-order arithmetic; `consume(idx, reduced)` is the
+    caller's dependent compute. Trace order puts the hops ahead of the
+    consumer they overlap; the absence of a data dependency between
+    them is what lets XLA's latency-hiding scheduler actually run
+    transport and compute concurrently. The schedule changes WHEN bytes
+    move, never what is summed in what order — every bit-identity claim
+    layered on top rests on transport/reduce alone."""
+    if not items:
+        return
+    moved = transport(items[0])
+    for idx in range(len(items)):
+        nxt = None
+        if idx + 1 < len(items):
+            nxt = transport(items[idx + 1])   # next item in flight
+        consume(idx, reduce(moved))
+        moved = nxt
 
 
 # --------------------------------------------- Megatron tp region boundaries

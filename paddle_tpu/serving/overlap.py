@@ -68,7 +68,8 @@ from ..core.tensor import Tensor
 from ..models import gpt as _gpt
 from ..models import llama as _llama
 from ..nn import functional as F
-from ..parallel.mesh import TP_AXIS, ring_collect
+from ..parallel.mesh import TP_AXIS, chunk_bounds, ring_collect, \
+    ring_pipeline
 from .. import nn
 
 __all__ = [
@@ -134,37 +135,32 @@ class OverlapPlan:
 
 
 def _chunk_bounds(chunks: int, rows: int) -> List[Tuple[int, int]]:
-    """Static micro-row chunk bounds: up to `chunks` non-empty
-    [lo, hi) row ranges covering [0, rows). Degenerates gracefully —
-    a 1-row decode payload yields one chunk (nothing to pipeline, but
-    the ring transport is still bit-identical)."""
-    k = max(1, min(int(chunks), int(rows)))
-    bounds = []
-    for j in range(k):
-        lo, hi = (j * rows) // k, ((j + 1) * rows) // k
-        if hi > lo:
-            bounds.append((lo, hi))
-    return bounds
+    """Serving alias of the shared `parallel.mesh.chunk_bounds` (the
+    scheduler moved to the mesh substrate so training's bucket pipeline
+    and this decode overlap share one implementation)."""
+    return chunk_bounds(chunks, rows)
 
 
 def _ring_pipeline(plan: OverlapPlan, partial, consume) -> None:
-    """The double-buffered schedule: split `partial` (rows-leading
-    shard-local array) into micro-row chunks, and for each chunk emit
-    the NEXT chunk's ring transport before reducing and consuming the
-    current one. `consume(idx, lo, hi, reduced)` runs in row order, so
-    callers rebuild full outputs with one concatenate. Trace order puts
-    hops ahead of the consumer they overlap; the absence of a data
-    dependency is what lets the scheduler actually run them together."""
+    """The double-buffered schedule, as a thin adapter over the shared
+    `parallel.mesh.ring_pipeline`: split `partial` (rows-leading
+    shard-local array) into micro-row chunks — the pipeline's items are
+    the [lo, hi) bounds, transported by slicing + `plan.transport` at
+    exactly the trace points the scheduler dictates — and for each
+    chunk emit the NEXT chunk's ring transport before reducing and
+    consuming the current one. `consume(idx, lo, hi, reduced)` runs in
+    row order, so callers rebuild full outputs with one concatenate."""
     bounds = _chunk_bounds(plan.chunks, partial.shape[0])
-    lo0, hi0 = bounds[0]
-    moved = plan.transport(partial[lo0:hi0])
-    for idx, (lo, hi) in enumerate(bounds):
-        nxt = None
-        if idx + 1 < len(bounds):
-            nlo, nhi = bounds[idx + 1]
-            nxt = plan.transport(partial[nlo:nhi])   # next chunk in flight
-        consume(idx, lo, hi, plan.reduce(moved))
-        moved = nxt
+
+    def transport(bound):
+        lo, hi = bound
+        return plan.transport(partial[lo:hi])
+
+    def consume_idx(idx, reduced):
+        lo, hi = bounds[idx]
+        consume(idx, lo, hi, reduced)
+
+    ring_pipeline(bounds, transport, plan.reduce, consume_idx)
 
 
 class _TpPartial:

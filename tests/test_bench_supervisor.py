@@ -1,0 +1,212 @@
+"""Bench supervisor: sticky backend-init probe verdict (ISSUE 20).
+
+BENCH_r05 failure mode under test: attempt 1's child wedges inside
+backend init; attempt 2 re-imports jax on the SAME dead runtime and
+burns its whole 700 s with no parsed metric. The fix is a sticky
+verdict: once init is known-wedged — probe-detected (verdict file) or
+hard-wedged (partial's wedged_phase=init|smoke) — every later attempt
+starts pinned to `BENCH_FORCE_CPU=1`.
+
+All hermetic: the probe is faked via the BENCH_BACKEND_PROBE_CMD test
+seam (a real subprocess that wedges/dies on cue), and the supervisor
+loop runs with `_run_child` stubbed — no jax import, no TPU."""
+import json
+import os
+import sys
+
+import pytest
+
+import bench
+
+
+@pytest.fixture()
+def scratch(tmp_path, monkeypatch):
+    """Point every bench scratch path at a tmp dir."""
+    monkeypatch.setattr(bench, "TRACE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "PARTIAL_PATH",
+                        str(tmp_path / "bench_partial.json"))
+    monkeypatch.setattr(bench, "VERDICT_PATH",
+                        str(tmp_path / "backend_probe_verdict.json"))
+    return tmp_path
+
+
+class TestProbe:
+    def test_healthy_probe_returns_none(self, monkeypatch):
+        monkeypatch.setenv("BENCH_BACKEND_PROBE_CMD", "pass")
+        assert bench._probe_backend_init(30.0) is None
+
+    def test_wedging_probe_times_out(self, monkeypatch):
+        # the fake wedged backend: hangs far past the probe budget
+        monkeypatch.setenv("BENCH_BACKEND_PROBE_CMD",
+                           "import time; time.sleep(60)")
+        reason = bench._probe_backend_init(1.0)
+        assert reason is not None and "timed out" in reason
+
+    def test_dying_probe_reports_exit(self, monkeypatch):
+        monkeypatch.setenv("BENCH_BACKEND_PROBE_CMD",
+                           "raise SystemExit(7)")
+        reason = bench._probe_backend_init(30.0)
+        assert reason is not None and "exit 7" in reason
+
+    def test_verdict_round_trip(self, scratch):
+        assert bench._read_probe_verdict() is None
+        bench._write_probe_verdict("probe timed out after 1s")
+        assert bench._read_probe_verdict() == "probe timed out after 1s"
+
+    def test_garbled_verdict_reads_as_none(self, scratch):
+        with open(bench.VERDICT_PATH, "w") as f:
+            f.write("not json{")
+        assert bench._read_probe_verdict() is None
+
+
+class TestWedgedVerdict:
+    def test_none_without_signals(self, scratch):
+        assert bench._backend_wedged_verdict() is None
+
+    def test_verdict_file_wins(self, scratch):
+        bench._write_probe_verdict("probe exit 1: dead")
+        assert bench._backend_wedged_verdict() == "probe exit 1: dead"
+
+    @pytest.mark.parametrize("phase", ["init", "smoke"])
+    def test_wedged_init_phase_counts(self, scratch, phase):
+        with open(bench.PARTIAL_PATH, "w") as f:
+            json.dump({"detail": {"wedged_phase": phase}}, f)
+        v = bench._backend_wedged_verdict()
+        assert v is not None and phase in v
+
+    def test_late_wedge_does_not_count(self, scratch):
+        # the backend came up and died later — retrying TPU is correct
+        with open(bench.PARTIAL_PATH, "w") as f:
+            json.dump({"detail": {"wedged_phase": "serving_prefix"}}, f)
+        assert bench._backend_wedged_verdict() is None
+
+
+def _fake_metric_line(device: str = "cpu") -> str:
+    return json.dumps({"metric": bench.METRIC, "value": 123.0,
+                       "unit": bench.UNIT, "vs_baseline": 1.0,
+                       "detail": {"device": device}})
+
+
+class _Supervisor:
+    """Run bench.main() in supervisor mode with _run_child stubbed.
+
+    `script` maps attempt index -> behaviour: a callable invoked with
+    the attempt's extra_env; returns the child's line (or None for a
+    failed/timed-out attempt)."""
+
+    def __init__(self, monkeypatch, script):
+        self.envs = []
+        self.emitted = []
+        self.printed = []
+
+        def run_child(extra_env, timeout):
+            idx = len(self.envs)
+            self.envs.append(dict(extra_env))
+            return script[idx](extra_env) if idx < len(script) else None
+
+        monkeypatch.setattr(bench, "_run_child", run_child)
+        monkeypatch.setattr(bench, "_emit",
+                            lambda obj: self.emitted.append(obj))
+        monkeypatch.setattr(bench, "_log", lambda msg: None)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        monkeypatch.setattr(bench, "print",
+                            lambda *a, **k: self.printed.append(a),
+                            raising=False)
+        monkeypatch.delenv("BENCH_CHILD", raising=False)
+
+
+class TestStickySupervisor:
+    def test_attempt2_pinned_to_cpu_after_init_wedge(self, scratch,
+                                                     monkeypatch):
+        """THE regression: attempt 1 dies at init (probe verdict left
+        behind), attempt 2 must start with BENCH_FORCE_CPU=1 and its
+        successful number must be marked as the CPU fallback."""
+        def attempt1(extra_env):
+            # the child's probe found the backend wedged and wrote the
+            # sticky verdict — then the child itself died anyway
+            bench._write_probe_verdict("probe timed out after 180s")
+            return None
+
+        def attempt2(extra_env):
+            assert extra_env.get("BENCH_FORCE_CPU") == "1"
+            return _fake_metric_line("cpu")
+
+        sup = _Supervisor(monkeypatch, [attempt1, attempt2])
+        bench.main()
+        assert len(sup.envs) == 2
+        assert "BENCH_FORCE_CPU" not in sup.envs[0]
+        assert sup.envs[1].get("BENCH_FORCE_CPU") == "1"
+        assert len(sup.emitted) == 1
+        out = sup.emitted[0]
+        assert out["error"] == "tpu backend unavailable; CPU fallback number"
+        assert out["vs_baseline"] == 0.0
+        assert "probe timed out" in out["detail"]["backend_verdict"]
+
+    def test_attempt2_pinned_after_hard_init_wedge(self, scratch,
+                                                   monkeypatch):
+        """No probe verdict (the child hard-wedged before writing one),
+        but the per-phase watchdog recorded wedged_phase=init."""
+        def attempt1(extra_env):
+            with open(bench.PARTIAL_PATH, "w") as f:
+                json.dump({"detail": {"wedged_phase": "init"}}, f)
+            return None
+
+        def attempt2(extra_env):
+            return _fake_metric_line("cpu")
+
+        sup = _Supervisor(monkeypatch, [attempt1, attempt2])
+        bench.main()
+        assert sup.envs[1].get("BENCH_FORCE_CPU") == "1"
+        assert sup.emitted[0]["vs_baseline"] == 0.0
+
+    def test_late_failure_retries_tpu(self, scratch, monkeypatch):
+        """Attempt 1 died AFTER init — the backend works; attempt 2
+        must retry the default (TPU) backend, and its clean line is
+        printed unmarked."""
+        def attempt1(extra_env):
+            with open(bench.PARTIAL_PATH, "w") as f:
+                json.dump({"detail": {"wedged_phase": "pretrain"}}, f)
+            return None
+
+        def attempt2(extra_env):
+            assert "BENCH_FORCE_CPU" not in extra_env
+            return _fake_metric_line("tpu")
+
+        sup = _Supervisor(monkeypatch, [attempt1, attempt2])
+        bench.main()
+        assert "BENCH_FORCE_CPU" not in sup.envs[1]
+        assert sup.emitted == []          # clean line printed, not marked
+        assert len(sup.printed) == 1
+
+    def test_stale_verdict_cleared_at_run_start(self, scratch,
+                                                monkeypatch):
+        """A verdict from a PREVIOUS run must not pin this run's
+        attempt 1 (or 2): the supervisor clears it up front."""
+        bench._write_probe_verdict("stale from yesterday")
+
+        def attempt1(extra_env):
+            assert "BENCH_FORCE_CPU" not in extra_env
+            return _fake_metric_line("tpu")
+
+        sup = _Supervisor(monkeypatch, [attempt1])
+        bench.main()
+        assert len(sup.envs) == 1
+        assert not os.path.exists(bench.VERDICT_PATH)
+        assert len(sup.printed) == 1
+
+
+class TestChildStickyPath:
+    def test_child_honors_existing_verdict_without_reprobing(
+            self, scratch, monkeypatch):
+        """Belt-and-braces: a CHILD that starts with a verdict on disk
+        must skip the probe entirely (no subprocess spawn) — re-running
+        a probe against a known-dead backend wastes its budget."""
+        bench._write_probe_verdict("probe timed out after 180s")
+        calls = []
+        monkeypatch.setattr(bench, "_probe_backend_init",
+                            lambda t: calls.append(t) or None)
+        # replicate only the init-decision logic the child runs
+        assert os.environ.get("BENCH_FORCE_CPU") != "1"
+        sticky = bench._read_probe_verdict()
+        assert sticky is not None
+        assert calls == []

@@ -597,6 +597,30 @@ class TestHostSync:
             rule="HOST-SYNC")
         assert findings == []
 
+    def test_bucketed_train_path_covered_by_default(self):
+        """ISSUE 20: the bucketed/overlapped ZeRO step bodies and the
+        bucket packer are default hot roots — they trace into the one
+        train executable. A host read smuggled into the packer (or any
+        helper the step body reaches) fires; the build-time layout
+        planner (build_bucket_layout) is deliberately cold — it runs
+        once on the host at construction."""
+        findings = run("""
+            import numpy as np
+
+            def _overlapped_update(ctx, params, grads, state, lr, t):
+                return _pack_bucket(ctx, ctx._buckets[0], grads)
+
+            def _pack_bucket(ctx, bucket, grads):
+                return np.asarray(grads[bucket["names"][0]])
+
+            def build_bucket_layout(names, chunks, itemsize, dp, cap):
+                return [{"width": int(np.asarray(cap))}]
+            """, path="paddle_tpu/parallel/zero.py", rule="HOST-SYNC")
+        hit_fns = sorted(set(
+            f.message.split("hot-path function `")[1].split("`")[0]
+            for f in findings))
+        assert hit_fns == ["_pack_bucket"]  # layout planner stays cold
+
     def test_hot_modules_mapping_is_configurable(self):
         """The traced-module list is constructor state, not a hardcoded
         constant: a custom mapping REPLACES the default roots."""

@@ -570,6 +570,56 @@ class TestCollectiveMesh:
         assert [f.line for f in fs] == [6]
         assert "ring_perm" in fs[0].message
 
+    # ---- training-side ring (ISSUE 20) -------------------------------
+    # parallel/zero.py now moves grad BUCKETS over the same ppermute
+    # ring on the dp axis (ring-pipelined reduce-scatter). The contract
+    # is axis-agnostic: a perm table literal-coded for one dp degree
+    # drops grad shards at any other, which silently corrupts the
+    # optimizer update instead of crashing.
+
+    def test_training_dp_ring_literal_table_fires(self):
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def reduce_scatter_bucket(flat):
+                moved = flat
+                for _ in range(3):
+                    moved = jax.lax.ppermute(
+                        moved, "dp", perm=[(0, 1), (1, 2), (2, 3), (3, 0)])
+                return moved
+            def build(devs):
+                mesh = Mesh(devs, axis_names=("dp",))
+                return shard_map(reduce_scatter_bucket, mesh=mesh,
+                                 in_specs=P("dp"), out_specs=P("dp"))
+        """, rule="COLLECTIVE-MESH")
+        assert [f.line for f in fs] == [8]
+        assert "literal" in fs[0].message
+
+    def test_training_dp_ring_mesh_sized_table_is_clean(self):
+        # the engine's actual idiom: ring_perm(dp) built once from the
+        # declared axis size, closed over by the hop body
+        fs = run("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            def ring_perm(n):
+                return [(s, (s + 1) % n) for s in range(n)]
+            def make_bucket_scatter(dp):
+                perm = ring_perm(dp)
+                def scatter(flat):
+                    moved = flat
+                    for _ in range(dp - 1):
+                        moved = jax.lax.ppermute(moved, "dp", perm=perm)
+                    return moved
+                return scatter
+            def build(devs, dp):
+                mesh = Mesh(devs, axis_names=("dp",))
+                return shard_map(make_bucket_scatter(dp), mesh=mesh,
+                                 in_specs=P("dp"), out_specs=P("dp"))
+        """, rule="COLLECTIVE-MESH")
+        assert fs == []
+
 
 # ---------------------------------------------------------------------------
 # METRIC-CARDINALITY

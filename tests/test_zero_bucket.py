@@ -1,0 +1,474 @@
+"""Bucketed, ring-pipelined ZeRO collectives + bf16 mixed precision
+(ISSUE 20).
+
+THE claims under test:
+
+- the leaf->bucket layout (`build_bucket_layout`) is a pure host
+  function with exact invariants at hostile shapes (0-d scalars,
+  non-divisible leaf sizes, a leaf larger than `bucket_bytes`,
+  dp-padding interaction);
+- the shard-major packing (`_pack_bucket`) makes the bucketed scatter
+  bit-identical to the per-leaf scatter BY CONSTRUCTION: row d of the
+  packed flat is the concatenation of every member leaf's shard-d
+  slice, so each element is summed in the identical fixed shard order;
+- every `bucket_bytes`, and the `overlap=True` ring-pipelined
+  schedule, yields fp32 results BIT-IDENTICAL to the serial per-leaf
+  step — across dp x stage x grad_accum, dp2 x tp2, telemetry on/off
+  (the schedule moves bytes earlier; it never reorders a sum);
+- `param_dtype="bf16"`: fp32 master weights ride the degree-blind
+  (dp, tp, chunk) state layout (save at dp=2, restore at dp=4), the
+  dynamic loss scaler skips nonfinite steps (params reverted, scale
+  backed off) and grows after good intervals, and the bf16 loss
+  trajectory stays within the documented tolerance of fp32;
+- the comms probes (`comm_seconds`, `measure_overlap_fraction`)
+  publish `training_comm_seconds{collective=}` and a [0, 1] overlap
+  fraction.
+"""
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.parallel import (
+    TP_AXIS, ZeroTrainStep, copy_to_tp_region, reduce_from_tp_region,
+    zero_train_step,
+)
+from paddle_tpu.parallel.zero import _pack_bucket, build_bucket_layout
+
+HID = 24
+_rng = np.random.RandomState(0)
+X = _rng.randn(32, 16).astype("float32")
+Y = _rng.randn(32, 8).astype("float32")
+
+
+def _build():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(16, HID), nn.ReLU(), nn.Linear(HID, 8))
+
+
+def _run(steps=3, x=X, y=Y, tele=False, **kw):
+    net = _build()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    step = zero_train_step(net, opt, enable_telemetry=tele, **kw)
+    params, st = step.init_state()
+    losses = []
+    for t in range(1, steps + 1):
+        loss, params, st = step(params, st, (x, y), 0.01, t)
+        losses.append(float(loss))
+    return losses, {k: np.asarray(v) for k, v in params.items()}, step, st
+
+
+_BASE = {}
+
+
+def _baseline(dp, stage, accum=1):
+    """Serial per-leaf engine results, cached across the module."""
+    key = (dp, stage, accum)
+    if key not in _BASE:
+        _BASE[key] = _run(stage=stage, dp=dp, grad_accum=accum)[:2]
+    return _BASE[key]
+
+
+def _bit_equal(a, b):
+    return all(np.array_equal(a[k], b[k]) for k in a)
+
+
+# ------------------------------------------------- bucket layout unit
+
+class TestBucketLayout:
+    CHUNKS = {"scalar": 1,      # 0-d leaf: loc_size 1
+              "odd": 2,         # loc_size 7 at dp=4 -> ceil(7/4)=2
+              "big": 100,
+              "tail": 3}
+
+    def test_every_leaf_once_in_order(self):
+        names = list(self.CHUNKS)
+        out = build_bucket_layout(names, self.CHUNKS, 4, 4, 64)
+        flat = [k for b in out for k in b["names"]]
+        assert flat == names
+
+    def test_offsets_and_width_are_consecutive(self):
+        names = list(self.CHUNKS)
+        for cap in (None, 16, 64, 1 << 20):
+            for b in build_bucket_layout(names, self.CHUNKS, 4, 2, cap):
+                off = 0
+                for k in b["names"]:
+                    assert b["offs"][k] == off
+                    off += self.CHUNKS[k]
+                assert b["width"] == off
+
+    def test_cap_respected_for_multi_leaf_buckets(self):
+        names = list(self.CHUNKS)
+        cap = 64
+        for b in build_bucket_layout(names, self.CHUNKS, 4, 2, cap):
+            nbytes = sum(2 * self.CHUNKS[k] * 4 for k in b["names"])
+            assert len(b["names"]) == 1 or nbytes <= cap
+
+    def test_oversized_leaf_gets_own_bucket(self):
+        out = build_bucket_layout(list(self.CHUNKS), self.CHUNKS, 4, 2, 64)
+        big = [b for b in out if "big" in b["names"]]
+        assert len(big) == 1 and big[0]["names"] == ("big",)
+
+    def test_none_cap_is_one_bucket_per_leaf(self):
+        out = build_bucket_layout(list(self.CHUNKS), self.CHUNKS, 4, 2,
+                                  None)
+        assert [b["names"] for b in out] == [(k,) for k in self.CHUNKS]
+
+    def test_everything_fits_one_bucket(self):
+        out = build_bucket_layout(list(self.CHUNKS), self.CHUNKS, 4, 2,
+                                  1 << 20)
+        assert len(out) == 1
+        assert out[0]["width"] == sum(self.CHUNKS.values())
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="dp"):
+            build_bucket_layout(["a"], {"a": 1}, 4, 0, None)
+        with pytest.raises(ValueError, match="bucket_bytes"):
+            build_bucket_layout(["a"], {"a": 1}, 4, 2, 0)
+
+
+class TestPackRoundTrip:
+    """Shard-major packing at hostile shapes: 0-d scalar, non-divisible
+    sizes (dp padding), multi-dim leaves."""
+
+    DP = 2
+    LEAVES = {
+        "scalar": np.float32(3.5),                        # 0-d
+        "odd": _rng.randn(7).astype("float32"),           # 7 % 2 != 0
+        "mat": _rng.randn(3, 5).astype("float32"),        # 15 % 2 != 0
+    }
+
+    def _ctx(self):
+        chunks = {k: -(-np.asarray(v).size // self.DP)
+                  for k, v in self.LEAVES.items()}
+        return types.SimpleNamespace(dp=self.DP, _chunks=chunks)
+
+    def test_rows_are_per_shard_concats(self):
+        """Row d of the packed (dp, width) layout == concat of every
+        member leaf's shard-d slice of its padded flat — the identity
+        the bit-parity proof rests on."""
+        ctx = self._ctx()
+        names = list(self.LEAVES)
+        bucket = build_bucket_layout(names, ctx._chunks, 4, self.DP,
+                                     1 << 20)[0]
+        grads = {k: jnp.asarray(v) for k, v in self.LEAVES.items()}
+        packed = np.asarray(_pack_bucket(ctx, bucket, grads)).reshape(
+            self.DP, bucket["width"])
+        for d in range(self.DP):
+            parts = []
+            for k in names:
+                c = ctx._chunks[k]
+                flat = np.zeros(self.DP * c, np.float32)
+                flat[:np.asarray(self.LEAVES[k]).size] = \
+                    np.asarray(self.LEAVES[k]).reshape(-1)
+                parts.append(flat[d * c:(d + 1) * c])
+            np.testing.assert_array_equal(packed[d],
+                                          np.concatenate(parts))
+
+    def test_unpack_inverts_pack(self):
+        """The tail unpack (column block -> flatten -> trim dp padding)
+        recovers every leaf exactly."""
+        ctx = self._ctx()
+        names = list(self.LEAVES)
+        bucket = build_bucket_layout(names, ctx._chunks, 4, self.DP,
+                                     1 << 20)[0]
+        grads = {k: jnp.asarray(v) for k, v in self.LEAVES.items()}
+        gathered = np.asarray(_pack_bucket(ctx, bucket, grads)).reshape(
+            self.DP, bucket["width"])
+        for k in names:
+            off, c = bucket["offs"][k], ctx._chunks[k]
+            size = np.asarray(self.LEAVES[k]).size
+            got = gathered[:, off:off + c].reshape(-1)[:size].reshape(
+                np.asarray(self.LEAVES[k]).shape)
+            np.testing.assert_array_equal(
+                got, np.asarray(self.LEAVES[k], np.float32))
+
+
+# -------------------------------------------------- fp32 bit identity
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("bucket_bytes", [256, 1024, 1 << 20])
+    def test_bucket_size_sweep_serial_schedule(self, bucket_bytes):
+        """Every bucket_bytes yields bit-identical fp32 results —
+        acceptance pin."""
+        l0, p0 = _baseline(2, 2)
+        l1, p1, _, _ = _run(stage=2, dp=2, bucket_bytes=bucket_bytes)
+        assert l0 == l1
+        assert _bit_equal(p0, p1)
+
+    @pytest.mark.parametrize("dp,stage,accum", [
+        (2, 1, 1), (2, 2, 1), (4, 2, 1), (2, 2, 4),
+    ])
+    def test_overlap_matrix(self, dp, stage, accum):
+        """Ring-pipelined overlap == serial across the (dp, stage,
+        grad_accum) matrix, bit for bit."""
+        l0, p0 = _baseline(dp, stage, accum)
+        l1, p1, _, _ = _run(stage=stage, dp=dp, grad_accum=accum,
+                            overlap=True, bucket_bytes=512)
+        assert l0 == l1
+        assert _bit_equal(p0, p1)
+
+    def test_overlap_without_bucket_cap(self):
+        """overlap=True with bucket_bytes=None pipelines per-leaf
+        buckets — still bit-identical."""
+        l0, p0 = _baseline(2, 2)
+        l1, p1, _, _ = _run(stage=2, dp=2, overlap=True)
+        assert l0 == l1 and _bit_equal(p0, p1)
+
+    def test_telemetry_on_off_identical(self):
+        """Telemetry must not perturb the overlapped executable."""
+        l0, p0, _, _ = _run(stage=2, dp=2, overlap=True,
+                            bucket_bytes=512, tele=False)
+        l1, p1, _, _ = _run(stage=2, dp=2, overlap=True,
+                            bucket_bytes=512, tele=True)
+        assert l0 == l1 and _bit_equal(p0, p1)
+
+    def test_dp1_knobs_inert(self):
+        """dp=1 runs the literal stage-0 executable; the schedule knobs
+        must be inert there."""
+        l0, p0, _, _ = _run(stage=1, dp=1)
+        l1, p1, _, _ = _run(stage=1, dp=1, overlap=True, bucket_bytes=64)
+        assert l0 == l1 and _bit_equal(p0, p1)
+
+
+def _tp_loss_fn(params, x, y):
+    h = jax.nn.relu(copy_to_tp_region(x) @ params["w1"])
+    out = reduce_from_tp_region(h @ params["w2"])
+    return jnp.mean((out - y) ** 2)
+
+
+class TestTpOverlapComposition:
+    TP_SPECS = {"w1": P(None, TP_AXIS), "w2": P(TP_AXIS, None)}
+
+    def _run_tp(self, stage, **kw):
+        rng = np.random.RandomState(3)
+        full = {"w1": rng.randn(16, 32).astype("float32"),
+                "w2": rng.randn(32, 8).astype("float32")}
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=nn.Linear(2, 2).parameters())
+        step = ZeroTrainStep(None, opt, _tp_loss_fn, stage=stage, dp=2,
+                             tp=2, param_specs=self.TP_SPECS, **kw)
+        params, st = step.init_state(full)
+        loss = None
+        for t in range(1, 4):
+            loss, params, st = step(params, st, (X, Y[:, :8]), 0.01, t)
+        host = {k: np.asarray(jax.device_put(
+            v, jax.sharding.NamedSharding(step.mesh, P())))
+            for k, v in params.items()}
+        return float(loss), host
+
+    def test_dp2_tp2_overlap_parity(self):
+        loss0, p0 = self._run_tp(0)
+        loss1, p1 = self._run_tp(2, overlap=True, bucket_bytes=512)
+        assert loss0 == loss1
+        assert _bit_equal(p0, p1)
+
+
+# ------------------------------------------------------- validation
+
+class TestValidation:
+    def _opt(self, net):
+        return paddle.optimizer.Adam(learning_rate=0.01,
+                                     parameters=net.parameters())
+
+    def test_stage0_rejects_schedule_knobs(self):
+        net = _build()
+        with pytest.raises(ValueError, match="stage"):
+            zero_train_step(net, self._opt(net), stage=0, overlap=True)
+        with pytest.raises(ValueError, match="stage"):
+            zero_train_step(net, self._opt(net), stage=0,
+                            bucket_bytes=1 << 20)
+
+    def test_bucket_bytes_must_be_positive(self):
+        net = _build()
+        with pytest.raises(ValueError, match="bucket_bytes"):
+            zero_train_step(net, self._opt(net), stage=1, bucket_bytes=0)
+
+    def test_unknown_param_dtype_rejected(self):
+        net = _build()
+        with pytest.raises(ValueError, match="param_dtype"):
+            zero_train_step(net, self._opt(net), stage=1,
+                            param_dtype="fp8")
+
+    def test_fp32_spellings_accepted(self):
+        net = _build()
+        step = zero_train_step(net, self._opt(net), stage=1,
+                               param_dtype="float32")
+        assert step.describe()["param_dtype"] == "fp32"
+
+
+# ------------------------------------------------- bf16 mixed precision
+
+def _run_bf16(steps=3, dp=2, stage=2, tele=False, x=X, y=Y, **kw):
+    return _run(steps=steps, x=x, y=y, tele=tele, stage=stage, dp=dp,
+                param_dtype="bf16", **kw)
+
+
+class TestBf16:
+    def test_dtypes_and_scaler_layout(self):
+        """Working weights bf16, masters fp32 at full logical shape on
+        save, scaler scalars present and replicated."""
+        _, params, step, st = _run_bf16(overlap=True, bucket_bytes=512)
+        assert all(str(v.dtype) == "bfloat16" for v in params.values())
+        host = step.save_optimizer_state(st)
+        assert host["__scaler__"]["scale"].dtype == np.float32
+        for k, shape in step._shapes.items():
+            m = host[k]["master_weight"]
+            assert m.dtype == np.float32 and tuple(m.shape) == shape
+
+    def test_master_weights_degree_blind(self):
+        """Save bf16 state at dp=2, restore at dp=4 AND back at dp=2;
+        the dp=2 restart continues in bit-lockstep with the
+        uninterrupted dp=2 run."""
+        losses_full, p_full, _, _ = _run_bf16(steps=3)
+        _, p2, s2, st2 = _run_bf16(steps=2)
+        host = s2.save_optimizer_state(st2)
+        for m in host.values():
+            for arr in m.values():
+                assert not np.isnan(np.asarray(
+                    arr, np.float32)).any()
+
+        def _continue(dp):
+            net = _build()
+            opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters())
+            step = zero_train_step(net, opt, stage=2, dp=dp,
+                                   param_dtype="bf16")
+            params, _ = step.init_state()
+            params = {k: jax.device_put(
+                jnp.asarray(p2[k]),
+                jax.sharding.NamedSharding(step.mesh, P()))
+                for k in p2}
+            st = step.load_optimizer_state(host)
+            loss, params, st = step(params, st, (X, Y), 0.01, 3)
+            return float(loss), {k: np.asarray(v)
+                                 for k, v in params.items()}
+
+        loss2, params2 = _continue(2)
+        assert loss2 == losses_full[-1]
+        assert _bit_equal(p_full, params2)
+        loss4, params4 = _continue(4)   # degree change: runs, stays sane
+        assert np.isfinite(loss4)
+        for k in params4:
+            assert params4[k].dtype == params2[k].dtype
+
+    def test_nonfinite_step_skipped_and_scale_backs_off(self):
+        """A NaN batch must NOT poison the params: the step is skipped
+        (params bit-unchanged), the scale halves, telemetry records the
+        skip + backoff event — and training continues."""
+        net = _build()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        step = zero_train_step(net, opt, stage=2, dp=2, overlap=True,
+                               param_dtype="bf16", enable_telemetry=True)
+        params, st = step.init_state()
+        _, params, st = step(params, st, (X, Y), 0.01, 1)
+        before = {k: np.asarray(v) for k, v in params.items()}
+        x_bad = X.copy()
+        x_bad[0, 0] = np.nan
+        loss_bad, params, st = step(params, st, (x_bad, Y), 0.01, 2)
+        after = {k: np.asarray(v) for k, v in params.items()}
+        assert _bit_equal(before, after)          # reverted, not poisoned
+        summ = step.describe()["telemetry"]
+        assert summ["skipped_steps"] == 1
+        assert summ["loss_scale_events"]["backoff"] == 1
+        assert summ["loss_scale"] == 2.0 ** 14    # halved from 2**15
+        assert summ["last"]["skipped"] is True
+        # recovery: the next good step trains normally
+        loss3, params, st = step(params, st, (X, Y), 0.01, 3)
+        assert np.isfinite(loss3)
+        assert not _bit_equal(after, {k: np.asarray(v)
+                                      for k, v in params.items()})
+
+    def test_scale_grows_after_good_interval(self):
+        losses, _, step, st = _run(
+            steps=5, tele=True, stage=2, dp=2, param_dtype="bf16",
+            scale_growth_interval=2)
+        summ = step.describe()["telemetry"]
+        # growth at steps 2 and 4: 2**15 -> 2**17
+        assert summ["loss_scale"] == 2.0 ** 17
+        assert summ["loss_scale_events"]["growth"] == 2
+        assert summ["skipped_steps"] == 0
+
+    def test_loss_trajectory_within_tolerance(self):
+        """The documented bounded-error contract: bf16 loss tracks fp32
+        within 5% relative over the pretrain-shaped toy run."""
+        l32, _ = _baseline(2, 2)
+        lbf, _, _, _ = _run_bf16(steps=3)
+        for a, b in zip(l32, lbf):
+            assert abs(a - b) <= 0.05 * max(abs(a), 1e-6)
+
+
+# ------------------------------------------------------------- probes
+
+class TestProbes:
+    def test_comm_seconds_publishes_histograms(self):
+        _, _, step, _ = _run(steps=1, tele=True, stage=2, dp=2,
+                             overlap=True, bucket_bytes=512)
+        out = step.comm_seconds(samples=2, elems=2048, best_of=2)
+        assert set(out) == {"reduce_scatter", "all_gather"}
+        assert all(v > 0 for v in out.values())
+        comm = step.describe()["telemetry"]["comm"]
+        assert comm["reduce_scatter"]["count"] >= 2
+        assert comm["all_gather"]["count"] >= 2
+
+    def test_overlap_fraction_measured_and_published(self):
+        _, _, step, _ = _run(steps=1, tele=True, stage=2, dp=2,
+                             overlap=True, bucket_bytes=512)
+        frac = step.measure_overlap_fraction(samples=2, best_of=2)
+        assert 0.0 <= frac <= 1.0
+        d = step.describe()
+        assert d["overlap_fraction"] == frac
+        assert d["telemetry"]["overlap_fraction"] == frac
+
+    def test_overlap_fraction_needs_bucket_layout(self):
+        net = _build()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        step = zero_train_step(net, opt, stage=1, dp=2)
+        step.init_state()
+        with pytest.raises(RuntimeError, match="bucket"):
+            step.measure_overlap_fraction()
+
+    def test_describe_names_the_schedule(self):
+        _, _, step, _ = _run(steps=1, stage=2, dp=2, overlap=True,
+                             bucket_bytes=1 << 20)
+        d = step.describe()
+        assert d["overlap"] is True
+        assert d["bucket_bytes"] == 1 << 20
+        assert d["buckets"] >= 1
+        assert d["param_dtype"] == "fp32"
+
+    def test_training_report_renders_comm_and_scale_sections(
+            self, tmp_path):
+        """tools/training_report.py turns the new metrics into prose:
+        comm-probe rows, the measured overlap fraction, and the
+        mixed-precision counter line."""
+        import importlib.util
+        import json
+        import os
+
+        _, _, step, _ = _run(steps=2, tele=True, stage=2, dp=2,
+                             overlap=True, bucket_bytes=512,
+                             param_dtype="bf16")
+        step.comm_seconds(samples=1, elems=1024, best_of=1)
+        step.measure_overlap_fraction(samples=1, best_of=1)
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(step._telemetry.snapshot()))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "_training_report_cli",
+            os.path.join(repo, "tools", "training_report.py"))
+        tr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tr)
+        report = tr.render(*tr.load_report(str(path)))
+        assert "reduce_scatter" in report and "all_gather" in report
+        assert "overlap fraction" in report
+        assert "loss scale 32768" in report
+        assert "skipped steps 0" in report

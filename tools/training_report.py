@@ -17,6 +17,11 @@ reads first:
 - the per-shard straggler table from
   `training_shard_step_seconds{shard=}` (best-of probes; a shard whose
   BEST case is slow is flagged);
+- the comms-vs-compute story (ISSUE 20): the
+  `training_comm_seconds{collective=}` probe histograms, the measured
+  `training_overlap_fraction` (how much of the bucket collectives' wall
+  the ring pipeline hides behind update math), and the mixed-precision
+  counters (current loss scale, skipped steps, backoff/growth events);
 - the sentinel verdict and flag counts.
 
 Usage:
@@ -111,6 +116,8 @@ def format_steps(steps: List[dict], last: Optional[int] = None) -> str:
     for s in shown:
         nf = s.get("nonfinite", 0)
         mark = " !!" if (nf and nf > 0) else ""
+        if s.get("skipped"):
+            mark += " skipped (loss-scale backoff)"
         wall = s.get("wall_s")
         lines.append(
             f"  {s.get('step', '?'):>6}  {_fmt(s.get('loss')):>12}  "
@@ -197,11 +204,59 @@ def format_sentinel(sentinel: Optional[dict],
     return "\n".join(lines)
 
 
-def _counter_value(snapshot: Optional[dict], name: str):
+def _counter_value(snapshot: Optional[dict], name: str,
+                   labels: Optional[dict] = None):
     for d in _metric_rows(snapshot):
         if d.get("name") == name and "value" in d:
-            return d["value"]
+            if labels is None or (d.get("labels") or {}) == labels:
+                return d["value"]
     return None
+
+
+def format_comms(snapshot: Optional[dict]) -> str:
+    """The wire side of the step: comm-probe histograms + the measured
+    overlap fraction (ISSUE 20)."""
+    rows = [d for d in _metric_rows(snapshot)
+            if d.get("name") == "training_comm_seconds"
+            and d.get("count")]
+    lines = []
+    for d in sorted(rows, key=lambda d:
+                    (d.get("labels") or {}).get("collective", "")):
+        coll = (d.get("labels") or {}).get("collective", "?")
+        mean = d["sum"] / d["count"]
+        best = d.get("min")
+        lines.append(f"  {coll:<16}{d['count']:>4} probes  "
+                     f"best {(best or 0) * 1e6:9.1f} us  "
+                     f"mean {mean * 1e6:9.1f} us")
+    if not lines:
+        lines.append("  (no comm probes in the snapshot — run "
+                     "comm_seconds())")
+    frac = _counter_value(snapshot, "training_overlap_fraction")
+    if frac is not None:
+        lines.append(f"  overlap fraction {float(frac):.3f} of the "
+                     "bucket collectives' wall hidden behind shard "
+                     "update math")
+    return "\n".join(lines)
+
+
+def format_mixed_precision(snapshot: Optional[dict]) -> str:
+    scale = _counter_value(snapshot, "training_loss_scale")
+    if scale is None:
+        return "  (no loss-scale gauge — fp32 run, or telemetry unbound)"
+    skipped = _counter_value(
+        snapshot, "training_skipped_steps_total") or 0
+    backoff = _counter_value(snapshot, "training_loss_scale_events_total",
+                             {"event": "backoff"}) or 0
+    growth = _counter_value(snapshot, "training_loss_scale_events_total",
+                            {"event": "growth"}) or 0
+    lines = [f"  loss scale {_fmt(float(scale))}   "
+             f"skipped steps {int(skipped)}   "
+             f"scale events: backoff={int(backoff)} "
+             f"growth={int(growth)}"]
+    if skipped:
+        lines.append("  (skipped steps revert params/state and back "
+                     "the scale off — see `!! skipped` ring rows)")
+    return "\n".join(lines)
 
 
 def render(training: dict, snapshot: Optional[dict], doc: dict,
@@ -249,6 +304,12 @@ def render(training: dict, snapshot: Optional[dict], doc: dict,
     out.append("")
     out.append("per-shard straggler probe (best-of-N):")
     out.append(format_stragglers(snapshot))
+    out.append("")
+    out.append("collectives (comm probes + measured overlap):")
+    out.append(format_comms(snapshot))
+    out.append("")
+    out.append("mixed precision:")
+    out.append(format_mixed_precision(snapshot))
     if full_metrics:
         out.append("")
         out.append("metrics snapshot:")
